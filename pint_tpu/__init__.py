@@ -1,0 +1,64 @@
+"""pint_tpu — a TPU-native pulsar-timing framework.
+
+A ground-up re-design of the capabilities of clp3ef/PINT (a fork of
+nanograv/PINT, ``src/pint/``) for JAX/XLA on TPU:
+
+- time and phase are carried in double-double (two-float64) arithmetic
+  (``pint_tpu.ops.dd``) instead of x87 ``np.longdouble``
+  (reference: src/pint/pulsar_mjd.py, src/pint/phase.py);
+- the per-TOA delay/phase component stack is a registry of pure jittable
+  functions over a flat ``ToaBatch`` struct-of-arrays pytree
+  (reference: src/pint/models/timing_model.py TimingModel.delay/phase);
+- design matrices come from ``jax.jacfwd`` over the flat parameter vector
+  (reference: TimingModel.designmatrix / d_phase_d_param dispatch);
+- the GLS noise-covariance Woodbury solve is one jit-compiled XLA kernel
+  (reference: src/pint/fitter.py GLSFitter.fit_toas);
+- a second batch axis vmaps/shards independent pulsars over a TPU mesh
+  (PTA-scale fits).
+
+Host Python does parsing, registries and orchestration; device code is a
+closed set of pure functions. Everything numerical runs in float64
+(``jax_enable_x64``), with double-double pairs where ~1 ns over decades is
+required.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+
+# Physical constants (SI unless noted). Values per SURVEY.md Appendix A.1.
+c_m_s = 299_792_458.0  # speed of light, exact
+AU_m = 1.495_978_707_00e11  # astronomical unit, IAU 2012 exact
+pc_m = 3.085_677_581_49e16  # parsec
+Tsun_s = 4.925_490_947e-6  # GM_sun/c^3 [s] — solar Shapiro scale
+GMsun_m3_s2 = 1.327_124_400_18e20
+
+# Dispersion constant, TEMPO convention (exact 1/2.41e-4), NOT the physical
+# 4148.808 value — kept for .par compatibility
+# (reference: src/pint/__init__.py DMconst).
+DMconst = 1.0 / 2.41e-4  # s MHz^2 pc^-1 cm^3
+
+SECS_PER_DAY = 86400.0
+MJD_J2000 = 51544.5  # TT epoch J2000.0 as MJD
+light_second_m = c_m_s  # 1 lt-s in meters
+
+def __getattr__(name):
+    # Lazy top-level API (avoids import cycles during bring-up):
+    # pint_tpu.get_model / get_model_and_toas / get_TOAs mirror the
+    # reference's pint.get_model etc. (src/pint/models/model_builder.py).
+    try:
+        if name in ("get_model", "get_model_and_toas"):
+            from pint_tpu.models import model_builder
+
+            return getattr(model_builder, name)
+        if name == "get_TOAs":
+            from pint_tpu import toa
+
+            return toa.get_TOAs
+    except ModuleNotFoundError as e:
+        raise AttributeError(
+            f"pint_tpu.{name} is not available yet: {e}"
+        ) from e
+    raise AttributeError(f"module 'pint_tpu' has no attribute {name!r}")

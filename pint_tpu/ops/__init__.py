@@ -1,0 +1,26 @@
+"""Device-side numeric primitives: double-double arithmetic, Taylor/Horner
+evaluation, Chebyshev ephemeris kernels.
+
+These replace the native substrate the reference borrows from numpy
+``longdouble`` (x87 80-bit) and scipy — see SURVEY.md §2b.
+"""
+
+from pint_tpu.ops.dd import (  # noqa: F401
+    DD,
+    dd,
+    dd_add,
+    dd_add_f,
+    dd_div,
+    dd_frac,
+    dd_from_parts,
+    dd_mul,
+    dd_mul_f,
+    dd_neg,
+    dd_round,
+    dd_sub,
+    dd_sub_f,
+    dd_to_f64,
+    two_sum,
+    two_prod,
+)
+from pint_tpu.ops.taylor import taylor_horner, taylor_horner_deriv, dd_taylor_horner  # noqa: F401

@@ -1,0 +1,329 @@
+"""Double-double ("dd") arithmetic: each value is an unevaluated sum
+``hi + lo`` of two float64, giving ~32 significant digits (eps ~ 2^-104).
+
+This is the TPU-native replacement for the reference's load-bearing use of
+x87 ``np.longdouble`` (eps 1.08e-19) in time/phase bookkeeping
+(reference: src/pint/pulsar_mjd.py, src/pint/phase.py Phase). TPU has no
+extended-precision type, but f64 pairs exceed longdouble precision
+(~1e-32 relative), so pulse phase stays exact to ≪1 ns over centuries.
+
+Design notes (TPU/XLA-first):
+
+- ``DD`` is a NamedTuple pytree of two f64 arrays → flows through
+  jit/vmap/scan/shard_map like any array pair; elementwise ops fuse in XLA.
+- Error-free transforms use Knuth two-sum and Dekker/Veltkamp split
+  two-product (no FMA primitive is exposed portably through jnp; the split
+  product is exact in round-to-nearest f64, which XLA:TPU honors for f64).
+- The user-facing ops carry ``jax.custom_jvp`` rules whose tangents are
+  plain first-order f64 rules. This keeps autodiff (the design-matrix
+  path, reference: TimingModel.designmatrix) from tracing through the
+  error-term algebra: derivatives never need 32 digits, residual *values*
+  do.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Arr = jax.Array
+FloatLike = Union[float, Arr]
+
+_SPLITTER = 134217729.0  # 2**27 + 1, Veltkamp splitting constant for f64
+
+
+class DD(NamedTuple):
+    """Unevaluated sum hi + lo, |lo| <= ulp(hi)/2 after renormalization."""
+
+    hi: Arr
+    lo: Arr
+
+    # Convenience operators — thin sugar over the module functions so call
+    # sites in model code read naturally. All return DD.
+    def __add__(self, other):
+        return dd_add(self, _as_dd(other))
+
+    def __radd__(self, other):
+        return dd_add(_as_dd(other), self)
+
+    def __sub__(self, other):
+        return dd_sub(self, _as_dd(other))
+
+    def __rsub__(self, other):
+        return dd_sub(_as_dd(other), self)
+
+    def __mul__(self, other):
+        return dd_mul(self, _as_dd(other))
+
+    def __rmul__(self, other):
+        return dd_mul(_as_dd(other), self)
+
+    def __truediv__(self, other):
+        return dd_div(self, _as_dd(other))
+
+    def __neg__(self):
+        return dd_neg(self)
+
+
+def _as_dd(x) -> DD:
+    if isinstance(x, DD):
+        return x
+    x = jnp.asarray(x, dtype=jnp.float64)
+    return DD(x, jnp.zeros_like(x))
+
+
+def dd(hi, lo=0.0) -> DD:
+    """Construct a DD from one or two float64 values (renormalized).
+
+    Uses full two-sum: callers may pass unnormalized (hi, lo) of any
+    relative magnitude.
+    """
+    hi, lo = jnp.broadcast_arrays(
+        jnp.asarray(hi, dtype=jnp.float64), jnp.asarray(lo, dtype=jnp.float64)
+    )
+    s = two_sum(hi, lo)
+    return _quick_two_sum(s.hi, s.lo)
+
+
+def dd_from_parts(hi, lo) -> DD:
+    """Trusted constructor: caller guarantees (hi, lo) already normalized."""
+    return DD(jnp.asarray(hi, jnp.float64), jnp.asarray(lo, jnp.float64))
+
+
+def dd_to_f64(a: DD) -> Arr:
+    return a.hi + a.lo
+
+
+# ----------------------------------------------------------------------
+# Error-free transforms (internal; plain f64 ops, exact by construction)
+# ----------------------------------------------------------------------
+
+def two_sum(a: Arr, b: Arr) -> DD:
+    """Knuth two-sum: s + err == a + b exactly."""
+    s = a + b
+    bb = s - a
+    err = (a - (s - bb)) + (b - bb)
+    return DD(s, err)
+
+
+def _quick_two_sum(a: Arr, b: Arr) -> DD:
+    """Fast two-sum, requires |a| >= |b| (or a == 0)."""
+    s = a + b
+    err = b - (s - a)
+    return DD(s, err)
+
+
+def _split(a: Arr):
+    t = _SPLITTER * a
+    a_hi = t - (t - a)
+    a_lo = a - a_hi
+    return a_hi, a_lo
+
+
+def two_prod(a: Arr, b: Arr) -> DD:
+    """Dekker two-product: p + err == a * b exactly (round-to-nearest)."""
+    p = a * b
+    a_hi, a_lo = _split(a)
+    b_hi, b_lo = _split(b)
+    err = ((a_hi * b_hi - p) + a_hi * b_lo + a_lo * b_hi) + a_lo * b_lo
+    return DD(p, err)
+
+
+# ----------------------------------------------------------------------
+# DD arithmetic. Each public op has a custom JVP with plain-f64 tangents.
+# ----------------------------------------------------------------------
+
+@jax.custom_jvp
+def dd_add(a: DD, b: DD) -> DD:
+    s = two_sum(a.hi, b.hi)
+    e = s.lo + (a.lo + b.lo)
+    return _quick_two_sum(s.hi, e)
+
+
+@dd_add.defjvp
+def _dd_add_jvp(primals, tangents):
+    a, b = primals
+    da, db = tangents
+    t = (da.hi + da.lo) + (db.hi + db.lo)
+    return dd_add(a, b), DD(t, jnp.zeros_like(t))
+
+
+@jax.custom_jvp
+def dd_sub(a: DD, b: DD) -> DD:
+    s = two_sum(a.hi, -b.hi)
+    e = s.lo + (a.lo - b.lo)
+    return _quick_two_sum(s.hi, e)
+
+
+@dd_sub.defjvp
+def _dd_sub_jvp(primals, tangents):
+    a, b = primals
+    da, db = tangents
+    t = (da.hi + da.lo) - (db.hi + db.lo)
+    return dd_sub(a, b), DD(t, jnp.zeros_like(t))
+
+
+@jax.custom_jvp
+def dd_mul(a: DD, b: DD) -> DD:
+    p = two_prod(a.hi, b.hi)
+    e = p.lo + (a.hi * b.lo + a.lo * b.hi)
+    return _quick_two_sum(p.hi, e)
+
+
+@dd_mul.defjvp
+def _dd_mul_jvp(primals, tangents):
+    a, b = primals
+    da, db = tangents
+    av = a.hi + a.lo
+    bv = b.hi + b.lo
+    t = (da.hi + da.lo) * bv + (db.hi + db.lo) * av
+    return dd_mul(a, b), DD(t, jnp.zeros_like(t))
+
+
+@jax.custom_jvp
+def dd_div(a: DD, b: DD) -> DD:
+    # Long division with one Newton correction — standard dd recipe.
+    q1 = a.hi / b.hi
+    r = dd_sub(a, dd_mul_f(b, q1))
+    q2 = (r.hi + r.lo) / (b.hi + b.lo)
+    return _quick_two_sum(q1, q2)
+
+
+@dd_div.defjvp
+def _dd_div_jvp(primals, tangents):
+    a, b = primals
+    da, db = tangents
+    av = a.hi + a.lo
+    bv = b.hi + b.lo
+    q = dd_div(a, b)
+    t = ((da.hi + da.lo) - (db.hi + db.lo) * (av / bv)) / bv
+    return q, DD(t, jnp.zeros_like(t))
+
+
+def dd_neg(a: DD) -> DD:
+    return DD(-a.hi, -a.lo)
+
+
+def dd_abs(a: DD) -> DD:
+    neg = a.hi < 0
+    return DD(jnp.where(neg, -a.hi, a.hi), jnp.where(neg, -a.lo, a.lo))
+
+
+# f64-mixed fast paths (second operand an ordinary float64)
+
+def dd_add_f(a: DD, b: FloatLike) -> DD:
+    b = jnp.asarray(b, jnp.float64)
+    s = two_sum(a.hi, b)
+    return _quick_two_sum(s.hi, s.lo + a.lo)
+
+
+def dd_sub_f(a: DD, b: FloatLike) -> DD:
+    return dd_add_f(a, -jnp.asarray(b, jnp.float64))
+
+
+def dd_mul_f(a: DD, b: FloatLike) -> DD:
+    b = jnp.asarray(b, jnp.float64)
+    p = two_prod(a.hi, b)
+    return _quick_two_sum(p.hi, p.lo + a.lo * b)
+
+
+def dd_div_f(a: DD, b: FloatLike) -> DD:
+    return dd_div(a, _as_dd(b))
+
+
+# ----------------------------------------------------------------------
+# Rounding / fractional part — the pulse-number primitives
+# (reference: src/pint/phase.py Phase int/frac decomposition)
+# ----------------------------------------------------------------------
+
+@jax.custom_jvp
+def dd_round(a: DD) -> DD:
+    """Round to nearest integer, returned as DD (exact)."""
+    n = jnp.round(a.hi)
+    # hi - n is exact (Sterbenz) whenever |hi - n| <= 0.5 ulp-scale; the
+    # residual plus lo decides whether rounding must be bumped by one.
+    r = (a.hi - n) + a.lo
+    bump = jnp.where(r > 0.5, 1.0, 0.0) + jnp.where(r < -0.5, -1.0, 0.0)
+    return dd(n + bump)
+
+
+@dd_round.defjvp
+def _dd_round_jvp(primals, tangents):
+    (a,) = primals
+    (da,) = tangents
+    z = jnp.zeros_like(a.hi)
+    return dd_round(a), DD(z, z)
+
+
+@jax.custom_jvp
+def dd_frac(a: DD) -> DD:
+    """Signed fractional part in [-0.5, 0.5]: a - round(a), exact.
+
+    This is the "phase.frac" of the reference's Phase class — residuals in
+    turns. d(frac)/dx == 1 away from half-integers, which the JVP encodes.
+    """
+    n = jnp.round(a.hi)
+    s = two_sum(a.hi, -n)
+    # s.hi may be ≪ a.lo when a is nearly integer — full two_sum required.
+    f0 = two_sum(s.hi, a.lo)
+    f = _quick_two_sum(f0.hi, f0.lo + s.lo)
+    # renormalize into [-0.5, 0.5]
+    shift = jnp.where(f.hi > 0.5, 1.0, 0.0) + jnp.where(f.hi < -0.5, -1.0, 0.0)
+    s2 = two_sum(f.hi, -shift)
+    f1 = two_sum(s2.hi, f.lo)
+    return _quick_two_sum(f1.hi, f1.lo + s2.lo)
+
+
+@dd_frac.defjvp
+def _dd_frac_jvp(primals, tangents):
+    (a,) = primals
+    (da,) = tangents
+    t = da.hi + da.lo
+    return dd_frac(a), DD(t, jnp.zeros_like(t))
+
+
+def dd_int_frac(a: DD):
+    """(integer part as DD, signed frac in [-0.5, 0.5] as DD)."""
+    n = dd_round(a)
+    return n, dd_frac(a)
+
+
+# ----------------------------------------------------------------------
+# Comparisons (value-level; return bool arrays)
+# ----------------------------------------------------------------------
+
+def dd_lt(a: DD, b: DD) -> Arr:
+    return (a.hi < b.hi) | ((a.hi == b.hi) & (a.lo < b.lo))
+
+
+def dd_le(a: DD, b: DD) -> Arr:
+    return (a.hi < b.hi) | ((a.hi == b.hi) & (a.lo <= b.lo))
+
+
+def dd_where(cond: Arr, a: DD, b: DD) -> DD:
+    return DD(jnp.where(cond, a.hi, b.hi), jnp.where(cond, a.lo, b.lo))
+
+
+def dd_sum(a: DD, axis=None) -> DD:
+    """Sum of a DD array along axis with compensated (Neumaier-style)
+    accumulation of the hi chain; los are summed plainly (they are already
+    ~1e-16 relative, their rounding error is ~1e-32 relative — negligible).
+    """
+    if axis is None:
+        a = DD(a.hi.ravel(), a.lo.ravel())
+        axis = 0
+    s = jnp.cumsum(a.hi, axis=axis)
+    n = a.hi.shape[axis]
+    prev = jnp.concatenate(
+        [jnp.zeros_like(jax.lax.slice_in_dim(s, 0, 1, axis=axis)),
+         jax.lax.slice_in_dim(s, 0, n - 1, axis=axis)],
+        axis=axis,
+    )
+    # exact error of each step s_i = prev_i + x_i (Knuth two-sum error term)
+    bb = s - prev
+    err = (prev - (s - bb)) + (a.hi - bb)
+    hi_s = jax.lax.index_in_dim(s, n - 1, axis=axis, keepdims=False)
+    lo_s = jnp.sum(err, axis=axis) + jnp.sum(a.lo, axis=axis)
+    return _quick_two_sum(hi_s, lo_s)

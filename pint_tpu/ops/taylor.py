@@ -1,0 +1,70 @@
+"""Taylor-series evaluation kernels.
+
+``taylor_horner(dt, [c0, c1, c2, ...]) = c0 + c1 dt + c2 dt^2/2! + ...``
+is the spindown phase engine of the reference
+(src/pint/utils.py taylor_horner / taylor_horner_deriv;
+src/pint/models/spindown.py Spindown.spindown_phase).
+
+Two variants here:
+- plain f64 (for delays/derivatives, XLA-fusable Horner chain);
+- double-double accumulator (for absolute pulse phase, where F0*dt is
+  ~1e10 turns and must keep <1e-9 turn error).
+
+Coefficient lists are static Python sequences → the Horner chain unrolls
+at trace time into a fixed fused op-chain (no dynamic shapes, MXU/VPU
+friendly).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from pint_tpu.ops.dd import DD, dd_add, dd_add_f, dd_div_f, dd_mul, _as_dd
+
+
+def taylor_horner(dt, coeffs: Sequence):
+    """Sum_i coeffs[i] * dt^i / i! in plain f64 via Horner."""
+    return taylor_horner_deriv(dt, coeffs, deriv_order=0)
+
+
+def taylor_horner_deriv(dt, coeffs: Sequence, deriv_order: int = 1):
+    """deriv_order-th derivative of taylor_horner wrt dt (f64)."""
+    coeffs = list(coeffs)
+    n = len(coeffs)
+    if n <= deriv_order:
+        return jnp.zeros_like(jnp.asarray(dt, jnp.float64))
+    dt = jnp.asarray(dt, jnp.float64)
+    # derivative shifts the series: result = sum_{i>=d} c_i dt^{i-d}/(i-d)!
+    fact = [math.factorial(i - deriv_order) for i in range(deriv_order, n)]
+    cs = [float(coeffs[i]) if not hasattr(coeffs[i], "shape") else coeffs[i]
+          for i in range(deriv_order, n)]
+    acc = jnp.zeros_like(dt)
+    for i in reversed(range(len(cs))):
+        acc = acc * dt + cs[i] / fact[i]
+    return acc
+
+
+def dd_taylor_horner(dt: DD, coeffs: Sequence) -> DD:
+    """Sum_i coeffs[i] * dt^i / i! with a double-double accumulator.
+
+    ``dt`` is DD (seconds since epoch); coeffs are f64 scalars (or DD for
+    F0, whose 16 digits alone can't place 1e10 turns to 1e-9 — pass the
+    parfile string remainder through a DD coefficient when available).
+    """
+    n = len(coeffs)
+    if n == 0:
+        z = jnp.zeros_like(dt.hi)
+        return DD(z, z)
+    acc = _as_dd(jnp.zeros_like(dt.hi))
+    for i in reversed(range(n)):
+        ci = coeffs[i]
+        fct = float(math.factorial(i))
+        acc = dd_mul(acc, dt)
+        if isinstance(ci, DD):
+            acc = dd_add(acc, dd_div_f(ci, fct) if fct != 1.0 else ci)
+        else:
+            acc = dd_add_f(acc, jnp.asarray(ci, jnp.float64) / fct)
+    return acc

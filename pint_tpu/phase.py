@@ -1,0 +1,61 @@
+"""Pulse-phase bookkeeping.
+
+The reference keeps phase as an (int, frac) pair of longdoubles
+(src/pint/phase.py Phase) so that ~1e10 turns of absolute phase never eat
+the sub-ns fractional part. Here a phase is simply a ``DD`` (double-double
+turns); ``Phase`` is a thin named wrapper exposing the same (int, frac)
+decomposition, registered as a pytree so it flows through jit/vmap.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+
+from pint_tpu.ops.dd import (
+    DD,
+    _as_dd,
+    dd_add,
+    dd_frac,
+    dd_neg,
+    dd_round,
+    dd_sub,
+    dd_to_f64,
+)
+
+
+class Phase(NamedTuple):
+    """Absolute pulse phase in turns, carried as DD."""
+
+    turns: DD
+
+    @property
+    def int(self) -> jax.Array:
+        """Nearest-integer pulse number (f64-exact up to 2^53 turns)."""
+        return dd_round(self.turns).hi
+
+    @property
+    def frac(self) -> jax.Array:
+        """Signed fractional phase in [-0.5, 0.5] turns (f64; its own
+        rounding error is ~1e-16 turns ≈ 1e-18 s at F0=61 Hz)."""
+        return dd_to_f64(dd_frac(self.turns))
+
+    @property
+    def frac_dd(self) -> DD:
+        return dd_frac(self.turns)
+
+    def __add__(self, other):
+        other = other.turns if isinstance(other, Phase) else _as_dd(other)
+        return Phase(dd_add(self.turns, other))
+
+    def __sub__(self, other):
+        other = other.turns if isinstance(other, Phase) else _as_dd(other)
+        return Phase(dd_sub(self.turns, other))
+
+    def __neg__(self):
+        return Phase(dd_neg(self.turns))
+
+
+def phase_from_f64(x) -> Phase:
+    return Phase(_as_dd(x))
