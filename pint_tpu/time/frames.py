@@ -1,0 +1,205 @@
+"""Earth rotation and celestial frames — the ERFA replacement layer.
+
+Replaces the PyERFA calls the reference makes through
+src/pint/erfautils.py (gcrs_posvel_from_itrf: pnm06a/era00/sp00/pom00)
+with an equinox-based chain:
+
+    GCRS = P(t) · N(t) · R3(−GAST) · W · ITRF
+
+- P: IAU-2006-compatible precession (Capitaine polynomials for ζ, z, θ);
+- N: IAU2000B nutation truncated to the 10 largest lunisolar terms
+  (~10 mas worst-case vs full series → ≲30 cm on the geocenter-to-site
+  vector ≈ 1 ns of Roemer — see error budget in ARCHITECTURE.md);
+- GAST = GMST(ERA) + Δψ cos ε (equation of the equinoxes, leading term);
+- W: polar motion, identity by default (no IERS tables offline; ~0.3″
+  ≈ 9 m ≈ 30 ns — irrelevant for self-consistent fixtures, hook provided
+  for real-data use);
+- UT1 ≈ UTC (|ΔUT1| < 0.9 s ≈ ≤40 cm of site position; same hook).
+
+All host-side numpy f64; angles in radians, times as TT/UT1 MJD f64
+(sub-second argument errors are harmless here — rates are ≤ 7.3e-5 rad/s
+and position enters delays divided by c).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ASEC2RAD = np.pi / (180.0 * 3600.0)
+TURNAS = 1296000.0  # arcsec per turn
+MJD_J2000 = 51544.5
+OMEGA_EARTH = 2 * np.pi * 1.00273781191135448 / 86400.0  # rad/s (ERA rate)
+
+
+def _jc(tt_mjd):
+    """Julian centuries TT since J2000."""
+    return (np.asarray(tt_mjd, np.float64) - MJD_J2000) / 36525.0
+
+
+def earth_rotation_angle(ut1_mjd):
+    """ERA(UT1), IAU 2000 (reference ERFA era00). Radians in [0, 2π)."""
+    t = np.asarray(ut1_mjd, np.float64) - MJD_J2000
+    # split t to keep the fast term accurate: ERA/2π = 0.779057… + t
+    # + 0.00273781…·t (mod 1); the integer part of t drops out.
+    era = 2 * np.pi * (
+        (t % 1.0 + 0.7790572732640 + 0.00273781191135448 * t) % 1.0)
+    return era % (2 * np.pi)
+
+
+def gmst06(ut1_mjd, tt_mjd):
+    """GMST consistent with IAU 2006 precession (reference ERFA gmst06):
+    GMST = ERA + polynomial(t_TT)."""
+    t = _jc(tt_mjd)
+    poly = (0.014506 + 4612.156534 * t + 1.3915817 * t * t
+            - 0.00000044 * t**3 - 0.000029956 * t**4) * ASEC2RAD
+    return (earth_rotation_angle(ut1_mjd) + poly) % (2 * np.pi)
+
+
+def obliquity06(tt_mjd):
+    """Mean obliquity of the ecliptic, IAU 2006 (arcsec poly → rad)."""
+    t = _jc(tt_mjd)
+    eps = (84381.406 - 46.836769 * t - 0.0001831 * t * t
+           + 0.00200340 * t**3)
+    return eps * ASEC2RAD
+
+
+# IAU2000B truncated: (l, l', F, D, Om multipliers), dpsi_sin, deps_cos
+# in arcsec. Ten largest terms of the lunisolar series.
+_NUT_TERMS = np.array([
+    # l   l'  F   D  Om     dpsi        deps
+    (0.0, 0.0, 0.0, 0.0, 1.0, -17.2064161, 9.2052331),
+    (0.0, 0.0, 2.0, -2.0, 2.0, -1.3170906, 0.5730336),
+    (0.0, 0.0, 2.0, 0.0, 2.0, -0.2276413, 0.0978459),
+    (0.0, 0.0, 0.0, 0.0, 2.0, 0.2074554, -0.0897492),
+    (0.0, 1.0, 0.0, 0.0, 0.0, 0.1475877, 0.0073871),
+    (0.0, 1.0, 2.0, -2.0, 2.0, -0.0516821, 0.0224386),
+    (1.0, 0.0, 0.0, 0.0, 0.0, 0.0711159, -0.0006750),
+    (0.0, 0.0, 2.0, 0.0, 1.0, -0.0387298, 0.0200728),
+    (1.0, 0.0, 2.0, 0.0, 2.0, -0.0301461, 0.0129025),
+    (0.0, -1.0, 2.0, -2.0, 2.0, 0.0215829, -0.0095929),
+])
+
+
+def _fundamental_args(t):
+    """Delaunay arguments (rad); t in Julian centuries TT (IERS 2003)."""
+    l = (134.96340251 + 477198.8675605 * t) * np.pi / 180.0   # noqa: E741
+    lp = (357.52910918 + 35999.0502911 * t) * np.pi / 180.0
+    F = (93.27209062 + 483202.0174577 * t) * np.pi / 180.0
+    D = (297.85019547 + 445267.1114469 * t) * np.pi / 180.0
+    Om = (125.04455501 - 1934.1362891 * t) * np.pi / 180.0
+    return l, lp, F, D, Om
+
+
+def nutation00b_truncated(tt_mjd):
+    """(Δψ, Δε) in radians, 10-term truncation of IAU2000B."""
+    t = _jc(tt_mjd)
+    l, lp, F, D, Om = _fundamental_args(t)
+    dpsi = np.zeros_like(t)
+    deps = np.zeros_like(t)
+    for cl, clp, cF, cD, cOm, sp, ce in _NUT_TERMS:
+        arg = cl * l + clp * lp + cF * F + cD * D + cOm * Om
+        dpsi = dpsi + sp * np.sin(arg)
+        deps = deps + ce * np.cos(arg)
+    return dpsi * ASEC2RAD, deps * ASEC2RAD
+
+
+def _R1(a):
+    c, s = np.cos(a), np.sin(a)
+    z, o = np.zeros_like(c), np.ones_like(c)
+    return np.stack([
+        np.stack([o, z, z], -1),
+        np.stack([z, c, s], -1),
+        np.stack([z, -s, c], -1),
+    ], -2)
+
+
+def _R2(a):
+    c, s = np.cos(a), np.sin(a)
+    z, o = np.zeros_like(c), np.ones_like(c)
+    return np.stack([
+        np.stack([c, z, -s], -1),
+        np.stack([z, o, z], -1),
+        np.stack([s, z, c], -1),
+    ], -2)
+
+
+def _R3(a):
+    c, s = np.cos(a), np.sin(a)
+    z, o = np.zeros_like(c), np.ones_like(c)
+    return np.stack([
+        np.stack([c, s, z], -1),
+        np.stack([-s, c, z], -1),
+        np.stack([z, z, o], -1),
+    ], -2)
+
+
+def precession_matrix(tt_mjd):
+    """Mean-of-J2000 ← mean-of-date rotation, Capitaine/IAU-2006-compatible
+    equatorial precession angles ζ, z, θ:
+        v_J2000 = R3(ζ) R2(−θ) R3(z) · v_date  (transpose of the classic
+        date←J2000 matrix R3(−z) R2(θ) R3(−ζ)).
+    """
+    t = _jc(tt_mjd)
+    zeta = (2.650545 + 2306.083227 * t + 0.2988499 * t**2
+            + 0.01801828 * t**3) * ASEC2RAD
+    z = (-2.650545 + 2306.077181 * t + 1.0927348 * t**2
+         + 0.01826837 * t**3) * ASEC2RAD
+    theta = (2004.191903 * t - 0.4294934 * t**2
+             - 0.04182264 * t**3) * ASEC2RAD
+    # date ← J2000 is R3(-z) R2(theta) R3(-zeta); we return its transpose
+    m = _R3(-z) @ _R2(theta) @ _R3(-zeta)
+    return np.swapaxes(m, -1, -2)
+
+
+def nutation_matrix(tt_mjd):
+    """Mean-of-date ← true-of-date: N^T = [R1(−ε−Δε) R3(−Δψ) R1(ε)]^T …
+    returned as true→mean transpose so GCRS chain composes as P·N·R3(−GAST).
+    """
+    eps = obliquity06(tt_mjd)
+    dpsi, deps = nutation00b_truncated(tt_mjd)
+    n = _R1(-(eps + deps)) @ _R3(-dpsi) @ _R1(eps)  # true ← mean
+    return np.swapaxes(n, -1, -2)  # mean ← true
+
+
+def gast06(ut1_mjd, tt_mjd):
+    eps = obliquity06(tt_mjd)
+    dpsi, _ = nutation00b_truncated(tt_mjd)
+    return (gmst06(ut1_mjd, tt_mjd) + dpsi * np.cos(eps)) % (2 * np.pi)
+
+
+def itrf_to_gcrs_posvel(itrf_xyz_m, utc_mjd, tt_mjd):
+    """Observatory ITRF (x,y,z) [m] → GCRS position [m] and velocity [m/s]
+    at the given epochs (reference: src/pint/erfautils.py
+    gcrs_posvel_from_itrf). UT1≈UTC; polar motion ≈ I.
+
+    itrf_xyz_m: (3,) site vector. utc/tt_mjd: (N,) epochs.
+    Returns pos (N,3), vel (N,3).
+    """
+    itrf = np.asarray(itrf_xyz_m, np.float64)
+    utc_mjd = np.atleast_1d(np.asarray(utc_mjd, np.float64))
+    tt_mjd = np.atleast_1d(np.asarray(tt_mjd, np.float64))
+    # compute the nutation series once — shared by GAST and the N matrix
+    eps = obliquity06(tt_mjd)
+    dpsi, deps = nutation00b_truncated(tt_mjd)
+    gast = (gmst06(utc_mjd, tt_mjd) + dpsi * np.cos(eps)) % (2 * np.pi)
+    # true-of-date equatorial coords of the site
+    cg, sg = np.cos(gast), np.sin(gast)
+    x, y, z = itrf
+    tod_pos = np.stack([cg * x - sg * y, sg * x + cg * y,
+                        np.full_like(cg, z)], -1)
+    # velocity: d/dt R3(−GAST) — Earth rotation dominates (precession
+    # rates are ~1e-12 rad/s, negligible vs 7.3e-5)
+    tod_vel = OMEGA_EARTH * np.stack(
+        [-sg * x - cg * y, cg * x - sg * y, np.zeros_like(cg)], -1)
+    n_true_from_mean = _R1(-(eps + deps)) @ _R3(-dpsi) @ _R1(eps)
+    pn = precession_matrix(tt_mjd) @ np.swapaxes(n_true_from_mean, -1, -2)
+    pos = np.einsum("...ij,...j->...i", pn, tod_pos)
+    vel = np.einsum("...ij,...j->...i", pn, tod_vel)
+    return pos, vel
+
+
+def icrs_to_ecliptic_matrix(obliquity_arcsec: float = 84381.406):
+    """Rotation ecliptic ← ICRS/equatorial (IERS2010 obliquity default;
+    reference: src/pint/pulsar_ecliptic.py PulsarEcliptic + ecliptic.dat).
+    """
+    return _R1(np.float64(obliquity_arcsec * ASEC2RAD))

@@ -1,0 +1,102 @@
+"""UTC → TAI → TT → TDB scale conversions on dd MJDs.
+
+Replaces astropy.time scale chains + ERFA ``dtdb``
+(reference: src/pint/toa.py TOAs.compute_TDBs; SURVEY.md Appendix A.3).
+
+TDB−TT uses a truncated Fairhead–Bretagnon analytic series (36 leading
+terms of the ERFA/FB1990 expansion). Truncation error vs the full ~800-term
+series is a few hundred ns worst-case — adequate for bring-up and fully
+self-consistent for the simulate→fit oracle; the term table is data, so
+extending it later is mechanical. The additional topocentric term
+−(v_⊕·r_obs)/c² (~2 µs diurnal) is applied in the TOA pipeline where the
+observatory GCRS vectors are available.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pint_tpu.ops import dd_np
+from pint_tpu.time.leapseconds import tai_minus_utc
+
+TT_MINUS_TAI = 32.184  # seconds, exact
+SECS_PER_DAY = 86400.0
+MJD_J2000 = 51544.5  # TT
+
+# Fairhead & Bretagnon 1990 leading terms: (amplitude [s],
+# frequency [rad / Julian millennium], phase [rad]); t in TT millennia
+# since J2000. Constant-in-t group:
+_FB_T0 = np.array([
+    (1.656674564e-3, 6283.075849991, 6.240054195),
+    (2.2417471e-5, 5753.384884897, 4.296977442),
+    (1.3839792e-5, 12566.151699983, 6.196904410),
+    (4.770086e-6, 529.690965095, 0.444401603),
+    (4.676740e-6, 6069.776754553, 4.021195093),
+    (2.256707e-6, 213.299095438, 5.543113262),
+    (1.694205e-6, -3.523118349, 5.025132748),
+    (1.554905e-6, 77713.771467920, 5.198467090),
+    (1.276839e-6, 7860.419392439, 5.988822341),
+    (1.193379e-6, 5223.693919802, 3.649823730),
+    (1.115322e-6, 3930.209696220, 1.422745069),
+    (0.794185e-6, 11506.769769794, 2.322313077),
+    (0.600309e-6, 1577.343542448, 2.678271909),
+    (0.496817e-6, 6208.294251424, 5.696701824),
+    (0.486306e-6, 5884.926846583, 0.520007179),
+    (0.468597e-6, 6244.942814354, 5.866398759),
+    (0.447061e-6, 26.298319800, 3.615796498),
+    (0.435206e-6, -398.149003408, 4.349338347),
+    (0.432392e-6, 74.781598567, 2.435898309),
+    (0.375510e-6, 5507.553238667, 4.103476804),
+    (0.243085e-6, -775.522611324, 3.651837925),
+    (0.230685e-6, 5856.477659115, 4.773852582),
+    (0.203747e-6, 12036.460734888, 4.333987818),
+    (0.173435e-6, 18849.227549974, 6.153743485),
+    (0.159080e-6, 10977.078804699, 1.890075226),
+    (0.143935e-6, -796.298006816, 5.957517795),
+    (0.137927e-6, 11790.629088659, 1.135934669),
+    (0.119979e-6, 38.133035638, 4.551585768),
+    (0.118971e-6, 5486.777843175, 1.914547226),
+    (0.116120e-6, 1059.381930189, 0.873504123),
+])
+# t^1 group:
+_FB_T1 = np.array([
+    (102.156724e-6, 6283.075849991, 4.249032005),
+    (1.706807e-6, 12566.151699983, 4.205904248),
+    (0.269668e-6, 213.299095438, 3.400290479),
+    (0.265919e-6, 529.690965095, 5.836047367),
+    (0.210568e-6, -3.523118349, 6.262738348),
+    (0.077996e-6, 5223.693919802, 4.670344204),
+])
+
+
+def utc_mjd_to_tt_mjd(day, frac):
+    """Pulsar-MJD UTC (int day f64, frac dd) → TT as one dd MJD.
+
+    TT = UTC + (TAI−UTC)(utc day) + 32.184 s. The pulsar-MJD convention
+    makes the day fraction elapsed/86400 even on 86401-s days, so the
+    offset addition is uniform (this is precisely why the convention
+    exists — reference: src/pint/pulsar_mjd.py).
+    """
+    day = np.asarray(day, np.float64)
+    off = tai_minus_utc(day) + TT_MINUS_TAI  # seconds
+    mjd = dd_np.add_f(frac, day)
+    return dd_np.add(mjd, dd_np.div_f(dd_np.dd(off), SECS_PER_DAY))
+
+
+def tdb_minus_tt_seconds(tt_mjd_f64):
+    """Truncated Fairhead–Bretagnon TDB−TT [s] at TT MJD(s) (f64 is ample:
+    the series slope is ~1e-7 s/s, so µs-level argument error is harmless).
+    """
+    t = (np.asarray(tt_mjd_f64, np.float64) - MJD_J2000) / 365250.0
+    w = np.zeros_like(t)
+    for A, om, ph in _FB_T0:
+        w = w + A * np.sin(om * t + ph)
+    for A, om, ph in _FB_T1:
+        w = w + t * (A * np.sin(om * t + ph))
+    return w
+
+
+def tt_mjd_to_tdb_mjd(tt_mjd):
+    """TT dd MJD → TDB dd MJD (geocentric term only)."""
+    dtdb = tdb_minus_tt_seconds(dd_np.to_f64(tt_mjd))
+    return dd_np.add(tt_mjd, dd_np.div_f(dd_np.dd(dtdb), SECS_PER_DAY))

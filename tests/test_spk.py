@@ -1,0 +1,113 @@
+"""SPK/DAF reader test against a synthetic kernel built in-test.
+
+No real .bsp ships in this environment, so we construct a minimal valid
+little-endian DAF/SPK file with one type-2 segment whose Chebyshev
+coefficients encode a known trajectory, and check the reader + evaluator
+reproduce it (including the center-chain walk)."""
+
+import numpy as np
+
+from pint_tpu.ephemeris.spk import SPKEphemeris
+
+
+def _write_daf_spk(path, segments):
+    """segments: list of (target, center, init, intlen, coeffs(n,3,deg))."""
+    # Layout: record 1 = file record; record 2 = summary record;
+    # record 3 = name record; data from record 4.
+    nd, ni = 2, 6
+    ss = nd + (ni + 1) // 2
+    data_words = []
+    seg_meta = []
+    word_ptr = 3 * 128 + 1  # 1-based word index of first data word
+    for target, center, init, intlen, coeffs in segments:
+        n, ncomp, deg = coeffs.shape
+        rsize = 2 + ncomp * deg
+        start = word_ptr
+        for i in range(n):
+            mid = init + (i + 0.5) * intlen
+            rad = intlen / 2.0
+            data_words.extend([mid, rad])
+            data_words.extend(coeffs[i].ravel().tolist())
+        data_words.extend([init, intlen, float(rsize), float(n)])
+        end = start + n * rsize + 4 - 1
+        word_ptr = end + 1
+        et0, et1 = init, init + n * intlen
+        seg_meta.append((et0, et1, target, center, 1, 2, start, end))
+
+    # file record
+    fr = bytearray(1024)
+    fr[0:8] = b"DAF/SPK "
+    fr[8:12] = np.int32(nd).tobytes()
+    fr[12:16] = np.int32(ni).tobytes()
+    fr[16:76] = b"synthetic kernel".ljust(60)
+    fr[76:80] = np.int32(2).tobytes()   # FWARD
+    fr[80:84] = np.int32(2).tobytes()   # BWARD
+    fr[84:88] = np.int32(word_ptr).tobytes()  # FREE
+    fr[88:96] = b"LTL-IEEE"
+    # summary record
+    sr = np.zeros(128)
+    sr[0] = 0.0  # next
+    sr[1] = 0.0  # prev
+    sr[2] = float(len(seg_meta))
+    for i, (et0, et1, tgt, ctr, frame, typ, start, end) in enumerate(seg_meta):
+        off = 3 + i * ss
+        sr[off] = et0
+        sr[off + 1] = et1
+        ints = np.array([tgt, ctr, frame, typ, start, end], dtype=np.int32)
+        sr[off + 2:off + 5] = np.frombuffer(ints.tobytes(), dtype=np.float64)
+    nr = b" " * 1024  # name record
+    body = np.array(data_words, dtype=np.float64).tobytes()
+    with open(path, "wb") as f:
+        f.write(bytes(fr))
+        f.write(sr.tobytes())
+        f.write(nr)
+        f.write(body)
+
+
+def test_spk_roundtrip(tmp_path):
+    # EMB wrt SSB: quadratic trajectory x = 1e6 + 5 t_rel km (per comp
+    # scaled), encoded in Chebyshev basis per 86400-s interval
+    init = 0.0
+    intlen = 86400.0
+    n = 4
+    deg = 4
+    coeffs_emb = np.zeros((n, 3, deg))
+    coeffs_moon = np.zeros((n, 3, deg))
+    for i in range(n):
+        # pos(s) = a + b·T1(s) + c·T2(s), s in [-1,1]
+        coeffs_emb[i, 0, :3] = [1.0e6 + i, 50.0, 7.0]
+        coeffs_emb[i, 1, :3] = [2.0e6 - i, -30.0, 3.0]
+        coeffs_emb[i, 2, :3] = [5.0e5, 10.0, 0.5]
+        coeffs_moon[i, 0, :3] = [3.8e5, 5.0, 0.0]
+    path = tmp_path / "synthetic.bsp"
+    _write_daf_spk(str(path), [
+        (3, 0, init, intlen, coeffs_emb),     # EMB wrt SSB
+        (399, 3, init, intlen, coeffs_moon),  # "Earth" wrt EMB
+    ])
+    eph = SPKEphemeris(str(path))
+    # mid of interval 1: s=0 → pos = a - c (T2(0)=-1)
+    tdb_mjd = 51544.5 + 1.5  # ET = 1.5 days → interval 1 center
+    p, v = eph.ssb_posvel(3, tdb_mjd)
+    want_x = (1.0e6 + 1 - 7.0) * 1e3
+    np.testing.assert_allclose(p[0, 0], want_x, rtol=1e-14)
+    # velocity: d/det [b T1 + c T2] = (b + 4 c s)/rad; s=0 → b/rad
+    np.testing.assert_allclose(v[0, 0], 50.0 / (intlen / 2) * 1e3, rtol=1e-12)
+    # chain: earth = EMB + moon-segment offset
+    pe, _ = eph.ssb_posvel("earth", tdb_mjd)
+    np.testing.assert_allclose(pe[0, 0], want_x + (3.8e5 + 5 * 0 - 0) * 1e3,
+                               rtol=1e-14)
+    # interior point: day 0.75 → interval 0, s = +0.5 →
+    # f = a + b·T1(0.5) + c·T2(0.5) = a + 0.5·b − 0.5·c
+    p2, _ = eph.ssb_posvel(3, 51544.5 + 0.75)
+    want2 = (1.0e6 + 0 + 0.5 * 50.0 - 0.5 * 7.0) * 1e3
+    np.testing.assert_allclose(p2[0, 0], want2, rtol=1e-14)
+
+
+def test_spk_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.bsp"
+    p.write_bytes(b"NOT A DAF" + b"\0" * 2000)
+    try:
+        SPKEphemeris(str(p))
+        assert False, "should have raised"
+    except ValueError as e:
+        assert "not an SPK" in str(e)

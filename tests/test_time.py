@@ -1,0 +1,158 @@
+"""Tests for the time-scale / Earth-orientation / ephemeris stack.
+
+No astropy/erfa oracle exists in this environment (SURVEY.md §4
+implication), so the checks are physical invariants with known values:
+leap-second table facts, TDB−TT annual amplitude ~1.657 ms, ERA/GMST
+rates, Earth orbital radius ≈ 1 au and speed ≈ 29.8 km/s, site rotation
+speed ≈ 465·cos(lat) m/s, MJD string round-trips at sub-ns.
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.ops import dd_np
+from pint_tpu.time import (
+    earth_rotation_angle,
+    gmst06,
+    itrf_to_gcrs_posvel,
+    mjd_to_str,
+    obliquity06,
+    parse_mjd_string,
+    tai_minus_utc,
+    tdb_minus_tt_seconds,
+    tt_mjd_to_tdb_mjd,
+    utc_mjd_to_tt_mjd,
+)
+from pint_tpu.time.mjd import parse_mjd_strings
+from pint_tpu.ephemeris import get_ephemeris, AnalyticEphemeris
+
+
+def test_leap_seconds():
+    assert tai_minus_utc(41317.0) == 10.0
+    assert tai_minus_utc(57753.9) == 36.0  # 2016-12-31
+    assert tai_minus_utc(57754.0) == 37.0  # 2017-01-01
+    assert tai_minus_utc(60000.0) == 37.0  # 2023, still 37
+    np.testing.assert_array_equal(
+        tai_minus_utc(np.array([50000.0, 58000.0])), [29.0, 37.0])
+
+
+def test_utc_to_tt_offset():
+    # post-2017: TT-UTC = 69.184 s
+    day, frac = parse_mjd_string("58526.0")
+    tt = utc_mjd_to_tt_mjd(day, frac)
+    assert abs(dd_np.to_f64(tt) - (58526.0 + 69.184 / 86400)) < 1e-12
+
+
+def test_mjd_string_roundtrip():
+    for s in ["58526.123456789012345", "51544.000000000000001",
+              "60000.999999999999999", "42000.5"]:
+        day, frac = parse_mjd_string(s)
+        out = mjd_to_str(day, frac, ndigits=15)
+        # compare at the digit level (sub-ns: 1e-15 day = 0.1 ns)
+        a = float(s)
+        b = float(out)
+        assert abs(a - b) < 1e-9  # f64 comparison sanity
+        # exact digit check
+        want_frac = s.split(".")[1] if "." in s else ""
+        got_frac = out.split(".")[1]
+        assert got_frac == want_frac.ljust(len(got_frac), "0")[:len(got_frac)]
+
+
+def test_mjd_parse_precision_vs_longdouble():
+    s = "58526.123456789012345678"
+    day, frac = parse_mjd_string(s)
+    ld = np.longdouble("0.123456789012345678")
+    got = np.float64(np.longdouble(frac[0]) + np.longdouble(frac[1]) - ld)
+    assert abs(got) < 1e-19  # day-fraction: 1e-19 day ≈ 10 ps
+
+
+def test_tdb_minus_tt_shape():
+    # annual sinusoid, amplitude ≈ 1.657 ms, zero-mean
+    mjd = np.linspace(55000, 55365, 366)
+    d = tdb_minus_tt_seconds(mjd)
+    assert 1.5e-3 < d.max() < 1.8e-3
+    assert -1.8e-3 < d.min() < -1.5e-3
+    assert abs(d.mean()) < 2e-4
+    tdb = tt_mjd_to_tdb_mjd(dd_np.dd(55000.0))
+    assert abs(dd_np.to_f64(tdb) - 55000.0) * 86400 < 2e-3
+
+
+def test_era_and_gmst_rates():
+    # ERA advances ~2π·1.0027379 per day
+    e0 = earth_rotation_angle(58000.0)
+    e1 = earth_rotation_angle(58001.0)
+    rate = (e1 - e0) % (2 * np.pi)
+    assert abs(rate - 2 * np.pi * 0.00273781191135448) < 1e-10
+    g = gmst06(51544.5, 51544.5)
+    # GMST at J2000.0 noon ≈ 18h 41m 50s ≈ 4.894961 rad
+    assert abs(g - 4.894961212) < 1e-4
+
+
+def test_obliquity():
+    assert abs(obliquity06(51544.5) - 84381.406 * np.pi / (180 * 3600)) < 1e-12
+
+
+def test_itrf_to_gcrs_geometry():
+    # GBT coordinates (SURVEY.md A.9)
+    gbt = np.array([882589.65, -4924872.32, 3943729.35])
+    mjd = np.linspace(58000, 58001, 25)
+    pos, vel = itrf_to_gcrs_posvel(gbt, mjd, mjd + 69.184 / 86400)
+    r = np.linalg.norm(gbt)
+    # radius preserved by rotations
+    np.testing.assert_allclose(np.linalg.norm(pos, axis=1), r, rtol=1e-12)
+    # site speed = Ω × ρ_cyl
+    rho = np.hypot(gbt[0], gbt[1])
+    want_v = 2 * np.pi * 1.00273781191135448 / 86400 * rho
+    np.testing.assert_allclose(np.linalg.norm(vel, axis=1), want_v, rtol=1e-6)
+    # z oscillates daily with amplitude ρ·sin(axis tilt vs J2000):
+    # precession since 2000 is ~16yr × 20″/yr ≈ 320″ → ~8 km at GBT's ρ.
+    # (Constant-z holds in the true-of-date frame, not GCRS.)
+    assert np.ptp(pos[:, 2]) < 25_000.0
+    assert abs(np.mean(pos[:, 2]) - gbt[2]) < 15_000.0
+    # one sidereal day ≈ back to start
+    pos2, _ = itrf_to_gcrs_posvel(gbt, np.array([58000.0 + 0.9972695663]),
+                                  np.array([58000.0 + 0.9972695663]))
+    assert np.linalg.norm(pos2[0] - pos[0]) < 2000.0
+
+
+def test_earth_orbit():
+    eph = get_ephemeris()
+    mjd = np.linspace(56000, 56365, 100)
+    p, v = eph.ssb_posvel("earth", mjd)
+    r = np.linalg.norm(p, axis=1)
+    AU = 1.495978707e11
+    # heliocentric-ish distance ~1 au (SSB offset < 0.01 au)
+    assert np.all(np.abs(r / AU - 1.0) < 0.03)
+    speed = np.linalg.norm(v, axis=1)
+    assert np.all(np.abs(speed - 29780) < 1500)  # m/s, e=0.0167 modulation
+    # orbital plane: z-component in equatorial frame oscillates with
+    # obliquity tilt: max |z| ≈ sin(23.44°)·au
+    assert 0.35 < np.max(np.abs(p[:, 2])) / AU < 0.42
+
+
+def test_sun_near_ssb():
+    eph = AnalyticEphemeris()
+    p, _ = eph.ssb_posvel("sun", np.array([57000.0]))
+    # Sun-SSB distance is ~0.5-2 solar radii (~7e8 m) era-dependent
+    d = np.linalg.norm(p[0])
+    assert 1e8 < d < 3e9
+
+
+def test_jupiter_orbit():
+    eph = AnalyticEphemeris()
+    p, v = eph.ssb_posvel("jupiter", np.array([57000.0]))
+    AU = 1.495978707e11
+    assert 4.9 < np.linalg.norm(p[0]) / AU < 5.5
+    assert 11000 < np.linalg.norm(v[0]) < 14500
+
+
+def test_unknown_ephemeris_falls_back_with_warning():
+    with pytest.warns(UserWarning, match="analytic"):
+        eph = get_ephemeris("DE440")
+    assert isinstance(eph, AnalyticEphemeris)
+
+
+def test_parse_mjd_strings_vector():
+    days, (fh, fl) = parse_mjd_strings(["58000.25", "58001.75"])
+    np.testing.assert_array_equal(days, [58000.0, 58001.0])
+    np.testing.assert_allclose(fh, [0.25, 0.75])
