@@ -133,20 +133,37 @@ def _moon_geo_pos(tdb_mjd):
                      r * sl], -1)
 
 
+_sun_cache = {}
+
+
 def _sun_wrt_ssb_ecl(tdb_mjd):
-    """Sun wrt SSB, ecliptic-J2000 [m]: −Σ μ_i r_i / (1 + Σ μ_i)."""
+    """Sun wrt SSB, ecliptic-J2000 [m]: −Σ μ_i r_i / (1 + Σ μ_i).
+
+    Memoized on the epoch array: every body queried at the same epochs
+    shares one 8-planet Kepler-solve sweep (compute_posvels hits this
+    with identical arrays for earth/sun/each planet)."""
     tdb_mjd = np.asarray(tdb_mjd, np.float64)
+    key = (tdb_mjd.shape, tdb_mjd.tobytes())
+    hit = _sun_cache.get(key)
+    if hit is not None:
+        return hit
     num = np.zeros(tdb_mjd.shape + (3,))
     mtot = 0.0
     for body, mu in _MASS_RATIO.items():
         num = num + mu * _helio_pos(body, tdb_mjd) * AU
         mtot += mu
-    return -num / (1.0 + mtot)
+    out = -num / (1.0 + mtot)
+    if len(_sun_cache) > 8:
+        _sun_cache.clear()
+    _sun_cache[key] = out
+    return out
 
 
 def _pos_ssb_ecl(body, tdb_mjd):
     """Body wrt SSB, ecliptic-J2000 [m]."""
     tdb_mjd = np.asarray(tdb_mjd, np.float64)
+    if body == "ssb":
+        return np.zeros(tdb_mjd.shape + (3,))
     sun = _sun_wrt_ssb_ecl(tdb_mjd)
     if body == "sun":
         return sun

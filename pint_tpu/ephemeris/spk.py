@@ -165,8 +165,15 @@ class SPKEphemeris:
         return pos, vel
 
     def ssb_posvel(self, body, tdb_mjd):
-        body_id = _NAIF_IDS[str(body).lower()] if not isinstance(body, int) \
-            else body
+        if isinstance(body, (int, np.integer)):
+            body_id = int(body)
+        else:
+            try:
+                body_id = _NAIF_IDS[str(body).lower()]
+            except KeyError:
+                raise KeyError(
+                    f"unknown body {body!r}; known: {sorted(_NAIF_IDS)}"
+                ) from None
         tdb_mjd = np.atleast_1d(np.asarray(tdb_mjd, np.float64))
         et = (tdb_mjd - _ET0_MJD) * _SPD
         pos, vel = self._posvel_wrt(body_id, et)

@@ -1,0 +1,198 @@
+"""``.tim`` TOA-file parser/writer (TEMPO2 "FORMAT 1" plus the TEMPO
+Princeton column format).
+
+Reference behavior: src/pint/toa.py (.tim parsing in get_TOAs / TOA
+class). Key property preserved here: **the MJD never passes through a
+single float64** — it stays a decimal string until
+``pint_tpu.time.mjd.parse_mjd_string`` splits it exactly into
+(int day, double-double fraction).
+
+Supported commands: FORMAT, MODE, INCLUDE, C/CC/# comments, SKIP/NOSKIP,
+END, TIME (accumulated offset, seconds), EFAC/EQUAD (scoped multipliers,
+recorded as flags), JUMP (toggle pairs → ``-tim_jump N`` flag, mirroring
+the reference's jump-flag behavior), PHASE/TRACK (recorded as flags).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class TimTOA:
+    """One parsed TOA line, host-side."""
+
+    mjd_str: str  # full-precision decimal string, scale = site clock (UTC)
+    freq_mhz: float
+    error_us: float
+    obs: str
+    name: str = ""
+    flags: Dict[str, str] = field(default_factory=dict)
+
+
+_COMMANDS = {
+    "FORMAT", "MODE", "INCLUDE", "SKIP", "NOSKIP", "END", "TIME",
+    "EFAC", "EQUAD", "EMIN", "EMAX", "FMIN", "FMAX", "JUMP", "PHASE",
+    "TRACK", "INFO",
+}
+
+
+def _is_number(tok: str) -> bool:
+    try:
+        float(tok)
+        return True
+    except ValueError:
+        return False
+
+
+def _parse_format1_line(parts: List[str]) -> Optional[TimTOA]:
+    # name freq mjd error site [-flag value]...
+    if len(parts) < 5:
+        return None
+    name, freq, mjd, err, site = parts[:5]
+    if not (_is_number(freq) and _is_number(mjd) and _is_number(err)):
+        return None
+    flags: Dict[str, str] = {}
+    i = 5
+    while i < len(parts):
+        tok = parts[i]
+        if tok.startswith("-") and not _is_number(tok):
+            key = tok[1:]
+            if i + 1 < len(parts):
+                flags[key] = parts[i + 1]
+                i += 2
+            else:
+                flags[key] = ""
+                i += 1
+        else:
+            i += 1  # stray token; tolerated like the reference
+    return TimTOA(mjd_str=mjd, freq_mhz=float(freq), error_us=float(err),
+                  obs=site, name=name, flags=flags)
+
+
+def _parse_princeton_line(line: str) -> Optional[TimTOA]:
+    """TEMPO Princeton format: observatory code in column 0, then
+    fixed columns — name(2:15) freq(15:24) MJD(24:44) err(44:53)
+    dmcorr(68:78). Parsed leniently by token position within slices.
+    """
+    if len(line) < 44:
+        return None
+    obs = line[0]
+    name = line[1:15].strip()
+    freq = line[15:24].strip()
+    mjd = line[24:44].strip().replace(" ", "")
+    err = line[44:53].strip()
+    if not (freq and mjd and err):
+        return None
+    if not (_is_number(freq) and _is_number(mjd) and _is_number(err)):
+        return None
+    return TimTOA(mjd_str=mjd, freq_mhz=float(freq), error_us=float(err),
+                  obs=obs, name=name)
+
+
+def parse_tim(source, _depth: int = 0) -> List[TimTOA]:
+    """Parse a .tim file (path, file object, or literal multi-line string).
+
+    INCLUDE is followed relative to the including file's directory.
+    """
+    if hasattr(source, "read"):
+        lines = source.read().splitlines()
+        base_dir = "."
+    else:
+        text = str(source)
+        if "\n" in text:
+            lines = text.splitlines()
+            base_dir = "."
+        else:
+            with open(text, "r") as f:
+                lines = f.read().splitlines()
+            base_dir = os.path.dirname(os.path.abspath(text))
+
+    toas: List[TimTOA] = []
+    skipping = False
+    time_offset_s = 0.0
+    efac = 1.0
+    equad_us = 0.0
+    jump_active = False
+    jump_count = 0
+
+    for raw in lines:
+        line = raw.rstrip("\n")
+        stripped = line.strip()
+        if not stripped:
+            continue
+        if stripped.startswith(("#", "C ", "CC ")) or stripped in ("C", "CC"):
+            continue
+        parts = stripped.split()
+        head = parts[0].upper()
+
+        if head in _COMMANDS:
+            if head == "SKIP":
+                skipping = True
+            elif head == "NOSKIP":
+                skipping = False
+            elif head == "END":
+                break
+            elif head == "INCLUDE" and len(parts) > 1:
+                if _depth > 10:
+                    raise RecursionError("INCLUDE nesting too deep")
+                inc = parts[1]
+                if not os.path.isabs(inc):
+                    inc = os.path.join(base_dir, inc)
+                toas.extend(parse_tim(inc, _depth=_depth + 1))
+            elif head == "TIME" and len(parts) > 1:
+                time_offset_s += float(parts[1])
+            elif head == "EFAC" and len(parts) > 1:
+                efac = float(parts[1])
+            elif head == "EQUAD" and len(parts) > 1:
+                equad_us = float(parts[1])
+            elif head == "JUMP":
+                jump_active = not jump_active
+                if jump_active:
+                    jump_count += 1
+            # FORMAT/MODE/PHASE/TRACK/INFO: recorded implicitly or ignored
+            continue
+
+        if skipping:
+            continue
+
+        toa = _parse_format1_line(parts)
+        if toa is None:
+            toa = _parse_princeton_line(line)
+        if toa is None:
+            raise ValueError(f"unparseable TOA line: {line!r}")
+        if time_offset_s != 0.0:
+            toa.flags["to"] = repr(time_offset_s)
+        if efac != 1.0:
+            toa.error_us *= efac
+        if equad_us != 0.0:
+            toa.error_us = (toa.error_us ** 2 + equad_us ** 2) ** 0.5
+        if jump_active:
+            toa.flags.setdefault("tim_jump", str(jump_count))
+        toas.append(toa)
+    return toas
+
+
+def write_tim(path_or_file, toas: List[TimTOA], comment: str = "") -> None:
+    """Write TOAs in TEMPO2 FORMAT 1 (round-trips through parse_tim)."""
+    own = not hasattr(path_or_file, "write")
+    f = open(path_or_file, "w") if own else path_or_file
+    try:
+        f.write("FORMAT 1\n")
+        if comment:
+            for c in comment.splitlines():
+                f.write(f"C {c}\n")
+        for t in toas:
+            name = t.name or "unk"
+            flags = "".join(
+                f" -{k} {v}" for k, v in sorted(t.flags.items()) if v != ""
+            )
+            f.write(
+                f"{name} {t.freq_mhz:.6f} {t.mjd_str} "
+                f"{t.error_us:.3f} {t.obs}{flags}\n"
+            )
+    finally:
+        if own:
+            f.close()

@@ -1,0 +1,405 @@
+"""TOA container and the ingestion pipeline (clock → TDB → posvels).
+
+Reference: src/pint/toa.py (TOA, TOAs, get_TOAs). Architectural change
+for TPU (SURVEY.md §3.1 boundary note): all Earth-frame, clock, and
+ephemeris physics is precomputed **once, on the host** into flat numpy
+columns; the device then sees a closed struct-of-arrays pytree
+(``ToaBatch``) of jnp arrays. Everything downstream of ``to_batch()`` is
+pure array math under jit.
+
+Times are carried as (int day f64, fraction as host double-double pair)
+and never squeezed through a single float64.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import c_m_s
+from pint_tpu.ephemeris import get_ephemeris
+from pint_tpu.io.tim import TimTOA, parse_tim, write_tim
+from pint_tpu.observatory import get_observatory
+from pint_tpu.ops import dd_np
+from pint_tpu.ops.dd import DD
+from pint_tpu.time import mjd as mjdmod
+from pint_tpu.time import scales
+
+SECS_PER_DAY = 86400.0
+
+# Planets used by PLANET_SHAPIRO, in reference order
+# (src/pint/models/solar_system_shapiro.py _ss_obj_delay callers).
+PLANETS = ("jupiter", "saturn", "venus", "uranus", "neptune")
+
+
+class ToaBatch(NamedTuple):
+    """Device-side struct-of-arrays view of a TOA set. All leaves are jnp
+    arrays; shapes are static per jit cache key. Positions are in
+    light-seconds, velocities in lt-s/s (i.e. v/c), matching the natural
+    units of delay formulas.
+    """
+
+    tdb_day: jnp.ndarray        # (N,) integer TDB day (f64-exact)
+    tdb_frac: DD                # (N,) dd TDB day fraction
+    freq_mhz: jnp.ndarray       # (N,) barycentric obs frequency (inf ok)
+    error_us: jnp.ndarray       # (N,) raw TOA uncertainty
+    ssb_obs_pos: jnp.ndarray    # (N,3) SSB→observatory, lt-s
+    ssb_obs_vel: jnp.ndarray    # (N,3) d/dt of the above, lt-s/s
+    obs_sun_pos: jnp.ndarray    # (N,3) observatory→Sun, lt-s
+    obs_planet_pos: jnp.ndarray  # (P,N,3) observatory→planet, lt-s
+    pulse_number: jnp.ndarray   # (N,) f64, NaN where untracked
+
+    @property
+    def ntoas(self):
+        return self.freq_mhz.shape[0]
+
+
+class TOAs:
+    """Host-side TOA table (reference: TOAs over an astropy Table; here a
+    plain struct of numpy columns + python-side flags)."""
+
+    def __init__(self, timtoas: List[TimTOA]):
+        days, frac = mjdmod.parse_mjd_strings([t.mjd_str for t in timtoas])
+        self.mjd_day = days                      # UTC (pulsar-MJD) int day
+        self.mjd_frac = frac                     # dd day fraction
+        self.freq_mhz = np.array(
+            [t.freq_mhz if t.freq_mhz > 0 else np.inf for t in timtoas])
+        self.error_us = np.array([t.error_us for t in timtoas])
+        self.obs = [get_observatory(t.obs).name for t in timtoas]
+        self.flags: List[Dict[str, str]] = [dict(t.flags) for t in timtoas]
+        self.names = [t.name for t in timtoas]
+        # applied "TIME" offsets from the tim file (seconds)
+        toff = np.array([float(f.get("to", 0.0)) for f in self.flags])
+        if np.any(toff != 0.0):
+            self.mjd_frac = dd_np.add(
+                self.mjd_frac, dd_np.div_f(dd_np.dd(toff), SECS_PER_DAY))
+        self.clock_applied = False
+        # populated by the pipeline:
+        self.tdb_day: Optional[np.ndarray] = None
+        self.tdb_frac = None
+        self.ssb_obs_pos = None   # (N,3) meters
+        self.ssb_obs_vel = None   # (N,3) m/s
+        self.obs_sun_pos = None
+        self.obs_planet_pos = None  # dict name -> (N,3) m
+        self.ephem = None
+        self.planets = False
+
+    # ---------------- basic container protocol ----------------
+
+    def __len__(self):
+        return len(self.obs)
+
+    @property
+    def ntoas(self):
+        return len(self.obs)
+
+    def get_mjds(self, high_precision=False):
+        """UTC MJDs as f64 (or (day, frac-dd) when high_precision)."""
+        if high_precision:
+            return self.mjd_day, self.mjd_frac
+        return self.mjd_day + dd_np.to_f64(self.mjd_frac)
+
+    def get_errors(self):
+        return self.error_us
+
+    def get_freqs(self):
+        return self.freq_mhz
+
+    def get_obss(self):
+        return list(self.obs)
+
+    def get_flag_value(self, flag, fill_value=None, as_type=None):
+        out = []
+        for f in self.flags:
+            v = f.get(flag, fill_value)
+            if v is not None and as_type is not None:
+                v = as_type(v)
+            out.append(v)
+        return out
+
+    def get_pulse_numbers(self):
+        pn = self.get_flag_value("pn", fill_value="nan", as_type=float)
+        arr = np.array(pn)
+        return None if np.all(np.isnan(arr)) else arr
+
+    def compute_pulse_numbers(self, model):
+        """Attach -pn flags from the model's nearest-integer phase
+        (reference: TOAs.compute_pulse_numbers)."""
+        ph = model.phase(self, abs_phase=True)
+        pn = np.asarray(ph.int)
+        for f, p in zip(self.flags, pn):
+            f["pn"] = repr(float(p))
+
+    def select(self, mask):
+        """Boolean-mask subset (new TOAs object; reference: TOAs.select
+        but non-destructive)."""
+        mask = np.asarray(mask)
+        idx = np.flatnonzero(mask) if mask.dtype == bool else mask
+        out = object.__new__(TOAs)
+        out.mjd_day = self.mjd_day[idx]
+        out.mjd_frac = (self.mjd_frac[0][idx], self.mjd_frac[1][idx])
+        out.freq_mhz = self.freq_mhz[idx]
+        out.error_us = self.error_us[idx]
+        out.obs = [self.obs[i] for i in idx]
+        out.flags = [dict(self.flags[i]) for i in idx]
+        out.names = [self.names[i] for i in idx]
+        out.clock_applied = self.clock_applied
+        out.ephem = self.ephem
+        out.planets = self.planets
+        for col in ("tdb_day", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+            v = getattr(self, col)
+            setattr(out, col, None if v is None else v[idx])
+        out.tdb_frac = None if self.tdb_frac is None else \
+            (self.tdb_frac[0][idx], self.tdb_frac[1][idx])
+        out.obs_planet_pos = None if self.obs_planet_pos is None else \
+            {k: v[idx] for k, v in self.obs_planet_pos.items()}
+        return out
+
+    def first_MJD(self):
+        return float(np.min(self.get_mjds()))
+
+    def last_MJD(self):
+        return float(np.max(self.get_mjds()))
+
+    # ---------------- the pipeline ----------------
+
+    def apply_clock_corrections(self, include_gps=True, include_bipm=True,
+                                bipm_version="BIPM2021", limits="warn"):
+        """Add observatory clock chain to the raw MJDs, per obs group
+        (reference: TOAs.apply_clock_corrections)."""
+        if self.clock_applied:
+            return
+        mjd_f64 = self.get_mjds()
+        corr = np.zeros(self.ntoas)
+        for site in set(self.obs):
+            m = np.array([o == site for o in self.obs])
+            obs = get_observatory(site)
+            corr[m] = obs.clock_corrections(
+                mjd_f64[m], include_gps=include_gps,
+                include_bipm=include_bipm, bipm_version=bipm_version,
+                limits=limits)
+        self.mjd_frac = dd_np.add(
+            self.mjd_frac, dd_np.div_f(dd_np.dd(corr), SECS_PER_DAY))
+        for f, c in zip(self.flags, corr):
+            f["clkcorr"] = repr(float(c))
+        self.clock_applied = True
+
+    def compute_TDBs(self, ephem=None):
+        """UTC(site) → TT → TDB per TOA (reference: TOAs.compute_TDBs).
+        Barycenter-site TOAs are already TDB and pass through."""
+        tdb_day = np.array(self.mjd_day)
+        fhi = np.array(self.mjd_frac[0])
+        flo = np.array(self.mjd_frac[1])
+        scale = np.array(
+            [get_observatory(o).timescale for o in self.obs])
+        utc_mask = scale != "tdb"
+        if np.any(utc_mask):
+            day = self.mjd_day[utc_mask]
+            frac = (self.mjd_frac[0][utc_mask], self.mjd_frac[1][utc_mask])
+            tt = scales.utc_mjd_to_tt_mjd(day, frac)
+            tdb = scales.tt_mjd_to_tdb_mjd(tt)
+            # renormalize to (int day, frac) — keep day integral for exact
+            # downstream (day − epoch) arithmetic
+            d = np.round(tdb[0])
+            rest = dd_np.add_f(dd_np.dd(tdb[0] - d, tdb[1]), 0.0)
+            tdb_day[utc_mask] = d
+            fhi[utc_mask] = rest[0]
+            flo[utc_mask] = rest[1]
+        self.tdb_day = tdb_day
+        self.tdb_frac = (fhi, flo)
+
+    def compute_posvels(self, ephem=None, planets=False):
+        """Observatory SSB position/velocity and Sun/planet geometry at
+        each TDB (reference: TOAs.compute_posvels)."""
+        if self.tdb_day is None:
+            self.compute_TDBs(ephem=ephem)
+        eph = get_ephemeris(ephem)
+        self.ephem = getattr(eph, "name", str(ephem))
+        self.planets = planets
+        tdb = self.tdb_day + dd_np.to_f64(self.tdb_frac)
+        utc = self.get_mjds()
+        earth_pos, earth_vel = eph.ssb_posvel("earth", tdb)
+        obs_pos = np.zeros((self.ntoas, 3))
+        obs_vel = np.zeros((self.ntoas, 3))
+        for site in set(self.obs):
+            m = np.array([o == site for o in self.obs])
+            obs = get_observatory(site)
+            if obs.name == "barycenter":
+                # positions stay zero; earth contribution removed below
+                continue
+            p, v = obs.gcrs_posvel(utc[m], tdb[m])
+            obs_pos[m] = p
+            obs_vel[m] = v
+        bary = np.array([o == "barycenter" for o in self.obs])
+        ssb_obs_pos = earth_pos + obs_pos
+        ssb_obs_vel = earth_vel + obs_vel
+        if np.any(bary):
+            ssb_obs_pos[bary] = 0.0
+            ssb_obs_vel[bary] = 0.0
+        self.ssb_obs_pos = ssb_obs_pos
+        self.ssb_obs_vel = ssb_obs_vel
+        sun_pos, _ = eph.ssb_posvel("sun", tdb)
+        self.obs_sun_pos = sun_pos - ssb_obs_pos
+        self.obs_planet_pos = {}
+        if planets:
+            for pl in PLANETS:
+                p, _ = eph.ssb_posvel(pl, tdb)
+                self.obs_planet_pos[pl] = p - ssb_obs_pos
+
+    # ---------------- device view ----------------
+
+    def to_batch(self) -> ToaBatch:
+        """Freeze into the device pytree (meters → light-seconds)."""
+        if self.ssb_obs_pos is None:
+            raise ValueError(
+                "run compute_posvels() (or use get_TOAs) before to_batch()")
+        pn = self.get_pulse_numbers()
+        if pn is None:
+            pn = np.full(self.ntoas, np.nan)
+        planet = np.stack(
+            [self.obs_planet_pos[p] for p in PLANETS], axis=0
+        ) / c_m_s if self.obs_planet_pos else np.zeros((0, self.ntoas, 3))
+        return ToaBatch(
+            tdb_day=jnp.asarray(self.tdb_day),
+            tdb_frac=DD(jnp.asarray(self.tdb_frac[0]),
+                        jnp.asarray(self.tdb_frac[1])),
+            freq_mhz=jnp.asarray(self.freq_mhz),
+            error_us=jnp.asarray(self.error_us),
+            ssb_obs_pos=jnp.asarray(self.ssb_obs_pos / c_m_s),
+            ssb_obs_vel=jnp.asarray(self.ssb_obs_vel / c_m_s),
+            obs_sun_pos=jnp.asarray(self.obs_sun_pos / c_m_s),
+            obs_planet_pos=jnp.asarray(planet),
+            pulse_number=jnp.asarray(pn),
+        )
+
+    def write_TOA_file(self, path):
+        """Round-trip back to a FORMAT-1 tim file. Clock corrections, if
+        applied, are subtracted so the file matches the original site
+        clocks (reference: TOAs.write_TOA_file commentary)."""
+        day, frac = self.mjd_day, self.mjd_frac
+        if self.clock_applied:
+            corr = np.array(
+                [float(f.get("clkcorr", 0.0)) for f in self.flags])
+            frac = dd_np.sub(frac, dd_np.div_f(dd_np.dd(corr), SECS_PER_DAY))
+        out = []
+        for i in range(self.ntoas):
+            flags = {k: v for k, v in self.flags[i].items()
+                     if k not in ("clkcorr", "to")}
+            out.append(TimTOA(
+                mjd_str=mjdmod.mjd_to_str(day[i], (frac[0][i], frac[1][i])),
+                freq_mhz=float(self.freq_mhz[i])
+                if np.isfinite(self.freq_mhz[i]) else 0.0,
+                error_us=float(self.error_us[i]),
+                obs=self.obs[i], name=self.names[i] or f"toa{i}",
+                flags=flags))
+        write_tim(path, out)
+
+
+def merge_TOAs(toas_list: List[TOAs]) -> TOAs:
+    """Concatenate TOA sets (reference: merge_TOAs). All inputs must be
+    at the same pipeline stage."""
+    first = toas_list[0]
+    out = object.__new__(TOAs)
+    out.mjd_day = np.concatenate([t.mjd_day for t in toas_list])
+    out.mjd_frac = (
+        np.concatenate([t.mjd_frac[0] for t in toas_list]),
+        np.concatenate([t.mjd_frac[1] for t in toas_list]))
+    out.freq_mhz = np.concatenate([t.freq_mhz for t in toas_list])
+    out.error_us = np.concatenate([t.error_us for t in toas_list])
+    out.obs = sum((t.obs for t in toas_list), [])
+    out.flags = sum(([dict(f) for f in t.flags] for t in toas_list), [])
+    out.names = sum((t.names for t in toas_list), [])
+    out.clock_applied = first.clock_applied
+    out.ephem = first.ephem
+    out.planets = first.planets
+    stages = {t.clock_applied for t in toas_list}
+    if len(stages) > 1:
+        raise ValueError("cannot merge TOAs at different pipeline stages")
+    for col in ("tdb_day", "ssb_obs_pos", "ssb_obs_vel", "obs_sun_pos"):
+        vals = [getattr(t, col) for t in toas_list]
+        setattr(out, col,
+                None if any(v is None for v in vals)
+                else np.concatenate(vals))
+    fracs = [t.tdb_frac for t in toas_list]
+    out.tdb_frac = None if any(f is None for f in fracs) else (
+        np.concatenate([f[0] for f in fracs]),
+        np.concatenate([f[1] for f in fracs]))
+    pls = [t.obs_planet_pos for t in toas_list]
+    if any(p is None for p in pls):
+        out.obs_planet_pos = None
+    elif any(bool(p) != bool(pls[0]) for p in pls):
+        raise ValueError(
+            "cannot merge TOAs with and without planet positions; "
+            "recompute with a consistent planets= setting")
+    elif not pls[0]:
+        out.obs_planet_pos = {}
+    else:
+        out.obs_planet_pos = {
+            k: np.concatenate([p[k] for p in pls]) for k in pls[0]}
+    return out
+
+
+def get_TOAs(timfile, ephem=None, planets=False, model=None,
+             include_gps=True, include_bipm=True, bipm_version="BIPM2021",
+             limits="warn") -> TOAs:
+    """One-call ingestion pipeline: parse → clock → TDB → posvels
+    (reference: src/pint/toa.py get_TOAs)."""
+    if model is not None:
+        if ephem is None:
+            ephem = getattr(model, "EPHEM", None) and model.EPHEM.value
+        if not planets:
+            ps = getattr(model, "PLANET_SHAPIRO", None)
+            planets = bool(ps is not None and ps.value)
+    t = TOAs(parse_tim(timfile))
+    t.apply_clock_corrections(include_gps=include_gps,
+                              include_bipm=include_bipm,
+                              bipm_version=bipm_version, limits=limits)
+    t.compute_TDBs(ephem=ephem)
+    t.compute_posvels(ephem=ephem, planets=planets)
+    return t
+
+
+def get_TOAs_array(mjds, obs="barycenter", freqs=np.inf, errors=1.0,
+                   ephem=None, planets=False, flags=None, include_gps=True,
+                   include_bipm=True, bipm_version="BIPM2021",
+                   limits="warn") -> TOAs:
+    """Build TOAs directly from arrays (reference: get_TOAs_array). mjds
+    may be f64 (splitting day/frac) or an (day, frac-dd) pair."""
+    if isinstance(mjds, tuple):
+        day, frac = mjds
+        day = np.asarray(day, np.float64)
+        frac = (np.asarray(frac[0], np.float64),
+                np.asarray(frac[1], np.float64))
+    else:
+        m = np.asarray(mjds, np.float64)
+        day = np.floor(m)
+        frac = dd_np.dd(m - day)
+    n = day.shape[0]
+    freqs = np.broadcast_to(np.asarray(freqs, np.float64), (n,))
+    errors = np.broadcast_to(np.asarray(errors, np.float64), (n,))
+    obs_list = [obs] * n if isinstance(obs, str) else list(obs)
+    out = object.__new__(TOAs)
+    out.mjd_day = day
+    out.mjd_frac = frac
+    out.freq_mhz = np.array(freqs)
+    out.error_us = np.array(errors)
+    out.obs = [get_observatory(o).name for o in obs_list]
+    out.flags = [dict(f) for f in flags] if flags is not None \
+        else [{} for _ in range(n)]
+    out.names = [f"fake{i}" for i in range(n)]
+    out.clock_applied = False
+    out.tdb_day = None
+    out.tdb_frac = None
+    out.ssb_obs_pos = out.ssb_obs_vel = out.obs_sun_pos = None
+    out.obs_planet_pos = None
+    out.ephem = None
+    out.planets = planets
+    out.apply_clock_corrections(include_gps=include_gps,
+                                include_bipm=include_bipm,
+                                bipm_version=bipm_version, limits=limits)
+    out.compute_TDBs(ephem=ephem)
+    out.compute_posvels(ephem=ephem, planets=planets)
+    return out

@@ -1,0 +1,145 @@
+"""Ingestion-layer tests: tim parsing, observatory registry, TOA pipeline
+(reference test analogs: tests/test_toa_reader.py, test_toa_flag.py,
+test_observatory.py)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.par import parse_parfile, parfile_dict
+from pint_tpu.io.tim import parse_tim, write_tim
+from pint_tpu.observatory import get_observatory, list_observatories
+from pint_tpu.toa import TOAs, get_TOAs, get_TOAs_array, merge_TOAs
+
+TIM = """FORMAT 1
+C a comment
+fake.ff 1400.000000 53478.2858714192189 21.710 gbt -be GUPPI -pn 12
+fake.ff 1400.000000 53483.2767051885165 21.950 gbt -be GUPPI
+fake.ff 428.000000 53489.4683897879295 29.950 @ -fe L-wide
+"""
+
+
+def test_parse_tim_basic():
+    toas = parse_tim(TIM)
+    assert len(toas) == 3
+    assert toas[0].mjd_str == "53478.2858714192189"
+    assert toas[0].flags["be"] == "GUPPI"
+    assert toas[0].flags["pn"] == "12"
+    assert toas[2].obs == "@"
+    assert toas[1].error_us == pytest.approx(21.95)
+
+
+def test_tim_commands():
+    text = """FORMAT 1
+MODE 1
+a 1400 50000.5 1.0 gbt
+SKIP
+b 1400 50001.5 1.0 gbt
+NOSKIP
+EFAC 2
+c 1400 50002.5 1.0 gbt
+END
+d 1400 50003.5 1.0 gbt
+"""
+    toas = parse_tim(text)
+    assert [t.name for t in toas] == ["a", "c"]
+    assert toas[1].error_us == pytest.approx(2.0)
+
+
+def test_tim_roundtrip(tmp_path):
+    toas = parse_tim(TIM)
+    p = tmp_path / "out.tim"
+    write_tim(str(p), toas)
+    back = parse_tim(str(p))
+    assert len(back) == len(toas)
+    assert back[0].mjd_str == toas[0].mjd_str
+    assert back[0].flags["be"] == "GUPPI"
+
+
+def test_parse_parfile():
+    par = """PSR J1234+5678
+F0 61.485476554373 1 1e-10
+F1 -1.1815e-15 1
+DM 223.9
+JUMP -fe L-wide 0.000216 1 0.000002
+JUMP -fe 430 0.000181 1
+# comment
+RAJ 17:48:52.75
+"""
+    lines = parse_parfile(par)
+    d = parfile_dict(lines)
+    assert d["F0"][0][0] == "61.485476554373"
+    assert len(d["JUMP"]) == 2
+    assert d["JUMP"][1][1] == "430"
+
+
+def test_observatory_registry():
+    gbt = get_observatory("gbt")
+    assert get_observatory("1") is gbt
+    assert get_observatory("GBT") is gbt
+    bary = get_observatory("@")
+    assert bary.timescale == "tdb"
+    assert "meerkat" in list_observatories()
+    with pytest.raises(KeyError):
+        get_observatory("notasite")
+
+
+def test_toa_pipeline():
+    t = get_TOAs(io.StringIO(TIM), ephem=None)
+    assert t.ntoas == 3
+    assert t.tdb_day is not None
+    # TAI-UTC = 32 s in April 2005 → TDB-UTC ~ 32 + 32.184 s
+    delta_day = (t.tdb_day + t.tdb_frac[0]) - t.get_mjds()
+    assert np.allclose(delta_day[:2] * 86400, 64.184, atol=0.01)
+    # barycentric TOA passes through unchanged
+    assert delta_day[2] * 86400 == pytest.approx(0.0, abs=1e-6)
+    # Earth orbital position ~ 1 AU from SSB for ground sites, 0 for @
+    r = np.linalg.norm(t.ssb_obs_pos, axis=1)
+    assert 1.3e11 < r[0] < 1.7e11
+    assert r[2] == 0.0
+    # orbital speed ~30 km/s
+    v = np.linalg.norm(t.ssb_obs_vel, axis=1)
+    assert 2.5e4 < v[0] < 3.5e4
+    # Sun roughly 1 AU from observer
+    rs = np.linalg.norm(t.obs_sun_pos, axis=1)
+    assert 1.4e11 < rs[0] < 1.6e11
+
+
+def test_to_batch():
+    t = get_TOAs(io.StringIO(TIM), planets=True)
+    b = t.to_batch()
+    assert b.ntoas == 3
+    assert b.obs_planet_pos.shape == (5, 3, 3)
+    # light-seconds: Earth ~ 499 s from SSB
+    r = np.linalg.norm(np.asarray(b.ssb_obs_pos), axis=1)
+    assert 450 < r[0] < 520
+    pn = np.asarray(b.pulse_number)
+    assert pn[0] == 12.0 and np.isnan(pn[1])
+
+
+def test_get_toas_array_and_merge():
+    t1 = get_TOAs_array(np.array([55000.1, 55001.2]), obs="parkes",
+                        freqs=1400.0, errors=0.5)
+    t2 = get_TOAs_array(np.array([55002.3]), obs="parkes", freqs=1400.0)
+    m = merge_TOAs([t1, t2])
+    assert m.ntoas == 3
+    assert m.ssb_obs_pos.shape == (3, 3)
+    assert np.all(np.diff(m.get_mjds()) > 0)
+
+
+def test_select():
+    t = get_TOAs(io.StringIO(TIM))
+    sub = t.select(np.array([True, False, True]))
+    assert sub.ntoas == 2
+    assert sub.obs == ["gbt", "barycenter"]
+    assert sub.ssb_obs_pos.shape == (2, 3)
+
+
+def test_write_roundtrip_mjd_precision(tmp_path):
+    t = get_TOAs(io.StringIO(TIM))
+    p = tmp_path / "rt.tim"
+    t.write_TOA_file(str(p))
+    back = parse_tim(str(p))
+    # MJD strings survive the clock-correction round trip to ~ps
+    assert back[0].mjd_str.startswith("53478.28587141921")
