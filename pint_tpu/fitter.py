@@ -1,0 +1,222 @@
+"""Fitters: weighted least squares (WLS) and the downhill wrapper.
+
+Reference: src/pint/fitter.py (Fitter, WLSFitter, DownhillFitter family;
+GLSFitter lives in pint_tpu.gls once noise models land). The linear
+solve is one jitted XLA kernel (SVD with singular-value thresholding,
+exactly the reference's scaled-design-matrix solve); residual/design
+evaluation reuses the model's compiled phase function.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.residuals import Residuals
+
+__all__ = ["Fitter", "WLSFitter", "DownhillWLSFitter", "fit_summary",
+           "ConvergenceFailure", "MaxiterReached", "StepProblem"]
+
+
+class ConvergenceFailure(RuntimeError):
+    pass
+
+
+class MaxiterReached(ConvergenceFailure):
+    pass
+
+
+class StepProblem(ConvergenceFailure):
+    pass
+
+
+@partial(jax.jit, static_argnames=("threshold_arg",))
+def _wls_solve(M, r, err_s, threshold_arg=None):
+    """min ||(r − Mx)/σ||²: column-normalized SVD solve.
+
+    Returns (x, cov, chi2_post_linear). Mirrors the reference
+    WLSFitter.fit_toas: scale M by 1/σ rows and per-column norms, SVD,
+    zero singular values below threshold·s_max.
+    """
+    w = 1.0 / err_s
+    Mw = M * w[:, None]
+    rw = r * w
+    norm = jnp.sqrt(jnp.sum(Mw * Mw, axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = Mw / norm[None, :]
+    U, s, Vt = jnp.linalg.svd(Mn, full_matrices=False)
+    thresh = (threshold_arg if threshold_arg is not None
+              else jnp.finfo(jnp.float64).eps * max(M.shape))
+    keep = s > thresh * s[0]
+    s_inv = jnp.where(keep, 1.0 / s, 0.0)
+    x_n = Vt.T @ (s_inv * (U.T @ rw))
+    x = x_n / norm
+    cov_n = (Vt.T * (s_inv ** 2)[None, :]) @ Vt
+    cov = cov_n / jnp.outer(norm, norm)
+    resid_post = rw - Mn @ x_n
+    chi2_post = jnp.sum(resid_post ** 2)
+    return x, cov, chi2_post
+
+
+class Fitter:
+    """Base fitter: parameter bookkeeping + the fit_toas contract
+    (reference: Fitter)."""
+
+    def __init__(self, toas, model, residuals=None, track_mode=None):
+        self.toas = toas
+        self.model = model
+        self.track_mode = track_mode
+        self.resids_init = residuals or Residuals(toas, model,
+                                                  track_mode=track_mode)
+        self.resids = self.resids_init
+        self.parameter_covariance_matrix = None
+        self.errors: Dict[str, float] = {}
+        self.converged = False
+
+    @staticmethod
+    def auto(toas, model, downhill=True, **kw):
+        """Pick a fitter from the model contents (reference:
+        Fitter.auto): GLS when correlated-noise components are present,
+        WLS otherwise; downhill wrappers by default."""
+        has_noise = any(
+            getattr(c, "is_basis_noise", False)
+            for c in model.components.values())
+        if has_noise:
+            from pint_tpu.gls import DownhillGLSFitter, GLSFitter
+
+            cls = DownhillGLSFitter if downhill else GLSFitter
+        else:
+            cls = DownhillWLSFitter if downhill else WLSFitter
+        return cls(toas, model, **kw)
+
+    # -- shared plumbing ----------------------------------------------
+
+    def get_fitparams(self) -> List[str]:
+        return self.model.free_params
+
+    def get_designmatrix(self):
+        return self.model.designmatrix(self.toas, incoffset=True)
+
+    def update_model(self, x: np.ndarray, names: List[str]):
+        for name, dx in zip(names, x):
+            if name == "Offset":
+                continue
+            self.model.get_param(name).add_delta(float(dx))
+        self.model.invalidate_cache(params_only=True)
+
+    def set_uncertainties(self, cov: np.ndarray, names: List[str]):
+        self.parameter_covariance_matrix = cov
+        sig = np.sqrt(np.diag(cov))
+        for name, s in zip(names, sig):
+            if name == "Offset":
+                continue
+            self.model.get_param(name).uncertainty = float(s)
+            self.errors[name] = float(s)
+
+    def print_summary(self):
+        print(fit_summary(self))
+
+    def fit_toas(self, maxiter=1, **kw):
+        raise NotImplementedError
+
+
+class WLSFitter(Fitter):
+    """Weighted least squares via jitted SVD (reference: WLSFitter)."""
+
+    def fit_toas(self, maxiter=1, threshold=None):
+        chi2 = None
+        for _ in range(max(1, maxiter)):
+            self.resids = Residuals(self.toas, self.model,
+                                    track_mode=self.track_mode)
+            r = self.resids.time_resids
+            err_s = self.toas.get_errors() * 1e-6
+            M, names, units = self.get_designmatrix()
+            x, cov, _ = _wls_solve(jnp.asarray(M), jnp.asarray(r),
+                                   jnp.asarray(err_s),
+                                   threshold_arg=threshold)
+            # residual here is model-phase excess: r ≈ M·(θ−θ_true), so
+            # the parameter correction is −x
+            x = -np.asarray(x)
+            self.update_model(x, names)
+            self.set_uncertainties(np.asarray(cov), names)
+        self.resids = Residuals(self.toas, self.model,
+                                track_mode=self.track_mode)
+        chi2 = self.resids.chi2
+        self.converged = True
+        return chi2
+
+
+class DownhillWLSFitter(WLSFitter):
+    """Step-halving line-search wrapper (reference: DownhillWLSFitter /
+    DownhillFitter.fit_toas): accept a step only if chi2 improves, else
+    retry with lambda/2; raise after exhausting maxiter."""
+
+    def fit_toas(self, maxiter=20, threshold=None, min_lambda=1e-3,
+                 required_chi2_decrease=1e-2):
+        best_chi2 = Residuals(self.toas, self.model,
+                              track_mode=self.track_mode).chi2
+        converged = False
+        for _ in range(maxiter):
+            self.resids = Residuals(self.toas, self.model,
+                                    track_mode=self.track_mode)
+            r = self.resids.time_resids
+            err_s = self.toas.get_errors() * 1e-6
+            M, names, units = self.get_designmatrix()
+            x, cov, _ = _wls_solve(jnp.asarray(M), jnp.asarray(r),
+                                   jnp.asarray(err_s),
+                                   threshold_arg=threshold)
+            x = -np.asarray(x)  # see WLSFitter: correction is −solution
+            lam = 1.0
+            accepted = False
+            while lam >= min_lambda:
+                self.update_model(lam * x, names)
+                new_chi2 = Residuals(self.toas, self.model,
+                                     track_mode=self.track_mode).chi2
+                if new_chi2 <= best_chi2 + 1e-12:
+                    accepted = True
+                    break
+                self.update_model(-lam * x, names)  # undo
+                lam /= 2.0
+            if not accepted:
+                converged = True  # cannot improve: at the minimum
+                break
+            improved = best_chi2 - new_chi2
+            best_chi2 = new_chi2
+            self.set_uncertainties(np.asarray(cov), names)
+            if improved < required_chi2_decrease:
+                converged = True
+                break
+        else:
+            raise MaxiterReached(
+                f"no convergence in {maxiter} downhill iterations")
+        self.converged = converged
+        self.resids = Residuals(self.toas, self.model,
+                                track_mode=self.track_mode)
+        if self.parameter_covariance_matrix is None:
+            self.set_uncertainties(np.asarray(cov), names)
+        return best_chi2
+
+
+def fit_summary(fitter: Fitter) -> str:
+    """Human-readable post-fit report (reference:
+    Fitter.print_summary)."""
+    m = fitter.model
+    res = fitter.resids
+    lines = [
+        f"Fitted model {m.name or '?'} with {type(fitter).__name__}",
+        f"TOAs: {fitter.toas.ntoas}   free params: "
+        f"{len(m.free_params)}   dof: {res.dof}",
+        f"Post-fit weighted RMS: {res.rms_weighted() * 1e6:.4f} us",
+        f"chi2: {res.chi2:.3f}   reduced chi2: {res.reduced_chi2:.4f}",
+        "",
+        f"{'PARAM':<12} {'VALUE':>24} {'UNCERTAINTY':>14} UNITS",
+    ]
+    for name in m.free_params:
+        p = m.get_param(name)
+        unc = f"{p.uncertainty:.3g}" if p.uncertainty is not None else "-"
+        lines.append(f"{name:<12} {p.value:>24.15g} {unc:>14} {p.units}")
+    return "\n".join(lines)

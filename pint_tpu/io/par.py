@@ -35,21 +35,31 @@ class ParfileLine:
 _COMMENT_PREFIXES = ("#", "C ", "c ")
 
 
-def _iter_lines(source) -> "list[str]":
+def resolve_source(source, kind: str = "par"):
+    """Shared path-vs-literal resolution for par/tim inputs.
+
+    Returns (lines, base_dir) — base_dir is the containing directory for
+    file inputs (INCLUDE resolution), '.' otherwise.
+    """
     import os
 
     if hasattr(source, "read"):
-        return source.read().splitlines()
+        return source.read().splitlines(), "."
     text = str(source)
     if os.path.exists(text):
         with open(text, "r") as f:
-            return f.read().splitlines()
-    # Not an existing file: literal par content. A "KEY value" line always
-    # contains whitespace or a newline; a mistyped path contains neither,
-    # so fail with the clearer file error in that case.
+            return (f.read().splitlines(),
+                    os.path.dirname(os.path.abspath(text)))
+    # Not an existing file: literal content. A data line always contains
+    # whitespace or a newline; a mistyped path contains neither, so fail
+    # with the clearer file error in that case.
     if "\n" in text or " " in text or "\t" in text:
-        return text.splitlines()
-    raise FileNotFoundError(f"no such par file: {text!r}")
+        return text.splitlines(), "."
+    raise FileNotFoundError(f"no such {kind} file: {text!r}")
+
+
+def _iter_lines(source) -> "list[str]":
+    return resolve_source(source, kind="par")[0]
 
 
 def parse_parfile(source: Union[str, io.IOBase]) -> List[ParfileLine]:

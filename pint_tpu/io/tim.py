@@ -60,8 +60,12 @@ def _parse_format1_line(parts: List[str]) -> Optional[TimTOA]:
         tok = parts[i]
         if tok.startswith("-") and not _is_number(tok):
             key = tok[1:]
-            if i + 1 < len(parts):
-                flags[key] = parts[i + 1]
+            nxt = parts[i + 1] if i + 1 < len(parts) else None
+            # a following token that itself looks like a flag means this
+            # flag is value-less
+            if nxt is not None and not (nxt.startswith("-")
+                                        and not _is_number(nxt)):
+                flags[key] = nxt
                 i += 2
             else:
                 flags[key] = ""
@@ -97,18 +101,9 @@ def parse_tim(source, _depth: int = 0) -> List[TimTOA]:
 
     INCLUDE is followed relative to the including file's directory.
     """
-    if hasattr(source, "read"):
-        lines = source.read().splitlines()
-        base_dir = "."
-    else:
-        text = str(source)
-        if "\n" in text:
-            lines = text.splitlines()
-            base_dir = "."
-        else:
-            with open(text, "r") as f:
-                lines = f.read().splitlines()
-            base_dir = os.path.dirname(os.path.abspath(text))
+    from pint_tpu.io.par import resolve_source
+
+    lines, base_dir = resolve_source(source, kind="tim")
 
     toas: List[TimTOA] = []
     skipping = False
@@ -127,6 +122,10 @@ def parse_tim(source, _depth: int = 0) -> List[TimTOA]:
             continue
         parts = stripped.split()
         head = parts[0].upper()
+
+        # inside SKIP...NOSKIP, commands are inert too (only NOSKIP exits)
+        if skipping and head != "NOSKIP":
+            continue
 
         if head in _COMMANDS:
             if head == "SKIP":
@@ -153,9 +152,6 @@ def parse_tim(source, _depth: int = 0) -> List[TimTOA]:
                 if jump_active:
                     jump_count += 1
             # FORMAT/MODE/PHASE/TRACK/INFO: recorded implicitly or ignored
-            continue
-
-        if skipping:
             continue
 
         toa = _parse_format1_line(parts)

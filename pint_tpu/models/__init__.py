@@ -1,0 +1,36 @@
+"""Timing-model layer (reference: src/pint/models/__init__.py).
+
+Importing this package registers the core component zoo and exposes the
+builder entry points.
+"""
+
+from pint_tpu.models.timing_model import (  # noqa: F401
+    Component,
+    DelayComponent,
+    PhaseComponent,
+    TimingModel,
+    component_types,
+)
+from pint_tpu.models import absolute_phase  # noqa: F401
+from pint_tpu.models import astrometry  # noqa: F401
+from pint_tpu.models import dispersion  # noqa: F401
+from pint_tpu.models import jump  # noqa: F401
+from pint_tpu.models import phase_offset  # noqa: F401
+from pint_tpu.models import solar_system_shapiro  # noqa: F401
+from pint_tpu.models import spindown  # noqa: F401
+from pint_tpu.models.model_builder import (  # noqa: F401
+    ModelBuilder,
+    get_model,
+    get_model_and_toas,
+)
+
+__all__ = [
+    "Component",
+    "DelayComponent",
+    "PhaseComponent",
+    "TimingModel",
+    "component_types",
+    "ModelBuilder",
+    "get_model",
+    "get_model_and_toas",
+]

@@ -1,0 +1,40 @@
+"""Absolute phase anchor: TZRMJD/TZRSITE/TZRFRQ.
+
+Reference: src/pint/models/absolute_phase.py (AbsPhase): a one-TOA
+internal TOAs set at the TZR point defines phase zero; TimingModel
+subtracts phase(TZR) from every phase when abs_phase=True. The TZR
+mini-batch itself is built host-side in TimingModel._make_tzr_toas and
+lives in the evaluation cache — this component only declares the
+parameters.
+"""
+
+from __future__ import annotations
+
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    strParameter,
+)
+from pint_tpu.models.timing_model import PhaseComponent
+from pint_tpu.ops.dd import DD
+import jax.numpy as jnp
+
+
+class AbsPhase(PhaseComponent):
+    category = "phase_offset"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter(
+            "TZRMJD", description="zero-phase reference TOA"))
+        self.add_param(strParameter("TZRSITE", value="ssb"))
+        self.add_param(floatParameter("TZRFRQ", units="MHz", value=None,
+                                      frozen=True))
+
+    def validate(self):
+        if self.TZRMJD.value is None:
+            raise ValueError("AbsPhase requires TZRMJD")
+
+    def phase(self, pv, batch, cache, ctx, tb):
+        z = jnp.zeros_like(batch.freq_mhz)
+        return DD(z, z)

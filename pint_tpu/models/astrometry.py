@@ -1,0 +1,166 @@
+"""Astrometry: sky position + proper motion + parallax → Roemer delay.
+
+Reference: src/pint/models/astrometry.py (Astrometry,
+AstrometryEquatorial, AstrometryEcliptic, solar_system_geometric_delay,
+ssb_to_psb_xyz_ICRS). All delays here are ≤ ~500 s needing ns accuracy →
+plain f64 on device (relative 2e-12 << f64 eps headroom); only time and
+phase need dd.
+
+Internal angle unit is radians (par I/O converts sexagesimal); proper
+motions are mas/yr, parallax mas — par-file units, so design-matrix
+columns are per-par-unit like the reference's.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import pc_m, c_m_s
+from pint_tpu.models.parameter import (
+    AngleParameter,
+    MJDParameter,
+    floatParameter,
+)
+from pint_tpu.models.timing_model import DelayComponent
+from pint_tpu.ops.dd import dd_to_f64
+from pint_tpu.time.frames import icrs_to_ecliptic_matrix
+
+MAS_YR_TO_RAD_S = (np.pi / 180.0 / 3600.0 / 1000.0) / (365.25 * 86400.0)
+MAS_TO_RAD = np.pi / 180.0 / 3600.0 / 1000.0
+PC_LS = pc_m / c_m_s  # parsec in light-seconds
+
+
+class Astrometry(DelayComponent):
+    category = "astrometry"
+    register = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter(
+            "POSEPOCH", description="epoch of position/proper motion"))
+        self.add_param(floatParameter("PX", units="mas", value=0.0,
+                                      description="parallax"))
+
+    def _tdb_mjd_f64(self, batch):
+        return batch.tdb_day + dd_to_f64(batch.tdb_frac)
+
+    def _dt_yr(self, pv, batch):
+        """Years since POSEPOCH (f64 — PM terms are tiny)."""
+        pos_mjd = pv["POSEPOCH"].hi + pv["POSEPOCH"].lo \
+            if "POSEPOCH" in pv else self._parent.ref_day
+        return (self._tdb_mjd_f64(batch) - pos_mjd) / 365.25
+
+    def psr_dir(self, pv, batch):
+        """Unit vector SSB→pulsar, ICRS, per TOA (N,3)."""
+        raise NotImplementedError
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        n = self.psr_dir(pv, batch)
+        ctx["psr_dir"] = n
+        r = batch.ssb_obs_pos  # lt-s
+        rdotn = jnp.sum(r * n, axis=-1)
+        # barycentric observing frequency for downstream dispersion
+        vdotn = jnp.sum(batch.ssb_obs_vel * n, axis=-1)  # v/c
+        ctx["bfreq"] = batch.freq_mhz * (1.0 - vdotn)
+        roemer = -rdotn
+        px = pv["PX"].hi if "PX" in pv else 0.0
+        pxr = jnp.where(jnp.asarray(px) != 0.0,
+                        self._parallax_delay(r, rdotn, px), 0.0)
+        return roemer + pxr
+
+    def _parallax_delay(self, r, rdotn, px_mas):
+        # Δ_px = (|r|² − (r·n̂)²) / (2 d)  [lt-s units] — reference:
+        # Astrometry.solar_system_geometric_delay parallax term
+        d_ls = PC_LS / (px_mas * 1e-3 + 1e-30)  # mas → arcsec → pc
+        r2 = jnp.sum(r * r, axis=-1)
+        return (r2 - rdotn ** 2) / (2.0 * d_ls)
+
+
+class AstrometryEquatorial(Astrometry):
+    """RAJ/DECJ/PMRA/PMDEC (reference: AstrometryEquatorial)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(AngleParameter("RAJ", units="H:M:S",
+                                      aliases=["RA"]))
+        self.add_param(AngleParameter("DECJ", units="D:M:S",
+                                      aliases=["DEC"]))
+        self.add_param(floatParameter("PMRA", units="mas/yr", value=0.0,
+                                      description="mu_alpha*cos(dec)"))
+        self.add_param(floatParameter("PMDEC", units="mas/yr", value=0.0))
+
+    def validate(self):
+        if self.RAJ.value is None or self.DECJ.value is None:
+            raise ValueError("AstrometryEquatorial requires RAJ and DECJ")
+
+    def psr_dir(self, pv, batch):
+        a0 = pv["RAJ"].hi + pv["RAJ"].lo
+        d0 = pv["DECJ"].hi + pv["DECJ"].lo
+        dt_yr = self._dt_yr(pv, batch)
+        pmra = pv.get("PMRA")
+        pmdec = pv.get("PMDEC")
+        mu_a = (pmra.hi if pmra is not None else 0.0) * MAS_TO_RAD
+        mu_d = (pmdec.hi if pmdec is not None else 0.0) * MAS_TO_RAD
+        cosd, sind = jnp.cos(d0), jnp.sin(d0)
+        # PMRA is mu_alpha* (includes cos dec): alpha advances by
+        # mu_a dt / cos(dec)
+        a = a0 + mu_a * dt_yr / cosd
+        d = d0 + mu_d * dt_yr
+        ca, sa = jnp.cos(a), jnp.sin(a)
+        cd, sd = jnp.cos(d), jnp.sin(d)
+        return jnp.stack([cd * ca, cd * sa, sd], axis=-1)
+
+
+class AstrometryEcliptic(Astrometry):
+    """ELONG/ELAT/PMELONG/PMELAT in the IAU-obliquity ecliptic frame
+    (reference: AstrometryEcliptic + pulsar_ecliptic.py)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(AngleParameter("ELONG", units="deg",
+                                      aliases=["LAMBDA"]))
+        self.add_param(AngleParameter("ELAT", units="deg",
+                                      aliases=["BETA"]))
+        self.add_param(floatParameter("PMELONG", units="mas/yr", value=0.0,
+                                      aliases=["PMLAMBDA"]))
+        self.add_param(floatParameter("PMELAT", units="mas/yr", value=0.0,
+                                      aliases=["PMBETA"]))
+        from pint_tpu.models.parameter import strParameter
+
+        self.add_param(strParameter("ECL", value="IERS2010"))
+
+    _OBLIQUITY = {  # arcsec (reference: src/pint/data/runtime/ecliptic.dat)
+        "IERS2010": 84381.406,
+        "IERS2003": 84381.4059,
+        "IAU1976": 84381.448,
+        "IAU1980": 84381.448,
+    }
+
+    def validate(self):
+        if self.ELONG.value is None or self.ELAT.value is None:
+            raise ValueError("AstrometryEcliptic requires ELONG and ELAT")
+
+    def _ecl_matrix(self):
+        obl = self._OBLIQUITY.get(
+            (self.ECL.value or "IERS2010").upper(), 84381.406)
+        # ecliptic ← ICRS; we need its transpose to go ecliptic → ICRS
+        return icrs_to_ecliptic_matrix(obl).T
+
+    def psr_dir(self, pv, batch):
+        l0 = pv["ELONG"].hi + pv["ELONG"].lo
+        b0 = pv["ELAT"].hi + pv["ELAT"].lo
+        dt_yr = self._dt_yr(pv, batch)
+        mu_l = pv["PMELONG"].hi * MAS_TO_RAD if "PMELONG" in pv else 0.0
+        mu_b = pv["PMELAT"].hi * MAS_TO_RAD if "PMELAT" in pv else 0.0
+        cosb = jnp.cos(b0)
+        lam = l0 + mu_l * dt_yr / cosb
+        bet = b0 + mu_b * dt_yr
+        cl, sl = jnp.cos(lam), jnp.sin(lam)
+        cb, sb = jnp.cos(bet), jnp.sin(bet)
+        n_ecl = jnp.stack([cb * cl, cb * sl, sb], axis=-1)
+        return n_ecl @ jnp.asarray(self._ecl_matrix()).T

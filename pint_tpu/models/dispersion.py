@@ -1,0 +1,193 @@
+"""Dispersion delays: DM Taylor series, DMX piecewise windows, DMJUMP.
+
+Reference: src/pint/models/dispersion_model.py (Dispersion, DispersionDM,
+DispersionDMX, DispersionJump). Delay = DMconst · DM(t) / ν² with ν the
+Doppler-corrected barycentric frequency (ctx["bfreq"] from astrometry).
+
+DMX windows become a host-precomputed (N,) int window-index array plus
+per-window mask columns only where needed: the delay is a dense
+mask·value contraction — a single (N,k)×(k,) matmul on device, MXU-
+friendly, replacing the reference's per-window TOASelect loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu import DMconst
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    maskParameter,
+    prefixParameter,
+    split_prefixed_name,
+)
+from pint_tpu.models.timing_model import DelayComponent
+from pint_tpu.ops.taylor import taylor_horner
+from pint_tpu.ops.dd import dd_to_f64
+
+
+class Dispersion(DelayComponent):
+    category = "dispersion"
+    register = False
+
+    def _bfreq(self, batch, ctx):
+        return ctx.get("bfreq", batch.freq_mhz)
+
+
+class DispersionDM(Dispersion):
+    """DM + DM1·dt + DM2·dt²/2... around DMEPOCH (reference:
+    DispersionDM)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("DM", units="pc cm^-3", value=0.0))
+        self.add_param(floatParameter("DM1", units="pc cm^-3 / yr^1",
+                                      value=None))
+        self.add_param(MJDParameter("DMEPOCH"))
+
+    def dm_terms(self):
+        out = ["DM"]
+        if self.DM1.value is not None:
+            out.append("DM1")
+        extras = []
+        for name in self.params:
+            if name.startswith("DM") and name not in (
+                    "DM", "DM1", "DMEPOCH") and name[2:].isdigit():
+                extras.append((int(name[2:]), name))
+        out.extend(nm for _, nm in sorted(extras))
+        return out
+
+    def add_dm_term(self, index, value=0.0, frozen=True, uncertainty=None):
+        p = prefixParameter(prefix="DM", index=index, value=value,
+                            units=f"pc cm^-3 / yr^{index}", frozen=frozen,
+                            uncertainty=uncertainty)
+        self.add_param(p)
+        return p
+
+    def dm_value(self, pv, batch):
+        """DM at each TOA [pc/cm3]. Taylor rates are per *second* in the
+        reference (DM1 in pc cm^-3 / s? — upstream uses per-year par
+        convention converted to sec); we keep par-file per-year units and
+        convert here."""
+        terms = self.dm_terms()
+        dm0 = pv["DM"].hi + pv["DM"].lo
+        if len(terms) == 1:
+            return dm0 * jnp.ones_like(batch.freq_mhz)
+        dmep = pv["DMEPOCH"].hi + pv["DMEPOCH"].lo if "DMEPOCH" in pv \
+            else self._parent.ref_day
+        tdb = batch.tdb_day + dd_to_f64(batch.tdb_frac)
+        dt_yr = (tdb - dmep) / 365.25
+        coeffs = [pv[nm].hi + pv[nm].lo for nm in terms]
+        return taylor_horner(dt_yr, coeffs)
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        bf = self._bfreq(batch, ctx)
+        dm = self.dm_value(pv, batch)
+        ctx["dm"] = dm
+        return DMconst * dm / (bf * bf)
+
+
+class DispersionDMX(Dispersion):
+    """Piecewise-constant ΔDM over MJD windows: DMX_0001/DMXR1_/DMXR2_
+    (reference: DispersionDMX + TOASelect masks)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("DMX", units="pc cm^-3", value=0.0,
+                                      description="legacy header value"))
+        self.dmx_ids: list = []  # index ints, sorted at setup
+
+    def add_dmx_range(self, index, mjd_start, mjd_end, value=0.0,
+                      frozen=True, index_str=None):
+        istr = index_str or f"{index:04d}"
+        self.add_param(prefixParameter(prefix="DMX_", index=index,
+                                       index_str=istr, value=value,
+                                       units="pc cm^-3", frozen=frozen))
+        self.add_param(prefixParameter(prefix="DMXR1_", index=index,
+                                       index_str=istr, value=mjd_start,
+                                       units="MJD"))
+        self.add_param(prefixParameter(prefix="DMXR2_", index=index,
+                                       index_str=istr, value=mjd_end,
+                                       units="MJD"))
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("DMX_"):
+                _, istr, idx = split_prefixed_name(name)
+                ids.append((idx, istr))
+        self.dmx_ids = sorted(ids)
+
+    def validate(self):
+        for idx, istr in self.dmx_ids:
+            for pre in ("DMXR1_", "DMXR2_"):
+                if f"{pre}{istr}" not in self.params:
+                    raise ValueError(f"DMX_{istr} missing {pre}{istr}")
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        """(N, k) window mask matrix, host-precomputed (static ranges —
+        DMXR bounds are not fittable, as in the reference)."""
+        if not self.dmx_ids:
+            return
+        mjd = toas.get_mjds()
+        cols = []
+        for idx, istr in self.dmx_ids:
+            r1 = self.params[f"DMXR1_{istr}"].value
+            r2 = self.params[f"DMXR2_{istr}"].value
+            cols.append(((mjd >= r1) & (mjd <= r2)).astype(np.float64))
+        cache["dmx_masks"] = np.stack(cols, axis=-1)
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.dmx_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        bf = self._bfreq(batch, ctx)
+        vals = jnp.stack(
+            [pv[f"DMX_{istr}"].hi + pv[f"DMX_{istr}"].lo
+             for _, istr in self.dmx_ids])
+        ddm = cache["dmx_masks"] @ vals  # (N,k)@(k,) one fused matmul
+        return DMconst * ddm / (bf * bf)
+
+
+class DispersionJump(Dispersion):
+    """DMJUMP: per-system constant DM offset applied to wideband DM
+    measurements only — zero TOA delay (reference: DispersionJump;
+    sign/semantics: subtracted from the measured DM channel)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.dmjumps: list = []
+
+    def add_dmjump(self, index, key, key_value, value=0.0, frozen=True):
+        p = maskParameter("DMJUMP", index=index, key=key,
+                          key_value=key_value, value=value, frozen=frozen,
+                          units="pc cm^-3")
+        self.add_param(p)
+        self.dmjumps.append(p.name)
+        return p
+
+    def setup(self):
+        self.dmjumps = [n for n in self.params if n.startswith("DMJUMP")]
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        for name in self.dmjumps:
+            cache[f"mask_{name}"] = self.params[name].select_mask(
+                toas).astype(np.float64)
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        return jnp.zeros_like(batch.freq_mhz)
+
+    def dm_jump_values(self, pv, cache):
+        """Σ DMJUMPi·maski (N,) — consumed by wideband DM residuals."""
+        out = None
+        for name in self.dmjumps:
+            v = (pv[name].hi + pv[name].lo) * cache[f"mask_{name}"]
+            out = v if out is None else out + v
+        return out

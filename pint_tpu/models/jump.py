@@ -1,0 +1,56 @@
+"""Phase jumps: per-TOA-subset constant offsets (JUMP mask parameters).
+
+Reference: src/pint/models/jump.py (PhaseJump). JUMP values are in
+seconds; the phase contribution is −JUMP·F0 on the selected TOAs
+(matching the reference's jump_phase sign convention: a positive JUMP
+makes the selected TOAs arrive "later").
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.parameter import maskParameter
+from pint_tpu.models.timing_model import PhaseComponent
+from pint_tpu.ops.dd import DD
+
+
+class PhaseJump(PhaseComponent):
+    category = "phase_jump"
+
+    def __init__(self):
+        super().__init__()
+        self.jumps: list = []
+
+    def add_jump(self, index=None, key=None, key_value=(), value=0.0,
+                 frozen=True, uncertainty=None):
+        index = index if index is not None else len(self.jumps) + 1
+        p = maskParameter("JUMP", index=index, key=key,
+                          key_value=key_value, value=value, frozen=frozen,
+                          uncertainty=uncertainty, units="s")
+        self.add_param(p)
+        self.jumps.append(p.name)
+        return p
+
+    def setup(self):
+        self.jumps = sorted(
+            (n for n in self.params if n.startswith("JUMP")),
+            key=lambda n: self.params[n].index)
+
+    def get_jump_param_objects(self):
+        return [self.params[n] for n in self.jumps]
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        for name in self.jumps:
+            cache[f"mask_{name}"] = self.params[name].select_mask(
+                toas).astype(np.float64)
+
+    def phase(self, pv, batch, cache, ctx, tb):
+        total = jnp.zeros_like(batch.freq_mhz)
+        f0 = pv["F0"].hi + pv["F0"].lo
+        for name in self.jumps:
+            total = total + (pv[name].hi + pv[name].lo) * \
+                cache[f"mask_{name}"]
+        ph = -total * f0
+        return DD(ph, jnp.zeros_like(ph))

@@ -1,0 +1,259 @@
+"""Model builder: .par file → TimingModel.
+
+Reference: src/pint/models/model_builder.py (ModelBuilder, get_model,
+get_model_and_toas, parse_parfile). Routing: each registered Component
+class contributes its parameter names/aliases to an index; prefixed
+families (F2.., DMX_0001, GL*_n) and mask families (JUMP, EFAC...) are
+recognized by pattern and materialized on their owning component.
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+from typing import Dict, List, Optional
+
+from pint_tpu.io.par import ParfileLine, parse_parfile
+from pint_tpu.models.parameter import (
+    maskParameter,
+    prefixParameter,
+    split_prefixed_name,
+)
+from pint_tpu.models.timing_model import (
+    Component,
+    MiscParams,
+    TimingModel,
+    component_types,
+)
+
+# components always present (reference: ModelBuilder default components)
+DEFAULT_COMPONENTS = ["Spindown"]
+
+# par key → (component class name, method) for prefix families
+_F_RE = re.compile(r"^F(\d+)$")
+_DM_RE = re.compile(r"^DM(\d+)$")
+_DMX_RE = re.compile(r"^(DMX_|DMXR1_|DMXR2_)(\d+)$")
+
+# mask-parameter families → owning component class (extended as the
+# component zoo grows; reference: maskParameter registry)
+MASK_FAMILIES: Dict[str, str] = {
+    "JUMP": "PhaseJump",
+    "DMJUMP": "DispersionJump",
+    "EFAC": "ScaleToaError",
+    "T2EFAC": "ScaleToaError",
+    "EQUAD": "ScaleToaError",
+    "T2EQUAD": "ScaleToaError",
+    "TNEQ": "ScaleToaError",
+    "ECORR": "EcorrNoise",
+    "TNECORR": "EcorrNoise",
+    "DMEFAC": "ScaleDmError",
+    "DMEQUAD": "ScaleDmError",
+    "FDJUMP": "FDJump",
+}
+# canonical mask param name per alias
+MASK_CANONICAL = {"T2EFAC": "EFAC", "T2EQUAD": "EQUAD", "TNECORR": "ECORR"}
+
+BINARY_COMPONENT_PREFIX = "Binary"
+
+
+def _build_param_index():
+    """name/alias → component class name, from registry templates."""
+    idx: Dict[str, str] = {}
+    for cls_name, cls in component_types.items():
+        try:
+            tmpl = cls()
+        except Exception:
+            continue
+        for pname, p in tmpl.params.items():
+            idx.setdefault(pname, cls_name)
+            for a in p.aliases:
+                idx.setdefault(a, cls_name)
+    return idx
+
+
+class UnknownParameterWarning(UserWarning):
+    pass
+
+
+class ModelBuilder:
+    """One-shot builder; call with parsed par lines."""
+
+    def __init__(self):
+        # importing the component modules populates the registry
+        import pint_tpu.models.absolute_phase  # noqa: F401
+        import pint_tpu.models.astrometry  # noqa: F401
+        import pint_tpu.models.dispersion  # noqa: F401
+        import pint_tpu.models.jump  # noqa: F401
+        import pint_tpu.models.phase_offset  # noqa: F401
+        import pint_tpu.models.solar_system_shapiro  # noqa: F401
+        import pint_tpu.models.spindown  # noqa: F401
+        try:  # optional layers, registered when present
+            import pint_tpu.models.noise  # noqa: F401
+        except ImportError:
+            pass
+        try:
+            import pint_tpu.models.binary  # noqa: F401
+        except ImportError:
+            pass
+        try:
+            import pint_tpu.models.components_extra  # noqa: F401
+        except ImportError:
+            pass
+        self.param_index = _build_param_index()
+
+    def __call__(self, lines: List[ParfileLine], name="") -> TimingModel:
+        comps: Dict[str, Component] = {}
+        unknown: List[str] = []
+        binary_name: Optional[str] = None
+        mask_counters: Dict[str, int] = {}
+
+        def get_comp(cls_name: str) -> Component:
+            if cls_name not in comps:
+                comps[cls_name] = component_types[cls_name]()
+            return comps[cls_name]
+
+        for cls_name in DEFAULT_COMPONENTS:
+            get_comp(cls_name)
+
+        for ln in lines:
+            key, toks = ln.key, ln.tokens
+            if key == "BINARY":
+                binary_name = toks[0]
+                cls_name = BINARY_COMPONENT_PREFIX + binary_name.upper()
+                if cls_name not in component_types:
+                    raise NotImplementedError(
+                        f"binary model {binary_name!r} is not implemented "
+                        f"(known: {sorted(c for c in component_types if c.startswith('Binary'))})")
+                get_comp(cls_name)
+                continue
+            if key == "UNITS":
+                if toks and toks[0].upper() == "TCB":
+                    raise ValueError(
+                        "UNITS TCB par files are not supported — convert "
+                        "with tcb2tdb first (reference behavior: "
+                        "explicit refusal unless allow_tcb)")
+                get_comp("MiscParams").UNITS.value = toks[0] if toks else "TDB"
+                continue
+
+            # 1. exact/alias match against the registry index
+            cls_name = self.param_index.get(key)
+            if cls_name is not None:
+                comp = get_comp(cls_name)
+                p = _param_by_name_or_alias(comp, key)
+                p.from_tokens(toks)
+                continue
+
+            # 2. prefix families
+            m = _F_RE.match(key)
+            if m:
+                comp = get_comp("Spindown")
+                p = comp.add_f_term(int(m.group(1)))
+                p.from_tokens(toks)
+                continue
+            m = _DM_RE.match(key)
+            if m:
+                comp = get_comp("DispersionDM")
+                p = comp.add_dm_term(int(m.group(1)))
+                p.from_tokens(toks)
+                continue
+            m = _DMX_RE.match(key)
+            if m:
+                comp = get_comp("DispersionDMX")
+                p = prefixParameter(name=key, units="pc cm^-3"
+                                    if m.group(1) == "DMX_" else "MJD")
+                comp.add_param(p)
+                p.from_tokens(toks)
+                continue
+
+            # 3. mask families (one param instance per line)
+            if key in MASK_FAMILIES:
+                cls_name = MASK_FAMILIES[key]
+                if cls_name not in component_types:
+                    unknown.append(key)
+                    continue
+                comp = get_comp(cls_name)
+                canonical = MASK_CANONICAL.get(key, key)
+                mask_counters[canonical] = mask_counters.get(canonical, 0) + 1
+                p = maskParameter(canonical, index=mask_counters[canonical])
+                comp.add_param(p)
+                p.from_tokens(toks)
+                continue
+
+            # 4. generic prefixed names owned by an existing family
+            #    (GLF0_1, WAVE1 ... routed once those components exist)
+            try:
+                prefix, _, _ = split_prefixed_name(key)
+                owner = self.param_index.get(prefix.rstrip("_")) or \
+                    self.param_index.get(prefix)
+                if owner:
+                    comp = get_comp(owner)
+                    p = prefixParameter(name=key)
+                    comp.add_param(p)
+                    p.from_tokens(toks)
+                    continue
+            except ValueError:
+                pass
+
+            unknown.append(key)
+
+        # Shared astrometry params (PX/POSEPOCH) index to the equatorial
+        # template; if the par is actually ecliptic, migrate them.
+        if "AstrometryEquatorial" in comps and "AstrometryEcliptic" in comps:
+            eq, ec = comps["AstrometryEquatorial"], comps["AstrometryEcliptic"]
+            if eq.RAJ.value is None and ec.ELONG.value is not None:
+                for nm in ("PX", "POSEPOCH"):
+                    if eq.params[nm].value is not None:
+                        ec.params[nm] = eq.params[nm]
+                del comps["AstrometryEquatorial"]
+            elif ec.ELONG.value is None and eq.RAJ.value is not None:
+                for nm in ("PX", "POSEPOCH"):
+                    if ec.params[nm].value is not None:
+                        eq.params[nm] = ec.params[nm]
+                del comps["AstrometryEcliptic"]
+
+        # implied components (reference: ModelBuilder._get_components)
+        if any(c in comps for c in ("AstrometryEquatorial",
+                                    "AstrometryEcliptic")):
+            get_comp("SolarSystemShapiro")
+
+        model = TimingModel(list(comps.values()), name=name)
+        if binary_name:
+            model.BINARY = binary_name
+        if unknown:
+            warnings.warn(
+                f"ignoring unrecognized par parameters: {sorted(set(unknown))}",
+                UnknownParameterWarning, stacklevel=2)
+        model.unknown_params = sorted(set(unknown))
+        for c in model.components.values():
+            c.setup()
+        model.validate()
+        return model
+
+
+def _param_by_name_or_alias(comp: Component, key: str):
+    if key in comp.params:
+        return comp.params[key]
+    for p in comp.params.values():
+        if key in p.aliases:
+            return p
+    raise KeyError(key)
+
+
+def get_model(parfile, name="") -> TimingModel:
+    """Build a TimingModel from a par file path/handle/string
+    (reference: get_model)."""
+    lines = parse_parfile(parfile)
+    model = ModelBuilder()(lines, name=name)
+    psr = model.PSR.value
+    if psr and not model.name:
+        model.name = psr
+    return model
+
+
+def get_model_and_toas(parfile, timfile, **kw):
+    """(model, toas) in one call (reference: get_model_and_toas)."""
+    from pint_tpu.toa import get_TOAs
+
+    model = get_model(parfile)
+    toas = get_TOAs(timfile, model=model, **kw)
+    return model, toas

@@ -1,0 +1,27 @@
+"""Explicit fittable overall phase offset PHOFF.
+
+Reference: src/pint/models/phase_offset.py (PhaseOffset) — replaces the
+implicit "Offset" design-matrix column when present; residual phase gets
+−PHOFF (turns).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pint_tpu.models.parameter import floatParameter
+from pint_tpu.models.timing_model import PhaseComponent
+from pint_tpu.ops.dd import DD
+
+
+class PhaseOffset(PhaseComponent):
+    category = "phase_offset"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("PHOFF", units="turn", value=0.0))
+
+    def phase(self, pv, batch, cache, ctx, tb):
+        off = -(pv["PHOFF"].hi + pv["PHOFF"].lo)
+        ph = off * jnp.ones_like(batch.freq_mhz)
+        return DD(ph, jnp.zeros_like(ph))

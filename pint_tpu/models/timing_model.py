@@ -1,0 +1,556 @@
+"""TimingModel core: Component registry + the delay/phase/designmatrix
+engine.
+
+Reference: src/pint/models/timing_model.py (TimingModel, Component,
+ModelMeta registry, DelayComponent/PhaseComponent,
+TimingModel.delay/phase/designmatrix/d_phase_d_param).
+
+TPU-first architecture (SURVEY.md §7): host Python owns parameters,
+registries and orchestration; the delay/phase stack compiles to ONE pure
+jitted function over
+
+    (theta_hi, theta_lo, frozen_hi, frozen_lo, batch: ToaBatch,
+     cache: dict[str, array])
+
+where theta is the free-parameter vector (double-double as two f64
+vectors so F0-class parameters keep 31 digits while staying traceable —
+no retrace on value updates) and ``cache`` holds host-precomputed
+per-TOA arrays (mask vectors, the TZR mini-batch...). The design matrix
+is ``jax.jacfwd`` of that function over theta_hi: the dd ops carry
+custom JVPs with plain-f64 tangents, so derivatives cost f64 math while
+values keep dd precision (the reference instead hand-registers
+d_phase_d_param functions per component).
+
+Component delay/phase methods are pure: they read parameter values only
+from the traced ``pv`` dict and per-TOA data only from batch/cache/ctx.
+``ctx`` is a per-evaluation scratch dict letting earlier components pass
+geometry downstream (pulsar direction, barycentric frequency) — the
+moral equivalent of the reference's cross-component attribute reaches.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    Parameter,
+    boolParameter,
+    intParameter,
+    maskParameter,
+    strParameter,
+)
+from pint_tpu.ops.dd import DD, dd_add, dd_mul_f, dd_sub_f, dd_to_f64
+from pint_tpu.phase import Phase
+
+SECS_PER_DAY = 86400.0
+
+# Registry: class name → Component subclass (reference: ModelMeta /
+# Component.component_types).
+component_types: Dict[str, type] = {}
+
+# Fixed evaluation order of delay categories (reference:
+# TimingModel.DEFAULT_ORDER / SURVEY.md §3.2) then phase categories.
+DELAY_CATEGORY_ORDER = [
+    "astrometry",
+    "solar_system_shapiro",
+    "troposphere",
+    "solar_wind",
+    "dispersion",
+    "frequency_dependent",
+    "pulsar_system",  # binary
+]
+PHASE_CATEGORY_ORDER = [
+    "spindown",
+    "glitch",
+    "wave",
+    "ifunc",
+    "phase_jump",
+    "phase_offset",
+]
+
+
+class Component:
+    """Base model component: a bag of Parameters plus pure device fns."""
+
+    category = "misc"
+    register = True
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.__dict__.get("register", True) and not cls.__name__.startswith("_"):
+            component_types[cls.__name__] = cls
+
+    def __init__(self):
+        self.params: Dict[str, Parameter] = {}
+        self._parent: Optional["TimingModel"] = None
+
+    def add_param(self, p: Parameter) -> Parameter:
+        self.params[p.name] = p
+        return p
+
+    def remove_param(self, name: str):
+        del self.params[name]
+
+    def __getattr__(self, name):
+        params = self.__dict__.get("params")
+        if params and name in params:
+            return params[name]
+        raise AttributeError(
+            f"{type(self).__name__} has no attribute/param {name!r}")
+
+    # -- lifecycle hooks (host) ---------------------------------------
+
+    def setup(self):
+        """Called after par parsing: materialize prefix/mask families."""
+
+    def validate(self):
+        """Raise on missing/contradictory parameters."""
+
+    def prepare(self, toas, batch, cache: dict, prefix: str = ""):
+        """Host precompute into `cache` (masks etc.) for this batch.
+        Keys must be namespaced `f"{prefix}{self.__class__.__name__}_*"`
+        or param-specific; values must be arrays (pytree leaves)."""
+
+    # -- conveniences --------------------------------------------------
+
+    @property
+    def param_names(self) -> List[str]:
+        return list(self.params)
+
+    def mask_params_of(self, prefix: str) -> List[maskParameter]:
+        return [p for p in self.params.values()
+                if isinstance(p, maskParameter) and p.prefix == prefix]
+
+
+class DelayComponent(Component):
+    category = "delay"
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        """Return this component's delay [seconds] as f64 (N,). `pv` maps
+        param name → DD scalar; `delay_so_far` is the accumulated f64
+        delay of earlier categories (binary models need it)."""
+        raise NotImplementedError
+
+
+class PhaseComponent(Component):
+    category = "phase"
+
+    def phase(self, pv, batch, cache, ctx, tb: DD) -> DD:
+        """Return this component's phase [turns] as DD (N,). `tb` is
+        barycentric time as DD seconds since the model's ref epoch."""
+        raise NotImplementedError
+
+
+class MiscParams(Component):
+    """Header/control parameters that drive no physics directly
+    (reference: these live on TimingModel itself)."""
+
+    category = "misc"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(strParameter("PSR", description="pulsar name",
+                                    aliases=["PSRJ", "PSRB"]))
+        self.add_param(strParameter("EPHEM", description="ephemeris name"))
+        self.add_param(strParameter("CLK", description="clock realization"))
+        self.add_param(strParameter("UNITS", value="TDB"))
+        self.add_param(strParameter("TIMEEPH"))
+        self.add_param(strParameter("T2CMETHOD"))
+        self.add_param(strParameter("DILATEFREQ"))
+        self.add_param(boolParameter("PLANET_SHAPIRO", value=False))
+        self.add_param(MJDParameter("START"))
+        self.add_param(MJDParameter("FINISH"))
+        self.add_param(intParameter("NTOA"))
+        self.add_param(floatParam("CHI2", units=""))
+        self.add_param(floatParam("TRES", units="us"))
+        self.add_param(strParameter("INFO"))
+        self.add_param(strParameter("MODE"))
+
+
+def floatParam(name, **kw):
+    from pint_tpu.models.parameter import floatParameter
+
+    return floatParameter(name, **kw)
+
+
+def _category_rank(comp: Component) -> int:
+    cats = DELAY_CATEGORY_ORDER + PHASE_CATEGORY_ORDER
+    try:
+        return cats.index(comp.category)
+    except ValueError:
+        return len(cats)
+
+
+class TimingModel:
+    """Ordered component container + compiled evaluation engine."""
+
+    def __init__(self, components: Optional[List[Component]] = None,
+                 name: str = ""):
+        self.name = name
+        self.components: Dict[str, Component] = {}
+        if not any(isinstance(c, MiscParams) for c in components or []):
+            self.add_component(MiscParams())
+        for c in components or []:
+            self.add_component(c)
+        self._cache_key = None
+        self._cache = None
+        self._jit_phase = None
+        self._cache_key_params = None
+
+    # ---------------- component / parameter plumbing -----------------
+
+    def add_component(self, comp: Component, setup=True):
+        comp._parent = self
+        self.components[type(comp).__name__] = comp
+        if setup:
+            comp.setup()
+        self.invalidate_cache()
+
+    def remove_component(self, name: str):
+        del self.components[name]
+        self.invalidate_cache()
+
+    @property
+    def delay_components(self) -> List[DelayComponent]:
+        out = [c for c in self.components.values()
+               if isinstance(c, DelayComponent)]
+        return sorted(out, key=_category_rank)
+
+    @property
+    def phase_components(self) -> List[PhaseComponent]:
+        out = [c for c in self.components.values()
+               if isinstance(c, PhaseComponent)]
+        return sorted(out, key=_category_rank)
+
+    @property
+    def params(self) -> List[str]:
+        out = []
+        for c in self.components.values():
+            out.extend(c.params)
+        return out
+
+    @property
+    def free_params(self) -> List[str]:
+        out = []
+        for c in self._ordered_components():
+            for p in c.params.values():
+                if not p.frozen and p.value is not None:
+                    out.append(p.name)
+        return out
+
+    def _ordered_components(self):
+        return sorted(self.components.values(), key=_category_rank)
+
+    def get_param(self, name: str) -> Parameter:
+        for c in self.components.values():
+            if name in c.params:
+                return c.params[name]
+            for p in c.params.values():
+                if name in p.aliases:
+                    return p
+        raise KeyError(f"model has no parameter {name!r}")
+
+    def __getattr__(self, name):
+        if name.startswith("_") or name in ("components",):
+            raise AttributeError(name)
+        comps = self.__dict__.get("components") or {}
+        for c in comps.values():
+            if name in c.params:
+                return c.params[name]
+        for c in comps.values():
+            for p in c.params.values():
+                if name in p.aliases:
+                    return p
+        raise AttributeError(f"model has no parameter {name!r}")
+
+    def __contains__(self, name):
+        try:
+            self.get_param(name)
+            return True
+        except KeyError:
+            return False
+
+    def set_param_values(self, values: Dict[str, float]):
+        for k, v in values.items():
+            self.get_param(k).value = v
+        self.invalidate_cache(params_only=True)
+
+    def get_param_values(self, names=None) -> Dict[str, float]:
+        names = names if names is not None else self.free_params
+        return {n: self.get_param(n).value for n in names}
+
+    # ---------------- device-vector packing ---------------------------
+
+    def _device_params(self) -> List[Parameter]:
+        """Numeric parameters visible to device code, in component order.
+        str/bool/int params are host-only statics."""
+        out = []
+        for c in self._ordered_components():
+            for p in c.params.values():
+                if isinstance(p, (strParameter, boolParameter,
+                                  intParameter)):
+                    continue
+                if p.value is None:
+                    continue
+                out.append(p)
+        return out
+
+    def _pack(self):
+        dev = self._device_params()
+        free = [p for p in dev if not p.frozen]
+        frozen = [p for p in dev if p.frozen]
+        th = np.array([p.dd[0] for p in free])
+        tl = np.array([p.dd[1] for p in free])
+        fh = np.array([p.dd[0] for p in frozen])
+        fl = np.array([p.dd[1] for p in frozen])
+        return ([p.name for p in free], [p.name for p in frozen],
+                th, tl, fh, fl)
+
+    # ---------------- compiled evaluation ------------------------------
+
+    @property
+    def ref_day(self) -> float:
+        """Static integer MJD all device times are relative to."""
+        cached = self.__dict__.get("_ref_day")
+        if cached is not None:
+            return cached
+        day = None
+        for nm in ("PEPOCH", "POSEPOCH", "TZRMJD"):
+            try:
+                p = self.get_param(nm)
+                if p.value is not None:
+                    day = float(np.round(p.value))
+                    break
+            except KeyError:
+                continue
+        self._ref_day = day if day is not None else 55000.0
+        return self._ref_day
+
+    def _raw_phase_fn(self, pv, batch, cache, sub: str):
+        """The shared delay→phase chain (device, pure)."""
+        ctx: dict = {}
+        delay = jnp.zeros_like(batch.freq_mhz)
+        for comp in self.delay_components:
+            delay = delay + comp.delay(pv, batch, cache[sub], ctx, delay)
+        tb = dd_mul_f(dd_addf_day(batch, self.ref_day), SECS_PER_DAY)
+        tb = dd_sub_f(tb, delay)
+        ctx["tb"] = tb
+        phase = DD(jnp.zeros_like(delay), jnp.zeros_like(delay))
+        for comp in self.phase_components:
+            phase = dd_add_dd(phase, comp.phase(pv, batch, cache[sub],
+                                                ctx, tb))
+        return phase, delay
+
+    def _build_phase_fn(self):
+        free_names, frozen_names, *_ = self._pack()
+
+        def phase_fn(th, tl, fh, fl, batch, cache):
+            pv = {}
+            for i, nm in enumerate(free_names):
+                pv[nm] = DD(th[i], tl[i])
+            for j, nm in enumerate(frozen_names):
+                pv[nm] = DD(fh[j], fl[j])
+            phase, delay = self._raw_phase_fn(pv, batch, cache, "main")
+            if "tzr_batch" in cache:
+                tzr_phase, _ = self._raw_phase_fn(
+                    pv, cache["tzr_batch"], cache, "tzr")
+                phase = dd_sub_dd(
+                    phase, DD(tzr_phase.hi[0], tzr_phase.lo[0]))
+            return phase, delay
+
+        return phase_fn, (free_names, frozen_names)
+
+    def _get_compiled(self):
+        key = (tuple(sorted(self.components)),
+               tuple(p.name for p in self._device_params()),
+               tuple(self.free_params), self.ref_day)
+        if self._jit_phase is None or self._cache_key_params != key:
+            fn, names = self._build_phase_fn()
+            self._jit_phase = jax.jit(fn)
+            self._names = names
+            self._cache_key_params = key
+        return self._jit_phase
+
+    def invalidate_cache(self, params_only=False):
+        self._jit_phase = None
+        self._cache_key_params = None
+        if not params_only:
+            self._cache_key = None
+            self._cache = None
+        # ref epoch may shift when epochs change
+        self.__dict__.pop("_ref_day", None)
+
+    def get_cache(self, toas) -> dict:
+        """Host-precomputed per-batch arrays (masks, TZR mini-batch)."""
+        key = id(toas)
+        if self._cache is not None and self._cache_key == key:
+            return self._cache
+        batch = toas.to_batch()
+        cache: dict = {"main": {}, "tzr": {}, "batch": batch}
+        for comp in self._ordered_components():
+            comp.prepare(toas, batch, cache["main"], prefix="")
+        tzr_toas = self._make_tzr_toas(toas)
+        if tzr_toas is not None:
+            cache["tzr_batch"] = tzr_toas.to_batch()
+            for comp in self._ordered_components():
+                comp.prepare(tzr_toas, cache["tzr_batch"], cache["tzr"],
+                             prefix="tzr_")
+        self._cache = cache
+        self._cache_key = key
+        return cache
+
+    def _make_tzr_toas(self, toas):
+        """Build the one-TOA TZR set (reference:
+        src/pint/models/absolute_phase.py AbsPhase.get_TZR_toa)."""
+        if "AbsPhase" not in self.components:
+            return None
+        comp = self.components["AbsPhase"]
+        if comp.TZRMJD.value is None:
+            return None
+        from pint_tpu.toa import get_TOAs_array
+
+        site = comp.TZRSITE.value or "ssb"
+        freq = comp.TZRFRQ.value
+        freq = np.inf if freq in (None, 0.0) else float(freq)
+        day, frac = comp.TZRMJD.day_frac
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            return get_TOAs_array(
+                (np.array([day]), (np.array([frac[0]]),
+                                   np.array([frac[1]]))),
+                obs=site, freqs=freq, errors=1.0,
+                ephem=self.EPHEM.value,
+                planets=bool(self.PLANET_SHAPIRO.value))
+
+    # ---------------- public evaluation API ---------------------------
+
+    def phase(self, toas, abs_phase=True) -> Phase:
+        """Total pulse phase at each TOA (reference: TimingModel.phase).
+        With abs_phase and a TZR point, phase is anchored there."""
+        cache = self.get_cache(toas)
+        if not abs_phase:
+            cache = {k: v for k, v in cache.items() if k != "tzr_batch"}
+        _, _, th, tl, fh, fl = self._pack()
+        fn = self._get_compiled()
+        phase, _ = fn(th, tl, fh, fl, cache["batch"], _strip(cache))
+        return Phase(phase)
+
+    def delay(self, toas) -> jnp.ndarray:
+        """Total barycentering+binary delay [s] (reference:
+        TimingModel.delay)."""
+        cache = self.get_cache(toas)
+        _, _, th, tl, fh, fl = self._pack()
+        fn = self._get_compiled()
+        _, delay = fn(th, tl, fh, fl, cache["batch"], _strip(cache))
+        return delay
+
+    def designmatrix(self, toas, incoffset=True):
+        """(M, names, units): M[i,j] = d(time-resid_i)/d(free-param_j)
+        [s / param-unit], with a leading all-ones offset column when
+        incoffset (reference: TimingModel.designmatrix)."""
+        cache = self.get_cache(toas)
+        free, _, th, tl, fh, fl = self._pack()
+        fn = self._get_compiled()
+        sc = _strip(cache)
+        batch = cache["batch"]
+
+        def phase_of(thx):
+            ph, _ = fn(thx, tl, fh, fl, batch, sc)
+            return ph.hi + ph.lo
+
+        jac = jax.jacfwd(phase_of)(th)  # (N, p) turns/unit
+        f0 = self.F0.value
+        M = np.asarray(jac) / f0
+        names = list(free)
+        if incoffset:
+            M = np.concatenate([np.ones((M.shape[0], 1)) / f0, M], axis=1)
+            names = ["Offset"] + names
+        units = ["turn"] + [self.get_param(n).units for n in free] \
+            if incoffset else [self.get_param(n).units for n in free]
+        return M, names, units
+
+    def d_phase_d_param(self, toas, param: str):
+        """Single-parameter phase derivative [turns/unit] via the same
+        jacfwd path (reference: TimingModel.d_phase_d_param)."""
+        free, _, th, tl, fh, fl = self._pack()
+        if param not in free:
+            raise ValueError(f"{param} is not a free parameter")
+        cache = self.get_cache(toas)
+        fn = self._get_compiled()
+        sc = _strip(cache)
+        i = free.index(param)
+
+        def phase_of(x):
+            ph, _ = fn(th.at[i].set(x) if hasattr(th, "at")
+                       else _np_set(th, i, x), tl, fh, fl,
+                       cache["batch"], sc)
+            return ph.hi + ph.lo
+
+        return jax.jacfwd(phase_of)(jnp.asarray(th[i]))
+
+    # ---------------- par-file round trip -----------------------------
+
+    def as_parfile(self) -> str:
+        lines = []
+        for c in self._ordered_components():
+            for p in c.params.values():
+                line = p.as_parfile_line()
+                if line:
+                    lines.append(line)
+        return "".join(lines)
+
+    def validate(self):
+        for c in self.components.values():
+            c.validate()
+
+    def compare(self, other: "TimingModel") -> str:
+        """Parameter-by-parameter diff (reference: TimingModel.compare)."""
+        rows = []
+        names = dict.fromkeys(list(self.params) + list(other.params))
+        for n in names:
+            a = self.get_param(n).value if n in self else None
+            b = other.get_param(n).value if n in other else None
+            if a != b:
+                rows.append(f"{n:<12} {a!r} -> {b!r}")
+        return "\n".join(rows)
+
+    def __repr__(self):
+        comps = ", ".join(self.components)
+        return f"<TimingModel {self.name or '?'} [{comps}]>"
+
+
+# ---------------- small device helpers ----------------
+
+
+def dd_addf_day(batch, ref_day: float) -> DD:
+    """(tdb - ref_day) in days as DD: exact integer-day difference plus
+    the dd fraction."""
+    from pint_tpu.ops.dd import dd_add_f
+
+    return dd_add_f(batch.tdb_frac, batch.tdb_day - ref_day)
+
+
+def dd_add_dd(a: DD, b: DD) -> DD:
+    return dd_add(a, b)
+
+
+def dd_sub_dd(a: DD, b: DD) -> DD:
+    from pint_tpu.ops.dd import dd_sub
+
+    return dd_sub(a, b)
+
+
+def _strip(cache: dict) -> dict:
+    """Cache minus the main batch (passed separately)."""
+    return {k: v for k, v in cache.items() if k != "batch"}
+
+
+def _np_set(arr, i, x):
+    arr = jnp.asarray(arr)
+    return arr.at[i].set(x)

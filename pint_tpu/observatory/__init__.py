@@ -96,6 +96,7 @@ class GeocenterObs(Observatory):
 
 _registry: "dict[str, Observatory]" = {}
 _alias_map: "dict[str, str]" = {}
+_builtins_loaded = False
 
 
 def register_observatory(obs: Observatory, overwrite=False):
@@ -111,9 +112,13 @@ def register_observatory(obs: Observatory, overwrite=False):
 
 
 def _ensure_builtins():
-    if _registry:
+    global _builtins_loaded
+    if _builtins_loaded:
         return
+    _builtins_loaded = True
     for name, entry in load_sites().items():
+        if name.lower() in _registry:  # user pre-registered an override
+            continue
         register_observatory(
             TopoObs(name, entry["itrf"], aliases=entry.get("aliases", ()),
                     tempo_code=entry.get("tempo_code")))

@@ -110,17 +110,22 @@ class ZeroClockFile(ClockFile):
 
 
 _warned_missing = set()
+_clock_cache: dict = {}
 
 
 def find_clock_file(name, fmt="tempo2"):
     """Locate `name` under $PINT_TPU_CLOCK_DIR; zero-fallback otherwise,
     warning once per file name (mirrors the reference's missing-clock
-    warning policy in src/pint/observatory/topo_obs.py)."""
+    warning policy in src/pint/observatory/topo_obs.py). Parsed files
+    are cached per (path, fmt)."""
     clock_dir = os.environ.get("PINT_TPU_CLOCK_DIR")
     if clock_dir:
         cand = os.path.join(clock_dir, name)
         if os.path.exists(cand):
-            return ClockFile.read(cand, fmt=fmt)
+            key = (os.path.abspath(cand), fmt)
+            if key not in _clock_cache:
+                _clock_cache[key] = ClockFile.read(cand, fmt=fmt)
+            return _clock_cache[key]
     if name not in _warned_missing:
         _warned_missing.add(name)
         warnings.warn(

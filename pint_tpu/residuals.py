@@ -1,0 +1,128 @@
+"""Residuals: phase and time residuals, chi-square.
+
+Reference: src/pint/residuals.py (Residuals.calc_phase_resids,
+calc_time_resids, rms_weighted, chi2). Phase arithmetic stays in
+double-double until the fractional part is extracted; everything after
+(means, chi2) is f64.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Residuals"]
+
+
+class Residuals:
+    """Timing residuals of `toas` under `model`.
+
+    track_mode: "nearest" assigns each TOA to the nearest integer pulse;
+    "use_pulse_numbers" uses -pn flags (reference: track_mode).
+    """
+
+    def __init__(self, toas, model, track_mode: Optional[str] = None,
+                 subtract_mean: bool = True, use_weighted_mean: bool = True):
+        self.toas = toas
+        self.model = model
+        if track_mode is None:
+            track_mode = ("use_pulse_numbers"
+                          if toas.get_pulse_numbers() is not None
+                          else "nearest")
+        self.track_mode = track_mode
+        self.subtract_mean = subtract_mean
+        self.use_weighted_mean = use_weighted_mean
+        self._phase_resids = None
+        self._time_resids = None
+
+    # -- lazy computation ---------------------------------------------
+
+    def calc_phase_resids(self) -> np.ndarray:
+        """Residual phase [turns], mean-subtracted (f64)."""
+        ph = self.model.phase(self.toas, abs_phase=True)
+        if self.track_mode == "use_pulse_numbers":
+            pn = self.toas.get_pulse_numbers()
+            if pn is None:
+                raise ValueError("track_mode=use_pulse_numbers but no "
+                                 "-pn flags on these TOAs")
+            full = (np.asarray(ph.int) - pn) + np.asarray(ph.frac)
+        elif self.track_mode == "nearest":
+            full = np.asarray(ph.frac)
+        else:
+            raise ValueError(f"unknown track_mode {self.track_mode!r}")
+        if self.subtract_mean:
+            full = full - self._mean(full)
+        return full
+
+    def _mean(self, x):
+        if not self.use_weighted_mean:
+            return x.mean()
+        err = self.toas.get_errors()
+        if np.any(err == 0):
+            return x.mean()
+        w = 1.0 / err ** 2
+        return np.sum(x * w) / np.sum(w)
+
+    @property
+    def phase_resids(self):
+        if self._phase_resids is None:
+            self._phase_resids = self.calc_phase_resids()
+        return self._phase_resids
+
+    def calc_time_resids(self) -> np.ndarray:
+        """Residuals in seconds: phase / F0 (reference uses the 'modelF0'
+        calctype by default — same thing)."""
+        return self.phase_resids / self.model.F0.value
+
+    @property
+    def time_resids(self):
+        if self._time_resids is None:
+            self._time_resids = self.calc_time_resids()
+        return self._time_resids
+
+    # -- summary stats -------------------------------------------------
+
+    @property
+    def resids_us(self):
+        return self.time_resids * 1e6
+
+    def rms_weighted(self) -> float:
+        """Weighted RMS [s] (reference: Residuals.rms_weighted)."""
+        err_s = self.toas.get_errors() * 1e-6
+        if np.any(err_s == 0):
+            return float(np.sqrt(np.mean(self.time_resids ** 2)))
+        w = 1.0 / err_s ** 2
+        r = self.time_resids
+        wmean = np.sum(r * w) / np.sum(w)
+        return float(np.sqrt(np.sum(w * (r - wmean) ** 2) / np.sum(w)))
+
+    def rms(self) -> float:
+        return float(np.sqrt(np.mean(self.time_resids ** 2)))
+
+    @property
+    def chi2(self) -> float:
+        """White chi2 against scaled (or raw) TOA errors. GLS-aware chi2
+        lives in the GLS fitter (reference: Residuals.chi2 defers the
+        same way)."""
+        err_s = self._scaled_errors_s()
+        return float(np.sum((self.time_resids / err_s) ** 2))
+
+    def _scaled_errors_s(self):
+        scaled = None
+        if hasattr(self.model, "scaled_toa_uncertainty"):
+            try:
+                scaled = self.model.scaled_toa_uncertainty(self.toas)
+            except Exception:
+                scaled = None
+        if scaled is not None:
+            return np.asarray(scaled)
+        return self.toas.get_errors() * 1e-6
+
+    @property
+    def dof(self) -> int:
+        return self.toas.ntoas - len(self.model.free_params) - 1
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof

@@ -1,0 +1,118 @@
+"""Fake-TOA simulation.
+
+Reference: src/pint/simulation.py (make_fake_toas_uniform,
+zero_residuals, make_fake_toas_fromtim). TOAs are Newton-iterated onto
+integer model phase (2–3 passes through the full jitted forward model),
+then optionally perturbed by a white-noise draw.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu.ops import dd_np
+from pint_tpu.residuals import Residuals
+from pint_tpu.toa import TOAs, get_TOAs_array
+
+SECS_PER_DAY = 86400.0
+
+
+def zero_residuals(toas: TOAs, model, maxiter: int = 4,
+                   tol_s: float = 1e-10) -> TOAs:
+    """Shift TOA MJDs until model residual phase is integer (reference:
+    simulation.zero_residuals Newton loop)."""
+    t = toas
+    for _ in range(maxiter):
+        r = Residuals(t, model, track_mode="nearest",
+                      subtract_mean=False).time_resids
+        if np.max(np.abs(r)) < tol_s:
+            break
+        day = t.mjd_day
+        frac = dd_np.sub(t.mjd_frac,
+                         dd_np.div_f(dd_np.dd(np.asarray(r)), SECS_PER_DAY))
+        t = _rebuild(t, day, frac)
+    return t
+
+
+def _rebuild(t: TOAs, day, frac) -> TOAs:
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        new = get_TOAs_array((day, frac), obs=t.obs, freqs=t.freq_mhz,
+                             errors=t.error_us, flags=t.flags,
+                             ephem=t.ephem, planets=t.planets)
+    new.names = list(t.names)
+    return new
+
+
+def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int,
+                           model, error_us: float = 1.0, obs: str = "gbt",
+                           freq_mhz: float = 1400.0, add_noise: bool = False,
+                           rng: Optional[np.random.Generator] = None,
+                           name: str = "fake") -> TOAs:
+    """Evenly spaced synthetic TOAs landing on integer model phase
+    (reference: make_fake_toas_uniform)."""
+    mjds = np.linspace(float(startMJD), float(endMJD), int(ntoas))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t = get_TOAs_array(
+            mjds, obs=obs, freqs=freq_mhz, errors=error_us,
+            ephem=model.EPHEM.value,
+            planets=bool(model.PLANET_SHAPIRO.value))
+    t.names = [f"{name}{i}" for i in range(t.ntoas)]
+    t = zero_residuals(t, model)
+    if add_noise:
+        rng = rng or np.random.default_rng()
+        noise_s = rng.standard_normal(t.ntoas) * t.error_us * 1e-6
+        frac = dd_np.add(t.mjd_frac,
+                         dd_np.div_f(dd_np.dd(noise_s), SECS_PER_DAY))
+        t = _rebuild(t, t.mjd_day, frac)
+    return t
+
+
+def make_fake_toas_fromtim(timfile, model, add_noise=False, rng=None):
+    """Replace the TOAs of an existing tim file with model-aligned fakes
+    (reference: make_fake_toas_fromtim)."""
+    from pint_tpu.toa import get_TOAs
+
+    t = get_TOAs(timfile, model=model)
+    t = zero_residuals(t, model)
+    if add_noise:
+        rng = rng or np.random.default_rng()
+        noise_s = rng.standard_normal(t.ntoas) * t.error_us * 1e-6
+        frac = dd_np.add(t.mjd_frac,
+                         dd_np.div_f(dd_np.dd(noise_s), SECS_PER_DAY))
+        t = _rebuild(t, t.mjd_day, frac)
+    return t
+
+
+def calculate_random_models(fitter, toas, Nmodels: int = 100,
+                            rng: Optional[np.random.Generator] = None):
+    """Draw parameter vectors from the post-fit covariance and return the
+    per-draw residual curves [s] (reference:
+    simulation.calculate_random_models)."""
+    rng = rng or np.random.default_rng()
+    cov = fitter.parameter_covariance_matrix
+    if cov is None:
+        raise ValueError("fit first: no covariance available")
+    names = [n for n in ["Offset"] + fitter.model.free_params
+             if n != "Offset"]
+    # covariance includes the Offset column when fitted with incoffset
+    full_names = ["Offset"] + names if cov.shape[0] == len(names) + 1 \
+        else names
+    draws = rng.multivariate_normal(
+        np.zeros(cov.shape[0]), cov, size=Nmodels)
+    out = np.empty((Nmodels, toas.ntoas))
+    import copy
+
+    for k in range(Nmodels):
+        m = copy.deepcopy(fitter.model)
+        for name, dx in zip(full_names, draws[k]):
+            if name == "Offset":
+                continue
+            m.get_param(name).add_delta(float(dx))
+        m.invalidate_cache(params_only=True)
+        out[k] = Residuals(toas, m, subtract_mean=False).time_resids
+    return out

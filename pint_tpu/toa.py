@@ -374,9 +374,11 @@ def get_TOAs_array(mjds, obs="barycenter", freqs=np.inf, errors=1.0,
         frac = (np.asarray(frac[0], np.float64),
                 np.asarray(frac[1], np.float64))
     else:
-        m = np.asarray(mjds, np.float64)
+        m = np.atleast_1d(np.asarray(mjds, np.float64))
         day = np.floor(m)
         frac = dd_np.dd(m - day)
+    day = np.atleast_1d(day)
+    frac = (np.atleast_1d(frac[0]), np.atleast_1d(frac[1]))
     n = day.shape[0]
     freqs = np.broadcast_to(np.asarray(freqs, np.float64), (n,))
     errors = np.broadcast_to(np.asarray(errors, np.float64), (n,))

@@ -1,0 +1,128 @@
+"""Fitter tests: simulate→perturb→fit→recover (the strongest available
+oracle, SURVEY.md §4), WLS vs Downhill agreement, summary output
+(reference analogs: tests/test_fitter.py, test_wls_fitter.py,
+test_downhill_fitter.py)."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import DownhillWLSFitter, Fitter, WLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toa import merge_TOAs
+
+PAR = """PSR J1748-2021E
+RAJ 17:48:52.75 1
+DECJ -20:21:29.0 1
+F0 61.485476554373152 1
+F1 -1.1815e-15 1
+PEPOCH 53750.0
+POSEPOCH 53750.0
+DM 223.9 1
+DMEPOCH 53750.0
+TZRMJD 53750.1
+TZRSITE @
+TZRFRQ 1400.0
+UNITS TDB
+"""
+
+PERTURB = {"F0": 3e-9, "F1": 2e-17, "DM": 2e-3, "RAJ": 2e-8,
+           "DECJ": 3e-8}
+
+
+@pytest.fixture(scope="module")
+def sim():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(PAR))
+        rng = np.random.default_rng(7)
+        tA = make_fake_toas_uniform(53400, 54100, 50, m, error_us=1.0,
+                                    obs="gbt", freq_mhz=1400.0,
+                                    add_noise=True, rng=rng)
+        tB = make_fake_toas_uniform(53410, 54090, 30, m, error_us=1.5,
+                                    obs="gbt", freq_mhz=428.0,
+                                    add_noise=True, rng=rng)
+        t = merge_TOAs([tA, tB])
+    truth = {n: m.get_param(n).value for n in m.free_params}
+    return m, t, truth
+
+
+def _perturb(m):
+    for name, dx in PERTURB.items():
+        m.get_param(name).add_delta(dx)
+    m.invalidate_cache(params_only=True)
+
+
+def _restore(m, truth):
+    for name, v in truth.items():
+        p = m.get_param(name)
+        p.value = v
+    m.invalidate_cache(params_only=True)
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (WLSFitter, dict(maxiter=3)),
+    (DownhillWLSFitter, dict(maxiter=15)),
+])
+def test_fit_recovers_truth(sim, cls, kw):
+    m, t, truth = sim
+    _restore(m, truth)
+    _perturb(m)
+    assert Residuals(t, m).rms_weighted() > 1e-4  # badly perturbed
+    f = cls(t, m)
+    chi2 = f.fit_toas(**kw)
+    assert f.resids.rms_weighted() < 3e-6
+    assert chi2 / f.resids.dof < 1.5
+    for name, tv in truth.items():
+        p = m.get_param(name)
+        assert p.uncertainty is not None and p.uncertainty > 0
+        pull = (p.value - tv) / p.uncertainty
+        assert abs(pull) < 5, f"{name} pull {pull}"
+    _restore(m, truth)
+
+
+def test_fit_idempotent_at_truth(sim):
+    """Fitting from the truth moves parameters < 1 sigma."""
+    m, t, truth = sim
+    _restore(m, truth)
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=2)
+    for name, tv in truth.items():
+        p = m.get_param(name)
+        assert abs(p.value - tv) < 3 * p.uncertainty
+    _restore(m, truth)
+
+
+def test_auto_picks_wls(sim):
+    m, t, _ = sim
+    f = Fitter.auto(t, m, downhill=False)
+    assert isinstance(f, WLSFitter)
+    f2 = Fitter.auto(t, m)
+    assert isinstance(f2, DownhillWLSFitter)
+
+
+def test_summary_runs(sim):
+    m, t, truth = sim
+    _restore(m, truth)
+    f = WLSFitter(t, m)
+    f.fit_toas()
+    from pint_tpu.fitter import fit_summary
+
+    s = fit_summary(f)
+    assert "F0" in s and "chi2" in s
+    _restore(m, truth)
+
+
+def test_simulation_zero_residuals(sim):
+    m, t, truth = sim
+    _restore(m, truth)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t0 = make_fake_toas_uniform(53500, 53600, 10, m, error_us=1.0,
+                                    obs="gbt", add_noise=False)
+    r = Residuals(t0, m, subtract_mean=False)
+    assert np.max(np.abs(r.time_resids)) < 1e-9

@@ -1,0 +1,202 @@
+"""TimingModel tests: builder routing, phase/delay physics sanity,
+design-matrix-vs-finite-difference derivative checks, par round trip
+(reference analogs: tests/test_model.py, test_model_derivatives.py,
+test_parfile_writing.py)."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+import pint_tpu
+from pint_tpu.models import get_model, get_model_and_toas
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """PSR J1748-2021E
+RAJ 17:48:52.75 1
+DECJ -20:21:29.0 1
+PMRA 3.5
+PMDEC -2.1
+PX 0.5
+F0 61.485476554373152 1
+F1 -1.1815e-15 1
+PEPOCH 53750.0
+POSEPOCH 53750.0
+DM 223.9 1
+DM1 0.003
+DMEPOCH 53750.0
+JUMP -fe 430 0.000216 1
+TZRMJD 53750.1
+TZRSITE @
+TZRFRQ 1400.0
+UNITS TDB
+"""
+
+TIM = """FORMAT 1
+t1 1400.0 53478.2858714192189 1.0 gbt -fe L-wide
+t2 1400.0 53483.2767051885165 1.0 gbt -fe L-wide
+t3 428.0 53489.4683897879295 1.5 gbt -fe 430
+t4 1400.0 53679.8756457127679 1.0 gbt -fe L-wide
+t5 428.0 53900.1234567890123 1.5 gbt -fe 430
+"""
+
+
+@pytest.fixture(scope="module")
+def model_and_toas():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model_and_toas(io.StringIO(PAR), io.StringIO(TIM))
+
+
+def test_builder_components(model_and_toas):
+    m, _ = model_and_toas
+    for c in ("Spindown", "AstrometryEquatorial", "DispersionDM",
+              "PhaseJump", "AbsPhase", "SolarSystemShapiro"):
+        assert c in m.components
+    assert m.F0.value == pytest.approx(61.485476554373152)
+    assert m.JUMP1.key == "-fe"
+    assert not m.JUMP1.frozen
+    assert set(m.free_params) == {"RAJ", "DECJ", "DM", "F0", "F1", "JUMP1"}
+
+
+def test_delay_physics(model_and_toas):
+    m, t = model_and_toas
+    d = np.asarray(m.delay(t))
+    # Roemer delay dominates: |d| <= ~501s + dispersion
+    disp = pint_tpu.DMconst * 223.9 / 428.0 ** 2
+    assert np.all(np.abs(d) < 510 + disp)
+    # dispersion: delay(DM) − delay(DM→0) scales as ν⁻² (SURVEY A.8 (d))
+    dm0 = m.DM.value
+    m.DM.value = 1e-9
+    m.invalidate_cache(params_only=True)
+    d_nodm = np.asarray(m.delay(t))
+    m.DM.value = dm0
+    m.invalidate_cache(params_only=True)
+    ddisp = d - d_nodm
+    freqs = np.asarray(t.get_freqs())
+    expect = pint_tpu.DMconst * dm0 / freqs ** 2
+    np.testing.assert_allclose(ddisp, expect, rtol=1e-3)
+
+
+def test_phase_absolute_anchor(model_and_toas):
+    m, t = model_and_toas
+    ph = m.phase(t, abs_phase=True)
+    # TZR at 53750.1: phases O(1e9) turns away
+    assert np.all(np.abs(np.asarray(ph.int)) > 1e6)
+    assert np.all(np.abs(np.asarray(ph.frac)) <= 0.5)
+
+
+def test_designmatrix_vs_finite_difference(model_and_toas):
+    """The de-facto gradcheck of the reference
+    (tests/test_model_derivatives.py): jacfwd columns vs central
+    differences on each free parameter."""
+    m, t = model_and_toas
+    M, names, units = m.designmatrix(t, incoffset=True)
+    f0 = m.F0.value
+
+    for name in m.free_params:
+        p = m.get_param(name)
+        # steps large enough that the f64 delay quantization (~1e-13 s)
+        # doesn't pollute the difference; curvature is negligible here
+        h = {"RAJ": 1e-9, "DECJ": 1e-9, "DM": 1e-4, "F0": 1e-11,
+             "F1": 1e-19, "JUMP1": 1e-8}[name]
+        p.add_delta(h)
+        m.invalidate_cache(params_only=True)
+        ph_plus = np.asarray(m.phase(t).frac)
+        int_plus = np.asarray(m.phase(t).int)
+        p.add_delta(-2 * h)
+        m.invalidate_cache(params_only=True)
+        ph_minus = np.asarray(m.phase(t).frac)
+        int_minus = np.asarray(m.phase(t).int)
+        p.add_delta(h)
+        m.invalidate_cache(params_only=True)
+        # frac keeps full precision at 1e9 turns; add back any integer
+        # crossing between the +h and −h evaluations
+        dphase = (ph_plus - ph_minus) + (int_plus - int_minus)
+        fd = dphase / (2 * h) / f0
+        col = M[:, names.index(name)]
+        scale = np.max(np.abs(fd)) or 1.0
+        np.testing.assert_allclose(col, fd, rtol=1e-4,
+                                   atol=1e-4 * scale,
+                                   err_msg=f"derivative mismatch: {name}")
+
+
+def test_parfile_roundtrip(model_and_toas):
+    m, t = model_and_toas
+    text = m.as_parfile()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m2 = get_model(io.StringIO(text))
+    for name in ("F0", "F1", "DM", "RAJ", "DECJ", "PMRA", "PX", "JUMP1"):
+        assert m2.get_param(name).value == pytest.approx(
+            m.get_param(name).value, rel=1e-12), name
+    assert m2.JUMP1.key == "-fe"
+    assert m2.JUMP1.key_value == ["430"]
+    # phases agree to sub-ns
+    ph1 = np.asarray(m.phase(t).frac)
+    ph2 = np.asarray(m2.phase(t).frac)
+    np.testing.assert_allclose(ph1, ph2, atol=1e-7)
+
+
+def test_jump_changes_selected_toas_only(model_and_toas):
+    m, t = model_and_toas
+    r0 = Residuals(t, m, subtract_mean=False).time_resids
+    m.JUMP1.add_delta(1e-4)
+    m.invalidate_cache(params_only=True)
+    r1 = Residuals(t, m, subtract_mean=False).time_resids
+    m.JUMP1.add_delta(-1e-4)
+    m.invalidate_cache(params_only=True)
+    delta = r1 - r0
+    sel = np.array([f.get("fe") == "430" for f in t.flags])
+    assert np.allclose(delta[~sel], 0, atol=1e-12)
+    assert np.allclose(delta[sel], -1e-4, atol=1e-9)
+
+
+def test_ecliptic_model():
+    par = """PSR J0613-0200
+ELONG 93.7990
+ELAT -25.4071
+F0 326.6005670870222 1
+PEPOCH 54500.0
+DM 38.778
+TZRMJD 54500.0
+TZRSITE @
+UNITS TDB
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        t = make_fake_toas_uniform(54400, 54600, 20, m, obs="parkes")
+    assert "AstrometryEcliptic" in m.components
+    r = Residuals(t, m, subtract_mean=False)
+    assert np.max(np.abs(r.time_resids)) < 1e-9
+
+
+def test_roemer_annual_amplitude():
+    """Roemer amplitude = 499.005·cos(ecliptic latitude) s
+    (SURVEY.md A.8 oracle (b))."""
+    par = """PSR TEST
+ELONG 120.0
+ELAT 0.0
+F0 100.0
+PEPOCH 55000.0
+DM 0.0
+UNITS TDB
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        from pint_tpu.toa import get_TOAs_array
+
+        t = get_TOAs_array(np.linspace(55000, 55365, 80), obs="geocenter",
+                           freqs=np.inf, errors=1.0)
+    d = np.asarray(m.delay(t))
+    amp = (d.max() - d.min()) / 2
+    assert amp == pytest.approx(499.005, rel=2e-3)
+
+
+def test_tcb_refused():
+    with pytest.raises(ValueError, match="TCB"):
+        get_model(io.StringIO("PSR X\nF0 10\nPEPOCH 55000\nUNITS TCB\n"))

@@ -1,0 +1,128 @@
+"""Parameter-system tests (reference analogs: tests/test_parameters.py,
+test_parfile_writing.py)."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.models.parameter import (
+    AngleParameter,
+    MJDParameter,
+    boolParameter,
+    floatParameter,
+    maskParameter,
+    parse_float_dd,
+    prefixParameter,
+    split_prefixed_name,
+)
+
+
+def test_split_prefixed_name():
+    assert split_prefixed_name("F12") == ("F", "12", 12)
+    assert split_prefixed_name("DMX_0001") == ("DMX_", "0001", 1)
+    assert split_prefixed_name("GLF0_2") == ("GLF0_", "2", 2)
+    with pytest.raises(ValueError):
+        split_prefixed_name("RAJ")
+
+
+def test_parse_float_dd_exact():
+    hi, lo = parse_float_dd("61.485476554373152396")
+    # reconstruct to 20 digits via Decimal
+    from decimal import Decimal
+
+    got = Decimal(hi) + Decimal(lo)
+    assert abs(got - Decimal("61.485476554373152396")) < Decimal("1e-25")
+    # scientific notation and D-exponents
+    hi, lo = parse_float_dd("-1.1815D-15")
+    assert hi == pytest.approx(-1.1815e-15)
+
+
+def test_float_parameter_long_precision():
+    p = floatParameter("F0", units="Hz")
+    p.from_tokens(["61.485476554373152396", "1", "1e-13"])
+    assert not p.frozen
+    assert p.uncertainty == 1e-13
+    assert p.dd[1] != 0.0  # kept sub-f64 bits
+    p.add_delta(1e-9)
+    assert p.value == pytest.approx(61.485476554373152396 + 1e-9)
+
+
+def test_angle_parameter_hms_dms():
+    ra = AngleParameter("RAJ", units="H:M:S")
+    ra.from_tokens(["17:48:52.75"])
+    assert ra.value == pytest.approx(
+        (17 + 48 / 60 + 52.75 / 3600) * np.pi / 12)
+    dec = AngleParameter("DECJ", units="D:M:S")
+    dec.from_tokens(["-20:21:29.0"])
+    assert dec.value == pytest.approx(
+        -(20 + 21 / 60 + 29.0 / 3600) * np.pi / 180)
+    # format round trip
+    ra2 = AngleParameter("RAJ", units="H:M:S")
+    ra2.from_tokens([ra._format_value()])
+    assert ra2.value == pytest.approx(ra.value, abs=1e-15)
+    dec2 = AngleParameter("DECJ", units="D:M:S")
+    dec2.from_tokens([dec._format_value()])
+    assert dec2.value == pytest.approx(dec.value, abs=1e-15)
+
+
+def test_mjd_parameter():
+    p = MJDParameter("PEPOCH")
+    p.from_tokens(["53750.000012345678912"])
+    day, frac = p.day_frac
+    assert day == 53750.0
+    assert frac[0] + frac[1] == pytest.approx(1.2345678912e-5, rel=1e-12)
+    # formatting keeps precision
+    assert p._format_value().startswith("53750.0000123456789")
+
+
+def test_bool_parameter():
+    p = boolParameter("PLANET_SHAPIRO")
+    for tok, want in [("Y", True), ("N", False), ("1", True), ("0", False)]:
+        p.from_tokens([tok])
+        assert p.value is want
+
+
+class _FakeTOAs:
+    def __init__(self, n):
+        self.ntoas = n
+        self.flags = [{"fe": "L-wide"} if i % 2 else {"fe": "430"}
+                      for i in range(n)]
+        self.freq_mhz = np.linspace(400, 1500, n)
+        self.obs = ["gbt"] * n
+        self.names = [f"t{i}" for i in range(n)]
+        self._mjds = np.linspace(50000, 51000, n)
+
+    def get_mjds(self):
+        return self._mjds
+
+
+def test_mask_parameter_select():
+    t = _FakeTOAs(10)
+    p = maskParameter("JUMP", index=1)
+    p.from_tokens(["-fe", "L-wide", "0.0002", "1"])
+    m = p.select_mask(t)
+    assert m.sum() == 5
+    assert not p.frozen
+    p2 = maskParameter("JUMP", index=2)
+    p2.from_tokens(["MJD", "50000", "50500", "1e-4"])
+    assert p2.select_mask(t).sum() == np.sum(t.get_mjds() <= 50500)
+    p3 = maskParameter("EFAC", index=1)
+    p3.from_tokens(["freq", "1000", "2000", "1.1"])
+    assert p3.select_mask(t).sum() == np.sum(t.freq_mhz >= 1000)
+    p4 = maskParameter("JUMP", index=3)
+    p4.from_tokens(["tel", "gbt", "1e-5"])
+    assert p4.select_mask(t).all()
+
+
+def test_mask_parameter_parfile_line():
+    p = maskParameter("JUMP", index=1)
+    p.from_tokens(["-fe", "L-wide", "0.000216", "1", "2e-06"])
+    line = p.as_parfile_line()
+    assert line.split() == ["JUMP", "-fe", "L-wide", "0.000216", "1",
+                            "2e-06"]
+
+
+def test_prefix_parameter():
+    p = prefixParameter(name="DMX_0007", value=1e-3, units="pc cm^-3")
+    assert p.prefix == "DMX_"
+    assert p.index == 7
+    assert p.name == "DMX_0007"
