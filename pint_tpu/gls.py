@@ -1,0 +1,284 @@
+"""Generalized-least-squares fitters (the north-star kernel).
+
+Reference: src/pint/fitter.py (GLSFitter.fit_toas basis/Woodbury branch,
+full_cov branch, DownhillGLSFitter). SURVEY.md Appendix A.6 gives the
+exact algebra:
+
+    M (N,p)  design matrix, unit-normalized columns, Offset prepended
+    F (N,q)  stacked noise bases;  phi (q,) their prior variances
+    Nvec     scaled white variances (EFAC/EQUAD applied)
+    Sigma = [M|F]^T N^-1 [M|F] + diag(0..0, 1/phi)     ((p+q),(p+q))
+    xhat  = Sigma^-1 [M|F]^T N^-1 r
+    chi2  = r^T N^-1 r - xhat^T [M|F]^T N^-1 r
+
+The whole solve — whitening, normal-equation assembly, Cholesky,
+covariance, chi2, and the GP noise realization F.xhat — is ONE jitted
+XLA kernel: the (N,p+q) matmuls tile onto the MXU and dominate the
+FLOPs; the (p+q)^2 Cholesky is tiny. An SVD fallback kernel handles
+singular systems (the reference's ``threshold`` branch). A dense
+full-covariance path (C = N + F phi F^T) is kept as an accuracy
+cross-check, as is a pure-numpy mirror of the reference algorithm used
+as the benchmark denominator (BASELINE.md measurement protocol).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitter import Fitter, MaxiterReached
+from pint_tpu.residuals import Residuals
+
+__all__ = ["GLSFitter", "DownhillGLSFitter", "gls_solve_np"]
+
+
+@jax.jit
+def _gls_kernel(M, F, phi, r, nvec):
+    """Basis-Woodbury GLS solve. Returns (dparams, cov_pp, chi2,
+    noise_resid, xhat_full, ok) — ok False when the Cholesky produced
+    non-finite values (caller falls back to SVD)."""
+    p = M.shape[1]
+    w = 1.0 / nvec                       # N^-1 diagonal
+    norm = jnp.sqrt(jnp.sum(M * M * w[:, None], axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = M / norm[None, :]
+    big = jnp.concatenate([Mn, F], axis=1)        # (N, p+q)
+    bigw = big * w[:, None]
+    Sigma = big.T @ bigw                           # (p+q, p+q)
+    prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
+    Sigma = Sigma + jnp.diag(prior)
+    b = bigw.T @ r                                 # (p+q,)
+    cf = jax.scipy.linalg.cho_factor(Sigma, lower=True)
+    xhat = jax.scipy.linalg.cho_solve(cf, b)
+    inv = jax.scipy.linalg.cho_solve(cf, jnp.eye(Sigma.shape[0]))
+    chi2 = jnp.sum(r * r * w) - xhat @ b
+    dparams = xhat[:p] / norm
+    cov = inv[:p, :p] / jnp.outer(norm, norm)
+    noise_resid = F @ xhat[p:]
+    ok = jnp.all(jnp.isfinite(xhat)) & jnp.all(jnp.isfinite(cov))
+    return dparams, cov, chi2, noise_resid, xhat, ok
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _gls_kernel_svd(M, F, phi, r, nvec, threshold=1e-12):
+    """Eigendecomposition solve of the same normal equations
+    (reference: GLSFitter threshold branch, dropping small singular
+    values of the scaled design).
+
+    Sigma's raw spectrum is dominated by the 1/phi prior of weakly
+    excited noise modes (up to ~1e20 above the O(1) parameter block), so
+    a threshold relative to the raw s_max would wrongly discard healthy
+    parameter directions. Jacobi-precondition to unit diagonal first:
+    genuine degeneracies are then exactly the small eigenvalues."""
+    p = M.shape[1]
+    w = 1.0 / nvec
+    norm = jnp.sqrt(jnp.sum(M * M * w[:, None], axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = M / norm[None, :]
+    big = jnp.concatenate([Mn, F], axis=1)
+    bigw = big * w[:, None]
+    Sigma = big.T @ bigw
+    prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
+    Sigma = Sigma + jnp.diag(prior)
+    b = bigw.T @ r
+    d = jnp.sqrt(jnp.diagonal(Sigma))
+    d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+    Sp = Sigma / jnp.outer(d, d)
+    s, U = jnp.linalg.eigh(Sp)
+    keep = s > threshold * s[-1]
+    s_inv = jnp.where(keep, 1.0 / jnp.where(keep, s, 1.0), 0.0)
+    xhat = (U @ (s_inv * (U.T @ (b / d)))) / d
+    inv = ((U * s_inv[None, :]) @ U.T) / jnp.outer(d, d)
+    chi2 = jnp.sum(r * r * w) - xhat @ b
+    dparams = xhat[:p] / norm
+    cov = inv[:p, :p] / jnp.outer(norm, norm)
+    noise_resid = F @ xhat[p:]
+    return dparams, cov, chi2, noise_resid, xhat
+
+
+@jax.jit
+def _gls_chi2_kernel(F, phi, r, nvec):
+    """chi2 at a parameter point: r^T C^-1 r with C = diag(nvec) +
+    F diag(phi) F^T, via Woodbury in basis space. Unlike _gls_kernel's
+    chi2 (which anticipates the linearized parameter step and is thus
+    nearly invariant along it), this is a true function of the current
+    parameters — the downhill accept/reject criterion (reference:
+    GLSState.chi2 in src/pint/fitter.py)."""
+    w = 1.0 / nvec
+    bF = (F * w[:, None]).T @ r
+    Sff = F.T @ (F * w[:, None]) + jnp.diag(1.0 / phi)
+    cf = jax.scipy.linalg.cho_factor(Sff, lower=True)
+    return jnp.sum(r * r * w) - bF @ jax.scipy.linalg.cho_solve(cf, bF)
+
+
+def gls_chi2(model, toas, resids=None) -> float:
+    """GLS-aware chi2 of current residuals (basis-marginalized)."""
+    r = resids if resids is not None else Residuals(toas, model).time_resids
+    nvec = model.scaled_toa_uncertainty(toas) ** 2
+    F = model.noise_model_designmatrix(toas)
+    if F is None:
+        return float(np.sum(np.asarray(r) ** 2 / nvec))
+    phi = model.noise_model_basis_weight(toas)
+    return float(_gls_chi2_kernel(jnp.asarray(F), jnp.asarray(phi),
+                                  jnp.asarray(r), jnp.asarray(nvec)))
+
+
+@jax.jit
+def _gls_kernel_fullcov(M, F, phi, r, nvec):
+    """Dense full-covariance GLS (reference: full_cov=True branch):
+    C = diag(Nvec) + F diag(phi) F^T, solve via Cholesky of C. O(N^2)
+    memory — accuracy cross-check only."""
+    C = jnp.diag(nvec) + (F * phi[None, :]) @ F.T
+    cf = jax.scipy.linalg.cho_factor(C, lower=True)
+    norm = jnp.sqrt(jnp.sum(M * M, axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = M / norm[None, :]
+    CiM = jax.scipy.linalg.cho_solve(cf, Mn)
+    Cir = jax.scipy.linalg.cho_solve(cf, r)
+    Sigma = Mn.T @ CiM
+    b = Mn.T @ Cir
+    cf2 = jax.scipy.linalg.cho_factor(Sigma, lower=True)
+    xhat = jax.scipy.linalg.cho_solve(cf2, b)
+    inv = jax.scipy.linalg.cho_solve(cf2, jnp.eye(Sigma.shape[0]))
+    chi2 = r @ Cir - xhat @ b
+    # conditional mean of the GP: phi F^T C^-1 (r - M dθ) ≈ phi F^T C^-1 r
+    noise_resid = (F * phi[None, :]) @ (F.T @ Cir)
+    return xhat / norm, inv / jnp.outer(norm, norm), chi2, noise_resid
+
+
+def gls_solve_np(M, F, phi, r, nvec):
+    """Pure-numpy mirror of _gls_kernel — the reference-algorithm CPU
+    path used as the benchmark denominator (BASELINE.md protocol; same
+    algebra as src/pint/fitter.py GLSFitter.fit_toas with
+    scipy cho_factor)."""
+    from scipy.linalg import cho_factor, cho_solve
+
+    p = M.shape[1]
+    w = 1.0 / nvec
+    norm = np.sqrt(np.sum(M * M * w[:, None], axis=0))
+    norm[norm == 0] = 1.0
+    Mn = M / norm[None, :]
+    big = np.concatenate([Mn, F], axis=1)
+    bigw = big * w[:, None]
+    Sigma = big.T @ bigw + np.diag(
+        np.concatenate([np.zeros(p), 1.0 / phi]))
+    b = bigw.T @ r
+    cf = cho_factor(Sigma, lower=True)
+    xhat = cho_solve(cf, b)
+    inv = cho_solve(cf, np.eye(Sigma.shape[0]))
+    chi2 = float(np.sum(r * r * w) - xhat @ b)
+    return (xhat[:p] / norm, inv[:p, :p] / np.outer(norm, norm), chi2,
+            F @ xhat[p:])
+
+
+class GLSFitter(Fitter):
+    """GLS fit with correlated noise marginalized in basis space
+    (reference: GLSFitter)."""
+
+    def __init__(self, toas, model, residuals=None, track_mode=None,
+                 full_cov=False):
+        super().__init__(toas, model, residuals=residuals,
+                         track_mode=track_mode)
+        self.full_cov = full_cov
+        self.noise_resids: Optional[np.ndarray] = None
+
+    # -- one linearized solve at the current parameters ----------------
+
+    def _solve_once(self, threshold=None):
+        self.resids = Residuals(self.toas, self.model,
+                                track_mode=self.track_mode)
+        r = jnp.asarray(self.resids.time_resids)
+        M, names, units = self.get_designmatrix()
+        M = jnp.asarray(M)
+        nvec = jnp.asarray(
+            self.model.scaled_toa_uncertainty(self.toas) ** 2)
+        Fb = self.model.noise_model_designmatrix(self.toas)
+        phi = self.model.noise_model_basis_weight(self.toas)
+        if Fb is None:
+            Fb = np.zeros((self.toas.ntoas, 0))
+            phi = np.ones(0)
+        Fb, phi = jnp.asarray(Fb), jnp.asarray(phi)
+        if self.full_cov:
+            x, cov, chi2, noise = _gls_kernel_fullcov(M, Fb, phi, r, nvec)
+        elif threshold is not None:
+            x, cov, chi2, noise, _ = _gls_kernel_svd(
+                M, Fb, phi, r, nvec, threshold=float(threshold))
+        else:
+            x, cov, chi2, noise, _, ok = _gls_kernel(M, Fb, phi, r, nvec)
+            if not bool(ok):
+                x, cov, chi2, noise, _ = _gls_kernel_svd(
+                    M, Fb, phi, r, nvec)
+        # r ≈ M (θ − θ_true): the correction is −x (see WLSFitter)
+        return (-np.asarray(x), np.asarray(cov), float(chi2),
+                np.asarray(noise), names)
+
+    def fit_toas(self, maxiter=1, threshold=None):
+        for _ in range(max(1, maxiter)):
+            x, cov, chi2, noise, names = self._solve_once(threshold)
+            self.update_model(x, names)
+            self.set_uncertainties(cov, names)
+            self.noise_resids = noise
+        self.resids = Residuals(self.toas, self.model,
+                                track_mode=self.track_mode)
+        x, cov, chi2, noise, names = self._solve_once(threshold)
+        self.noise_resids = noise
+        self.converged = True
+        return chi2
+
+    def get_noise_resids(self):
+        """ML realization of the correlated-noise process [s]
+        (reference: GLSFitter resids_noise)."""
+        return self.noise_resids
+
+
+class DownhillGLSFitter(GLSFitter):
+    """Step-halving downhill wrapper over the GLS step (reference:
+    DownhillGLSFitter)."""
+
+    def _chi2_here(self):
+        """chi2 at the current parameter point (basis-marginalized)."""
+        r = Residuals(self.toas, self.model,
+                      track_mode=self.track_mode).time_resids
+        return gls_chi2(self.model, self.toas, resids=r)
+
+    def fit_toas(self, maxiter=20, threshold=None, min_lambda=1e-3,
+                 required_chi2_decrease=1e-2):
+        best_chi2 = self._chi2_here()
+        x = cov = noise = names = None
+        converged = False
+        for _ in range(maxiter):
+            x, cov, _, noise, names = self._solve_once(threshold)
+            lam, accepted = 1.0, False
+            while lam >= min_lambda:
+                self.update_model(lam * x, names)
+                new_chi2 = self._chi2_here()
+                if new_chi2 <= best_chi2 + 1e-12:
+                    accepted = True
+                    break
+                self.update_model(-lam * x, names)
+                lam /= 2.0
+            if not accepted:
+                converged = True
+                break
+            improved = best_chi2 - new_chi2
+            best_chi2 = new_chi2
+            self.set_uncertainties(cov, names)
+            self.noise_resids = noise
+            if improved < required_chi2_decrease:
+                converged = True
+                break
+        else:
+            raise MaxiterReached(
+                f"no convergence in {maxiter} downhill GLS iterations")
+        self.converged = converged
+        # refresh uncertainties/noise realization at the final point
+        x, cov, _, noise, names = self._solve_once(threshold)
+        self.set_uncertainties(cov, names)
+        self.noise_resids = noise
+        self.resids = Residuals(self.toas, self.model,
+                                track_mode=self.track_mode)
+        return best_chi2

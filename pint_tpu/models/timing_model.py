@@ -383,6 +383,7 @@ class TimingModel:
         if not params_only:
             self._cache_key = None
             self._cache = None
+            self.__dict__.pop("_noise_basis_cache", None)
         # ref epoch may shift when epochs change
         self.__dict__.pop("_ref_day", None)
 
@@ -493,6 +494,85 @@ class TimingModel:
             return ph.hi + ph.lo
 
         return jax.jacfwd(phase_of)(jnp.asarray(th[i]))
+
+    # ---------------- noise-model aggregation -------------------------
+    # (reference: TimingModel.scaled_toa_uncertainty,
+    #  .noise_model_designmatrix, .noise_model_basis_weight,
+    #  .has_correlated_errors)
+
+    @property
+    def noise_components(self):
+        out = [c for c in self.components.values()
+               if getattr(c, "category", "") == "noise"]
+        return sorted(out, key=lambda c: type(c).__name__)
+
+    @property
+    def has_correlated_errors(self) -> bool:
+        return any(getattr(c, "is_basis_noise", False)
+                   for c in self.noise_components)
+
+    def scaled_toa_uncertainty(self, toas) -> np.ndarray:
+        """Per-TOA white sigma [s] after EFAC/EQUAD scaling."""
+        sigma2 = (toas.get_errors() * 1e-6) ** 2
+        for c in self.noise_components:
+            sigma2 = c.scale_toa_sigma_s2(toas, sigma2)
+        return np.sqrt(sigma2)
+
+    def scaled_dm_uncertainty(self, toas) -> np.ndarray:
+        """Per-TOA wideband-DM sigma [pc/cm^3] after DMEFAC/DMEQUAD."""
+        from pint_tpu.wideband import get_wideband_dm
+
+        _, dmerr = get_wideband_dm(toas)
+        sigma2 = dmerr ** 2
+        for c in self.noise_components:
+            sigma2 = c.scale_dm_sigma2(toas, sigma2)
+        return np.sqrt(sigma2)
+
+    def noise_model_basis_weight_pairs(self, toas):
+        """[(component name, F, phi), ...] for every active basis.
+        Cached per (TOA set, noise hyperparameter values): the bases are
+        static during a least-squares fit (hyperparameters only move
+        under MCMC), but quantization + Fourier builds are O(N·q) host
+        work worth doing once, not once per downhill trial step."""
+        key = (id(toas), tuple(
+            (p.name, p.value) for c in self.noise_components
+            for p in c.params.values()))
+        cached = self.__dict__.get("_noise_basis_cache")
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        out = []
+        for c in self.noise_components:
+            if not getattr(c, "is_basis_noise", False):
+                continue
+            pair = c.noise_basis_weight(toas)
+            if pair is not None:
+                out.append((type(c).__name__, pair[0], pair[1]))
+        self._noise_basis_cache = (key, out)
+        return out
+
+    def noise_model_designmatrix(self, toas):
+        """Stacked (N, q) noise basis, or None when no basis is active."""
+        pairs = self.noise_model_basis_weight_pairs(toas)
+        if not pairs:
+            return None
+        return np.concatenate([F for _, F, _ in pairs], axis=1)
+
+    def noise_model_basis_weight(self, toas):
+        """Stacked (q,) prior variances matching the designmatrix."""
+        pairs = self.noise_model_basis_weight_pairs(toas)
+        if not pairs:
+            return None
+        return np.concatenate([phi for _, _, phi in pairs])
+
+    def noise_model_dimensions(self, toas):
+        """{component name: (start, length)} column spans in the stacked
+        basis (reference: TimingModel.noise_model_dimensions)."""
+        out = {}
+        start = 0
+        for name, F, _ in self.noise_model_basis_weight_pairs(toas):
+            out[name] = (start, F.shape[1])
+            start += F.shape[1]
+        return out
 
     # ---------------- par-file round trip -----------------------------
 

@@ -102,9 +102,15 @@ class Residuals:
 
     @property
     def chi2(self) -> float:
-        """White chi2 against scaled (or raw) TOA errors. GLS-aware chi2
-        lives in the GLS fitter (reference: Residuals.chi2 defers the
-        same way)."""
+        """chi2 of the residuals. With correlated-noise components this
+        is the basis-marginalized GLS chi2 r^T C^-1 r (reference:
+        Residuals.calc_chi2 defers to the GLS solve the same way);
+        otherwise the white chi2 against scaled TOA errors."""
+        if getattr(self.model, "has_correlated_errors", False):
+            from pint_tpu.gls import gls_chi2
+
+            return gls_chi2(self.model, self.toas,
+                            resids=self.time_resids)
         err_s = self._scaled_errors_s()
         return float(np.sum((self.time_resids / err_s) ** 2))
 

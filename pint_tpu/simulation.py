@@ -47,9 +47,38 @@ def _rebuild(t: TOAs, day, frac) -> TOAs:
     return new
 
 
+def correlated_noise_draw(toas: TOAs, model,
+                          rng: Optional[np.random.Generator] = None
+                          ) -> np.ndarray:
+    """One realization [s] of the model's correlated-noise processes:
+    delta = F @ (sqrt(phi) * z), z ~ N(0,1) per basis column (reference:
+    simulation.add_correlated_noise over the noise-model bases)."""
+    rng = rng or np.random.default_rng()
+    F = model.noise_model_designmatrix(toas)
+    if F is None:
+        return np.zeros(toas.ntoas)
+    phi = model.noise_model_basis_weight(toas)
+    return F @ (np.sqrt(phi) * rng.standard_normal(F.shape[1]))
+
+
+def _noise_draw_s(t: TOAs, model, rng, white: bool,
+                  correlated: bool) -> np.ndarray:
+    """Noise draw [s]: white at the EFAC/EQUAD-scaled sigma when
+    ``white``, plus a correlated-basis draw when ``correlated``."""
+    noise_s = np.zeros(t.ntoas)
+    if white:
+        sigma = model.scaled_toa_uncertainty(t) if model.noise_components \
+            else t.error_us * 1e-6
+        noise_s = rng.standard_normal(t.ntoas) * sigma
+    if correlated:
+        noise_s = noise_s + correlated_noise_draw(t, model, rng)
+    return noise_s
+
+
 def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int,
                            model, error_us: float = 1.0, obs: str = "gbt",
                            freq_mhz: float = 1400.0, add_noise: bool = False,
+                           add_correlated_noise: bool = False,
                            rng: Optional[np.random.Generator] = None,
                            name: str = "fake") -> TOAs:
     """Evenly spaced synthetic TOAs landing on integer model phase
@@ -63,25 +92,28 @@ def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int,
             planets=bool(model.PLANET_SHAPIRO.value))
     t.names = [f"{name}{i}" for i in range(t.ntoas)]
     t = zero_residuals(t, model)
-    if add_noise:
+    if add_noise or add_correlated_noise:
         rng = rng or np.random.default_rng()
-        noise_s = rng.standard_normal(t.ntoas) * t.error_us * 1e-6
+        noise_s = _noise_draw_s(t, model, rng, add_noise,
+                                add_correlated_noise)
         frac = dd_np.add(t.mjd_frac,
                          dd_np.div_f(dd_np.dd(noise_s), SECS_PER_DAY))
         t = _rebuild(t, t.mjd_day, frac)
     return t
 
 
-def make_fake_toas_fromtim(timfile, model, add_noise=False, rng=None):
+def make_fake_toas_fromtim(timfile, model, add_noise=False,
+                           add_correlated_noise=False, rng=None):
     """Replace the TOAs of an existing tim file with model-aligned fakes
     (reference: make_fake_toas_fromtim)."""
     from pint_tpu.toa import get_TOAs
 
     t = get_TOAs(timfile, model=model)
     t = zero_residuals(t, model)
-    if add_noise:
+    if add_noise or add_correlated_noise:
         rng = rng or np.random.default_rng()
-        noise_s = rng.standard_normal(t.ntoas) * t.error_us * 1e-6
+        noise_s = _noise_draw_s(t, model, rng, add_noise,
+                                add_correlated_noise)
         frac = dd_np.add(t.mjd_frac,
                          dd_np.div_f(dd_np.dd(noise_s), SECS_PER_DAY))
         t = _rebuild(t, t.mjd_day, frac)

@@ -1,0 +1,237 @@
+"""Noise-model + GLS fitter tests (reference analogs:
+tests/test_gls_fitter.py, test_ecorr_average.py, test_dmefac_dmequad.py,
+test_pldmnoise.py): basis construction unit tests, white-noise scaling
+semantics, simulate→fit recovery with correlated noise, and agreement
+between the jitted TPU kernel, the SVD path, the full-covariance path,
+and the pure-numpy reference-algorithm mirror."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import Fitter
+from pint_tpu.gls import DownhillGLSFitter, GLSFitter, gls_solve_np
+from pint_tpu.models import get_model
+from pint_tpu.models.noise import (
+    create_fourier_design_matrix,
+    create_quantization_matrix,
+    powerlaw,
+)
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR_BASE = """PSR J1910+1256
+RAJ 19:10:09.70 1
+DECJ 12:56:25.5 1
+F0 200.65880532 1
+F1 -3.9e-16 1
+PEPOCH 55000.0
+POSEPOCH 55000.0
+DM 38.07 1
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400.0
+UNITS TDB
+"""
+
+NOISE_LINES = """EFAC -be GUPPI 1.1
+EQUAD -be GUPPI 0.5
+ECORR -be GUPPI 2.0
+TNREDAMP -13.5
+TNREDGAM 3.5
+TNREDC 10
+"""
+
+
+# ---------------------------------------------------------------- unit
+
+
+def test_quantization_matrix_buckets():
+    t = np.array([0.0, 0.001, 0.002, 5.0, 5.001, 20.0])
+    U = create_quantization_matrix(t, dt_days=0.5, nmin=2)
+    # two epochs of >=2 TOAs; the singleton at day 20 is dropped
+    assert U.shape == (6, 2)
+    np.testing.assert_array_equal(U[:, 0], [1, 1, 1, 0, 0, 0])
+    np.testing.assert_array_equal(U[:, 1], [0, 0, 0, 1, 1, 0])
+
+
+def test_quantization_matrix_unsorted_input():
+    t = np.array([5.0, 0.0, 5.001, 0.001])
+    U = create_quantization_matrix(t, dt_days=0.5)
+    assert U.shape == (4, 2)
+    assert U[1, 0] == 1 and U[3, 0] == 1 and U[0, 1] == 1 and U[2, 1] == 1
+
+
+def test_fourier_design_matrix():
+    t = np.linspace(0, 1000.0, 64)
+    F, freqs = create_fourier_design_matrix(t, 3)
+    assert F.shape == (64, 6) and freqs.shape == (6,)
+    T = t.max() - t.min()
+    np.testing.assert_allclose(freqs[:2], 1.0 / T)
+    np.testing.assert_allclose(F[:, 0], np.sin(2 * np.pi * t / T))
+    np.testing.assert_allclose(F[:, 1], np.cos(2 * np.pi * t / T))
+
+
+def test_powerlaw_scaling():
+    # doubling A quadruples power; gamma steepens low frequencies
+    f = np.array([1e-8, 1e-7])
+    p1 = powerlaw(f, 1e-14, 3.0)
+    p2 = powerlaw(f, 2e-14, 3.0)
+    np.testing.assert_allclose(p2 / p1, 4.0)
+    assert powerlaw(f, 1e-14, 5.0)[0] / powerlaw(f, 1e-14, 5.0)[1] \
+        == pytest.approx(1e5)
+
+
+# ------------------------------------------------------------ fixtures
+
+
+def _model(noise=True):
+    par = PAR_BASE + (NOISE_LINES if noise else "")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(io.StringIO(par))
+
+
+@pytest.fixture(scope="module")
+def sim_noise():
+    """Simulated dataset carrying EFAC/EQUAD + ECORR + red noise, with
+    clustered same-day TOAs so ECORR has epochs to bite on."""
+    m = _model()
+    rng = np.random.default_rng(11)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.simulation import _rebuild, zero_residuals
+        from pint_tpu.toa import get_TOAs_array
+
+        base = np.linspace(54500, 56500, 80)
+        mjds = np.concatenate([base, base + 0.002, base + 0.004])
+        mjds.sort()
+        t = get_TOAs_array(mjds, obs="gbt", freqs=1400.0, errors=1.0)
+        for f in t.flags:
+            f["be"] = "GUPPI"
+        t = zero_residuals(t, m)
+        from pint_tpu.simulation import _noise_draw_s
+        from pint_tpu.ops import dd_np
+
+        noise_s = _noise_draw_s(t, m, rng, white=True, correlated=True)
+        frac = dd_np.add(t.mjd_frac,
+                         dd_np.div_f(dd_np.dd(noise_s), 86400.0))
+        t = _rebuild(t, t.mjd_day, frac)
+        for f in t.flags:
+            f["be"] = "GUPPI"
+    truth = {n: m.get_param(n).value for n in m.free_params}
+    return m, t, truth
+
+
+# -------------------------------------------------- white-noise scaling
+
+
+def test_scaled_toa_uncertainty(sim_noise):
+    m, t, _ = sim_noise
+    sig = m.scaled_toa_uncertainty(t)
+    # EFAC 1.1, EQUAD 0.5 us on 1.0 us errors:
+    expect = 1.1 * np.sqrt(1.0 + 0.25) * 1e-6
+    np.testing.assert_allclose(sig, expect)
+
+
+def test_noise_basis_shapes(sim_noise):
+    m, t, _ = sim_noise
+    F = m.noise_model_designmatrix(t)
+    phi = m.noise_model_basis_weight(t)
+    dims = m.noise_model_dimensions(t)
+    assert F.shape[0] == t.ntoas and F.shape[1] == phi.shape[0]
+    # 80 epochs of 3 TOAs + 2*10 Fourier modes
+    assert dims["EcorrNoise"][1] == 80
+    assert dims["PLRedNoise"][1] == 20
+    assert np.all(phi > 0)
+
+
+# ------------------------------------------------------------ solves
+
+
+def test_gls_matches_numpy_mirror(sim_noise):
+    m, t, _ = sim_noise
+    f = GLSFitter(t, m)
+    r = Residuals(t, m).time_resids
+    M, names, _ = f.get_designmatrix()
+    nvec = m.scaled_toa_uncertainty(t) ** 2
+    F = m.noise_model_designmatrix(t)
+    phi = m.noise_model_basis_weight(t)
+    from pint_tpu.gls import _gls_kernel, _gls_kernel_fullcov, _gls_kernel_svd
+    import jax.numpy as jnp
+
+    args = (jnp.asarray(M), jnp.asarray(F), jnp.asarray(phi),
+            jnp.asarray(r), jnp.asarray(nvec))
+    x, cov, chi2, noise, _, ok = _gls_kernel(*args)
+    assert bool(ok)
+    xn, covn, chi2n, noisen = gls_solve_np(M, F, phi, r, nvec)
+    np.testing.assert_allclose(np.asarray(x), xn, rtol=1e-8, atol=1e-14)
+    np.testing.assert_allclose(float(chi2), chi2n, rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(noise), noisen, rtol=1e-6,
+                               atol=1e-12)
+    # SVD path agrees
+    xs, covs, chi2s, _, _ = _gls_kernel_svd(*args)
+    np.testing.assert_allclose(np.asarray(xs), xn, rtol=1e-6, atol=1e-13)
+    # full-covariance cross-check (dense Woodbury equivalence)
+    xf, covf, chi2f, noisef = _gls_kernel_fullcov(*args)
+    np.testing.assert_allclose(np.asarray(xf), xn, rtol=1e-6, atol=1e-13)
+    np.testing.assert_allclose(float(chi2f), chi2n, rtol=1e-6)
+
+
+def test_gls_recovers_parameters(sim_noise):
+    m, t, truth = sim_noise
+    perturb = {"F0": 2e-10, "F1": 5e-18, "DM": 5e-4}
+    for k, dx in perturb.items():
+        m.get_param(k).add_delta(dx)
+    m.invalidate_cache(params_only=True)
+    f = DownhillGLSFitter(t, m)
+    f.fit_toas(maxiter=10)
+    for k in truth:
+        err = f.errors.get(k)
+        assert err is not None and err > 0
+        diff = abs(m.get_param(k).value - truth[k])
+        assert diff < 5 * err, (k, diff, err)
+    # restore
+    for k, v in truth.items():
+        m.get_param(k).value = v
+    m.invalidate_cache(params_only=True)
+
+
+def test_gls_chi2_sane(sim_noise):
+    m, t, _ = sim_noise
+    f = GLSFitter(t, m)
+    chi2 = f.fit_toas()
+    dof = t.ntoas - len(m.free_params) - 1
+    assert 0.5 < chi2 / dof < 2.0, chi2 / dof
+    assert f.noise_resids is not None and f.noise_resids.shape == (t.ntoas,)
+
+
+def test_auto_picks_gls(sim_noise):
+    m, t, _ = sim_noise
+    f = Fitter.auto(t, m)
+    assert isinstance(f, DownhillGLSFitter)
+    m2 = _model(noise=False)
+    f2 = Fitter.auto(t, m2, downhill=False)
+    assert type(f2).__name__ == "WLSFitter"
+
+
+def test_gls_reduces_to_wls_without_noise():
+    """With no noise components, GLS and WLS give identical updates."""
+    from pint_tpu.fitter import WLSFitter
+
+    m1, m2 = _model(noise=False), _model(noise=False)
+    rng = np.random.default_rng(3)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t = make_fake_toas_uniform(54500, 55500, 60, m1, error_us=1.0,
+                                   add_noise=True, rng=rng)
+    for m in (m1, m2):
+        m.F0.add_delta(1e-10)
+        m.invalidate_cache(params_only=True)
+    c1 = GLSFitter(t, m1).fit_toas()
+    c2 = WLSFitter(t, m2).fit_toas(maxiter=2)
+    assert m1.F0.value == pytest.approx(m2.F0.value, abs=5e-14)
+    assert c1 == pytest.approx(
+        Residuals(t, m2).chi2, rel=1e-6)
