@@ -1,0 +1,167 @@
+"""North-star benchmark (BASELINE.md): GLS fit iteration throughput —
+design-matrix build + whitening + normal equations + Cholesky — on a
+10k-TOA, 40-free-parameter model with ECORR + power-law red noise.
+
+Numerator: the single jitted XLA fit step (pint_tpu.parallel.fit_step)
+on the default backend (TPU under axon; falls back to CPU elsewhere).
+Denominator: the reference algorithm's CPU path — phase/design matrix
+evaluated on the CPU backend plus the numpy/scipy Woodbury GLS solve
+(pint_tpu.gls.gls_solve_np), mirroring src/pint/fitter.py
+GLSFitter.fit_toas (BASELINE.md measurement protocol: the reference
+itself is not runnable in this image).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+NTOA = 10_000
+NDMX = 25  # 25 DMX + 15 other free params = 40 columns + offset
+
+
+def build_problem():
+    import io
+    import warnings
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    span0, span1 = 53000.0, 57000.0
+    par = [
+        "PSR J0000+0000",
+        "RAJ 12:00:00.0 1",
+        "DECJ 30:00:00.0 1",
+        "PMRA 2.0 1",
+        "PMDEC -3.0 1",
+        "PX 1.2 1",
+        "F0 300.123456789 1",
+        "F1 -1.0e-15 1",
+        "F2 1e-26 1",
+        "DM 20.0 1",
+        "DM1 1e-4 1",
+        "DM2 1e-6 1",
+        "PEPOCH 55000",
+        "POSEPOCH 55000",
+        "DMEPOCH 55000",
+        "TZRMJD 55000.1",
+        "TZRSITE @",
+        "TZRFRQ 1400",
+        "UNITS TDB",
+        "EFAC -be X 1.1",
+        "EQUAD -be X 0.3",
+        "ECORR -be X 1.2",
+        "TNREDAMP -13.7",
+        "TNREDGAM 3.5",
+        "TNREDC 30",
+    ]
+    for i in range(4):
+        par.append(f"JUMP -grp g{i} 1e-6 1")
+    edges = np.linspace(span0, span1, NDMX + 1)
+    for i in range(NDMX):
+        par.append(f"DMX_{i + 1:04d} 0.0 1")
+        par.append(f"DMXR1_{i + 1:04d} {edges[i]:.4f}")
+        par.append(f"DMXR2_{i + 1:04d} {edges[i + 1]:.4f}")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO("\n".join(par) + "\n"))
+        rng = np.random.default_rng(1)
+        # clustered epochs so ECORR has structure: 2500 epochs x 4 TOAs
+        toas = make_fake_toas_uniform(
+            span0 + 1, span1 - 1, NTOA, model, error_us=1.0,
+            add_noise=True, rng=rng)
+        for i, f in enumerate(toas.flags):
+            f["be"] = "X"
+            f["grp"] = f"g{i % 5}"  # g4 matches no JUMP: 4 free jumps
+    return model, toas
+
+
+def time_fn(fn, reps=5):
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    backend = jax.default_backend()
+    log(f"backend: {backend}, devices: {jax.devices()}")
+
+    model, toas = build_problem()
+    nfree = len(model.free_params)
+    log(f"N={toas.ntoas} free params={nfree}")
+
+    from pint_tpu.parallel import build_fit_step
+
+    step_fn, args, names = build_fit_step(model, toas)
+    jitted = jax.jit(step_fn)
+    t0 = time.perf_counter()
+    out = jitted(*args)
+    jax.block_until_ready(out)
+    log(f"compile+first run: {time.perf_counter() - t0:.1f}s "
+        f"chi2={float(out[2]):.1f}")
+
+    accel_t = time_fn(lambda: jax.block_until_ready(jitted(*args)))
+    log(f"accelerated fit step: {accel_t * 1e3:.1f} ms "
+        f"({toas.ntoas / accel_t:.0f} TOA/s)")
+
+    # ---- CPU reference-algorithm path -------------------------------
+    cpu = jax.devices("cpu")[0]
+    from pint_tpu.gls import gls_solve_np
+
+    with jax.default_device(cpu):
+        cpu_args = jax.device_put(args, cpu)
+        cpu_jit = jax.jit(step_fn)
+        jax.block_until_ready(cpu_jit(*cpu_args))  # warm
+
+        # CPU denominator, reference-style: design matrix + residuals on
+        # host, then the numpy/scipy basis-Woodbury solve
+        M_, names_, _ = model.designmatrix(toas)
+        r_ = np.zeros(toas.ntoas)
+
+        def cpu_once():
+            from pint_tpu.residuals import Residuals
+
+            res = Residuals(toas, model)
+            r = res.time_resids
+            M, _, _ = model.designmatrix(toas)
+            nvec = model.scaled_toa_uncertainty(toas) ** 2
+            F = model.noise_model_designmatrix(toas)
+            phi = model.noise_model_basis_weight(toas)
+            model._cache_key = None  # defeat caching: honest rebuild
+            model.__dict__.pop("_noise_basis_cache", None)
+            return gls_solve_np(np.asarray(M), F, phi, np.asarray(r),
+                                nvec)
+
+        cpu_t = time_fn(cpu_once, reps=3)
+    log(f"cpu reference path: {cpu_t * 1e3:.1f} ms "
+        f"({toas.ntoas / cpu_t:.0f} TOA/s)")
+
+    value = toas.ntoas / accel_t
+    print(json.dumps({
+        "metric": "gls_fit_iteration_throughput_10k_toas_40p",
+        "value": round(value, 1),
+        "unit": "TOA/s",
+        "vs_baseline": round(cpu_t / accel_t, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

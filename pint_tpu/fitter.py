@@ -43,7 +43,12 @@ def _wls_solve(M, r, err_s, threshold_arg=None):
     zero singular values below threshold·s_max.
     """
     w = 1.0 / err_s
-    Mw = M * w[:, None]
+    # two-stage column scaling: F1/F2 columns reach ~1e13 s/unit, so
+    # sum((M*w)^2) would exceed the exponent range of TPU-emulated f64
+    # (f32-range limited); divide by the overflow-safe column max first
+    colmax = jnp.max(jnp.abs(M), axis=0)
+    colmax = jnp.where(colmax == 0, 1.0, colmax)
+    Mw = (M / colmax[None, :]) * w[:, None]
     rw = r * w
     norm = jnp.sqrt(jnp.sum(Mw * Mw, axis=0))
     norm = jnp.where(norm == 0, 1.0, norm)
@@ -54,9 +59,9 @@ def _wls_solve(M, r, err_s, threshold_arg=None):
     keep = s > thresh * s[0]
     s_inv = jnp.where(keep, 1.0 / s, 0.0)
     x_n = Vt.T @ (s_inv * (U.T @ rw))
-    x = x_n / norm
+    x = x_n / colmax / norm
     cov_n = (Vt.T * (s_inv ** 2)[None, :]) @ Vt
-    cov = cov_n / jnp.outer(norm, norm)
+    cov = cov_n / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
     resid_post = rw - Mn @ x_n
     chi2_post = jnp.sum(resid_post ** 2)
     return x, cov, chi2_post

@@ -43,21 +43,34 @@ def _gls_kernel(M, F, phi, r, nvec):
     non-finite values (caller falls back to SVD)."""
     p = M.shape[1]
     w = 1.0 / nvec                       # N^-1 diagonal
-    norm = jnp.sqrt(jnp.sum(M * M * w[:, None], axis=0))
+    # two-stage column scaling: sum(M^2*w) can exceed the exponent
+    # range of TPU-emulated f64 (f32-range limited) for F1/F2 columns;
+    # dividing by the overflow-safe column max first keeps all
+    # intermediates in range (see pint_tpu/parallel/fit_step.py)
+    colmax = jnp.max(jnp.abs(M), axis=0)
+    colmax = jnp.where(colmax == 0, 1.0, colmax)
+    Ms = M / colmax[None, :]
+    norm = jnp.sqrt(jnp.sum(Ms * Ms * w[:, None], axis=0))
     norm = jnp.where(norm == 0, 1.0, norm)
-    Mn = M / norm[None, :]
+    Mn = Ms / norm[None, :]
     big = jnp.concatenate([Mn, F], axis=1)        # (N, p+q)
     bigw = big * w[:, None]
     Sigma = big.T @ bigw                           # (p+q, p+q)
     prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
     Sigma = Sigma + jnp.diag(prior)
     b = bigw.T @ r                                 # (p+q,)
-    cf = jax.scipy.linalg.cho_factor(Sigma, lower=True)
-    xhat = jax.scipy.linalg.cho_solve(cf, b)
-    inv = jax.scipy.linalg.cho_solve(cf, jnp.eye(Sigma.shape[0]))
+    # Jacobi-preconditioned Cholesky: raw Sigma mixes O(1) data terms
+    # with 1/phi priors (~1e25); unit-diagonal scaling keeps the
+    # factorization stable, notably on TPU's non-IEEE emulated f64
+    d = jnp.sqrt(jnp.diagonal(Sigma))
+    d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+    cf = jax.scipy.linalg.cho_factor(Sigma / jnp.outer(d, d), lower=True)
+    xhat = jax.scipy.linalg.cho_solve(cf, b / d) / d
+    inv = jax.scipy.linalg.cho_solve(
+        cf, jnp.eye(Sigma.shape[0])) / jnp.outer(d, d)
     chi2 = jnp.sum(r * r * w) - xhat @ b
-    dparams = xhat[:p] / norm
-    cov = inv[:p, :p] / jnp.outer(norm, norm)
+    dparams = xhat[:p] / colmax / norm
+    cov = inv[:p, :p] / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
     noise_resid = F @ xhat[p:]
     ok = jnp.all(jnp.isfinite(xhat)) & jnp.all(jnp.isfinite(cov))
     return dparams, cov, chi2, noise_resid, xhat, ok
@@ -76,9 +89,12 @@ def _gls_kernel_svd(M, F, phi, r, nvec, threshold=1e-12):
     genuine degeneracies are then exactly the small eigenvalues."""
     p = M.shape[1]
     w = 1.0 / nvec
-    norm = jnp.sqrt(jnp.sum(M * M * w[:, None], axis=0))
+    colmax = jnp.max(jnp.abs(M), axis=0)
+    colmax = jnp.where(colmax == 0, 1.0, colmax)
+    Ms = M / colmax[None, :]
+    norm = jnp.sqrt(jnp.sum(Ms * Ms * w[:, None], axis=0))
     norm = jnp.where(norm == 0, 1.0, norm)
-    Mn = M / norm[None, :]
+    Mn = Ms / norm[None, :]
     big = jnp.concatenate([Mn, F], axis=1)
     bigw = big * w[:, None]
     Sigma = big.T @ bigw
@@ -94,8 +110,8 @@ def _gls_kernel_svd(M, F, phi, r, nvec, threshold=1e-12):
     xhat = (U @ (s_inv * (U.T @ (b / d)))) / d
     inv = ((U * s_inv[None, :]) @ U.T) / jnp.outer(d, d)
     chi2 = jnp.sum(r * r * w) - xhat @ b
-    dparams = xhat[:p] / norm
-    cov = inv[:p, :p] / jnp.outer(norm, norm)
+    dparams = xhat[:p] / colmax / norm
+    cov = inv[:p, :p] / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
     noise_resid = F @ xhat[p:]
     return dparams, cov, chi2, noise_resid, xhat
 
@@ -111,8 +127,11 @@ def _gls_chi2_kernel(F, phi, r, nvec):
     w = 1.0 / nvec
     bF = (F * w[:, None]).T @ r
     Sff = F.T @ (F * w[:, None]) + jnp.diag(1.0 / phi)
-    cf = jax.scipy.linalg.cho_factor(Sff, lower=True)
-    return jnp.sum(r * r * w) - bF @ jax.scipy.linalg.cho_solve(cf, bF)
+    d = jnp.sqrt(jnp.diagonal(Sff))
+    d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+    cf = jax.scipy.linalg.cho_factor(Sff / jnp.outer(d, d), lower=True)
+    return jnp.sum(r * r * w) - bF @ (
+        jax.scipy.linalg.cho_solve(cf, bF / d) / d)
 
 
 def gls_chi2(model, toas, resids=None) -> float:
@@ -220,11 +239,9 @@ class GLSFitter(Fitter):
         for _ in range(max(1, maxiter)):
             x, cov, chi2, noise, names = self._solve_once(threshold)
             self.update_model(x, names)
-            self.set_uncertainties(cov, names)
-            self.noise_resids = noise
-        self.resids = Residuals(self.toas, self.model,
-                                track_mode=self.track_mode)
+        # uncertainties, chi2 and noise realization at the final point
         x, cov, chi2, noise, names = self._solve_once(threshold)
+        self.set_uncertainties(cov, names)
         self.noise_resids = noise
         self.converged = True
         return chi2
@@ -240,10 +257,10 @@ class DownhillGLSFitter(GLSFitter):
     DownhillGLSFitter)."""
 
     def _chi2_here(self):
-        """chi2 at the current parameter point (basis-marginalized)."""
-        r = Residuals(self.toas, self.model,
-                      track_mode=self.track_mode).time_resids
-        return gls_chi2(self.model, self.toas, resids=r)
+        """chi2 at the current parameter point (basis-marginalized;
+        Residuals.chi2 is GLS-aware and does exactly this)."""
+        return Residuals(self.toas, self.model,
+                         track_mode=self.track_mode).chi2
 
     def fit_toas(self, maxiter=20, threshold=None, min_lambda=1e-3,
                  required_chi2_decrease=1e-2):
@@ -276,9 +293,8 @@ class DownhillGLSFitter(GLSFitter):
                 f"no convergence in {maxiter} downhill GLS iterations")
         self.converged = converged
         # refresh uncertainties/noise realization at the final point
+        # (_solve_once also leaves self.resids at the final parameters)
         x, cov, _, noise, names = self._solve_once(threshold)
         self.set_uncertainties(cov, names)
         self.noise_resids = noise
-        self.resids = Residuals(self.toas, self.model,
-                                track_mode=self.track_mode)
         return best_chi2

@@ -1,0 +1,365 @@
+"""Binary pulsar models: ELL1/ELL1H, BT, DD/DDS.
+
+Reference: src/pint/models/pulsar_binary.py (PulsarBinary wrapper) +
+src/pint/models/stand_alone_psr_binaries/ (BT_model.py, DD_model.py,
+ELL1_model.py, ELL1H_model.py, binary_orbits.py). The reference splits
+wrapper (units, Parameters) from numpy standalone kernels; here the
+"standalone kernel" is simply the pure-jnp ``binary_delay`` method —
+unit handling lives in the parameter definitions, derivatives come from
+jacfwd through the (fixed-iteration, jit-friendly) Kepler solve instead
+of the reference's hand-coded ``prtl_der`` chains.
+
+Formulas follow SURVEY.md Appendix A.5:
+- Kepler: E - e sinE = M, Newton with a fixed 10-iteration unroll
+  (converges to f64 round-off for e < 0.95; branch-free).
+- DD (Damour-Deruelle 1986): alpha = x sin(omega), beta =
+  x sqrt(1-etheta^2) cos(omega); Dre = alpha (cosE - er) +
+  (beta + gamma) sinE with the inverse-timing expansion
+  Dre (1 - nhat Drep + (nhat Drep)^2 + 1/2 nhat^2 Dre Drepp - 1/2
+  e sinE/(1-e cosE) nhat^2 Dre Drep); Shapiro
+  -2 r ln(1 - e cosE - s [sin(omega)(cosE - e) +
+  sqrt(1-e^2) cos(omega) sinE]).
+- BT (Blandford-Teukolsky 1976): same Roemer/Einstein structure with
+  er = etheta = e and no Shapiro.
+- ELL1 (Lange et al. 2001): Phi = mean phase from TASC; Dre =
+  x [sinPhi + (eps2/2) sin2Phi - (eps1/2) cos2Phi]; Shapiro
+  -2 r ln(1 - s sinPhi). ELL1H re-parameterizes Shapiro with
+  orthometric H3/H4/STIG (Freire & Wex 2010).
+
+Orbits: PB/PBDOT or the FB0..FBn orbital-frequency series (reference:
+binary_orbits.py OrbitPB/OrbitFBX), selected by FB0's presence.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    prefixParameter,
+)
+from pint_tpu.models.timing_model import DelayComponent
+from pint_tpu.ops.dd import dd_add_f, dd_mul_f, dd_sub_f, dd_sub, dd_to_f64
+
+SECS_PER_DAY = 86400.0
+SECS_PER_YEAR = 365.25 * SECS_PER_DAY
+DEG2RAD = np.pi / 180.0
+TSUN = 4.925490947e-6  # GM_sun/c^3 [s]
+TWOPI = 2.0 * np.pi
+
+
+def _v(pv, name, default=0.0):
+    """Traced f64 value of a (possibly absent) parameter."""
+    p = pv.get(name)
+    return (p.hi + p.lo) if p is not None else default
+
+
+def kepler_E(M, ecc, niter: int = 10):
+    """Eccentric anomaly from mean anomaly: fixed-unroll Newton
+    (jit/vmap/grad friendly; reference: binary_generic.py
+    compute_eccentric_anomaly's iterative solve)."""
+    E = M + ecc * jnp.sin(M)
+    for _ in range(niter):
+        E = E - (E - ecc * jnp.sin(E) - M) / (1.0 - ecc * jnp.cos(E))
+    return E
+
+
+class PulsarBinary(DelayComponent):
+    """Base binary component (reference: pulsar_binary.PulsarBinary).
+
+    Subclasses define ``epoch_param`` (T0 or TASC) and
+    ``binary_delay(pv, dt, nhat, M, ctx)`` where dt is seconds since the
+    orbital epoch, M the mean anomaly/phase [rad], nhat = dM/dt [rad/s].
+    """
+
+    category = "pulsar_system"
+    register = False
+    epoch_param = "T0"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("PB", units="d",
+                                      description="orbital period"))
+        self.add_param(floatParameter("PBDOT", units="s/s", value=0.0))
+        self.add_param(floatParameter("A1", units="ls",
+                                      description="projected semi-major axis"))
+        self.add_param(floatParameter("A1DOT", units="ls/s", value=0.0,
+                                      aliases=["XDOT"]))
+        self.add_param(floatParameter("M2", units="Msun"))
+        self.add_param(floatParameter("SINI", units=""))
+        self.fb_terms: List[str] = []
+
+    def add_fb_term(self, index, value=0.0, frozen=True):
+        p = prefixParameter(prefix="FB", index=index,
+                            index_str=str(index), value=value,
+                            frozen=frozen, units=f"1/s^{index + 1}")
+        self.add_param(p)
+        self.setup()
+        return p
+
+    def setup(self):
+        self.fb_terms = sorted(
+            (n for n in self.params
+             if n.startswith("FB") and n[2:].isdigit()),
+            key=lambda n: int(n[2:]))
+        # TEMPO convention: *DOT values > 1e-7 are in 1e-12 units
+        for name in ("PBDOT", "A1DOT", "EDOT", "EPS1DOT", "EPS2DOT"):
+            if name in self.params:
+                p = self.params[name]
+                if p.value is not None and abs(p.value) > 1e-7:
+                    p.value = p.value * 1e-12
+                    if p.uncertainty is not None:
+                        p.uncertainty = p.uncertainty * 1e-12
+
+    def validate(self):
+        if self.params[self.epoch_param].value is None:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.epoch_param}")
+        if self.PB.value is None and not self.fb_terms:
+            raise ValueError(
+                f"{type(self).__name__} requires PB or FB0")
+
+    # -- orbit machinery ----------------------------------------------
+
+    def _dt(self, pv, batch, delay_so_far):
+        """Barycentric seconds since the orbital epoch."""
+        ref = self._parent.ref_day
+        tb = dd_mul_f(dd_add_f(batch.tdb_frac, batch.tdb_day - ref),
+                      SECS_PER_DAY)
+        epoch = pv[self.epoch_param]
+        eref = dd_mul_f(dd_add_f(dd_sub_f(epoch, ref), 0.0), SECS_PER_DAY)
+        return dd_to_f64(dd_sub(tb, eref)) - delay_so_far
+
+    def _orbit(self, pv, dt):
+        """(M, nhat): mean anomaly/phase [rad] and dM/dt [rad/s]."""
+        if self.fb_terms:
+            from pint_tpu.ops.taylor import taylor_horner, \
+                taylor_horner_deriv
+
+            coeffs = [jnp.zeros(())] + [_v(pv, n) for n in self.fb_terms]
+            M = TWOPI * taylor_horner(dt, coeffs)
+            nhat = TWOPI * taylor_horner_deriv(dt, coeffs, 1)
+            return M, nhat
+        pb_s = _v(pv, "PB") * SECS_PER_DAY
+        pbdot = _v(pv, "PBDOT")
+        u = dt / pb_s
+        M = TWOPI * (u - 0.5 * pbdot * u * u)
+        nhat = (TWOPI / pb_s) * (1.0 - pbdot * u)
+        return M, nhat
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        dt = self._dt(pv, batch, delay_so_far)
+        M, nhat = self._orbit(pv, dt)
+        return self.binary_delay(pv, dt, M, nhat, ctx)
+
+    def binary_delay(self, pv, dt, M, nhat, ctx):
+        raise NotImplementedError
+
+    # -- shared pieces -------------------------------------------------
+
+    @staticmethod
+    def _shapiro_rs(pv):
+        """(r, s) from M2/SINI [s, 1]."""
+        return TSUN * _v(pv, "M2"), _v(pv, "SINI")
+
+    @staticmethod
+    def _inverse_timing(Dre, Drep, Drepp, anhat, ecc_sinE_term):
+        """The DD inverse-orbit-timing expansion (reference:
+        DD_model.py delayR; SURVEY.md A.5)."""
+        nd = anhat * Drep
+        return Dre * (1.0 - nd + nd * nd
+                      + 0.5 * anhat * anhat * Dre * Drepp
+                      - 0.5 * ecc_sinE_term * anhat * anhat * Dre * Drep)
+
+
+class BinaryELL1(PulsarBinary):
+    """Small-eccentricity model (reference: binary_ell1.BinaryELL1 /
+    ELL1_model.ELL1model)."""
+
+    register = True
+    epoch_param = "TASC"
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("TASC",
+                                    description="ascending-node epoch"))
+        self.add_param(floatParameter("EPS1", units="", value=0.0,
+                                      description="e sin(omega)"))
+        self.add_param(floatParameter("EPS2", units="", value=0.0,
+                                      description="e cos(omega)"))
+        self.add_param(floatParameter("EPS1DOT", units="1/s", value=0.0))
+        self.add_param(floatParameter("EPS2DOT", units="1/s", value=0.0))
+
+    def _roemer(self, pv, dt, Phi, nhat):
+        x = _v(pv, "A1") + _v(pv, "A1DOT") * dt
+        eps1 = _v(pv, "EPS1") + _v(pv, "EPS1DOT") * dt
+        eps2 = _v(pv, "EPS2") + _v(pv, "EPS2DOT") * dt
+        sP, cP = jnp.sin(Phi), jnp.cos(Phi)
+        s2P, c2P = jnp.sin(2 * Phi), jnp.cos(2 * Phi)
+        # the constant -(3/2) eps1 term is part of the O(e) expansion of
+        # the Keplerian Roemer delay (Lange et al. 2001); without it
+        # ELL1 and BT disagree by a constant 1.5 x e sin(omega)
+        Dre = x * (sP + 0.5 * (eps2 * s2P - eps1 * c2P) - 1.5 * eps1)
+        Drep = x * (cP + eps2 * c2P + eps1 * s2P)
+        Drepp = x * (-sP - 2.0 * eps2 * s2P + 2.0 * eps1 * c2P)
+        return self._inverse_timing(Dre, Drep, Drepp, nhat, 0.0)
+
+    def _shapiro(self, pv, Phi):
+        r, s = self._shapiro_rs(pv)
+        return -2.0 * r * jnp.log(1.0 - s * jnp.sin(Phi))
+
+    def binary_delay(self, pv, dt, M, nhat, ctx):
+        return self._roemer(pv, dt, M, nhat) + self._shapiro(pv, M)
+
+
+class BinaryELL1H(BinaryELL1):
+    """ELL1 with orthometric Shapiro parameters H3/H4/STIG
+    (reference: binary_ell1.BinaryELL1H / ELL1H_model; Freire & Wex
+    2010). With STIG (or H4, via STIG = H4/H3): exact mapping
+    r = H3/STIG^3, s = 2 STIG/(1+STIG^2); with H3 alone the
+    third-harmonic approximation -(4/3) H3 sin(3 Phi)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.remove_param("M2")
+        self.remove_param("SINI")
+        self.add_param(floatParameter("H3", units="s",
+                                      description="3rd Shapiro harmonic"))
+        self.add_param(floatParameter("H4", units="s"))
+        self.add_param(floatParameter("STIG", units="",
+                                      aliases=["VARSIGMA"]))
+
+    def validate(self):
+        super().validate()
+        if self.H3.value is None:
+            raise ValueError("ELL1H requires H3")
+        if self.H4.value is not None and self.STIG.value is not None:
+            raise ValueError("give H4 or STIG, not both")
+
+    def _shapiro(self, pv, Phi):
+        h3 = _v(pv, "H3")
+        if self.STIG.value is not None or self.H4.value is not None:
+            stig = _v(pv, "STIG") if self.STIG.value is not None else \
+                _v(pv, "H4") / h3
+            r = h3 / (stig * stig * stig)
+            s = 2.0 * stig / (1.0 + stig * stig)
+            return -2.0 * r * jnp.log(1.0 - s * jnp.sin(Phi))
+        return -(4.0 / 3.0) * h3 * jnp.sin(3.0 * Phi)
+
+
+class _KeplerBinary(PulsarBinary):
+    """Shared eccentric-orbit plumbing for BT/DD."""
+
+    register = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("T0",
+                                    description="periastron epoch"))
+        self.add_param(floatParameter("ECC", units="", value=0.0,
+                                      aliases=["E"]))
+        self.add_param(floatParameter("EDOT", units="1/s", value=0.0))
+        self.add_param(floatParameter("OM", units="deg", value=0.0))
+        self.add_param(floatParameter("OMDOT", units="deg/yr", value=0.0))
+        self.add_param(floatParameter("GAMMA", units="s", value=0.0))
+
+    def _elements(self, pv, dt):
+        """(x, ecc, omega [rad]) with secular drifts applied."""
+        x = _v(pv, "A1") + _v(pv, "A1DOT") * dt
+        ecc = _v(pv, "ECC") + _v(pv, "EDOT") * dt
+        om = (_v(pv, "OM") + _v(pv, "OMDOT") * dt / SECS_PER_YEAR) \
+            * DEG2RAD
+        return x, ecc, om
+
+
+class BinaryBT(_KeplerBinary):
+    """Blandford-Teukolsky (reference: binary_bt.BinaryBT /
+    BT_model.BTmodel): Keplerian Roemer + Einstein, no Shapiro."""
+
+    register = True
+
+    def binary_delay(self, pv, dt, M, nhat, ctx):
+        x, ecc, om = self._elements(pv, dt)
+        E = kepler_E(M, ecc)
+        sE, cE = jnp.sin(E), jnp.cos(E)
+        alpha = x * jnp.sin(om)
+        beta = x * jnp.sqrt(1.0 - ecc * ecc) * jnp.cos(om)
+        gamma = _v(pv, "GAMMA")
+        Dre = alpha * (cE - ecc) + (beta + gamma) * sE
+        Drep = -alpha * sE + (beta + gamma) * cE
+        Drepp = -alpha * cE - (beta + gamma) * sE
+        anhat = nhat / (1.0 - ecc * cE)
+        return self._inverse_timing(
+            Dre, Drep, Drepp, anhat, ecc * sE / (1.0 - ecc * cE))
+
+
+class BinaryDD(_KeplerBinary):
+    """Damour-Deruelle (reference: binary_dd.BinaryDD /
+    DD_model.DDmodel)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("DR", units="", value=0.0))
+        self.add_param(floatParameter("DTH", units="", value=0.0,
+                                      aliases=["DTHETA"]))
+        self.add_param(floatParameter("A0", units="s", value=0.0))
+        self.add_param(floatParameter("B0", units="s", value=0.0))
+
+    def _shapiro_s(self, pv):
+        return _v(pv, "SINI")
+
+    def binary_delay(self, pv, dt, M, nhat, ctx):
+        x, ecc, om = self._elements(pv, dt)
+        er = ecc * (1.0 + _v(pv, "DR"))
+        eth = ecc * (1.0 + _v(pv, "DTH"))
+        E = kepler_E(M, ecc)
+        sE, cE = jnp.sin(E), jnp.cos(E)
+        sw, cw = jnp.sin(om), jnp.cos(om)
+        alpha = x * sw
+        beta = x * jnp.sqrt(1.0 - eth * eth) * cw
+        gamma = _v(pv, "GAMMA")
+        # Roemer + Einstein with inverse-timing correction
+        Dre = alpha * (cE - er) + (beta + gamma) * sE
+        Drep = -alpha * sE + (beta + gamma) * cE
+        Drepp = -alpha * cE - (beta + gamma) * sE
+        anhat = nhat / (1.0 - ecc * cE)
+        roemer = self._inverse_timing(
+            Dre, Drep, Drepp, anhat, ecc * sE / (1.0 - ecc * cE))
+        # Shapiro
+        r = TSUN * _v(pv, "M2")
+        s = self._shapiro_s(pv)
+        sqr = jnp.sqrt(1.0 - ecc * ecc)
+        shap = -2.0 * r * jnp.log(
+            1.0 - ecc * cE - s * (sw * (cE - ecc) + sqr * cw * sE))
+        # aberration (A0/B0, usually 0)
+        a0, b0 = _v(pv, "A0"), _v(pv, "B0")
+        nu = 2.0 * jnp.arctan2(
+            jnp.sqrt(1.0 + ecc) * jnp.sin(E / 2.0),
+            jnp.sqrt(1.0 - ecc) * jnp.cos(E / 2.0))
+        omnu = om + nu
+        aberr = a0 * (jnp.sin(omnu) + ecc * sw) + \
+            b0 * (jnp.cos(omnu) + ecc * cw)
+        return roemer + shap + aberr
+
+
+class BinaryDDS(BinaryDD):
+    """DD with SHAPMAX parameterization s = 1 - exp(-SHAPMAX)
+    (reference: binary_dd.BinaryDDS / DDS_model)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.remove_param("SINI")
+        self.add_param(floatParameter("SHAPMAX", units="", value=0.0))
+
+    def _shapiro_s(self, pv):
+        return 1.0 - jnp.exp(-_v(pv, "SHAPMAX"))

@@ -33,6 +33,7 @@ DEFAULT_COMPONENTS = ["Spindown"]
 _F_RE = re.compile(r"^F(\d+)$")
 _DM_RE = re.compile(r"^DM(\d+)$")
 _DMX_RE = re.compile(r"^(DMX_|DMXR1_|DMXR2_)(\d+)$")
+_FB_RE = re.compile(r"^FB(\d+)$")
 
 # mask-parameter families → owning component class (extended as the
 # component zoo grows; reference: maskParameter registry)
@@ -115,16 +116,22 @@ class ModelBuilder:
         for cls_name in DEFAULT_COMPONENTS:
             get_comp(cls_name)
 
+        # BINARY first, regardless of line order: binary parameters
+        # (T0, TASC, PB...) exist on several Binary* classes and must
+        # route to the instance the BINARY line selects
         for ln in lines:
-            key, toks = ln.key, ln.tokens
-            if key == "BINARY":
-                binary_name = toks[0]
+            if ln.key == "BINARY" and ln.tokens:
+                binary_name = ln.tokens[0]
                 cls_name = BINARY_COMPONENT_PREFIX + binary_name.upper()
                 if cls_name not in component_types:
                     raise NotImplementedError(
                         f"binary model {binary_name!r} is not implemented "
                         f"(known: {sorted(c for c in component_types if c.startswith('Binary'))})")
                 get_comp(cls_name)
+
+        for ln in lines:
+            key, toks = ln.key, ln.tokens
+            if key == "BINARY":
                 continue
             if key == "UNITS":
                 if toks and toks[0].upper() == "TCB":
@@ -135,13 +142,37 @@ class ModelBuilder:
                 get_comp("MiscParams").UNITS.value = toks[0] if toks else "TDB"
                 continue
 
-            # 1. exact/alias match against the registry index
+            # 1a. exact/alias match against already-instantiated
+            # components (binary params must land on the selected model)
+            matched = False
+            for comp in comps.values():
+                try:
+                    p = _param_by_name_or_alias(comp, key)
+                except KeyError:
+                    continue
+                p.from_tokens(toks)
+                matched = True
+                break
+            if matched:
+                continue
+
+            # 1b. exact/alias match against the registry index
             cls_name = self.param_index.get(key)
             if cls_name is not None:
                 comp = get_comp(cls_name)
                 p = _param_by_name_or_alias(comp, key)
                 p.from_tokens(toks)
                 continue
+
+            # 1c. FB orbital-frequency series → the active binary
+            m = _FB_RE.match(key)
+            if m:
+                binary = [c for c in comps.values()
+                          if type(c).__name__.startswith("Binary")]
+                if binary:
+                    p = binary[0].add_fb_term(int(m.group(1)))
+                    p.from_tokens(toks)
+                    continue
 
             # 2. prefix families
             m = _F_RE.match(key)

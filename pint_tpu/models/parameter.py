@@ -56,15 +56,20 @@ def parse_float_dd(s: str):
     ip, fp = m.group(2) or "", m.group(3) or ""
     exp = int(m.group(4) or 0) - len(fp)
     digits = (ip + fp).lstrip("0") or "0"
-    # value = digits * 10^exp
-    a, b = digits[:16], digits[16:32]
-    val = dd_np.mul(dd_np.dd(float(int(a))),
-                    _pow10_dd(exp + len(digits) - len(a)))
-    if b:
+    # value = digits * 10^exp, accumulated in 16-digit legs (three legs
+    # cover 48 significant digits — beyond dd's ~32 — so formatted dd
+    # values round-trip bit-exactly including the hi+lo f64 rounding)
+    val = dd_np.dd(0.0)
+    pos = 0
+    for leg in range(3):
+        chunk = digits[pos:pos + 16]
+        if not chunk:
+            break
         val = dd_np.add(
             val,
-            dd_np.mul(dd_np.dd(float(int(b))),
-                      _pow10_dd(exp + len(digits) - len(a) - len(b))))
+            dd_np.mul(dd_np.dd(float(int(chunk))),
+                      _pow10_dd(exp + len(digits) - pos - len(chunk))))
+        pos += 16
     return (sign * val[0], sign * val[1])
 
 

@@ -534,12 +534,15 @@ class TimingModel:
         static during a least-squares fit (hyperparameters only move
         under MCMC), but quantization + Fourier builds are O(N·q) host
         work worth doing once, not once per downhill trial step."""
-        key = (id(toas), tuple(
-            (p.name, p.value) for c in self.noise_components
-            for p in c.params.values()))
+        key = tuple(
+            (p.name, p.value, getattr(p, "key", None),
+             tuple(getattr(p, "key_value", ())))
+            for c in self.noise_components for p in c.params.values())
         cached = self.__dict__.get("_noise_basis_cache")
-        if cached is not None and cached[0] == key:
-            return cached[1]
+        # identity check via a held reference (not a bare id(), which
+        # CPython reuses after garbage collection)
+        if cached is not None and cached[0] is toas and cached[1] == key:
+            return cached[2]
         out = []
         for c in self.noise_components:
             if not getattr(c, "is_basis_noise", False):
@@ -547,7 +550,7 @@ class TimingModel:
             pair = c.noise_basis_weight(toas)
             if pair is not None:
                 out.append((type(c).__name__, pair[0], pair[1]))
-        self._noise_basis_cache = (key, out)
+        self._noise_basis_cache = (toas, key, out)
         return out
 
     def noise_model_designmatrix(self, toas):
@@ -578,6 +581,13 @@ class TimingModel:
 
     def as_parfile(self) -> str:
         lines = []
+        # derive the BINARY name from the component actually present
+        # (programmatically built models have no builder-side attribute)
+        binary = next(
+            (name[len("Binary"):] for name in self.components
+             if name.startswith("Binary")), None)
+        if binary:
+            lines.append(f"{'BINARY':<15} {binary:>25}\n")
         for c in self._ordered_components():
             for p in c.params.values():
                 line = p.as_parfile_line()

@@ -1,0 +1,18 @@
+"""Parallel / multi-chip execution layer.
+
+The reference is single-node (SURVEY.md §2c); its only parallelism is a
+process pool for chi2 grids. Here the parallel axes are TPU-native:
+
+- the TOA axis is block-sharded across the device mesh (the
+  "sequence-parallel" axis: design-matrix rows, residuals, and noise
+  bases live distributed; normal-equation assembly reduces over ICI) —
+  `pint_tpu.parallel.fit_step`;
+- the pulsar axis is an embarrassingly-parallel batch axis for PTA-scale
+  runs (vmapped GLS across pulsars, sharded over the mesh) —
+  `pint_tpu.parallel.pta`.
+"""
+
+from pint_tpu.parallel.fit_step import (  # noqa: F401
+    build_fit_step,
+    build_sharded_fit_step,
+)

@@ -1,0 +1,241 @@
+"""One GLS/WLS fit iteration as a single pure jittable function, and
+its mesh-sharded variant.
+
+Reference: src/pint/fitter.py GLSFitter.fit_toas runs residuals →
+designmatrix → solve as three host phases over numpy; here the whole
+iteration — phase evaluation (dd), residual mean subtraction, jacfwd
+design matrix, whitening, normal equations, Cholesky, chi2 — is ONE
+XLA program. That is the unit the driver compile-checks (`entry`) and
+the unit the benchmark times.
+
+Sharding (SURVEY.md §5 long-context): the TOA axis is the sequence
+axis. All (N, ...) inputs are block-sharded over the mesh's 'toa' axis;
+XLA GSPMD inserts the psum/all-gather for the weighted mean, the
+normal-equation reduction M^T N^-1 M (a ring-reduce over ICI — the
+moral equivalent of ring attention for normal-equation assembly), and
+the replicated (p+q)^2 Cholesky. Nothing in the model code mentions
+devices: the same function runs single-chip or sharded depending only
+on input shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.ops.dd import dd_frac
+
+__all__ = ["build_fit_step", "build_sharded_fit_step", "toa_sharding"]
+
+
+def _pad_to(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def build_fit_step(model, toas, pad_to: Optional[int] = None):
+    """(step_fn, args, names): step_fn is pure and jittable,
+
+        step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid)
+            -> (dparams, cov, chi2, resids)
+
+    dparams is the GLS parameter correction (Offset column first), cov
+    its covariance, chi2 the basis-marginalized chi2 at the current
+    point, resids the mean-subtracted time residuals [s].
+
+    ``valid`` is a 0/1 mask supporting padding of the TOA axis to a
+    mesh-divisible length: padded rows carry weight 0 everywhere.
+    """
+    phase_fn, (free_names, frozen_names) = model._build_phase_fn()
+    cache = model.get_cache(toas)
+    free, frozen, th, tl, fh, fl = model._pack()
+    if "F0" in free:
+        f0_src = ("free", free.index("F0"))
+    else:
+        f0_src = ("frozen", frozen.index("F0"))
+
+    batch = cache["batch"]
+    sc = {k: v for k, v in cache.items() if k != "batch"}
+    n = toas.ntoas
+
+    nvec_np = model.scaled_toa_uncertainty(toas) ** 2
+    F_np = model.noise_model_designmatrix(toas)
+    phi_np = model.noise_model_basis_weight(toas)
+    if F_np is None:
+        F_np, phi_np = np.zeros((n, 0)), np.ones(0)
+
+    valid_np = np.ones(n)
+    if pad_to is not None and pad_to > n:
+        pad = pad_to - n
+
+        def padn(x, fill=0.0):
+            if x.ndim == 1:
+                return np.concatenate([np.asarray(x),
+                                       np.full(pad, fill)])
+            w = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+            return np.pad(np.asarray(x), w)
+
+        batch = jax.tree.map(
+            lambda a: jnp.asarray(_pad_leaf(np.asarray(a), pad)), batch)
+        sc = jax.tree.map(
+            lambda a: (jnp.asarray(_pad_leaf(np.asarray(a), pad))
+                       if np.asarray(a).ndim and
+                       np.asarray(a).shape[0] == n else jnp.asarray(a)),
+            sc)
+        F_np = padn(F_np)
+        nvec_np = padn(nvec_np, fill=1.0)  # avoid 0-division; masked out
+        valid_np = padn(valid_np)
+
+    def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid):
+        def phase_f64(thx):
+            ph, _ = phase_fn(thx, tl, fh, fl, batch, cache)
+            # absolute-phase dd collapses to f64 AFTER the fractional
+            # part is extracted — sub-ns residual precision survives
+            f = dd_frac(ph)
+            return f.hi + f.lo
+
+        frac = phase_f64(th)
+        i = f0_src[1]
+        f0 = (th[i] + tl[i]) if f0_src[0] == "free" else (fh[i] + fl[i])
+        w = valid / nvec
+        wmean = jnp.sum(frac * w) / jnp.sum(w)
+        r = (frac - wmean) / f0
+        jac = jax.jacfwd(phase_f64)(th) / f0
+        ones = (valid / f0)[:, None]
+        M = jnp.concatenate([ones, jac * valid[:, None]], axis=1)
+        r = r * valid
+        Fv = F * valid[:, None]
+        return _gls_core(M, Fv, phi, r, nvec, valid)
+
+    args = (jnp.asarray(th), jnp.asarray(tl), jnp.asarray(fh),
+            jnp.asarray(fl), batch, sc, jnp.asarray(F_np),
+            jnp.asarray(phi_np), jnp.asarray(nvec_np),
+            jnp.asarray(valid_np))
+    return step_fn, args, ["Offset"] + free
+
+
+def _pad_leaf(a: np.ndarray, pad: int) -> np.ndarray:
+    """Pad the TOA axis of a batch leaf by replicating the last row
+    (zero-padding would put observers at the SSB origin and NaN the
+    Shapiro log; replicated rows are real physics, masked out of every
+    reduction by ``valid``). ToaBatch leaves are (N,), (N,3), or
+    (P,N,3); 1-length TZR leaves are left alone."""
+    if a.ndim == 0 or a.shape == (1,):
+        return a
+    if a.ndim == 3:
+        return np.pad(a, [(0, 0), (0, pad), (0, 0)], mode="edge")
+    return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1), mode="edge")
+
+
+def _gls_core(M, F, phi, r, nvec, valid):
+    """The basis-Woodbury solve (same algebra as pint_tpu.gls), inlined
+    so the whole iteration fuses into one XLA program."""
+    p = M.shape[1]
+    w = valid / nvec
+    # Two-stage column normalization. The F1/F2 design columns reach
+    # ~1e13 s/unit, so sum(M^2 * w) would hit ~1e38+ — beyond the
+    # exponent range of TPU-emulated f64 (f32-range limited). Scaling
+    # by the (overflow-safe) column max first keeps every intermediate
+    # far from the range limit; the two factors are applied
+    # sequentially on the way back out for the same reason.
+    colmax = jnp.max(jnp.abs(M), axis=0)
+    colmax = jnp.where(colmax == 0, 1.0, colmax)
+    Ms = M / colmax[None, :]
+    norm = jnp.sqrt(jnp.sum(Ms * Ms * w[:, None], axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = Ms / norm[None, :]
+    big = jnp.concatenate([Mn, F], axis=1)
+    bigw = big * w[:, None]
+    Sigma = big.T @ bigw
+    q = F.shape[1]
+    prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi]) if q else \
+        jnp.zeros(p)
+    Sigma = Sigma + jnp.diag(prior)
+    b = bigw.T @ r
+    # Jacobi-precondition to unit diagonal: Sigma mixes O(1) data terms
+    # with 1/phi priors up to ~1e25, and TPU f64 (emulated, not
+    # IEEE-correctly-rounded) loses the Cholesky on that raw scaling
+    d = jnp.sqrt(jnp.diagonal(Sigma))
+    d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+    cf = jax.scipy.linalg.cho_factor(Sigma / jnp.outer(d, d), lower=True)
+    xhat = jax.scipy.linalg.cho_solve(cf, b / d) / d
+    inv = jax.scipy.linalg.cho_solve(
+        cf, jnp.eye(Sigma.shape[0])) / jnp.outer(d, d)
+    # chi2 at the point: marginalize noise basis only (see gls.py)
+    if q:
+        bF = bigw[:, p:].T @ r
+        SF = Sigma[p:, p:]
+        dF = d[p:]
+        cfF = jax.scipy.linalg.cho_factor(SF / jnp.outer(dF, dF),
+                                          lower=True)
+        chi2 = jnp.sum(r * r * w) - bF @ (jax.scipy.linalg.cho_solve(
+            cfF, bF / dF) / dF)
+    else:
+        chi2 = jnp.sum(r * r * w)
+    dparams = -xhat[:p] / colmax / norm  # r ≈ M(θ−θ_true): corr is −x
+    cov = inv[:p, :p] / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
+    return dparams, cov, chi2, r
+
+
+# ---------------------------------------------------------------- mesh
+
+
+def toa_sharding(mesh, axis: str = "toa"):
+    """NamedSharding placing the leading (TOA) axis over ``axis``,
+    replicating everything else."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def shard_leaf(a):
+        a = jnp.asarray(a)
+        if a.ndim == 0 or a.shape[0] == 1:
+            return NamedSharding(mesh, P())
+        if a.ndim == 3:  # (P, N, 3) planet stack: N is axis 1
+            return NamedSharding(mesh, P(None, axis, None))
+        return NamedSharding(
+            mesh, P(axis, *([None] * (a.ndim - 1))))
+
+    return shard_leaf
+
+
+def build_sharded_fit_step(model, toas, mesh, axis: str = "toa"):
+    """The same fit step, with all TOA-axis inputs block-sharded over
+    ``mesh``'s ``axis``. Pads N to a mesh-divisible length with masked
+    rows. Returns (jitted_fn, device_args, names)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nshard = mesh.shape[axis]
+    pad_to = _pad_to(toas.ntoas, nshard)
+    step_fn, args, names = build_fit_step(model, toas, pad_to=pad_to)
+    th, tl, fh, fl, batch, sc, F, phi, nvec, valid = args
+
+    shard = toa_sharding(mesh, axis)
+    rep = NamedSharding(mesh, P())
+
+    def place(tree, fn):
+        return jax.tree.map(lambda a: jax.device_put(jnp.asarray(a),
+                                                     fn(a)), tree)
+
+    batch_s = place(batch, shard)
+    # cache entries: shard those with a leading N axis, replicate rest
+    n = pad_to
+
+    def cache_sharding(a):
+        a = jnp.asarray(a)
+        if a.ndim >= 1 and a.shape[0] == n:
+            return shard(a)
+        return rep
+
+    sc_s = place(sc, cache_sharding)
+    dev_args = (
+        jax.device_put(th, rep), jax.device_put(tl, rep),
+        jax.device_put(fh, rep), jax.device_put(fl, rep),
+        batch_s, sc_s,
+        jax.device_put(F, shard(F)), jax.device_put(phi, rep),
+        jax.device_put(nvec, shard(nvec)),
+        jax.device_put(valid, shard(valid)),
+    )
+    out_shardings = (rep, rep, rep, shard(jnp.zeros(n)))
+    jitted = jax.jit(step_fn, out_shardings=out_shardings)
+    return jitted, dev_args, names

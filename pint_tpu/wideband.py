@@ -29,8 +29,13 @@ def get_wideband_dm(toas) -> Tuple[np.ndarray, np.ndarray]:
         missing = sum(1 for v in dm if v is None)
         raise ValueError(
             f"{missing}/{toas.ntoas} TOAs lack -pp_dm wideband flags")
-    dme_arr = np.array([1.0 if v is None else v for v in dme])
-    return np.array(dm, dtype=np.float64), dme_arr
+    if any(v is None for v in dme):
+        missing = sum(1 for v in dme if v is None)
+        raise ValueError(
+            f"{missing}/{toas.ntoas} TOAs have -pp_dm but no -pp_dme "
+            "uncertainty flag")
+    return (np.array(dm, dtype=np.float64),
+            np.array(dme, dtype=np.float64))
 
 
 def has_wideband_dm(toas) -> bool:

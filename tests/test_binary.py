@@ -1,0 +1,238 @@
+"""Binary-model tests (reference analogs: tests/test_dd.py,
+tests/test_ell1*.py, test_fbx.py, test_model_derivatives.py): Kepler
+solver property, cross-model consistency (ELL1 vs BT at tiny e, DD vs
+BT with Shapiro off, DDS vs DD), Shapiro conjunction behavior, FB-series
+orbits, simulate→fit recovery, and jacfwd-vs-finite-difference
+derivative checks."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.models.binary import kepler_E
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BASE = """PSR J1012+5307
+RAJ 10:12:33.43 1
+DECJ 53:07:02.5 1
+F0 190.2678376220576 1
+F1 -6.2e-16 1
+PEPOCH 55000.0
+POSEPOCH 55000.0
+DM 9.02 1
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400.0
+UNITS TDB
+"""
+
+ELL1_LINES = """BINARY ELL1
+PB 0.60467271355 1
+A1 0.5818172 1
+TASC 55000.40712 1
+EPS1 1.2e-5 1
+EPS2 -3.4e-6 1
+"""
+
+BT_LINES = """BINARY BT
+PB 0.60467271355 1
+A1 0.5818172 1
+T0 55000.40712 1
+ECC 1.0e-5 1
+OM 45.0 1
+GAMMA 0.0
+"""
+
+
+def _model(extra):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(io.StringIO(BASE + extra))
+
+
+def _sim(m, n=80, rng=None, **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return make_fake_toas_uniform(54800, 55200, n, m, error_us=1.0,
+                                      rng=rng, **kw)
+
+
+def test_kepler_property():
+    rng = np.random.default_rng(0)
+    M = rng.uniform(-50, 50, 256)
+    for e in (0.0, 1e-5, 0.1, 0.5, 0.9):
+        E = np.asarray(kepler_E(M, e))
+        np.testing.assert_allclose(E - e * np.sin(E), M, atol=1e-12)
+
+
+def test_ell1_delay_shape():
+    """Roemer delay ~ x sin(Phi): amplitude and periodicity."""
+    m = _model(ELL1_LINES)
+    t = _sim(m, n=200)
+    d = np.asarray(m.delay(t))
+    m2 = _model("")  # same model, no binary
+    d2 = np.asarray(m2.delay(t))
+    binary = d - d2
+    x = 0.5818172
+    assert np.max(binary) < x * 1.01 and np.max(binary) > x * 0.95
+    assert np.min(binary) > -x * 1.01 and np.min(binary) < -x * 0.95
+
+
+def test_ell1_vs_bt_small_ecc():
+    """ELL1 and BT agree to ~ns at e = 1e-5 with matched
+    parameterizations (EPS1 = e sin(om), EPS2 = e cos(om), TASC =
+    T0 - om/n) — the upstream consistency oracle (SURVEY.md A.8e)."""
+    e, om_deg = 1.0e-5, 45.0
+    pb = 0.60467271355
+    om = np.deg2rad(om_deg)
+    eps1, eps2 = e * np.sin(om), e * np.cos(om)
+    # Lange mapping: Phi = M + om, i.e. TASC = T0 - om PB/2pi
+    t0 = 55000.40712
+    tasc = t0 - om * pb / (2 * np.pi)
+    mb = _model(BT_LINES)
+    me = _model(
+        "BINARY ELL1\n"
+        f"PB {pb} 1\nA1 0.5818172 1\nTASC {tasc:.12f} 1\n"
+        f"EPS1 {eps1:.3e} 1\nEPS2 {eps2:.3e} 1\n")
+    t = _sim(mb, n=150)
+    db = np.asarray(mb.delay(t))
+    de = np.asarray(me.delay(t))
+    # agreement to x*e^2 ~ 60 ps level; allow ns
+    np.testing.assert_allclose(db, de, atol=2e-9)
+
+
+def test_dd_vs_bt_no_shapiro():
+    """DD with DR=DTH=0, no M2/SINI reduces to BT."""
+    dd_lines = BT_LINES.replace("BINARY BT", "BINARY DD")
+    mdd = _model(dd_lines)
+    mbt = _model(BT_LINES)
+    t = _sim(mbt, n=100)
+    np.testing.assert_allclose(np.asarray(mdd.delay(t)),
+                               np.asarray(mbt.delay(t)), atol=1e-12)
+
+
+def test_dds_vs_dd_shapmax():
+    """DDS with s = 1-exp(-SHAPMAX) matches DD with equivalent SINI."""
+    sini = 0.95
+    shapmax = -np.log(1.0 - sini)
+    dd = BT_LINES.replace("BINARY BT", "BINARY DD") + \
+        "M2 0.25 1\nSINI 0.95 1\n"
+    dds = BT_LINES.replace("BINARY BT", "BINARY DDS") + \
+        f"M2 0.25 1\nSHAPMAX {shapmax:.15f} 1\n"
+    mdd, mdds = _model(dd), _model(dds)
+    t = _sim(mdd, n=100)
+    np.testing.assert_allclose(np.asarray(mdds.delay(t)),
+                               np.asarray(mdd.delay(t)), atol=1e-13)
+
+
+def test_shapiro_peaks_at_conjunction():
+    """ELL1 Shapiro delay is largest near Phi = pi/2."""
+    m = _model(ELL1_LINES + "M2 0.3 1\nSINI 0.98 1\n")
+    m0 = _model(ELL1_LINES)
+    t = _sim(m0, n=400)
+    shap = np.asarray(m.delay(t)) - np.asarray(m0.delay(t))
+    # phase of each TOA
+    pb_s = 0.60467271355 * 86400.0
+    tasc = 55000.40712
+    mjd = t.get_mjds()
+    phi = 2 * np.pi * ((mjd - tasc) * 86400.0 % pb_s) / pb_s
+    peak_bin = np.abs(phi - np.pi / 2) < 0.3
+    away = np.abs(phi - 3 * np.pi / 2) < 0.3
+    assert shap[peak_bin].max() > shap[away].max() + 1e-7
+    r = 4.925490947e-6 * 0.3
+    expect_peak = -2 * r * np.log(1 - 0.98)
+    assert abs(shap[peak_bin].max() - shap.min() - expect_peak) \
+        < 0.3 * expect_peak
+
+
+def test_fb_series_matches_pb():
+    """FB0 = 1/PB_s orbit reproduces the PB orbit."""
+    pb_s = 0.60467271355 * 86400.0
+    fb_lines = (
+        "BINARY ELL1\n"
+        f"FB0 {1.0 / pb_s:.20e} 1\n"
+        "A1 0.5818172 1\nTASC 55000.40712 1\n"
+        "EPS1 1.2e-5 1\nEPS2 -3.4e-6 1\n")
+    m1 = _model(ELL1_LINES)
+    m2 = _model(fb_lines)
+    assert m2.components["BinaryELL1"].fb_terms == ["FB0"]
+    t = _sim(m1, n=80)
+    np.testing.assert_allclose(np.asarray(m2.delay(t)),
+                               np.asarray(m1.delay(t)), rtol=0, atol=5e-11)
+
+
+def test_binary_derivatives_vs_finite_difference():
+    """jacfwd through the Kepler solve vs central differences. Two
+    frequencies so the DM column is not degenerate with the TZR
+    anchor."""
+    from pint_tpu.toa import merge_TOAs
+
+    m = _model(BT_LINES + "M2 0.2\nSINI 0.9\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        tA = make_fake_toas_uniform(54800, 55200, 20, m, error_us=1.0,
+                                    freq_mhz=1400.0)
+        tB = make_fake_toas_uniform(54810, 55190, 20, m, error_us=1.0,
+                                    freq_mhz=430.0)
+        t = merge_TOAs([tA, tB])
+    M, names, units = m.designmatrix(t, incoffset=False)
+    M = np.asarray(M)
+    steps = {"PB": 1e-8, "A1": 1e-7, "ECC": 1e-7, "OM": 1e-4,
+             "F0": 1e-11, "DM": 1e-5}
+    for pname, h in steps.items():
+        j = names.index(pname)
+        p = m.get_param(pname)
+        # add_delta keeps the parameter's dd tail (p.value = v0 + h
+        # would round F0 to f64 and noise the finite difference)
+        p.add_delta(h)
+        m.invalidate_cache(params_only=True)
+        rp = Residuals(t, m, subtract_mean=False).time_resids
+        p.add_delta(-2 * h)
+        m.invalidate_cache(params_only=True)
+        rm = Residuals(t, m, subtract_mean=False).time_resids
+        p.add_delta(h)
+        m.invalidate_cache(params_only=True)
+        fd = (np.asarray(rp) - np.asarray(rm)) / (2 * h)
+        scale = np.max(np.abs(fd)) + 1e-30
+        np.testing.assert_allclose(M[:, j], fd, rtol=2e-5,
+                                   atol=2e-6 * scale,
+                                   err_msg=pname)
+
+
+def test_ell1_fit_recovery():
+    """Simulate with an ELL1 binary, perturb, refit, recover (the
+    config-4 shape without red noise)."""
+    from pint_tpu.fitter import DownhillWLSFitter
+
+    m = _model(ELL1_LINES)
+    rng = np.random.default_rng(9)
+    t = _sim(m, n=120, rng=rng, add_noise=True)
+    truth = {n: m.get_param(n).value for n in ("A1", "PB", "EPS1",
+                                               "EPS2", "F0")}
+    m.A1.add_delta(3e-6)
+    m.EPS1.add_delta(2e-6)
+    m.F0.add_delta(1e-10)
+    m.invalidate_cache(params_only=True)
+    f = DownhillWLSFitter(t, m)
+    f.fit_toas(maxiter=15)
+    for k, v in truth.items():
+        err = f.errors.get(k)
+        assert err is not None
+        assert abs(m.get_param(k).value - v) < 5 * err, k
+
+
+def test_binary_parfile_roundtrip():
+    m = _model(ELL1_LINES + "M2 0.21 1\nSINI 0.97 1\n")
+    par = m.as_parfile()
+    assert "BINARY" in par and "ELL1" in par
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m2 = get_model(io.StringIO(par))
+    for nm in ("PB", "A1", "EPS1", "EPS2", "M2", "SINI"):
+        assert m2.get_param(nm).value == pytest.approx(
+            m.get_param(nm).value, rel=1e-12), nm
+    assert m2.TASC.value == pytest.approx(m.TASC.value, abs=1e-9)
