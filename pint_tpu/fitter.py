@@ -84,9 +84,21 @@ class Fitter:
 
     @staticmethod
     def auto(toas, model, downhill=True, **kw):
-        """Pick a fitter from the model contents (reference:
-        Fitter.auto): GLS when correlated-noise components are present,
-        WLS otherwise; downhill wrappers by default."""
+        """Pick a fitter from model contents and data (reference:
+        Fitter.auto): wideband when TOAs carry -pp_dm DM channels, GLS
+        when correlated-noise components are present, WLS otherwise;
+        downhill wrappers by default."""
+        from pint_tpu.wideband import has_wideband_dm
+
+        if has_wideband_dm(toas):
+            from pint_tpu.wideband_fitter import (
+                WidebandDownhillFitter,
+                WidebandTOAFitter,
+            )
+
+            cls = WidebandDownhillFitter if downhill else \
+                WidebandTOAFitter
+            return cls(toas, model, **kw)
         has_noise = any(
             getattr(c, "is_basis_noise", False)
             for c in model.components.values())

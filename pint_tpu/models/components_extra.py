@@ -1,0 +1,389 @@
+"""Additional timing-model components: glitches, harmonic whitening
+(Wave / WaveX / DMWaveX), frequency-dependent profile delays (FD), and
+solar-wind dispersion.
+
+Reference: src/pint/models/glitch.py (Glitch), wave.py (Wave),
+wavex.py (WaveX, DMWaveX), frequency_dependent.py (FD),
+solar_wind_dispersion.py (SolarWindDispersion).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.dispersion import DMconst
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    pairParameter,
+    prefixParameter,
+    split_prefixed_name,
+)
+from pint_tpu.models.timing_model import DelayComponent, PhaseComponent
+from pint_tpu.ops.dd import DD
+
+SECS_PER_DAY = 86400.0
+AU_M = 1.495978707e11
+PC_M = 3.0856775814913673e16
+C_M_S = 299792458.0
+
+
+def _val(pv, name, default=0.0):
+    p = pv.get(name)
+    return (p.hi + p.lo) if p is not None else default
+
+
+class Glitch(PhaseComponent):
+    """Sudden spin-up events with exponential recovery (reference:
+    glitch.Glitch). Per glitch index n: GLEP_n (epoch), GLPH_n (phase
+    step), GLF0_n/GLF1_n/GLF2_n (frequency-derivative steps),
+    GLF0D_n + GLTD_n (decaying frequency step, timescale in days).
+
+    phase(t>=GLEP) = GLPH + GLF0 dt + GLF1 dt^2/2 + GLF2 dt^3/6
+                     + GLF0D tau (1 - exp(-dt/tau))
+    """
+
+    category = "glitch"
+    register = True
+
+    PREFIXES = ("GLEP_", "GLPH_", "GLF0_", "GLF1_", "GLF2_",
+                "GLF0D_", "GLTD_")
+
+    def __init__(self):
+        super().__init__()
+        # first-glitch templates: route GL*_n par keys to this component
+        for pre in self.PREFIXES:
+            self.add_param(prefixParameter(
+                prefix=pre, index=1, index_str="1",
+                units={"GLEP_": "MJD", "GLPH_": "turn",
+                       "GLTD_": "d"}.get(pre, "Hz")))
+        self.glitch_ids: list = []
+
+    def add_glitch(self, index, epoch, ph=0.0, f0=0.0, f1=0.0, f2=0.0,
+                   f0d=0.0, td=0.0, frozen=True):
+        for pre, val in (("GLEP_", epoch), ("GLPH_", ph), ("GLF0_", f0),
+                         ("GLF1_", f1), ("GLF2_", f2), ("GLF0D_", f0d),
+                         ("GLTD_", td)):
+            self.add_param(prefixParameter(
+                prefix=pre, index=index, index_str=str(index), value=val,
+                frozen=frozen if pre != "GLEP_" else True,
+                units={"GLEP_": "MJD", "GLPH_": "turn", "GLTD_": "d"
+                       }.get(pre, "Hz")))
+        self.setup()
+
+    def setup(self):
+        ids = set()
+        for name, p in self.params.items():
+            for pre in self.PREFIXES:
+                if name.startswith(pre) and p.value is not None:
+                    ids.add(int(name[len(pre):]))
+        self.glitch_ids = sorted(ids)
+        # every glitch needs its epoch; default missing sub-params to 0
+        for i in self.glitch_ids:
+            for pre in self.PREFIXES:
+                nm = f"{pre}{i}"
+                if nm not in self.params:
+                    self.add_param(prefixParameter(
+                        prefix=pre, index=i, index_str=str(i),
+                        value=0.0, units=""))
+                elif self.params[nm].value is None and pre != "GLEP_":
+                    self.params[nm].value = 0.0
+
+    def validate(self):
+        for i in self.glitch_ids:
+            if self.params[f"GLEP_{i}"].value in (None, 0.0):
+                raise ValueError(f"glitch {i} needs GLEP_{i}")
+
+    def phase(self, pv, batch, cache, ctx, tb):
+        ref = self._parent.ref_day
+        total = jnp.zeros_like(batch.freq_mhz)
+        tb_f = tb.hi + tb.lo
+        for i in self.glitch_ids:
+            ep = _val(pv, f"GLEP_{i}")
+            dt = tb_f - (ep - ref) * SECS_PER_DAY
+            on = dt >= 0.0
+            dtc = jnp.where(on, dt, 0.0)
+            tau = _val(pv, f"GLTD_{i}") * SECS_PER_DAY
+            # branch-free decaying term; tau=0 means no decay component
+            has_tau = tau > 0
+            tau_safe = jnp.where(has_tau, tau, 1.0)
+            decay = jnp.where(
+                has_tau,
+                _val(pv, f"GLF0D_{i}") * tau_safe *
+                (1.0 - jnp.exp(-dtc / tau_safe)),
+                0.0)
+            ph = (_val(pv, f"GLPH_{i}")
+                  + _val(pv, f"GLF0_{i}") * dtc
+                  + _val(pv, f"GLF1_{i}") * dtc * dtc / 2.0
+                  + _val(pv, f"GLF2_{i}") * dtc ** 3 / 6.0
+                  + decay)
+            total = total + jnp.where(on, ph, 0.0)
+        return DD(total, jnp.zeros_like(total))
+
+
+class Wave(PhaseComponent):
+    """Legacy TEMPO sinusoid whitening (reference: wave.Wave):
+    WAVEOM [rad/day], WAVEEPOCH [MJD], WAVEn = (sin, cos) amplitude
+    pair [s]. The summed time offset w(t) enters as phase -F0 w(t)
+    (same sign convention as JUMP: positive offset = later arrival).
+
+    Wave amplitudes are host-static here (frozen; pairParameter is not
+    device-traced) — use WaveX for fittable harmonic terms.
+    """
+
+    category = "wave"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("WAVE_OM", units="rad/d",
+                                      aliases=["WAVEOM"]))
+        self.add_param(MJDParameter("WAVEEPOCH"))
+        self.add_param(pairParameter("WAVE1", units="s"))
+        self.wave_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("WAVE") and name[4:].isdigit():
+                ids.append(int(name[4:]))
+        self.wave_ids = sorted(ids)
+
+    def validate(self):
+        if self.wave_ids and self.WAVE_OM.value is None:
+            raise ValueError("WAVE terms require WAVE_OM")
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        if not self.wave_ids or self.WAVE_OM.value is None:
+            return
+        epoch = self.WAVEEPOCH.value
+        if epoch is None:
+            epoch = self._parent.PEPOCH.value
+        t = toas.tdb_day + toas.tdb_frac[0] + toas.tdb_frac[1] - epoch
+        om = self.WAVE_OM.value
+        w = np.zeros(toas.ntoas)
+        for k in self.wave_ids:
+            a, b = self.params[f"WAVE{k}"].value
+            w += a * np.sin(k * om * t) + b * np.cos(k * om * t)
+        cache["wave_offset"] = w
+
+    def phase(self, pv, batch, cache, ctx, tb):
+        if "wave_offset" not in cache:
+            z = jnp.zeros_like(batch.freq_mhz)
+            return DD(z, z)
+        f0 = pv["F0"].hi + pv["F0"].lo
+        ph = -jnp.asarray(cache["wave_offset"]) * f0
+        return DD(ph, jnp.zeros_like(ph))
+
+
+class WaveX(DelayComponent):
+    """Explicit-frequency Fourier delays, the modern deterministic
+    red-noise surrogate (reference: wavex.WaveX): per index n,
+    WXFREQ_000n [1/d], WXSIN_000n / WXCOS_000n [s];
+    delay = sum WXSIN sin(2 pi f t) + WXCOS cos(2 pi f t), t from
+    WXEPOCH (or PEPOCH). Frequencies are fixed; amplitudes fittable."""
+
+    category = "wavex"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("WXEPOCH"))
+        self.add_param(prefixParameter(prefix="WXFREQ_", index=1,
+                                       index_str="0001", units="1/d"))
+        self.add_param(prefixParameter(prefix="WXSIN_", index=1,
+                                       index_str="0001", units="s"))
+        self.add_param(prefixParameter(prefix="WXCOS_", index=1,
+                                       index_str="0001", units="s"))
+        self.wavex_ids: list = []
+
+    def add_wavex_component(self, freq_per_day, index=None, wxsin=0.0,
+                            wxcos=0.0, frozen=False):
+        index = index or (len(self.wavex_ids) + 1)
+        istr = f"{index:04d}"
+        for pre, val, frz in (("WXFREQ_", freq_per_day, True),
+                              ("WXSIN_", wxsin, frozen),
+                              ("WXCOS_", wxcos, frozen)):
+            if f"{pre}{istr}" in self.params:
+                p = self.params[f"{pre}{istr}"]
+                p.value = val
+                p.frozen = frz
+            else:
+                self.add_param(prefixParameter(
+                    prefix=pre, index=index, index_str=istr, value=val,
+                    frozen=frz,
+                    units="1/d" if pre == "WXFREQ_" else "s"))
+        self.setup()
+        return index
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("WXFREQ_"):
+                _, istr, idx = split_prefixed_name(name)
+                if self.params[name].value is not None:
+                    ids.append((idx, istr))
+        self.wavex_ids = sorted(ids)
+
+    def validate(self):
+        for idx, istr in self.wavex_ids:
+            for pre in ("WXSIN_", "WXCOS_"):
+                if f"{pre}{istr}" not in self.params:
+                    raise ValueError(f"WXFREQ_{istr} missing {pre}{istr}")
+
+    def _epoch(self):
+        return self.WXEPOCH.value if self.WXEPOCH.value is not None \
+            else self._parent.PEPOCH.value
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.wavex_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        ref = self._parent.ref_day
+        tb = ctx.get("tb_days")
+        if tb is None:
+            tb = (batch.tdb_day - ref) + batch.tdb_frac.hi \
+                + batch.tdb_frac.lo
+            ctx["tb_days"] = tb
+        t = tb - (self._epoch() - ref)  # days
+        total = jnp.zeros_like(batch.freq_mhz)
+        for idx, istr in self.wavex_ids:
+            arg = 2.0 * jnp.pi * _val(pv, f"WXFREQ_{istr}") * t
+            total = total + _val(pv, f"WXSIN_{istr}") * jnp.sin(arg) \
+                + _val(pv, f"WXCOS_{istr}") * jnp.cos(arg)
+        return total
+
+
+class DMWaveX(DelayComponent):
+    """Fourier DM variations (reference: wavex.DMWaveX): DMWXFREQ_000n
+    [1/d], DMWXSIN/DMWXCOS [pc/cm^3]; delay = K DM(t)/nu^2."""
+
+    category = "dispersion"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("DMWXEPOCH"))
+        self.add_param(prefixParameter(prefix="DMWXFREQ_", index=1,
+                                       index_str="0001", units="1/d"))
+        self.add_param(prefixParameter(prefix="DMWXSIN_", index=1,
+                                       index_str="0001",
+                                       units="pc cm^-3"))
+        self.add_param(prefixParameter(prefix="DMWXCOS_", index=1,
+                                       index_str="0001",
+                                       units="pc cm^-3"))
+        self.dmwavex_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("DMWXFREQ_"):
+                _, istr, idx = split_prefixed_name(name)
+                if self.params[name].value is not None:
+                    ids.append((idx, istr))
+        self.dmwavex_ids = sorted(ids)
+
+    def dm_value_device(self, pv, batch, cache, ctx):
+        if not self.dmwavex_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        ref = self._parent.ref_day
+        epoch = self.DMWXEPOCH.value
+        if epoch is None:
+            epoch = self._parent.PEPOCH.value
+        t = (batch.tdb_day - ref) + batch.tdb_frac.hi \
+            + batch.tdb_frac.lo - (epoch - ref)
+        dm = jnp.zeros_like(batch.freq_mhz)
+        for idx, istr in self.dmwavex_ids:
+            arg = 2.0 * jnp.pi * _val(pv, f"DMWXFREQ_{istr}") * t
+            dm = dm + _val(pv, f"DMWXSIN_{istr}") * jnp.sin(arg) \
+                + _val(pv, f"DMWXCOS_{istr}") * jnp.cos(arg)
+        return dm
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.dmwavex_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        return DMconst * self.dm_value_device(pv, batch, cache, ctx) \
+            / (bf * bf)
+
+
+class FD(DelayComponent):
+    """Frequency-dependent profile-evolution delay (reference:
+    frequency_dependent.FD): delay = sum_i FDi ln(nu/1 GHz)^i."""
+
+    category = "frequency_dependent"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter(prefix="FD", index=1,
+                                       index_str="1", units="s"))
+        self.fd_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("FD") and name[2:].isdigit() and \
+                    self.params[name].value is not None:
+                ids.append(int(name[2:]))
+        self.fd_ids = sorted(ids)
+
+    def validate(self):
+        # the Horner chain assigns exponent by position: indices must
+        # be 1..n with no gaps (reference: FD.validate raises likewise)
+        if self.fd_ids and self.fd_ids != list(
+                range(1, len(self.fd_ids) + 1)):
+            raise ValueError(
+                f"FD indices must be sequential from 1, got {self.fd_ids}")
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.fd_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        logf = jnp.log(bf / 1000.0)  # nu in MHz; reference: ln(nu/GHz)
+        total = jnp.zeros_like(bf)
+        # Horner over ln(nu/GHz), i >= 1
+        for i in reversed(self.fd_ids):
+            total = (total + _val(pv, f"FD{i}")) * logf
+        # TOAs at infinite frequency (barycentred data) see no FD delay
+        return jnp.where(jnp.isfinite(bf), total, 0.0)
+
+
+class SolarWindDispersion(DelayComponent):
+    """Solar-wind dispersion (reference:
+    solar_wind_dispersion.SolarWindDispersion): electron density
+    n_e(r) = NE_SW (1 AU/r)^2 integrated along the line of sight gives
+    DM_sw = NE_SW AU^2 (pi - rho)/(r sin rho), rho = observer-frame
+    angle between the Sun and pulsar directions (rho -> 0: pulsar
+    behind the Sun, delay spikes at solar conjunction — SURVEY.md A.4
+    oracle)."""
+
+    category = "solar_wind"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("NE_SW", units="cm^-3", value=0.0,
+                                      aliases=["NE1AU", "SOLARN0"]))
+        self.add_param(floatParameter("SWM", units="", value=0.0))
+
+    def validate(self):
+        if self.SWM.value not in (None, 0.0, 0):
+            raise NotImplementedError("only SWM 0 is implemented")
+
+    def dm_value_device(self, pv, batch, cache, ctx):
+        ne = _val(pv, "NE_SW")
+        n = ctx["psr_dir"]  # (N,3) unit observer->pulsar
+        s = batch.obs_sun_pos  # (N,3) observer->Sun, lt-s
+        r_lts = jnp.sqrt(jnp.sum(s * s, axis=-1))
+        cosr = jnp.sum(s * n, axis=-1) / r_lts
+        rho = jnp.arccos(jnp.clip(cosr, -1.0, 1.0))
+        r_m = r_lts * C_M_S
+        sinr = jnp.maximum(jnp.sin(rho), 1e-9)
+        # DM in pc/cm^3: NE_SW [cm^-3] * AU^2[m^2]/pc[m] * geom [1/m]
+        return ne * (AU_M * AU_M / PC_M) * (jnp.pi - rho) / (r_m * sinr)
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        return DMconst * self.dm_value_device(pv, batch, cache, ctx) \
+            / (bf * bf)

@@ -35,6 +35,12 @@ class Dispersion(DelayComponent):
     def _bfreq(self, batch, ctx):
         return ctx.get("bfreq", batch.freq_mhz)
 
+    def dm_value_device(self, pv, batch, cache, ctx):
+        """This component's DM contribution [pc/cm^3] (N,) — the hook
+        the wideband DM channel aggregates over (reference:
+        TimingModel.total_dm summing Dispersion dm_value)."""
+        return jnp.zeros_like(batch.freq_mhz)
+
 
 class DispersionDM(Dispersion):
     """DM + DM1·dt + DM2·dt²/2... around DMEPOCH (reference:
@@ -83,6 +89,9 @@ class DispersionDM(Dispersion):
         dt_yr = (tdb - dmep) / 365.25
         coeffs = [pv[nm].hi + pv[nm].lo for nm in terms]
         return taylor_horner(dt_yr, coeffs)
+
+    def dm_value_device(self, pv, batch, cache, ctx):
+        return self.dm_value(pv, batch)
 
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         bf = self._bfreq(batch, ctx)
@@ -143,15 +152,20 @@ class DispersionDMX(Dispersion):
             cols.append(((mjd >= r1) & (mjd <= r2)).astype(np.float64))
         cache["dmx_masks"] = np.stack(cols, axis=-1)
 
+    def dm_value_device(self, pv, batch, cache, ctx):
+        if not self.dmx_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        vals = jnp.stack(
+            [pv[f"DMX_{istr}"].hi + pv[f"DMX_{istr}"].lo
+             for _, istr in self.dmx_ids])
+        return cache["dmx_masks"] @ vals  # (N,k)@(k,) one fused matmul
+
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         if not self.dmx_ids:
             return jnp.zeros_like(batch.freq_mhz)
         bf = self._bfreq(batch, ctx)
-        vals = jnp.stack(
-            [pv[f"DMX_{istr}"].hi + pv[f"DMX_{istr}"].lo
-             for _, istr in self.dmx_ids])
-        ddm = cache["dmx_masks"] @ vals  # (N,k)@(k,) one fused matmul
-        return DMconst * ddm / (bf * bf)
+        return DMconst * self.dm_value_device(pv, batch, cache, ctx) \
+            / (bf * bf)
 
 
 class DispersionJump(Dispersion):
@@ -184,10 +198,14 @@ class DispersionJump(Dispersion):
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         return jnp.zeros_like(batch.freq_mhz)
 
-    def dm_jump_values(self, pv, cache):
-        """Σ DMJUMPi·maski (N,) — consumed by wideband DM residuals."""
-        out = None
+    def dm_value_device(self, pv, batch, cache, ctx):
+        """-Σ DMJUMPi·maski: the reference convention applies -DMJUMP
+        to the model-side DM of the selected subset (src/pint/models/
+        dispersion_model.py DispersionJump.jump_dm), so a positive
+        published DMJUMP means the subset's measured DM reads low."""
+        out = jnp.zeros_like(batch.freq_mhz)
         for name in self.dmjumps:
-            v = (pv[name].hi + pv[name].lo) * cache[f"mask_{name}"]
-            out = v if out is None else out + v
+            if name in pv:
+                out = out - (pv[name].hi + pv[name].lo) * \
+                    cache[f"mask_{name}"]
         return out

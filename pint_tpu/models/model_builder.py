@@ -58,7 +58,9 @@ BINARY_COMPONENT_PREFIX = "Binary"
 
 
 def _build_param_index():
-    """name/alias → component class name, from registry templates."""
+    """name/alias → component class name, from registry templates.
+    Prefixed-family members beyond the template's first instance
+    (GLEP_2, FD3, WXFREQ_0002...) resolve via their prefix."""
     idx: Dict[str, str] = {}
     for cls_name, cls in component_types.items():
         try:
@@ -69,6 +71,15 @@ def _build_param_index():
             idx.setdefault(pname, cls_name)
             for a in p.aliases:
                 idx.setdefault(a, cls_name)
+            prefix = getattr(p, "prefix", None)
+            if prefix is None:
+                try:
+                    prefix, _, _ = split_prefixed_name(pname)
+                except ValueError:
+                    prefix = None
+            if prefix:
+                idx.setdefault(prefix, cls_name)
+                idx.setdefault(prefix.rstrip("_"), cls_name)
     return idx
 
 
@@ -211,14 +222,28 @@ class ModelBuilder:
                 continue
 
             # 4. generic prefixed names owned by an existing family
-            #    (GLF0_1, WAVE1 ... routed once those components exist)
+            #    (GLF0_2, WAVE3, WXFREQ_0002 ... route via their prefix;
+            #    the new member clones the template member's class so
+            #    pair-valued families stay pair-valued)
             try:
                 prefix, _, _ = split_prefixed_name(key)
                 owner = self.param_index.get(prefix.rstrip("_")) or \
                     self.param_index.get(prefix)
                 if owner:
                     comp = get_comp(owner)
-                    p = prefixParameter(name=key)
+                    tmpl_member = next(
+                        (q for qn, q in comp.params.items()
+                         if qn != key and qn.startswith(prefix)
+                         and qn[len(prefix):].isdigit()), None)
+                    from pint_tpu.models.parameter import pairParameter
+
+                    if isinstance(tmpl_member, pairParameter):
+                        p = pairParameter(key,
+                                          units=tmpl_member.units)
+                    else:
+                        p = prefixParameter(
+                            name=key,
+                            units=getattr(tmpl_member, "units", ""))
                     comp.add_param(p)
                     p.from_tokens(toks)
                     continue

@@ -63,6 +63,7 @@ DELAY_CATEGORY_ORDER = [
     "solar_wind",
     "dispersion",
     "frequency_dependent",
+    "wavex",
     "pulsar_system",  # binary
 ]
 PHASE_CATEGORY_ORDER = [
@@ -290,11 +291,13 @@ class TimingModel:
     def _device_params(self) -> List[Parameter]:
         """Numeric parameters visible to device code, in component order.
         str/bool/int params are host-only statics."""
+        from pint_tpu.models.parameter import pairParameter
+
         out = []
         for c in self._ordered_components():
             for p in c.params.values():
                 if isinstance(p, (strParameter, boolParameter,
-                                  intParameter)):
+                                  intParameter, pairParameter)):
                     continue
                 if p.value is None:
                     continue
@@ -494,6 +497,44 @@ class TimingModel:
             return ph.hi + ph.lo
 
         return jax.jacfwd(phase_of)(jnp.asarray(th[i]))
+
+    # ---------------- wideband DM channel ------------------------------
+
+    def build_dm_fn(self, toas):
+        """(dm_fn, free_names): dm_fn(th) -> model DM per TOA [pc/cm^3],
+        pure and jacfwd-able, aggregating every component exposing
+        ``dm_value_device`` (DM polynomial, DMX, DMJUMP, solar wind,
+        DMWaveX). Astrometry's delay runs first to populate the ctx
+        geometry (pulsar direction) the solar-wind term needs
+        (reference: total DM summed over Dispersion components)."""
+        cache = self.get_cache(toas)
+        batch = cache["batch"]
+        main = cache["main"]
+        free, frozen, th, tl, fh, fl = self._pack()
+        astro = [c for c in self.delay_components
+                 if c.category == "astrometry"]
+        dm_comps = [c for c in self._ordered_components()
+                    if hasattr(c, "dm_value_device")]
+
+        def dm_fn(thx):
+            pv = {nm: DD(thx[i], tl[i]) for i, nm in enumerate(free)}
+            for j, nm in enumerate(frozen):
+                pv[nm] = DD(fh[j], fl[j])
+            ctx: dict = {}
+            zero = jnp.zeros_like(batch.freq_mhz)
+            for c in astro:
+                c.delay(pv, batch, main, ctx, zero)
+            dm = zero
+            for c in dm_comps:
+                dm = dm + c.dm_value_device(pv, batch, main, ctx)
+            return dm
+
+        return dm_fn, (free, np.asarray(th))
+
+    def total_dm(self, toas) -> np.ndarray:
+        """Model DM at each TOA [pc/cm^3] (host convenience)."""
+        dm_fn, (_, th) = self.build_dm_fn(toas)
+        return np.asarray(dm_fn(jnp.asarray(th)))
 
     # ---------------- noise-model aggregation -------------------------
     # (reference: TimingModel.scaled_toa_uncertainty,

@@ -54,36 +54,12 @@ class DMResiduals:
         self._resids: Optional[np.ndarray] = None
 
     def model_dm(self) -> np.ndarray:
-        """Model DM at each TOA [pc/cm^3]: polynomial DM(t) + DMX window
-        offsets + DMJUMP mask offsets (sign: DMJUMP is subtracted from
-        the measurement, reference: DispersionJump semantics)."""
-        from pint_tpu.models.dispersion import DMconst
-
-        # Evaluate via the compiled delay chain: the dispersion delay at
-        # frequency nu is DMconst*DM/nu^2, so DM = delay_disp*nu^2/K.
-        # Cheaper and exact: reuse component dm_value methods directly.
-        dm = np.zeros(self.toas.ntoas)
-        cache = self.model.get_cache(self.toas)
-        batch = cache["batch"]
-        comps = self.model.components
-        import jax.numpy as jnp
-
-        pv = _host_pv(self.model)
-        if "DispersionDM" in comps:
-            dm = dm + np.asarray(
-                comps["DispersionDM"].dm_value(pv, batch))
-        if "DispersionDMX" in comps and comps["DispersionDMX"].dmx_ids:
-            c = comps["DispersionDMX"]
-            vals = np.array([pv[f"DMX_{istr}"].hi + pv[f"DMX_{istr}"].lo
-                             for _, istr in c.dmx_ids])
-            dm = dm + cache["main"]["dmx_masks"] @ vals
-        if "DispersionJump" in comps:
-            c = comps["DispersionJump"]
-            for name in c.dmjumps:
-                p = c.params[name]
-                if p.value is not None:
-                    dm = dm - p.value * p.select_mask(self.toas)
-        return dm
+        """Model DM at each TOA [pc/cm^3], aggregated over every
+        component with a DM contribution (DM polynomial, DMX, DMJUMP
+        with the reference's -DMJUMP model-side sign, solar wind,
+        DMWaveX) via the single traced dm function
+        (TimingModel.build_dm_fn)."""
+        return self.model.total_dm(self.toas)
 
     def calc_resids(self) -> np.ndarray:
         measured, _ = get_wideband_dm(self.toas)
@@ -110,11 +86,3 @@ class DMResiduals:
         return float(np.sum((self.resids / self.dm_errors) ** 2))
 
 
-def _host_pv(model):
-    """Host-side param-name → DD dict mirroring the compiled packing."""
-    from pint_tpu.ops.dd import DD
-
-    pv = {}
-    for p in model._device_params():
-        pv[p.name] = DD(p.dd[0], p.dd[1])
-    return pv

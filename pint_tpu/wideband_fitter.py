@@ -1,0 +1,152 @@
+"""Wideband fitting: joint [TOA; DM] GLS.
+
+Reference: src/pint/fitter.py (WidebandTOAFitter,
+WidebandDownhillFitter) + src/pint/pint_matrix.py
+(combine_design_matrices_by_quantity). Wideband TOAs carry a per-TOA DM
+measurement (-pp_dm/-pp_dme flags); the fit minimizes the stacked
+residual
+
+    [ r_time ]   [ M_time  ]
+    [ r_dm   ] - [ M_dm    ] dtheta   over  diag([s_toa^2; s_dm^2])
+
+where M_time is the usual phase design matrix (d resid/d theta) and
+M_dm = -d DM_model/d theta (r_dm = measured - model). Correlated-noise
+bases act on the TOA rows (zero on DM rows; the reference couples
+PLDMNoise into the DM block — refinement tracked for a later round).
+Both blocks and the solve reuse the GLS kernel unchanged: the stack is
+just a taller whitened least-squares problem.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.fitter import Fitter, MaxiterReached
+from pint_tpu.gls import _gls_kernel, _gls_kernel_svd
+from pint_tpu.residuals import Residuals
+from pint_tpu.wideband import DMResiduals, get_wideband_dm
+
+__all__ = ["WidebandTOAFitter", "WidebandDownhillFitter"]
+
+
+def build_dm_designmatrix(model, toas, names: List[str]) -> np.ndarray:
+    """(N, p) matrix d DM_model/d theta_j for the free params in
+    ``names`` (column order matched; 'Offset' column = 0: the phase
+    offset does not move the DM channel). jacfwd of the SAME traced dm
+    function the DM residuals use (TimingModel.build_dm_fn), so the
+    design matrix can never desynchronize from the residuals."""
+    dm_fn, (free, th) = model.build_dm_fn(toas)
+    jac = np.asarray(jax.jacfwd(dm_fn)(jnp.asarray(th)))  # (N, p_free)
+    out = np.zeros((toas.ntoas, len(names)))
+    for j, nm in enumerate(names):
+        if nm == "Offset":
+            continue
+        out[:, j] = jac[:, free.index(nm)]
+    return out
+
+
+class WidebandTOAFitter(Fitter):
+    """Joint TOA+DM GLS fit (reference: WidebandTOAFitter)."""
+
+    def __init__(self, toas, model, residuals=None, track_mode=None):
+        get_wideband_dm(toas)  # validate flags up front
+        super().__init__(toas, model, residuals=residuals,
+                         track_mode=track_mode)
+        self.dm_resids = DMResiduals(toas, model)
+        self.noise_resids = None
+
+    def _solve_once(self, threshold=None):
+        self.resids = Residuals(self.toas, self.model,
+                                track_mode=self.track_mode)
+        self.dm_resids = DMResiduals(self.toas, self.model)
+        n = self.toas.ntoas
+        M_t, names, _ = self.get_designmatrix()
+        M_dm = -build_dm_designmatrix(self.model, self.toas, names)
+        M = np.concatenate([np.asarray(M_t), M_dm], axis=0)
+        r = np.concatenate([np.asarray(self.resids.time_resids),
+                            self.dm_resids.resids])
+        nvec = np.concatenate([
+            self.model.scaled_toa_uncertainty(self.toas) ** 2,
+            self.dm_resids.dm_errors ** 2])
+        F_t = self.model.noise_model_designmatrix(self.toas)
+        phi = self.model.noise_model_basis_weight(self.toas)
+        if F_t is None:
+            F = np.zeros((2 * n, 0))
+            phi = np.ones(0)
+        else:
+            F = np.concatenate([F_t, np.zeros_like(F_t)], axis=0)
+        args = (jnp.asarray(M), jnp.asarray(F), jnp.asarray(phi),
+                jnp.asarray(r), jnp.asarray(nvec))
+        if threshold is not None:
+            x, cov, chi2, noise, _ = _gls_kernel_svd(
+                *args, threshold=float(threshold))
+        else:
+            x, cov, chi2, noise, _, ok = _gls_kernel(*args)
+            if not bool(ok):
+                x, cov, chi2, noise, _ = _gls_kernel_svd(*args)
+        return (-np.asarray(x), np.asarray(cov), float(chi2),
+                np.asarray(noise)[:n], names)
+
+    def fit_toas(self, maxiter=1, threshold=None):
+        for _ in range(max(1, maxiter)):
+            x, cov, chi2, noise, names = self._solve_once(threshold)
+            self.update_model(x, names)
+        x, cov, chi2, noise, names = self._solve_once(threshold)
+        self.set_uncertainties(cov, names)
+        self.noise_resids = noise
+        self.converged = True
+        return chi2
+
+    @property
+    def chi2_dm(self) -> float:
+        return self.dm_resids.chi2
+
+
+class WidebandDownhillFitter(WidebandTOAFitter):
+    """Step-halving downhill wrapper over the wideband step (reference:
+    WidebandDownhillFitter)."""
+
+    def _chi2_here(self) -> float:
+        from pint_tpu.gls import gls_chi2
+
+        r = Residuals(self.toas, self.model,
+                      track_mode=self.track_mode).time_resids
+        return gls_chi2(self.model, self.toas, resids=r) + \
+            DMResiduals(self.toas, self.model).chi2
+
+    def fit_toas(self, maxiter=20, threshold=None, min_lambda=1e-3,
+                 required_chi2_decrease=1e-2):
+        best_chi2 = self._chi2_here()
+        x = cov = noise = names = None
+        converged = False
+        for _ in range(maxiter):
+            x, cov, _, noise, names = self._solve_once(threshold)
+            lam, accepted = 1.0, False
+            while lam >= min_lambda:
+                self.update_model(lam * x, names)
+                new_chi2 = self._chi2_here()
+                if new_chi2 <= best_chi2 + 1e-12:
+                    accepted = True
+                    break
+                self.update_model(-lam * x, names)
+                lam /= 2.0
+            if not accepted:
+                converged = True
+                break
+            improved = best_chi2 - new_chi2
+            best_chi2 = new_chi2
+            if improved < required_chi2_decrease:
+                converged = True
+                break
+        else:
+            raise MaxiterReached(
+                f"no convergence in {maxiter} wideband iterations")
+        self.converged = converged
+        x, cov, _, noise, names = self._solve_once(threshold)
+        self.set_uncertainties(cov, names)
+        self.noise_resids = noise
+        return best_chi2

@@ -1,0 +1,229 @@
+"""Tests for the extra component zoo (reference analogs:
+tests/test_glitch.py, test_model_wave.py, test_wavex.py, test_fd.py,
+test_solar_wind.py): parsing/routing, physical behavior, and fit
+recovery where applicable."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BASE = """PSR J0000+0000
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+F0 100.0 1
+F1 -1e-15 1
+PEPOCH 55000.0
+POSEPOCH 55000.0
+DM 30.0 1
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400.0
+UNITS TDB
+"""
+
+
+def _model(extra=""):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(io.StringIO(BASE + extra))
+
+
+def _sim(m, n=100, span=(54500, 55500), **kw):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return make_fake_toas_uniform(span[0], span[1], n, m,
+                                      error_us=1.0, **kw)
+
+
+# ----------------------------------------------------------- glitch
+
+
+def test_glitch_parsing_and_phase_step():
+    m0 = _model()
+    t = _sim(m0, n=200)
+    m = _model("GLEP_1 55000.0\nGLPH_1 0.1\nGLF0_1 2e-8\nGLF1_1 -1e-16\n")
+    assert m.components["Glitch"].glitch_ids == [1]
+    ph1 = m.phase(t)
+    ph0 = m0.phase(t)
+    full1 = np.asarray(ph1.int) + np.asarray(ph1.frac)
+    full0 = np.asarray(ph0.int) + np.asarray(ph0.frac)
+    mjd = t.get_mjds()
+    pre = mjd < 55000.0
+    d = full1 - full0  # turns, unwrapped
+    # before the glitch the difference is a pure constant (the TZR
+    # anchor at 55000.1 is post-glitch, shifting all phases equally;
+    # 1e-6 floor = f64 eps on the ~4e9-turn reconstructed phase)
+    assert np.ptp(d[pre]) < 1e-6
+    # phase step + spin-up after: offset from the pre-glitch level is
+    # >= GLPH = 0.1 turns, growing with time (GLF0 term)
+    dphi = d[~pre] - d[pre].mean()
+    assert np.all(dphi > 0.09)
+    assert dphi[-1] > dphi[0] + 1e-3
+
+
+def test_glitch_decay_term():
+    m = _model("GLEP_1 55000.0\nGLF0D_1 1e-8\nGLTD_1 100.0\n")
+    m0 = _model()
+    t = _sim(m0, n=200)
+    r1 = np.asarray(Residuals(t, m, subtract_mean=False).time_resids)
+    r0 = np.asarray(Residuals(t, m0, subtract_mean=False).time_resids)
+    mjd = t.get_mjds()
+    pre = mjd < 55000.0
+    dphi = ((r1 - r0) - (r1 - r0)[pre].mean()) * 100.0
+    # asymptote: GLF0D * tau = 1e-8 * 100*86400 = 0.0864 turns
+    late = mjd > 55450
+    np.testing.assert_allclose(dphi[late], 0.0864, rtol=0.02)
+    assert np.all(np.abs(dphi[pre]) < 1e-9)
+
+
+def test_glitch_requires_epoch():
+    with pytest.raises(ValueError, match="GLEP"):
+        _model("GLPH_1 0.1\n")
+
+
+def test_glitch_fit_recovery():
+    from pint_tpu.fitter import DownhillWLSFitter
+
+    m = _model("GLEP_1 55000.0\nGLPH_1 0.0 1\nGLF0_1 1e-8 1\n")
+    rng = np.random.default_rng(4)
+    t = _sim(m, n=150, add_noise=True, rng=rng)
+    truth = {"GLF0_1": 1e-8, "GLPH_1": 0.0}
+    m.get_param("GLF0_1").add_delta(3e-10)
+    m.invalidate_cache(params_only=True)
+    f = DownhillWLSFitter(t, m)
+    f.fit_toas(maxiter=15)
+    for k, v in truth.items():
+        err = f.errors[k]
+        assert abs(m.get_param(k).value - v) < 5 * err, k
+
+
+# ------------------------------------------------------------- wave
+
+
+def test_wave_parsing_and_offsets():
+    om = 2 * np.pi / 500.0  # rad/day, 500-day period
+    m = _model(f"WAVEEPOCH 55000\nWAVE_OM {om:.10f}\n"
+               "WAVE1 1e-5 -2e-5\nWAVE2 3e-6 0.0\n")
+    comp = m.components["Wave"]
+    assert comp.wave_ids == [1, 2]
+    m0 = _model()
+    t = _sim(m0, n=120)
+    r1 = np.asarray(Residuals(t, m, subtract_mean=False).time_resids)
+    r0 = np.asarray(Residuals(t, m0, subtract_mean=False).time_resids)
+    def w(dt_days):
+        return (1e-5 * np.sin(om * dt_days)
+                - 2e-5 * np.cos(om * dt_days)
+                + 3e-6 * np.sin(2 * om * dt_days))
+
+    dt_days = t.get_mjds() - 55000.0
+    # phase = -F0 w(t), anchored at the TZR epoch (55000.1): residuals
+    # shift by -(w(t) - w(tzr))
+    expect = -(w(dt_days) - w(0.1))
+    # 1e-9 floor: expectation uses UTC days where the model uses TDB
+    # (~69 s offset x dw/dt ~ 3e-10)
+    np.testing.assert_allclose(r1 - r0, expect, atol=1e-9)
+
+
+# ------------------------------------------------------------ wavex
+
+
+def test_wavex_delay_and_fit():
+    from pint_tpu.fitter import WLSFitter
+
+    m = _model("WXEPOCH 55000\nWXFREQ_0001 0.002\n"
+               "WXSIN_0001 5e-6 1\nWXCOS_0001 -3e-6 1\n")
+    assert m.components["WaveX"].wavex_ids == [(1, "0001")]
+    rng = np.random.default_rng(6)
+    t = _sim(m, n=150, add_noise=True, rng=rng)
+    truth = {"WXSIN_0001": 5e-6, "WXCOS_0001": -3e-6}
+    m.get_param("WXSIN_0001").add_delta(2e-6)
+    m.invalidate_cache(params_only=True)
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=2)
+    for k, v in truth.items():
+        err = f.errors[k]
+        assert abs(m.get_param(k).value - v) < 5 * err, k
+
+
+def test_dmwavex_scales_with_frequency():
+    m = _model("DMWXEPOCH 55000\nDMWXFREQ_0001 0.002\n"
+               "DMWXSIN_0001 1e-3\nDMWXCOS_0001 0.0\n")
+    m0 = _model()
+    tA = _sim(m0, n=60, freq_mhz=1400.0)
+    tB = _sim(m0, n=60, freq_mhz=700.0)
+    dA = np.asarray(m.delay(tA)) - np.asarray(m0.delay(tA))
+    dB = np.asarray(m.delay(tB)) - np.asarray(m0.delay(tB))
+    # nu^-2 scaling: factor 4 at half the frequency (small deviations
+    # from the Doppler-shifted barycentric frequency)
+    np.testing.assert_allclose(dB / dA, 4.0, rtol=5e-3)
+
+
+# --------------------------------------------------------------- FD
+
+
+def test_fd_delay():
+    m = _model("FD1 1e-5\nFD2 -3e-6\n")
+    assert m.components["FD"].fd_ids == [1, 2]
+    m0 = _model()
+    t = _sim(m0, n=40, freq_mhz=700.0)
+    d = np.asarray(m.delay(t)) - np.asarray(m0.delay(t))
+    # barycentric freq ≈ 700 MHz (small doppler); ln(0.7) = -0.3567
+    lf = np.log(0.7)
+    expect = 1e-5 * lf - 3e-6 * lf * lf
+    np.testing.assert_allclose(d, expect, rtol=1e-3)
+
+
+# ------------------------------------------------------- solar wind
+
+
+def test_solar_wind_conjunction_spike():
+    """DM_sw peaks when the pulsar is nearest the Sun on the sky; an
+    ecliptic-plane pulsar sees the spike once per year (SURVEY.md A.4
+    oracle). Scale: NE_SW=8 cm^-3 gives ~6e-3 pc/cm^3 near rho~25deg."""
+    m = _model("NE_SW 8.0\n")
+    m0 = _model()
+    t = _sim(m0, n=365, span=(55000, 55365))
+    d = np.asarray(m.delay(t)) - np.asarray(m0.delay(t))
+    assert np.all(d > 0)
+    # one clear annual peak, contrast > 3x
+    assert d.max() > 3 * np.median(d)
+    # reasonable magnitude at 1400 MHz: delay = K*DM/nu^2;
+    # median DM_sw ~ 1e-4..1e-2 pc/cm^3 → delay 0.2..20 us
+    assert 1e-8 < np.median(d) < 1e-4
+
+
+def test_solar_wind_fit_recovery():
+    from pint_tpu.fitter import WLSFitter
+
+    m = _model("NE_SW 8.0 1\n")
+    rng = np.random.default_rng(12)
+    t = _sim(m, n=200, span=(55000, 55730), add_noise=True, rng=rng)
+    m.get_param("NE_SW").add_delta(2.0)
+    m.invalidate_cache(params_only=True)
+    f = WLSFitter(t, m)
+    f.fit_toas(maxiter=2)
+    err = f.errors["NE_SW"]
+    assert abs(m.get_param("NE_SW").value - 8.0) < 5 * err
+
+
+# ------------------------------------------------- par round trips
+
+
+def test_extra_components_parfile_roundtrip():
+    par_extra = ("GLEP_1 55000.0\nGLPH_1 0.1\nGLF0_1 2e-8\n"
+                 "FD1 1e-5\nFD2 -3e-6\nNE_SW 8.0\n"
+                 "WXEPOCH 55000\nWXFREQ_0001 0.002\n"
+                 "WXSIN_0001 5e-6\nWXCOS_0001 -3e-6\n")
+    m = _model(par_extra)
+    out = m.as_parfile()
+    m2 = get_model(io.StringIO(out))
+    for nm in ("GLPH_1", "GLF0_1", "FD1", "FD2", "NE_SW",
+               "WXFREQ_0001", "WXSIN_0001"):
+        assert m2.get_param(nm).value == pytest.approx(
+            m.get_param(nm).value, rel=1e-12), nm

@@ -1,0 +1,169 @@
+"""Wideband fitting tests (reference analogs:
+tests/test_widebandTOA_fitting.py, test_wideband_dm_data.py,
+test_dmefac_dmequad.py): DM-channel flag handling, DM residuals, joint
+fit recovery incl. DMX windows, DMJUMP semantics, DMEFAC scaling, and
+the wideband-vs-narrowband DM-uncertainty improvement."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.fitter import Fitter
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.wideband import DMResiduals, get_wideband_dm, has_wideband_dm
+from pint_tpu.wideband_fitter import (
+    WidebandDownhillFitter,
+    WidebandTOAFitter,
+)
+
+PAR = """PSR J1713+0747
+RAJ 17:13:49.53 1
+DECJ 07:47:37.5 1
+F0 218.811843796082 1
+F1 -4.08e-16 1
+PEPOCH 55000.0
+POSEPOCH 55000.0
+DM 15.97 1
+DMEPOCH 55000.0
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400.0
+UNITS TDB
+DMX_0001 0.0 1
+DMXR1_0001 54490.0
+DMXR2_0001 54750.0
+DMX_0002 0.0 1
+DMXR1_0002 54750.1
+DMXR2_0002 55010.0
+"""
+
+
+def _sim_wb(par=PAR, n=120, dm_err=2e-4, seed=3, dm_offsets=None):
+    """Simulate narrowband TOAs, then attach synthetic -pp_dm channels:
+    model DM + optional injected offsets + Gaussian noise at dm_err."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        rng = np.random.default_rng(seed)
+        t = make_fake_toas_uniform(54500, 55500, n, m, error_us=1.0,
+                                   add_noise=True, rng=rng)
+        # set any flags BEFORE the model caches its selection masks
+        offsets = dm_offsets(t) if dm_offsets is not None else 0.0
+        dm_true = DMResiduals(t, m).model_dm() + offsets
+        dm_meas = dm_true + rng.standard_normal(t.ntoas) * dm_err
+        for i, f in enumerate(t.flags):
+            f["pp_dm"] = f"{dm_meas[i]:.10f}"
+            f["pp_dme"] = f"{dm_err:g}"
+    return m, t
+
+
+def test_flag_parsing_and_detection():
+    m, t = _sim_wb(n=20)
+    assert has_wideband_dm(t)
+    dm, dme = get_wideband_dm(t)
+    assert dm.shape == (20,) and np.all(dme == 2e-4)
+    r = DMResiduals(t, m)
+    assert np.std(r.resids) < 3 * 2e-4
+    assert 0.3 < r.chi2 / t.ntoas < 3.0
+
+
+def test_missing_dme_raises():
+    m, t = _sim_wb(n=10)
+    for f in t.flags:
+        f.pop("pp_dme")
+    with pytest.raises(ValueError, match="pp_dme"):
+        get_wideband_dm(t)
+
+
+def test_auto_picks_wideband():
+    m, t = _sim_wb(n=20)
+    f = Fitter.auto(t, m)
+    assert isinstance(f, WidebandDownhillFitter)
+
+
+def test_wideband_fit_recovers_dm_and_dmx():
+    m, t = _sim_wb(n=150, seed=8)
+    truth = {n: m.get_param(n).value
+             for n in ("DM", "DMX_0001", "DMX_0002", "F0")}
+    m.DM.add_delta(3e-3)
+    m.get_param("DMX_0001").add_delta(1e-3)
+    m.F0.add_delta(5e-11)
+    m.invalidate_cache(params_only=True)
+    f = WidebandTOAFitter(t, m)
+    f.fit_toas(maxiter=3)
+    for k, v in truth.items():
+        err = f.errors.get(k)
+        assert err is not None and err > 0, k
+        assert abs(m.get_param(k).value - v) < 5 * err, \
+            (k, m.get_param(k).value - v, err)
+
+
+def test_wideband_downhill_matches_plain():
+    m1, t = _sim_wb(n=100, seed=5)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m2 = get_model(io.StringIO(PAR))
+    for m in (m1, m2):
+        m.DM.add_delta(2e-3)
+        m.invalidate_cache(params_only=True)
+    WidebandTOAFitter(t, m1).fit_toas(maxiter=3)
+    WidebandDownhillFitter(t, m2).fit_toas(maxiter=10)
+    assert m1.DM.value == pytest.approx(m2.DM.value, abs=5e-7)
+
+
+def test_wideband_constrains_dm_better_than_narrowband():
+    """Single-frequency narrowband data cannot constrain DM (degenerate
+    with offset); the DM channel restores the constraint."""
+    m, t = _sim_wb(n=100, seed=11)
+    fw = WidebandTOAFitter(t, m)
+    fw.fit_toas()
+    wb_err = fw.errors["DM"]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m2 = get_model(io.StringIO(PAR))
+    from pint_tpu.fitter import WLSFitter
+
+    fn = WLSFitter(t, m2)
+    fn.fit_toas()
+    nb_err = fn.errors["DM"]
+    assert wb_err < 0.1 * nb_err, (wb_err, nb_err)
+    # and the wideband DM error is of order dm_err/sqrt(N)
+    assert wb_err < 5 * 2e-4 / np.sqrt(100)
+
+
+def test_dmjump_shifts_measured_dm():
+    """DMJUMP enters the model-side DM with a minus sign (reference:
+    DispersionJump.jump_dm), so a subset whose measured DM reads HIGH
+    by b fits DMJUMP = -b."""
+    par = PAR + "DMJUMP -fe L-wide 0.0 1\n"
+    offset = 5e-3
+
+    def inject(t):
+        # half the TOAs are L-wide: their *measured* DM is offset
+        out = np.zeros(t.ntoas)
+        for i, f in enumerate(t.flags):
+            if i % 2 == 0:
+                f["fe"] = "L-wide"
+                out[i] = offset
+            else:
+                f["fe"] = "S-wide"
+        return out
+
+    m, t = _sim_wb(par=par, n=120, seed=13, dm_offsets=inject)
+    f = WidebandTOAFitter(t, m)
+    f.fit_toas(maxiter=3)
+    dmj = m.get_param("DMJUMP1")
+    err = f.errors["DMJUMP1"]
+    assert abs(dmj.value - (-offset)) < 5 * err, (dmj.value, err)
+
+
+def test_dmefac_scales_dm_errors():
+    par = PAR + "DMEFAC -fe L-wide 2.5\n"
+    m, t = _sim_wb(par=par, n=40, seed=2)
+    for f in t.flags:
+        f["fe"] = "L-wide"
+    sig = m.scaled_dm_uncertainty(t)
+    np.testing.assert_allclose(sig, 2.5 * 2e-4)
