@@ -16,3 +16,9 @@ from pint_tpu.parallel.fit_step import (  # noqa: F401
     build_fit_step,
     build_sharded_fit_step,
 )
+from pint_tpu.parallel.pta import (  # noqa: F401
+    build_problem,
+    fit_pta,
+    pta_solve,
+    stack_problems,
+)

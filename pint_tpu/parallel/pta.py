@@ -1,0 +1,186 @@
+"""PTA-scale batch fitting: one vmapped GLS solve across many pulsars.
+
+The reference has no intra-process parallelism beyond a process pool
+(SURVEY.md §2c); per-pulsar independence is embarrassing parallelism.
+Here each pulsar's linearized GLS problem (design matrix, residuals,
+noise basis) is padded to a common (N_max, p_max, q_max) shape and the
+whole batch is solved by ONE vmapped, jitted kernel — the pulsar axis
+maps onto the mesh's 'pulsar' axis (DCN-friendly: zero cross-pulsar
+communication, result gather only), matching BASELINE.md config #5.
+
+Ragged shapes are handled with validity masks: padded TOA rows carry
+zero weight, padded parameter columns are identity-pinned in the normal
+matrix, padded basis columns get unit prior and zero data weight.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.residuals import Residuals
+
+__all__ = ["PulsarProblem", "build_problem", "stack_problems",
+           "pta_solve", "fit_pta"]
+
+
+class PulsarProblem:
+    """One pulsar's linearized GLS inputs (host, unpadded)."""
+
+    def __init__(self, M, r, nvec, F, phi, names, model=None, toas=None):
+        self.M = np.asarray(M)
+        self.r = np.asarray(r)
+        self.nvec = np.asarray(nvec)
+        self.F = np.asarray(F)
+        self.phi = np.asarray(phi)
+        self.names = list(names)
+        self.model = model
+        self.toas = toas
+
+
+def build_problem(toas, model, track_mode=None) -> PulsarProblem:
+    """Assemble the linearized problem at the model's current point."""
+    res = Residuals(toas, model, track_mode=track_mode)
+    M, names, _ = model.designmatrix(toas, incoffset=True)
+    nvec = model.scaled_toa_uncertainty(toas) ** 2
+    F = model.noise_model_designmatrix(toas)
+    phi = model.noise_model_basis_weight(toas)
+    if F is None:
+        F = np.zeros((toas.ntoas, 0))
+        phi = np.ones(0)
+    return PulsarProblem(np.asarray(M), np.asarray(res.time_resids),
+                         nvec, F, phi, names, model=model, toas=toas)
+
+
+def stack_problems(problems: Sequence[PulsarProblem]):
+    """Pad every pulsar to the batch maxima and stack:
+    returns dict of (P, ...) arrays."""
+    P = len(problems)
+    N = max(p.M.shape[0] for p in problems)
+    pmax = max(p.M.shape[1] for p in problems)
+    qmax = max(p.F.shape[1] for p in problems)
+    M = np.zeros((P, N, pmax))
+    F = np.zeros((P, N, qmax))
+    phi = np.ones((P, qmax))
+    r = np.zeros((P, N))
+    nvec = np.ones((P, N))
+    valid = np.zeros((P, N))
+    pvalid = np.zeros((P, pmax))
+    for k, pr in enumerate(problems):
+        n, pp = pr.M.shape
+        q = pr.F.shape[1]
+        M[k, :n, :pp] = pr.M
+        F[k, :n, :q] = pr.F
+        phi[k, :q] = pr.phi
+        r[k, :n] = pr.r
+        nvec[k, :n] = pr.nvec
+        valid[k, :n] = 1.0
+        pvalid[k, :pp] = 1.0
+    return {"M": M, "F": F, "phi": phi, "r": r, "nvec": nvec,
+            "valid": valid, "pvalid": pvalid}
+
+
+def _solve_one(M, F, phi, r, nvec, valid, pvalid):
+    """Masked, preconditioned basis-Woodbury solve for one pulsar
+    (same algebra as pint_tpu.gls._gls_kernel with padding guards)."""
+    p = M.shape[1]
+    w = valid / nvec
+    M = M * pvalid[None, :]
+    colmax = jnp.max(jnp.abs(M), axis=0)
+    colmax = jnp.where(colmax == 0, 1.0, colmax)
+    Ms = M / colmax[None, :]
+    norm = jnp.sqrt(jnp.sum(Ms * Ms * w[:, None], axis=0))
+    norm = jnp.where(norm == 0, 1.0, norm)
+    Mn = Ms / norm[None, :]
+    big = jnp.concatenate([Mn, F], axis=1)
+    bigw = big * w[:, None]
+    Sigma = big.T @ bigw
+    prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
+    Sigma = Sigma + jnp.diag(prior)
+    # pin padded parameter columns to identity so Cholesky stays PD
+    colvalid = jnp.concatenate([pvalid, jnp.ones(F.shape[1])])
+    Sigma = Sigma * jnp.outer(colvalid, colvalid) + \
+        jnp.diag(1.0 - colvalid)
+    b = bigw.T @ r * colvalid
+    d = jnp.sqrt(jnp.diagonal(Sigma))
+    d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+    cf = jax.scipy.linalg.cho_factor(Sigma / jnp.outer(d, d), lower=True)
+    xhat = jax.scipy.linalg.cho_solve(cf, b / d) / d
+    inv = jax.scipy.linalg.cho_solve(
+        cf, jnp.eye(Sigma.shape[0])) / jnp.outer(d, d)
+    chi2 = jnp.sum(r * r * w) - xhat @ b
+    dparams = -xhat[:p] / colmax / norm * pvalid
+    cov = inv[:p, :p] / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
+    return dparams, cov, chi2
+
+
+_pta_kernel = jax.jit(jax.vmap(_solve_one))
+
+
+def pta_solve(stacked: dict, mesh=None, axis: str = "pulsar"):
+    """Solve the whole batch in one device call. With ``mesh``, the
+    pulsar axis is block-sharded over ``axis`` (pads P up to a mesh
+    multiple)."""
+    arrs = {k: jnp.asarray(v) for k, v in stacked.items()}
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+        nshard = mesh.shape[axis]
+        P = arrs["M"].shape[0]
+        pad = (-P) % nshard
+        if pad:
+            arrs = {k: jnp.concatenate(
+                [v, jnp.ones((pad,) + v.shape[1:]) if k in
+                 ("nvec", "phi") else jnp.zeros((pad,) + v.shape[1:])],
+                axis=0) for k, v in arrs.items()}
+        sh = {k: NamedSharding(
+            mesh, Pspec(axis, *([None] * (v.ndim - 1))))
+            for k, v in arrs.items()}
+        arrs = {k: jax.device_put(v, sh[k]) for k, v in arrs.items()}
+        out = _pta_kernel(arrs["M"], arrs["F"], arrs["phi"], arrs["r"],
+                          arrs["nvec"], arrs["valid"], arrs["pvalid"])
+        return tuple(np.asarray(o)[:P] for o in out)
+    out = _pta_kernel(arrs["M"], arrs["F"], arrs["phi"], arrs["r"],
+                      arrs["nvec"], arrs["valid"], arrs["pvalid"])
+    return tuple(np.asarray(o) for o in out)
+
+
+def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,
+            track_mode=None) -> List[dict]:
+    """Batch-fit [(toas, model), ...]: each iteration assembles every
+    pulsar's linearized problem on the host (heterogeneous models), then
+    solves ALL of them in one vmapped device call and applies the
+    updates. Returns per-pulsar {chi2, errors} (models updated in
+    place)."""
+    out: List[dict] = [dict() for _ in pairs]
+    for _ in range(max(1, maxiter)):
+        problems = [build_problem(t, m, track_mode=track_mode)
+                    for t, m in pairs]
+        stacked = stack_problems(problems)
+        dparams, cov, chi2 = pta_solve(stacked, mesh=mesh)
+        for k, pr in enumerate(problems):
+            names = pr.names
+            x = dparams[k][:len(names)]
+            for name, dx in zip(names, x):
+                if name == "Offset":
+                    continue
+                pr.model.get_param(name).add_delta(float(dx))
+            pr.model.invalidate_cache(params_only=True)
+    # final pass: uncertainties + chi2 at the fitted point
+    problems = [build_problem(t, m, track_mode=track_mode)
+                for t, m in pairs]
+    stacked = stack_problems(problems)
+    dparams, cov, chi2 = pta_solve(stacked, mesh=mesh)
+    for k, pr in enumerate(problems):
+        errs = {}
+        sig = np.sqrt(np.diag(cov[k]))
+        for j, name in enumerate(pr.names):
+            if name == "Offset":
+                continue
+            pr.model.get_param(name).uncertainty = float(sig[j])
+            errs[name] = float(sig[j])
+        out[k] = {"chi2": float(chi2[k]), "errors": errs}
+    return out
