@@ -25,7 +25,7 @@ def log(*a):
 
 
 NTOA = 10_000
-NDMX = 25  # 25 DMX + 15 other free params = 40 columns + offset
+NDMX = 28  # 28 DMX + 12 other free params = 40 columns + offset
 
 
 def build_problem():
@@ -35,7 +35,7 @@ def build_problem():
     import numpy as np
 
     from pint_tpu.models import get_model
-    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
 
     span0, span1 = 53000.0, 57000.0
     par = [
@@ -48,9 +48,12 @@ def build_problem():
         "F0 300.123456789 1",
         "F1 -1.0e-15 1",
         "F2 1e-26 1",
-        "DM 20.0 1",
-        "DM1 1e-4 1",
-        "DM2 1e-6 1",
+        # DM/DM1/DM2 frozen: the free DMX windows cover the full span,
+        # so a free DM would be exactly collinear with their sum
+        # (singular normal matrix — NANOGrav convention freezes DM)
+        "DM 20.0",
+        "DM1 1e-4",
+        "DM2 1e-6",
         "PEPOCH 55000",
         "POSEPOCH 55000",
         "DMEPOCH 55000",
@@ -76,9 +79,20 @@ def build_problem():
         warnings.simplefilter("ignore")
         model = get_model(io.StringIO("\n".join(par) + "\n"))
         rng = np.random.default_rng(1)
-        # clustered epochs so ECORR has structure: 2500 epochs x 4 TOAs
-        toas = make_fake_toas_uniform(
-            span0 + 1, span1 - 1, NTOA, model, error_us=1.0,
+        # Clustered observing epochs so the ECORR quantization basis has
+        # real structure: NTOA/4 clusters of 4 TOAs within ~30 min, with
+        # inter-cluster gaps far above the 0.5-day bucket threshold
+        # (create_quantization_matrix, pint_tpu/models/noise.py).
+        ncluster = NTOA // 4
+        centers = np.linspace(span0 + 1, span1 - 1, ncluster)
+        offsets = np.array([0.0, 0.007, 0.014, 0.021])
+        mjds = (centers[:, None] + offsets[None, :]).ravel()
+        # Two frequency bands within every cluster: single-band data
+        # leaves DM/DM1/DM2 exactly collinear with Offset/F1/F2
+        # (singular normal matrix — the round-2 bench crash).
+        freqs = np.tile([1400.0, 1400.0, 820.0, 820.0], ncluster)
+        toas = make_fake_toas_fromMJDs(
+            mjds, model, error_us=1.0, freq_mhz=freqs,
             add_noise=True, rng=rng)
         for i, f in enumerate(toas.flags):
             f["be"] = "X"
@@ -121,6 +135,18 @@ def main():
     accel_t = time_fn(lambda: jax.block_until_ready(jitted(*args)))
     log(f"accelerated fit step: {accel_t * 1e3:.1f} ms "
         f"({toas.ntoas / accel_t:.0f} TOA/s)")
+
+    # optional device-trace capture for step attribution (jacfwd phase
+    # chain vs matmuls vs Cholesky): view with tensorboard/xprof
+    import os
+
+    profdir = os.environ.get("PINT_TPU_PROFILE_DIR")
+    if profdir:
+        from pint_tpu.profiling import trace
+
+        with trace(profdir):
+            jax.block_until_ready(jitted(*args))
+        log(f"profile trace written to {profdir}")
 
     # ---- CPU reference-algorithm path -------------------------------
     cpu = jax.devices("cpu")[0]

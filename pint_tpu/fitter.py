@@ -9,6 +9,7 @@ evaluation reuses the model's compiled phase function.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Dict, List, Optional
 
@@ -81,6 +82,29 @@ class Fitter:
         self.parameter_covariance_matrix = None
         self.errors: Dict[str, float] = {}
         self.converged = False
+        self.stats = None  # FitStats, set by fit_toas
+
+    def _record_stats(self, chi2: float, iterations: int, t0: float,
+                      dof=None):
+        """Populate self.stats (SURVEY §5 metrics requirement).
+        ``dof`` overrides the TOA-residual dof for fitters whose chi2
+        sums over more measurements (wideband: stacked TOA+DM)."""
+        from pint_tpu.profiling import FitStats
+
+        wall = time.perf_counter() - t0
+        n = self.toas.ntoas
+        if dof is None:
+            dof = getattr(self.resids, "dof",
+                          n - len(self.model.free_params))
+        self.stats = FitStats(
+            fitter=type(self).__name__, ntoa=n,
+            nfree=len(self.model.free_params), dof=dof,
+            chi2=float(chi2),
+            reduced_chi2=float(chi2) / dof if dof else float("nan"),
+            iterations=iterations, converged=self.converged,
+            wall_time_s=wall,
+            toas_per_sec=n * max(1, iterations) / wall if wall else 0.0)
+        return self.stats
 
     @staticmethod
     def auto(toas, model, downhill=True, **kw):
@@ -145,6 +169,7 @@ class WLSFitter(Fitter):
     """Weighted least squares via jitted SVD (reference: WLSFitter)."""
 
     def fit_toas(self, maxiter=1, threshold=None):
+        t0 = time.perf_counter()
         chi2 = None
         for _ in range(max(1, maxiter)):
             self.resids = Residuals(self.toas, self.model,
@@ -164,6 +189,7 @@ class WLSFitter(Fitter):
                                 track_mode=self.track_mode)
         chi2 = self.resids.chi2
         self.converged = True
+        self._record_stats(chi2, max(1, maxiter), t0)
         return chi2
 
 
@@ -174,10 +200,13 @@ class DownhillWLSFitter(WLSFitter):
 
     def fit_toas(self, maxiter=20, threshold=None, min_lambda=1e-3,
                  required_chi2_decrease=1e-2):
+        t0 = time.perf_counter()
+        iterations = 0
         best_chi2 = Residuals(self.toas, self.model,
                               track_mode=self.track_mode).chi2
         converged = False
         for _ in range(maxiter):
+            iterations += 1
             self.resids = Residuals(self.toas, self.model,
                                     track_mode=self.track_mode)
             r = self.resids.time_resids
@@ -215,6 +244,7 @@ class DownhillWLSFitter(WLSFitter):
                                 track_mode=self.track_mode)
         if self.parameter_covariance_matrix is None:
             self.set_uncertainties(np.asarray(cov), names)
+        self._record_stats(best_chi2, iterations, t0)
         return best_chi2
 
 

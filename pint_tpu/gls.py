@@ -23,6 +23,7 @@ as the benchmark denominator (BASELINE.md measurement protocol).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Optional
 
@@ -72,7 +73,17 @@ def _gls_kernel(M, F, phi, r, nvec):
     dparams = xhat[:p] / colmax / norm
     cov = inv[:p, :p] / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
     noise_resid = F @ xhat[p:]
-    ok = jnp.all(jnp.isfinite(xhat)) & jnp.all(jnp.isfinite(cov))
+    # ok must catch not just non-finites but the finite-garbage case of
+    # an (exactly or nearly) singular Sigma, where Cholesky happily
+    # produces a huge wrong solution: verify the solve by its relative
+    # residual in the preconditioned system
+    Sp = Sigma / jnp.outer(d, d)
+    solve_err = jnp.linalg.norm(Sp @ (d * xhat) - b / d)
+    # 1e-6: backward-stable Cholesky leaves residual ~eps*cond(Sp), so
+    # legitimately ill-conditioned-but-solvable systems (cond ~1e8+)
+    # must still pass; exact singularity leaves O(1) relative residual
+    ok = (jnp.all(jnp.isfinite(xhat)) & jnp.all(jnp.isfinite(cov))
+          & (solve_err <= 1e-6 * (jnp.linalg.norm(b / d) + 1.0)))
     return dparams, cov, chi2, noise_resid, xhat, ok
 
 
@@ -178,19 +189,29 @@ def gls_solve_np(M, F, phi, r, nvec):
 
     p = M.shape[1]
     w = 1.0 / nvec
-    norm = np.sqrt(np.sum(M * M * w[:, None], axis=0))
+    # identical two-stage equilibration as _gls_kernel (algebraically
+    # neutral): column-max scaling keeps sum(M^2*w) in range, and the
+    # Jacobi unit-diagonal scaling keeps the Cholesky away from the
+    # mixed O(1)-data / 1e25-prior conditioning cliff
+    colmax = np.max(np.abs(M), axis=0)
+    colmax[colmax == 0] = 1.0
+    Ms = M / colmax[None, :]
+    norm = np.sqrt(np.sum(Ms * Ms * w[:, None], axis=0))
     norm[norm == 0] = 1.0
-    Mn = M / norm[None, :]
+    Mn = Ms / norm[None, :]
     big = np.concatenate([Mn, F], axis=1)
     bigw = big * w[:, None]
     Sigma = big.T @ bigw + np.diag(
         np.concatenate([np.zeros(p), 1.0 / phi]))
     b = bigw.T @ r
-    cf = cho_factor(Sigma, lower=True)
-    xhat = cho_solve(cf, b)
-    inv = cho_solve(cf, np.eye(Sigma.shape[0]))
+    d = np.sqrt(np.diagonal(Sigma))
+    d[(d == 0) | ~np.isfinite(d)] = 1.0
+    cf = cho_factor(Sigma / np.outer(d, d), lower=True)
+    xhat = cho_solve(cf, b / d) / d
+    inv = cho_solve(cf, np.eye(Sigma.shape[0])) / np.outer(d, d)
     chi2 = float(np.sum(r * r * w) - xhat @ b)
-    return (xhat[:p] / norm, inv[:p, :p] / np.outer(norm, norm), chi2,
+    scale = colmax * norm
+    return (xhat[:p] / scale, inv[:p, :p] / np.outer(scale, scale), chi2,
             F @ xhat[p:])
 
 
@@ -236,6 +257,7 @@ class GLSFitter(Fitter):
                 np.asarray(noise), names)
 
     def fit_toas(self, maxiter=1, threshold=None):
+        t0 = time.perf_counter()
         for _ in range(max(1, maxiter)):
             x, cov, chi2, noise, names = self._solve_once(threshold)
             self.update_model(x, names)
@@ -244,6 +266,7 @@ class GLSFitter(Fitter):
         self.set_uncertainties(cov, names)
         self.noise_resids = noise
         self.converged = True
+        self._record_stats(chi2, max(1, maxiter), t0)
         return chi2
 
     def get_noise_resids(self):
@@ -264,10 +287,13 @@ class DownhillGLSFitter(GLSFitter):
 
     def fit_toas(self, maxiter=20, threshold=None, min_lambda=1e-3,
                  required_chi2_decrease=1e-2):
+        t0 = time.perf_counter()
+        iterations = 0
         best_chi2 = self._chi2_here()
         x = cov = noise = names = None
         converged = False
         for _ in range(maxiter):
+            iterations += 1
             x, cov, _, noise, names = self._solve_once(threshold)
             lam, accepted = 1.0, False
             while lam >= min_lambda:
@@ -297,4 +323,5 @@ class DownhillGLSFitter(GLSFitter):
         x, cov, _, noise, names = self._solve_once(threshold)
         self.set_uncertainties(cov, names)
         self.noise_resids = noise
+        self._record_stats(best_chi2, iterations, t0)
         return best_chi2

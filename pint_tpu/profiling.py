@@ -1,0 +1,109 @@
+"""Profiling & metrics instrumentation (SURVEY.md §5: tracing and a
+TOAs/sec scoreboard are first-class requirements; the reference has no
+equivalent — loguru DEBUG lines in src/pint/toa.py / fitter.py are its
+only visibility).
+
+Two layers:
+
+- ``FitStats``: the structured per-fit stats object every fitter
+  returns/attaches (chi2, iterations, wall time, TOAs/sec).
+- ``trace``/``annotate``: thin wrappers over ``jax.profiler`` so a fit
+  can be decomposed (phase chain vs jacfwd vs Cholesky) with
+  tensorboard-compatible traces, plus a process-wide scoreboard of
+  named wall-clock phases for quick attribution without a trace viewer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Dict, Optional
+
+__all__ = ["FitStats", "trace", "annotate", "scoreboard", "Scoreboard"]
+
+
+@dataclass
+class FitStats:
+    """Structured result of one fit (returned via Fitter.stats)."""
+
+    fitter: str = ""
+    ntoa: int = 0
+    nfree: int = 0
+    dof: int = 0
+    chi2: float = float("nan")
+    reduced_chi2: float = float("nan")
+    iterations: int = 0
+    converged: bool = False
+    wall_time_s: float = 0.0
+    toas_per_sec: float = 0.0
+    phases: Dict[str, float] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    def __str__(self) -> str:
+        return (f"{self.fitter}: chi2={self.chi2:.3f} "
+                f"(red. {self.reduced_chi2:.4f}), "
+                f"{self.iterations} iter in {self.wall_time_s * 1e3:.1f} ms "
+                f"({self.toas_per_sec:.0f} TOA/s)")
+
+
+class Scoreboard:
+    """Accumulates named wall-clock phases; the cheap always-on half of
+    the profiling story (the expensive half is jax.profiler traces)."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> str:
+        lines = [f"{'phase':<28} {'total_s':>10} {'calls':>7} {'avg_ms':>10}"]
+        for k in sorted(self.totals, key=self.totals.get, reverse=True):
+            t, c = self.totals[k], self.counts[k]
+            lines.append(f"{k:<28} {t:>10.3f} {c:>7} {t / c * 1e3:>10.2f}")
+        return "\n".join(lines)
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()
+
+
+scoreboard = Scoreboard()
+
+
+@contextlib.contextmanager
+def trace(logdir: Optional[str] = None):
+    """Capture a jax.profiler device trace around a block (view with
+    tensorboard / xprof). No-op when logdir is None."""
+    if logdir is None:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region: shows up inside device traces AND feeds the
+    scoreboard, so one instrumentation point serves both."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name), scoreboard.phase(name):
+        yield

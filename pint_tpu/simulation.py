@@ -83,7 +83,22 @@ def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int,
                            name: str = "fake") -> TOAs:
     """Evenly spaced synthetic TOAs landing on integer model phase
     (reference: make_fake_toas_uniform)."""
-    mjds = np.linspace(float(startMJD), float(endMJD), int(ntoas))
+    return make_fake_toas_fromMJDs(
+        np.linspace(float(startMJD), float(endMJD), int(ntoas)), model,
+        error_us=error_us, obs=obs, freq_mhz=freq_mhz,
+        add_noise=add_noise, add_correlated_noise=add_correlated_noise,
+        rng=rng, name=name)
+
+
+def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, obs: str = "gbt",
+                            freq_mhz=1400.0, add_noise: bool = False,
+                            add_correlated_noise: bool = False,
+                            rng: Optional[np.random.Generator] = None,
+                            name: str = "fake") -> TOAs:
+    """Synthetic TOAs at the given MJDs, landing on integer model phase
+    (reference: make_fake_toas_fromMJDs). ``freq_mhz``/``error_us`` may
+    be scalars or per-TOA arrays."""
+    mjds = np.asarray(mjds, dtype=np.float64)
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         t = get_TOAs_array(

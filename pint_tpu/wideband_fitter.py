@@ -19,6 +19,7 @@ just a taller whitened least-squares problem.
 
 from __future__ import annotations
 
+import time
 from typing import List
 
 import jax
@@ -92,6 +93,7 @@ class WidebandTOAFitter(Fitter):
                 np.asarray(noise)[:n], names)
 
     def fit_toas(self, maxiter=1, threshold=None):
+        t0 = time.perf_counter()
         for _ in range(max(1, maxiter)):
             x, cov, chi2, noise, names = self._solve_once(threshold)
             self.update_model(x, names)
@@ -99,6 +101,10 @@ class WidebandTOAFitter(Fitter):
         self.set_uncertainties(cov, names)
         self.noise_resids = noise
         self.converged = True
+        # chi2 sums over 2N stacked TOA+DM measurements
+        self._record_stats(chi2, max(1, maxiter), t0,
+                           dof=2 * self.toas.ntoas
+                           - len(self.model.free_params) - 1)
         return chi2
 
     @property
@@ -120,10 +126,13 @@ class WidebandDownhillFitter(WidebandTOAFitter):
 
     def fit_toas(self, maxiter=20, threshold=None, min_lambda=1e-3,
                  required_chi2_decrease=1e-2):
+        t0 = time.perf_counter()
+        iterations = 0
         best_chi2 = self._chi2_here()
         x = cov = noise = names = None
         converged = False
         for _ in range(maxiter):
+            iterations += 1
             x, cov, _, noise, names = self._solve_once(threshold)
             lam, accepted = 1.0, False
             while lam >= min_lambda:
@@ -149,4 +158,7 @@ class WidebandDownhillFitter(WidebandTOAFitter):
         x, cov, _, noise, names = self._solve_once(threshold)
         self.set_uncertainties(cov, names)
         self.noise_resids = noise
+        self._record_stats(best_chi2, iterations, t0,
+                           dof=2 * self.toas.ntoas
+                           - len(self.model.free_params) - 1)
         return best_chi2

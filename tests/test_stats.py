@@ -1,0 +1,110 @@
+"""Fit stats object + profiling scoreboard (SURVEY.md §5: metrics and
+tracing are first-class; the reference returns a bare chi2 from
+src/pint/fitter.py fit_toas — here every fitter attaches FitStats)."""
+
+import io
+import json
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.profiling import FitStats, Scoreboard, annotate, scoreboard
+from pint_tpu.simulation import make_fake_toas_uniform
+
+
+@pytest.fixture(scope="module")
+def wls_problem():
+    par = """
+PSR J0002+0002
+RAJ 10:00:00.0 1
+DECJ 10:00:00.0 1
+F0 100.0 1
+F1 -1e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 10.0 1
+DMEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(par))
+        rng = np.random.default_rng(3)
+        tA = make_fake_toas_uniform(54000, 56000, 30, model, freq_mhz=1400.0,
+                                    add_noise=True, rng=rng)
+        tB = make_fake_toas_uniform(54010, 55990, 30, model, freq_mhz=820.0,
+                                    add_noise=True, rng=rng)
+        from pint_tpu.toa import merge_TOAs
+
+        toas = merge_TOAs([tA, tB])
+    return model, toas
+
+
+def test_wls_fitter_records_stats(wls_problem):
+    import copy
+
+    from pint_tpu.fitter import WLSFitter
+
+    model, toas = wls_problem
+    f = WLSFitter(toas, copy.deepcopy(model))
+    chi2 = f.fit_toas(maxiter=2)
+    s = f.stats
+    assert isinstance(s, FitStats)
+    assert s.fitter == "WLSFitter"
+    assert s.ntoa == toas.ntoas
+    assert s.nfree == 5  # RAJ DECJ F0 F1 DM
+    assert s.chi2 == pytest.approx(chi2)
+    assert s.iterations == 2
+    assert s.wall_time_s > 0
+    assert s.toas_per_sec > 0
+    assert s.converged
+    # round-trips through JSON
+    d = json.loads(s.to_json())
+    assert d["dof"] == s.dof
+    assert "TOA/s" in str(s)
+
+
+def test_downhill_fitter_records_stats(wls_problem):
+    import copy
+
+    from pint_tpu.fitter import DownhillWLSFitter
+
+    model, toas = wls_problem
+    m = copy.deepcopy(model)
+    m.get_param("F0").add_delta(2e-10)
+    m.invalidate_cache(params_only=True)
+    f = DownhillWLSFitter(toas, m)
+    f.fit_toas()
+    assert f.stats.iterations >= 1
+    assert f.stats.converged
+    assert f.stats.reduced_chi2 == pytest.approx(
+        f.stats.chi2 / f.stats.dof)
+
+
+def test_scoreboard_phases():
+    sb = Scoreboard()
+    with sb.phase("alpha"):
+        pass
+    with sb.phase("alpha"):
+        pass
+    with sb.phase("beta"):
+        pass
+    assert sb.counts["alpha"] == 2
+    assert sb.counts["beta"] == 1
+    rep = sb.report()
+    assert "alpha" in rep and "beta" in rep
+    sb.reset()
+    assert not sb.totals
+
+
+def test_annotate_feeds_global_scoreboard():
+    scoreboard.reset()
+    with annotate("unit-test-phase"):
+        x = sum(range(100))
+    assert x == 4950
+    assert scoreboard.counts["unit-test-phase"] == 1
