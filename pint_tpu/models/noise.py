@@ -41,10 +41,15 @@ from pint_tpu.models.timing_model import Component
 __all__ = [
     "NoiseComponent", "ScaleToaError", "ScaleDmError", "EcorrNoise",
     "PLRedNoise", "PLDMNoise", "create_quantization_matrix",
-    "create_fourier_design_matrix", "powerlaw",
+    "quantization_buckets", "create_fourier_design_matrix", "powerlaw",
+    "EcorrOverlapError",
 ]
 
 FYR = 1.0 / (86400.0 * 365.25)  # 1/yr in Hz
+
+
+class EcorrOverlapError(ValueError):
+    """A TOA fell into two ECORR epochs (overlapping masks)."""
 
 
 def _tdb_seconds(toas) -> np.ndarray:
@@ -65,15 +70,13 @@ def powerlaw(f: np.ndarray, A: float, gamma: float) -> np.ndarray:
         * np.asarray(f, dtype=np.float64) ** (-gamma)
 
 
-def create_quantization_matrix(t_days: np.ndarray, dt_days: float = 0.5,
-                               nmin: int = 2) -> np.ndarray:
-    """Group times into observing epochs; return the (N, N_epoch) 0/1
-    membership matrix, keeping only epochs with >= nmin TOAs
-    (reference: noise_model.create_quantization_matrix).
-
-    A new bucket starts whenever the gap to the previous (sorted) time
-    exceeds dt_days.
-    """
+def quantization_buckets(t_days: np.ndarray, dt_days: float = 0.5,
+                         nmin: int = 2) -> List[np.ndarray]:
+    """Index lists of observing epochs: a new bucket starts whenever
+    the gap to the previous (sorted) time exceeds dt_days; buckets with
+    < nmin members are dropped. The sparse primitive behind both the
+    dense quantization matrix and the O(N) Sherman-Morrison segment
+    path."""
     t = np.asarray(t_days, dtype=np.float64)
     isort = np.argsort(t)
     buckets: List[List[int]] = []
@@ -83,8 +86,17 @@ def create_quantization_matrix(t_days: np.ndarray, dt_days: float = 0.5,
             buckets.append([])
         buckets[-1].append(i)
         last = t[i]
-    keep = [b for b in buckets if len(b) >= nmin]
-    U = np.zeros((len(t), len(keep)), dtype=np.float64)
+    return [np.asarray(b) for b in buckets if len(b) >= nmin]
+
+
+def create_quantization_matrix(t_days: np.ndarray, dt_days: float = 0.5,
+                               nmin: int = 2) -> np.ndarray:
+    """Group times into observing epochs; return the (N, N_epoch) 0/1
+    membership matrix, keeping only epochs with >= nmin TOAs
+    (reference: noise_model.create_quantization_matrix).
+    """
+    keep = quantization_buckets(t_days, dt_days, nmin)
+    U = np.zeros((len(np.asarray(t_days)), len(keep)), dtype=np.float64)
     for j, b in enumerate(keep):
         U[b, j] = 1.0
     return U
@@ -269,6 +281,36 @@ class EcorrNoise(NoiseComponent):
         if not Us:
             return None
         return np.concatenate(Us, axis=1), np.concatenate(ws)
+
+    def noise_epoch_segments(self, toas):
+        """Sparse epoch structure without densifying the quantization
+        matrix: (eid (N,) int32 — epoch index or -1 for 'no epoch' —,
+        jvar (K,) per-epoch variances [s^2]), or None when inactive.
+        Column order matches noise_basis_weight exactly (same mask and
+        bucket enumeration), O(N) memory at any scale. Raises
+        EcorrOverlapError when ECORR masks overlap (a TOA in two epochs
+        has no rank-1-per-epoch representation; callers fall back to
+        the dense basis)."""
+        mjd = toas.get_mjds()
+        eid = np.full(toas.ntoas, -1, dtype=np.int32)
+        jvar: list = []
+        for name in self.ecorrs:
+            p = self.params[name]
+            if p.value is None:
+                continue
+            idx = np.flatnonzero(p.select_mask(toas))
+            if len(idx) == 0:
+                continue
+            for b in quantization_buckets(mjd[idx]):
+                rows = idx[b]
+                if np.any(eid[rows] >= 0):
+                    raise EcorrOverlapError(
+                        f"overlapping ECORR masks ({name})")
+                eid[rows] = len(jvar)
+                jvar.append((p.value * 1e-6) ** 2)
+        if not jvar:
+            return None
+        return eid, np.asarray(jvar)
 
 
 class PLRedNoise(NoiseComponent):

@@ -116,7 +116,9 @@ class Parameter:
                 isinstance(self, (strParameter, boolParameter)):
             self._dd = None
         elif self._dd is None or dd_np.to_f64(self._dd) != v:
-            self._dd = dd_np.dd(float(v))
+            # two_sum of a non-finite value yields (nan, nan)
+            self._dd = (dd_np.dd(float(v)) if np.isfinite(v)
+                        else (float(v), 0.0))
 
     @property
     def quantity(self):  # PINT-compat alias
@@ -195,7 +197,9 @@ def dd_np_repr(pair) -> str:
     hi, lo = pair
     v = hi + lo
     if v == 0.0 or not np.isfinite(v):
-        return repr(hi)
+        # plain-float repr: numpy-2 scalar reprs ('np.float64(inf)')
+        # would not survive a par-file round trip
+        return repr(float(hi))
     # Decimal digits: print hi+lo by accumulating decimal remainders
     from decimal import Decimal, getcontext
     getcontext().prec = 50

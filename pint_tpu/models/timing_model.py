@@ -569,16 +569,20 @@ class TimingModel:
             sigma2 = c.scale_dm_sigma2(toas, sigma2)
         return np.sqrt(sigma2)
 
-    def noise_model_basis_weight_pairs(self, toas):
+    def noise_model_basis_weight_pairs(self, toas, exclude=()):
         """[(component name, F, phi), ...] for every active basis.
-        Cached per (TOA set, noise hyperparameter values): the bases are
-        static during a least-squares fit (hyperparameters only move
-        under MCMC), but quantization + Fourier builds are O(N·q) host
-        work worth doing once, not once per downhill trial step."""
+        Cached per (TOA set, noise hyperparameter values, exclude set):
+        the bases are static during a least-squares fit (hyperparameters
+        only move under MCMC), but quantization + Fourier builds are
+        O(N·q) host work worth doing once, not once per downhill trial
+        step. Excluded components are never densified at all (the fit
+        step excludes ECORR when it rides the segment path)."""
+        exclude = tuple(sorted(exclude))
         key = tuple(
             (p.name, p.value, getattr(p, "key", None),
              tuple(getattr(p, "key_value", ())))
-            for c in self.noise_components for p in c.params.values())
+            for c in self.noise_components for p in c.params.values()
+        ) + (exclude,)
         cached = self.__dict__.get("_noise_basis_cache")
         # identity check via a held reference (not a bare id(), which
         # CPython reuses after garbage collection)
@@ -586,7 +590,8 @@ class TimingModel:
             return cached[2]
         out = []
         for c in self.noise_components:
-            if not getattr(c, "is_basis_noise", False):
+            if not getattr(c, "is_basis_noise", False) or \
+                    type(c).__name__ in exclude:
                 continue
             pair = c.noise_basis_weight(toas)
             if pair is not None:
@@ -594,19 +599,70 @@ class TimingModel:
         self._noise_basis_cache = (toas, key, out)
         return out
 
-    def noise_model_designmatrix(self, toas):
-        """Stacked (N, q) noise basis, or None when no basis is active."""
-        pairs = self.noise_model_basis_weight_pairs(toas)
+    def noise_model_designmatrix(self, toas, exclude=()):
+        """Stacked (N, q) noise basis, or None when no basis is active.
+        ``exclude`` drops named components (the fit step excludes the
+        segment-handled ECORR components)."""
+        pairs = self.noise_model_basis_weight_pairs(toas,
+                                                    exclude=exclude)
         if not pairs:
             return None
         return np.concatenate([F for _, F, _ in pairs], axis=1)
 
-    def noise_model_basis_weight(self, toas):
+    def noise_model_basis_weight(self, toas, exclude=()):
         """Stacked (q,) prior variances matching the designmatrix."""
-        pairs = self.noise_model_basis_weight_pairs(toas)
+        pairs = self.noise_model_basis_weight_pairs(toas,
+                                                    exclude=exclude)
         if not pairs:
             return None
         return np.concatenate([phi for _, _, phi in pairs])
+
+    def noise_model_ecorr_segments(self, toas):
+        """ECORR epoch-segment structure for the Sherman-Morrison GLS
+        path: (epoch_ids (N,) int32 — value K means 'in no epoch' —,
+        jvar (K+1,) per-epoch jitter variances [s^2] with jvar[K] = 0,
+        consumed (tuple of component names to exclude from the dense
+        basis)), or None when no segment-capable component is active or
+        epochs overlap (then callers must fall back to the dense
+        quantization basis).
+
+        TPU-first design note: the reference treats ECORR as ~N_epoch
+        dense 0/1 basis columns inside the Woodbury solve
+        (src/pint/models/noise_model.py EcorrNoise.ecorr_basis_weight_
+        pair); on TPU that makes the normal matrix (p+q)^2 with
+        q ~ N/4. Because each epoch's covariance block is the rank-1
+        matrix jvar * 1 1^T, N_eff^-1 has a closed form via one
+        rank-1 downdate per epoch — O(N) segment sums instead of
+        O(N q^2) matmuls. Same algebra, hardware-shaped layout.
+        Extraction is sparse end-to-end (no dense U is ever built)."""
+        from pint_tpu.models.noise import EcorrOverlapError
+
+        eids, jvars, consumed = [], [], []
+        for c in self.noise_components:
+            fn = getattr(c, "noise_epoch_segments", None)
+            if fn is None:
+                continue
+            try:
+                seg = fn(toas)
+            except EcorrOverlapError:
+                return None  # fall back to the dense basis
+            if seg is not None:
+                eids.append(seg[0])
+                jvars.append(seg[1])
+                consumed.append(type(c).__name__)
+        if not eids:
+            return None
+        eid = np.full(toas.ntoas, -1, dtype=np.int32)
+        jv: list = []
+        for e, v in zip(eids, jvars):
+            mask = e >= 0
+            if np.any(eid[mask] >= 0):
+                return None  # overlap across components: dense fallback
+            eid[mask] = e[mask] + len(jv)
+            jv.extend(v.tolist())
+        K = len(jv)
+        eid[eid < 0] = K  # 'no epoch' slot with zero variance
+        return eid, np.asarray(jv + [0.0]), tuple(consumed)
 
     def noise_model_dimensions(self, toas):
         """{component name: (start, length)} column spans in the stacked

@@ -61,10 +61,21 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None):
     n = toas.ntoas
 
     nvec_np = model.scaled_toa_uncertainty(toas) ** 2
-    F_np = model.noise_model_designmatrix(toas)
-    phi_np = model.noise_model_basis_weight(toas)
+    # ECORR rides the Sherman-Morrison segment path (one rank-1
+    # downdate per observing epoch) instead of dense basis columns —
+    # see TimingModel.noise_model_ecorr_segments; only the remaining
+    # bases (red/DM noise Fourier modes) stay dense
+    seg = model.noise_model_ecorr_segments(toas)
+    if seg is not None:
+        eid_np, jvar_np, exclude = seg
+    else:
+        eid_np, jvar_np = np.zeros(n, np.int32), np.zeros(1)
+        exclude = ()
+    F_np = model.noise_model_designmatrix(toas, exclude=exclude)
+    phi_np = model.noise_model_basis_weight(toas, exclude=exclude)
     if F_np is None:
         F_np, phi_np = np.zeros((n, 0)), np.ones(0)
+    nseg = len(jvar_np)
 
     valid_np = np.ones(n)
     if pad_to is not None and pad_to > n:
@@ -87,8 +98,12 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None):
         F_np = padn(F_np)
         nvec_np = padn(nvec_np, fill=1.0)  # avoid 0-division; masked out
         valid_np = padn(valid_np)
+        # padded rows carry w=0 so their segment routing is irrelevant
+        eid_np = np.concatenate(
+            [eid_np, np.zeros(pad, np.int32)])
 
-    def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid):
+    def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
+                eid, jvar):
         def phase_f64(thx):
             ph, _ = phase_fn(thx, tl, fh, fl, batch, cache)
             # absolute-phase dd collapses to f64 AFTER the fractional
@@ -107,12 +122,13 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None):
         M = jnp.concatenate([ones, jac * valid[:, None]], axis=1)
         r = r * valid
         Fv = F * valid[:, None]
-        return _gls_core(M, Fv, phi, r, nvec, valid)
+        return _gls_core(M, Fv, phi, r, nvec, valid, eid, jvar, nseg)
 
     args = (jnp.asarray(th), jnp.asarray(tl), jnp.asarray(fh),
             jnp.asarray(fl), batch, sc, jnp.asarray(F_np),
             jnp.asarray(phi_np), jnp.asarray(nvec_np),
-            jnp.asarray(valid_np))
+            jnp.asarray(valid_np), jnp.asarray(eid_np),
+            jnp.asarray(jvar_np))
     return step_fn, args, ["Offset"] + free
 
 
@@ -129,9 +145,20 @@ def _pad_leaf(a: np.ndarray, pad: int) -> np.ndarray:
     return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1), mode="edge")
 
 
-def _gls_core(M, F, phi, r, nvec, valid):
+def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int):
     """The basis-Woodbury solve (same algebra as pint_tpu.gls), inlined
-    so the whole iteration fuses into one XLA program."""
+    so the whole iteration fuses into one XLA program.
+
+    ECORR enters as the effective white covariance
+        N_eff = diag(nvec) + sum_k jvar_k u_k u_k^T
+    (u_k = indicator of epoch k). Each epoch block is rank-1, so
+    Sherman-Morrison gives, for any vectors a,b:
+        a^T N_eff^-1 b = a^T W b - sum_k g_k (u_k^T W a)(u_k^T W b),
+        g_k = jvar_k / (1 + jvar_k s_k),  s_k = u_k^T w,  W = diag(w).
+    The u_k^T W · contractions are segment-sums over ``eid`` — O(N)
+    instead of carrying ~N/4 dense quantization columns through the
+    normal equations (the reference's layout). Only the Fourier noise
+    bases remain in F."""
     p = M.shape[1]
     w = valid / nvec
     # Two-stage column normalization. The F1/F2 design columns reach
@@ -149,11 +176,21 @@ def _gls_core(M, F, phi, r, nvec, valid):
     big = jnp.concatenate([Mn, F], axis=1)
     bigw = big * w[:, None]
     Sigma = big.T @ bigw
+    b = bigw.T @ r
+    rCr = jnp.sum(r * r * w)
+    if nseg > 1:  # static: no ECORR -> skip the dead downdate entirely
+        # epoch contractions (Sherman-Morrison downdate)
+        s_seg = jax.ops.segment_sum(w, eid, num_segments=nseg)
+        g = jvar / (1.0 + jvar * s_seg)
+        E = jax.ops.segment_sum(bigw, eid, num_segments=nseg)
+        wr_seg = jax.ops.segment_sum(w * r, eid, num_segments=nseg)
+        Sigma = Sigma - E.T @ (g[:, None] * E)
+        b = b - E.T @ (g * wr_seg)
+        rCr = rCr - jnp.sum(g * wr_seg ** 2)
     q = F.shape[1]
     prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi]) if q else \
         jnp.zeros(p)
     Sigma = Sigma + jnp.diag(prior)
-    b = bigw.T @ r
     # Jacobi-precondition to unit diagonal: Sigma mixes O(1) data terms
     # with 1/phi priors up to ~1e25, and TPU f64 (emulated, not
     # IEEE-correctly-rounded) loses the Cholesky on that raw scaling
@@ -163,17 +200,18 @@ def _gls_core(M, F, phi, r, nvec, valid):
     xhat = jax.scipy.linalg.cho_solve(cf, b / d) / d
     inv = jax.scipy.linalg.cho_solve(
         cf, jnp.eye(Sigma.shape[0])) / jnp.outer(d, d)
-    # chi2 at the point: marginalize noise basis only (see gls.py)
+    # chi2 at the point: marginalize the noise (F-basis + ECORR) only,
+    # not the parameter block (see gls.py _gls_chi2_kernel)
     if q:
-        bF = bigw[:, p:].T @ r
+        bF = b[p:]
         SF = Sigma[p:, p:]
         dF = d[p:]
         cfF = jax.scipy.linalg.cho_factor(SF / jnp.outer(dF, dF),
                                           lower=True)
-        chi2 = jnp.sum(r * r * w) - bF @ (jax.scipy.linalg.cho_solve(
+        chi2 = rCr - bF @ (jax.scipy.linalg.cho_solve(
             cfF, bF / dF) / dF)
     else:
-        chi2 = jnp.sum(r * r * w)
+        chi2 = rCr
     dparams = -xhat[:p] / colmax / norm  # r ≈ M(θ−θ_true): corr is −x
     cov = inv[:p, :p] / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
     return dparams, cov, chi2, r
@@ -208,7 +246,7 @@ def build_sharded_fit_step(model, toas, mesh, axis: str = "toa"):
     nshard = mesh.shape[axis]
     pad_to = _pad_to(toas.ntoas, nshard)
     step_fn, args, names = build_fit_step(model, toas, pad_to=pad_to)
-    th, tl, fh, fl, batch, sc, F, phi, nvec, valid = args
+    th, tl, fh, fl, batch, sc, F, phi, nvec, valid, eid, jvar = args
 
     shard = toa_sharding(mesh, axis)
     rep = NamedSharding(mesh, P())
@@ -235,6 +273,7 @@ def build_sharded_fit_step(model, toas, mesh, axis: str = "toa"):
         jax.device_put(F, shard(F)), jax.device_put(phi, rep),
         jax.device_put(nvec, shard(nvec)),
         jax.device_put(valid, shard(valid)),
+        jax.device_put(eid, shard(eid)), jax.device_put(jvar, rep),
     )
     out_shardings = (rep, rep, rep, shard(jnp.zeros(n)))
     jitted = jax.jit(step_fn, out_shardings=out_shardings)

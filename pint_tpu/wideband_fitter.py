@@ -101,15 +101,17 @@ class WidebandTOAFitter(Fitter):
         self.set_uncertainties(cov, names)
         self.noise_resids = noise
         self.converged = True
-        # chi2 sums over 2N stacked TOA+DM measurements
         self._record_stats(chi2, max(1, maxiter), t0,
-                           dof=2 * self.toas.ntoas
-                           - len(self.model.free_params) - 1)
+                           dof=self._wb_dof())
         return chi2
 
     @property
     def chi2_dm(self) -> float:
         return self.dm_resids.chi2
+
+    def _wb_dof(self) -> int:
+        """chi2 sums over 2N stacked TOA+DM measurements."""
+        return 2 * self.toas.ntoas - len(self.model.free_params) - 1
 
 
 class WidebandDownhillFitter(WidebandTOAFitter):
@@ -159,6 +161,5 @@ class WidebandDownhillFitter(WidebandTOAFitter):
         self.set_uncertainties(cov, names)
         self.noise_resids = noise
         self._record_stats(best_chi2, iterations, t0,
-                           dof=2 * self.toas.ntoas
-                           - len(self.model.free_params) - 1)
+                           dof=self._wb_dof())
         return best_chi2

@@ -1,0 +1,105 @@
+"""The fit step's Sherman-Morrison ECORR segment path must agree with
+the dense quantization-basis Woodbury solve (the reference's layout:
+src/pint/models/noise_model.py EcorrNoise.ecorr_basis_weight_pair into
+GLSFitter.fit_toas)."""
+
+import io
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.gls import _gls_kernel
+from pint_tpu.models import get_model
+from pint_tpu.parallel import build_fit_step
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+
+@pytest.fixture(scope="module")
+def ecorr_problem():
+    par = [
+        "PSR J0003+0003",
+        "RAJ 09:00:00.0 1",
+        "DECJ 15:00:00.0 1",
+        "F0 150.0 1",
+        "F1 -3e-15 1",
+        "PEPOCH 55000",
+        "POSEPOCH 55000",
+        "DM 25.0 1",
+        "DMEPOCH 55000",
+        "TZRMJD 55000.1",
+        "TZRSITE @",
+        "TZRFRQ 1400",
+        "UNITS TDB",
+        "EFAC -be X 1.2",
+        "ECORR -be X 1.5",
+        "TNREDAMP -13.2",
+        "TNREDGAM 2.5",
+        "TNREDC 6",
+    ]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO("\n".join(par) + "\n"))
+        rng = np.random.default_rng(11)
+        # 30 clusters of 3 TOAs -> 30 real ECORR epochs; two bands
+        centers = np.linspace(54001, 55999, 30)
+        offsets = np.array([0.0, 0.01, 0.02])
+        mjds = (centers[:, None] + offsets[None, :]).ravel()
+        freqs = np.tile([1400.0, 820.0, 1400.0], 30)
+        toas = make_fake_toas_fromMJDs(mjds, model, error_us=1.0,
+                                       freq_mhz=freqs, add_noise=True,
+                                       rng=rng)
+        for f in toas.flags:
+            f["be"] = "X"
+    return model, toas
+
+
+def test_segments_extracted(ecorr_problem):
+    model, toas = ecorr_problem
+    seg = model.noise_model_ecorr_segments(toas)
+    assert seg is not None
+    eid, jvar, consumed = seg
+    assert consumed == ("EcorrNoise",)
+    assert eid.shape == (toas.ntoas,)
+    assert jvar.shape == (31,)  # 30 epochs + the 'no epoch' slot
+    assert jvar[-1] == 0.0
+    assert np.all(eid < 31)
+    # every TOA is in a real epoch here and jvar = (1.5us)^2
+    assert np.all(eid < 30)
+    np.testing.assert_allclose(jvar[:30], (1.5e-6) ** 2)
+
+
+def test_segment_path_matches_dense(ecorr_problem):
+    model, toas = ecorr_problem
+    step_fn, args, names = build_fit_step(model, toas)
+    dp_seg, cov_seg, chi2_seg, r_seg = jax.jit(step_fn)(*args)
+
+    # dense reference: full stacked basis (ECORR quantization included)
+    r = Residuals(toas, model).time_resids
+    M, names_d, _ = model.designmatrix(toas, incoffset=True)
+    nvec = model.scaled_toa_uncertainty(toas) ** 2
+    F = model.noise_model_designmatrix(toas)
+    phi = model.noise_model_basis_weight(toas)
+    assert F.shape[1] == 30 + 12  # dense path: ECORR cols + 2*TNREDC
+    x, cov, chi2, noise, xfull, ok = _gls_kernel(
+        jnp.asarray(M), jnp.asarray(F), jnp.asarray(phi),
+        jnp.asarray(r), jnp.asarray(nvec))
+    assert bool(ok)
+    assert names == names_d
+    np.testing.assert_allclose(np.asarray(dp_seg), -np.asarray(x),
+                               rtol=1e-6, atol=1e-16)
+    np.testing.assert_allclose(np.asarray(cov_seg), np.asarray(cov),
+                               rtol=1e-5, atol=1e-30)
+
+
+def test_segment_chi2_matches_marginalized(ecorr_problem):
+    """The step's chi2 equals the GLS-marginalized chi2 of the current
+    residuals (Residuals.chi2 goes through the dense basis)."""
+    model, toas = ecorr_problem
+    step_fn, args, names = build_fit_step(model, toas)
+    _, _, chi2_seg, _ = jax.jit(step_fn)(*args)
+    chi2_dense = Residuals(toas, model).chi2
+    assert float(chi2_seg) == pytest.approx(chi2_dense, rel=1e-8)
