@@ -1,0 +1,141 @@
+"""Photon-event ingestion: mission FITS event tables -> TOAs.
+
+Reference: src/pint/event_toas.py (load_fits_TOAs, load_event_TOAs,
+per-mission wrappers) and src/pint/fermi_toas.py (load_Fermi_TOAs,
+photon weights). Events carry no TOA uncertainty; phases are assigned
+by evaluating the timing model at the photon times.
+
+Mission time scales: event TIME columns count seconds from the mission
+MJDREF (MJDREFI + MJDREFF) in the header's TIMESYS. Barycentered event
+files (TIMESYS=TDB, TIMEREF=SOLARSYSTEM) map directly onto '@'
+(barycenter) TOAs — the supported fast path. Un-barycentered TT files
+need the spacecraft orbit (satellite observatories); loading them
+without one raises rather than silently mis-assigning phases.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.io.fits import read_events_fits
+from pint_tpu.toa import TOAs, get_TOAs_array
+
+__all__ = ["load_fits_TOAs", "load_event_TOAs", "load_Fermi_TOAs",
+           "load_NICER_TOAs", "load_RXTE_TOAs", "load_NuSTAR_TOAs",
+           "load_Swift_TOAs", "load_XMM_TOAs", "get_event_weights"]
+
+# (MJDREFI, MJDREFF) fallbacks when the header omits them
+MISSION_MJDREF = {
+    "fermi": (51910, 7.428703703703703e-4),
+    "nicer": (56658, 7.775925925925926e-4),
+    "rxte": (49353, 6.965740740740740e-4),
+    "nustar": (55197, 7.660185185185185e-4),
+    "swift": (51910, 7.428703703703703e-4),
+    "xmm": (50814, 0.0),
+}
+
+
+def _mjdref(header, mission: Optional[str]) -> Tuple[float, float]:
+    if "MJDREFI" in header:
+        return float(header["MJDREFI"]), float(header.get("MJDREFF", 0.0))
+    if "MJDREF" in header:
+        v = float(header["MJDREF"])
+        return float(np.floor(v)), v - np.floor(v)
+    if mission and mission.lower() in MISSION_MJDREF:
+        return MISSION_MJDREF[mission.lower()]
+    raise ValueError("event file lacks MJDREF and mission is unknown")
+
+
+def load_fits_TOAs(eventfile, mission: Optional[str] = None,
+                   weightcolumn: Optional[str] = None,
+                   minmjd: float = -np.inf, maxmjd: float = np.inf,
+                   ephem: Optional[str] = None,
+                   planets: bool = False) -> TOAs:
+    """Read a FITS event table into barycentric TOAs (reference:
+    event_toas.load_fits_TOAs). Photon weights (e.g. Fermi photon
+    probabilities) are attached as a per-TOA flag ``-weight``."""
+    cols, header = read_events_fits(eventfile)
+    timesys = str(header.get("TIMESYS", "TT")).strip().upper()
+    if timesys != "TDB":
+        raise NotImplementedError(
+            f"TIMESYS={timesys}: only barycentered (TDB) event files "
+            "are supported without a spacecraft orbit file")
+    key = next((k for k in cols if k.upper() == "TIME"), None)
+    if key is None:
+        raise ValueError("event table has no TIME column")
+    mjdrefi, mjdreff = _mjdref(header, mission)
+    tsec = np.asarray(cols[key], dtype=np.float64)
+    tsec = tsec + float(header.get("TIMEZERO", 0.0))
+    # split precisely: day from the integer part of sec/86400 relative
+    # to MJDREFI; the fractional seconds stay at full f64 resolution
+    day_off = np.floor(tsec / 86400.0)
+    frac = (tsec - day_off * 86400.0) / 86400.0 + mjdreff
+    day = mjdrefi + day_off
+    carry = np.floor(frac)
+    day, frac = day + carry, frac - carry
+    mjd_float = day + frac
+    keep = (mjd_float >= minmjd) & (mjd_float <= maxmjd)
+    day, frac = day[keep], frac[keep]
+
+    flags = [dict() for _ in range(day.size)]
+    if weightcolumn is not None:
+        wkey = next((k for k in cols if k.upper() ==
+                     weightcolumn.upper()), None)
+        if wkey is None:
+            raise ValueError(f"no weight column {weightcolumn!r}")
+        wts = np.asarray(cols[wkey], dtype=np.float64)[keep]
+        for f, wval in zip(flags, wts):
+            f["weight"] = f"{wval:.8g}"
+
+    from pint_tpu.ops import dd_np
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t = get_TOAs_array((day, dd_np.dd(frac)), obs="barycenter",
+                           freqs=np.inf, errors=0.0, flags=flags,
+                           ephem=ephem, planets=planets)
+    t.names = [f"photon{i}" for i in range(t.ntoas)]
+    return t
+
+
+def load_event_TOAs(eventfile, mission: str, **kw) -> TOAs:
+    """Mission-dispatching wrapper (reference: load_event_TOAs)."""
+    return load_fits_TOAs(eventfile, mission=mission, **kw)
+
+
+def load_Fermi_TOAs(eventfile, weightcolumn: Optional[str] = None,
+                    **kw) -> TOAs:
+    """Fermi-LAT FT1 loader; weightcolumn typically 'MODEL_WEIGHT' or a
+    column produced by gtsrcprob (reference: fermi_toas.load_Fermi_TOAs)."""
+    return load_fits_TOAs(eventfile, mission="fermi",
+                          weightcolumn=weightcolumn, **kw)
+
+
+def load_NICER_TOAs(eventfile, **kw) -> TOAs:
+    return load_fits_TOAs(eventfile, mission="nicer", **kw)
+
+
+def load_RXTE_TOAs(eventfile, **kw) -> TOAs:
+    return load_fits_TOAs(eventfile, mission="rxte", **kw)
+
+
+def load_NuSTAR_TOAs(eventfile, **kw) -> TOAs:
+    return load_fits_TOAs(eventfile, mission="nustar", **kw)
+
+
+def load_Swift_TOAs(eventfile, **kw) -> TOAs:
+    return load_fits_TOAs(eventfile, mission="swift", **kw)
+
+
+def load_XMM_TOAs(eventfile, **kw) -> TOAs:
+    return load_fits_TOAs(eventfile, mission="xmm", **kw)
+
+
+def get_event_weights(toas: TOAs) -> Optional[np.ndarray]:
+    """Per-photon weights from the -weight flag, or None if absent."""
+    if not any("weight" in f for f in toas.flags):
+        return None
+    return np.array([float(f.get("weight", 1.0)) for f in toas.flags])

@@ -1,0 +1,4 @@
+"""Command-line entry points (reference: src/pint/scripts/ console
+scripts pintempo, zima, photonphase, pintbary, tcb2tdb,
+compare_parfiles). Each module exposes main(argv=None) so tests can
+invoke them in-process."""

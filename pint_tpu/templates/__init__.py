@@ -1,0 +1,232 @@
+"""Photon pulse-profile templates + maximum-likelihood fitting.
+
+Reference: src/pint/templates/ (lcprimitives.py LCGaussian/...,
+lctemplate.py LCTemplate, lcfitters.py LCFitter) — ~4k LoC of numpy
+class machinery there. TPU-first redesign: a template is a pure
+function of a flat parameter vector; the unbinned weighted photon
+log-likelihood and its gradient are one jitted XLA reduction over the
+photon axis, and the ML fit is gradient-based (the reference uses
+scipy simplex/L-BFGS per-primitive bookkeeping).
+
+Parameterization (one flat f64 vector `theta`):
+    theta = [logits (m+1,) | locs (m,) | log_widths (m,)]
+softmax(logits) -> [background, norm_1..norm_m]: normalizations are
+positive and sum to 1 with the background taking the remainder, so no
+constrained optimizer is needed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LCPrimitive", "LCGaussian", "LCVonMises", "LCLorentzian",
+           "LCTemplate", "LCFitter"]
+
+
+class LCPrimitive:
+    """One peak shape: a normalized pdf on phase [0,1) with a location
+    and a width parameter (reference: lcprimitives.LCPrimitive)."""
+
+    name = "prim"
+
+    @staticmethod
+    def pdf(phi, loc, width):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class LCGaussian(LCPrimitive):
+    """Wrapped Gaussian peak (reference: lcprimitives.LCGaussian).
+    width = sigma in phase units; wrapping summed over +-3 turns."""
+
+    name = "gaussian"
+
+    @staticmethod
+    def pdf(phi, loc, width):
+        d = phi - loc
+        ns = jnp.arange(-3.0, 4.0)
+        z = (d[..., None] + ns) / width[..., None]
+        g = jnp.exp(-0.5 * z * z)
+        return jnp.sum(g, axis=-1) / (width * jnp.sqrt(2 * jnp.pi))
+
+
+class LCVonMises(LCPrimitive):
+    """Von Mises peak: exp(kappa cos 2pi(phi-loc)) / I0(kappa), with
+    kappa = 1/(2 pi width)^2 matching the reference's width convention
+    (reference: lcprimitives.LCVonMises)."""
+
+    name = "vonmises"
+
+    @staticmethod
+    def pdf(phi, loc, width):
+        kappa = 1.0 / (2.0 * jnp.pi * width) ** 2
+        val = jnp.exp(kappa * (jnp.cos(2 * jnp.pi * (phi - loc)) - 1.0))
+        norm = jax.scipy.special.i0e(kappa)  # e^-k I0(k): overflow-safe
+        return val / norm
+
+
+class LCLorentzian(LCPrimitive):
+    """Wrapped Lorentzian (wrapped-Cauchy closed form), width = HWHM in
+    phase units (reference: lcprimitives.LCLorentzian)."""
+
+    name = "lorentzian"
+
+    @staticmethod
+    def pdf(phi, loc, width):
+        rho = jnp.exp(-2.0 * jnp.pi * width)
+        c = jnp.cos(2.0 * jnp.pi * (phi - loc))
+        return (1.0 - rho ** 2) / (1.0 + rho ** 2 - 2.0 * rho * c)
+
+
+_PRIM_TYPES = {c.name: c for c in (LCGaussian, LCVonMises, LCLorentzian)}
+
+
+class LCTemplate:
+    """Weighted sum of primitives + uniform background (reference:
+    lctemplate.LCTemplate). Holds primitive *types*; all numeric state
+    lives in the flat theta vector so the pdf is a pure function."""
+
+    def __init__(self, primitives: Sequence[LCPrimitive],
+                 norms: Sequence[float], locs: Sequence[float],
+                 widths: Sequence[float]):
+        self.primitives = list(primitives)
+        m = len(self.primitives)
+        assert len(norms) == len(locs) == len(widths) == m
+        self.theta = self.pack(np.asarray(norms, dtype=np.float64),
+                               np.asarray(locs, dtype=np.float64),
+                               np.asarray(widths, dtype=np.float64))
+
+    # ---- flat parameter vector ------------------------------------
+
+    @staticmethod
+    def pack(norms, locs, widths) -> np.ndarray:
+        bg = 1.0 - np.sum(norms)
+        if bg <= 0:
+            raise ValueError("norms must sum to < 1")
+        logits = np.log(np.concatenate([[bg], norms]))
+        return np.concatenate([logits, locs, np.log(widths)])
+
+    def unpack(self, theta) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        m = len(self.primitives)
+        p = jax.nn.softmax(jnp.asarray(theta[:m + 1]))
+        locs = jnp.mod(jnp.asarray(theta[m + 1:2 * m + 1]), 1.0)
+        widths = jnp.exp(jnp.asarray(theta[2 * m + 1:]))
+        return p[1:], locs, widths
+
+    # ---- evaluation ------------------------------------------------
+
+    def _pdf_fn(self):
+        prim_pdfs = [p.pdf for p in self.primitives]
+        m = len(prim_pdfs)
+
+        def pdf(theta, phi):
+            p = jax.nn.softmax(theta[:m + 1])
+            locs = theta[m + 1:2 * m + 1]
+            widths = jnp.exp(theta[2 * m + 1:])
+            val = p[0] * jnp.ones_like(phi)
+            for k, f in enumerate(prim_pdfs):
+                val = val + p[k + 1] * f(phi, locs[k], widths[k])
+            return val
+
+        return pdf
+
+    def __call__(self, phases, theta=None) -> np.ndarray:
+        theta = self.theta if theta is None else theta
+        return np.asarray(self._pdf_fn()(jnp.asarray(theta),
+                                         jnp.asarray(phases)))
+
+    @property
+    def norms(self) -> np.ndarray:
+        return np.asarray(self.unpack(self.theta)[0])
+
+    @property
+    def locs(self) -> np.ndarray:
+        return np.asarray(self.unpack(self.theta)[1])
+
+    @property
+    def widths(self) -> np.ndarray:
+        return np.asarray(self.unpack(self.theta)[2])
+
+    def random(self, n: int,
+               rng: Optional[np.random.Generator] = None) -> np.ndarray:
+        """Draw n photon phases from the template (for simulation
+        tests; reference: LCTemplate.random)."""
+        rng = rng or np.random.default_rng()
+        norms, locs, widths = (np.asarray(x) for x in
+                               self.unpack(self.theta))
+        bg = 1.0 - norms.sum()
+        comp = rng.choice(len(norms) + 1, size=n,
+                          p=np.concatenate([[bg], norms]))
+        out = rng.uniform(size=n)  # background
+        for k, prim in enumerate(self.primitives):
+            idx = comp == k + 1
+            nk = int(idx.sum())
+            if nk == 0:
+                continue
+            if isinstance(prim, LCGaussian):
+                draw = rng.normal(locs[k], widths[k], size=nk)
+            elif isinstance(prim, LCVonMises):
+                kappa = 1.0 / (2 * np.pi * widths[k]) ** 2
+                draw = locs[k] + rng.vonmises(0.0, kappa, size=nk) / (
+                    2 * np.pi)
+            else:  # Lorentzian
+                draw = locs[k] + widths[k] * np.tan(
+                    np.pi * (rng.uniform(size=nk) - 0.5)) / (2 * np.pi)
+            out[idx] = draw
+        return np.mod(out, 1.0)
+
+
+@partial(jax.jit, static_argnames=("pdf_id",))
+def _nll_cached(theta, phases, weights, pdf_id):  # pragma: no cover
+    raise RuntimeError("placeholder; replaced per-template below")
+
+
+class LCFitter:
+    """Unbinned weighted ML template fitter (reference:
+    lcfitters.LCFitter). loglikelihood = sum_i log(w_i f(phi_i) +
+    (1-w_i)); optimization is jitted gradient descent with backtracking
+    (no scipy dependency on the device path)."""
+
+    def __init__(self, template: LCTemplate, phases,
+                 weights=None):
+        self.template = template
+        self.phases = jnp.asarray(np.mod(phases, 1.0))
+        self.weights = (jnp.ones_like(self.phases) if weights is None
+                        else jnp.asarray(weights))
+        pdf = template._pdf_fn()
+
+        def nll(theta):
+            f = pdf(theta, self.phases)
+            return -jnp.sum(jnp.log(self.weights * f
+                                    + (1.0 - self.weights)))
+
+        self._nll = jax.jit(nll)
+        self._valgrad = jax.jit(jax.value_and_grad(nll))
+
+    def loglikelihood(self, theta=None) -> float:
+        theta = self.template.theta if theta is None else theta
+        return -float(self._nll(jnp.asarray(theta)))
+
+    def fit(self, maxiter: int = 500) -> dict:
+        """ML fit: host L-BFGS-B over the jitted device
+        value-and-grad (the reduction over the photon axis is the hot
+        part and runs as one XLA program per evaluation); updates the
+        template's theta in place."""
+        from scipy.optimize import minimize
+
+        def f(x):
+            v, g = self._valgrad(jnp.asarray(x))
+            return float(v), np.asarray(g, dtype=np.float64)
+
+        res = minimize(f, np.asarray(self.template.theta), jac=True,
+                       method="L-BFGS-B",
+                       options={"maxiter": maxiter})
+        self.template.theta = np.asarray(res.x)
+        return {"loglikelihood": -float(res.fun),
+                "iterations": int(res.nit),
+                "grad_norm": float(np.linalg.norm(res.jac)),
+                "success": bool(res.success)}

@@ -1,0 +1,273 @@
+"""Photon/event vertical: FITS I/O round-trip, event loading, event
+statistics, template ML recovery, and the photonphase CLI end-to-end
+(reference: src/pint/event_toas.py, eventstats.py, templates/,
+scripts/photonphase.py; test pattern per SURVEY.md §4.6)."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.eventstats import h_sig, hm, hmw, sf_hm, sig2sigma, z2m
+from pint_tpu.io.fits import read_events_fits, read_fits, write_events_fits
+from pint_tpu.models import get_model
+from pint_tpu.templates import (
+    LCFitter,
+    LCGaussian,
+    LCLorentzian,
+    LCTemplate,
+    LCVonMises,
+)
+
+NICER_MJDREF = (56658, 7.775925925925926e-4)
+
+
+# ---------------------------------------------------------------- FITS
+
+
+def test_fits_roundtrip(tmp_path):
+    path = tmp_path / "ev.fits"
+    rng = np.random.default_rng(0)
+    times = np.sort(rng.uniform(0, 1e6, 500))
+    weights = rng.uniform(0.1, 1.0, 500).astype(np.float32)
+    pha = rng.integers(0, 256, 500)
+    write_events_fits(path, {"TIME": times, "WEIGHT": weights,
+                             "PHA": pha},
+                      header_extra={"TIMESYS": "TDB",
+                                    "MJDREFI": 56658,
+                                    "MJDREFF": NICER_MJDREF[1],
+                                    "TELESCOP": "NICER"})
+    cols, header = read_events_fits(path)
+    np.testing.assert_allclose(cols["TIME"], times, rtol=0, atol=0)
+    np.testing.assert_allclose(cols["WEIGHT"], weights, rtol=1e-7)
+    assert np.all(cols["PHA"] == pha)
+    assert header["TIMESYS"] == "TDB"
+    assert header["MJDREFI"] == 56658
+    hdus = read_fits(path)
+    assert len(hdus) == 2  # primary + events
+
+
+def test_fits_file_size_is_block_aligned(tmp_path):
+    path = tmp_path / "b.fits"
+    write_events_fits(path, {"TIME": np.arange(3.0)})
+    assert path.stat().st_size % 2880 == 0
+
+
+# ------------------------------------------------------- event loading
+
+
+@pytest.fixture(scope="module")
+def pulsar_model():
+    par = """
+PSR J0030+0451
+RAJ 00:30:27.4
+DECJ 04:51:39.7
+F0 205.53069927
+F1 -4.3e-16
+PEPOCH 56500
+POSEPOCH 56500
+DM 4.33
+DMEPOCH 56500
+TZRMJD 56500.0
+TZRSITE @
+TZRFRQ inf
+UNITS TDB
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(io.StringIO(par))
+
+
+def _write_pulsed_events(path, model, n=2000, seed=1, weights=False,
+                         frac_pulsed=0.7, width=0.03):
+    """Simulate barycentric photon arrival times whose phases follow a
+    Gaussian peak at phi=0.3 (+ uniform background) under ``model``."""
+    rng = np.random.default_rng(seed)
+    mjd0, mjd1 = 56400.0, 56600.0
+    f0 = model.F0.value
+    # draw target phases, then place photons on the model's phase grid:
+    # t = t0 + (k + phi)/f0 to f64 accuracy is plenty for event tests
+    base = rng.uniform(mjd0, mjd1, n)
+    pulsed = rng.uniform(size=n) < frac_pulsed
+    phi_t = np.where(pulsed,
+                     np.mod(0.3 + width * rng.standard_normal(n), 1.0),
+                     rng.uniform(size=n))
+    pep = model.PEPOCH.value
+    dt = (base - pep) * 86400.0
+    k = np.floor(dt * f0)
+    f1 = model.F1.value or 0.0
+    tsec = (k + phi_t) / f0 - 0.5 * f1 / f0 * ((k + phi_t) / f0) ** 2
+    mjd = pep + tsec / 86400.0
+    mjdrefi, mjdreff = NICER_MJDREF
+    times = ((mjd - mjdrefi) - mjdreff) * 86400.0
+    cols = {"TIME": np.sort(times)}
+    if weights:
+        w = np.where(pulsed, rng.uniform(0.5, 1.0, n),
+                     rng.uniform(0.0, 0.5, n))
+        cols["WEIGHT"] = w[np.argsort(times)]
+    write_events_fits(path, cols, header_extra={
+        "TIMESYS": "TDB", "TIMEREF": "SOLARSYSTEM",
+        "MJDREFI": mjdrefi, "MJDREFF": mjdreff, "TELESCOP": "NICER",
+        "TIMEZERO": 0.0, "TIMEUNIT": "s"})
+
+
+def test_load_fits_toas_phases_cluster(tmp_path, pulsar_model):
+    from pint_tpu.event_toas import load_NICER_TOAs
+
+    path = tmp_path / "nicer.fits"
+    _write_pulsed_events(path, pulsar_model, n=1500, frac_pulsed=1.0,
+                         width=0.01)
+    toas = load_NICER_TOAs(path)
+    assert toas.ntoas == 1500
+    assert all(o == "barycenter" for o in toas.obs)
+    phases = np.mod(np.asarray(pulsar_model.phase(toas).frac), 1.0)
+    # simulated peak at 0.3 with width 0.01 (spindown phase only: the
+    # quadratic F1 inversion is approximate at the <1e-3 cycle level)
+    d = np.abs(np.mod(phases - 0.3 + 0.5, 1.0) - 0.5)
+    assert np.median(d) < 0.02
+
+
+def test_load_fits_toas_rejects_tt(tmp_path):
+    from pint_tpu.event_toas import load_fits_TOAs
+
+    path = tmp_path / "tt.fits"
+    write_events_fits(path, {"TIME": np.arange(10.0)},
+                      header_extra={"TIMESYS": "TT", "MJDREFI": 56658,
+                                    "MJDREFF": NICER_MJDREF[1]})
+    with pytest.raises(NotImplementedError):
+        load_fits_TOAs(path)
+
+
+def test_event_weights_flag_roundtrip(tmp_path, pulsar_model):
+    from pint_tpu.event_toas import get_event_weights, load_fits_TOAs
+
+    path = tmp_path / "w.fits"
+    _write_pulsed_events(path, pulsar_model, n=200, weights=True)
+    toas = load_fits_TOAs(path, mission="nicer", weightcolumn="WEIGHT")
+    w = get_event_weights(toas)
+    assert w is not None and w.shape == (200,)
+    assert np.all((w >= 0) & (w <= 1))
+
+
+# ---------------------------------------------------------- eventstats
+
+
+def test_z2m_uniform_null():
+    rng = np.random.default_rng(2)
+    phases = rng.uniform(size=20000)
+    # under the null Z^2_m ~ chi^2_{2m}: mean 2m
+    assert z2m(phases, m=2) < 20.0
+    assert hm(phases) < 30.0
+
+
+def test_z2m_strong_signal():
+    rng = np.random.default_rng(3)
+    phases = np.mod(0.5 + 0.02 * rng.standard_normal(2000), 1.0)
+    z = z2m(phases, m=2)
+    h = hm(phases)
+    assert z > 1000.0
+    assert h > 1000.0
+    assert h_sig(h) > 10.0
+
+
+def test_hmw_weights_suppress_background():
+    rng = np.random.default_rng(4)
+    sig = np.mod(0.2 + 0.02 * rng.standard_normal(500), 1.0)
+    bkg = rng.uniform(size=5000)
+    phases = np.concatenate([sig, bkg])
+    w = np.concatenate([np.full(500, 0.9), np.full(5000, 0.05)])
+    h_w = hmw(phases, w)
+    h_unw = hm(phases)
+    assert h_w > h_unw  # weighting recovers the buried signal
+
+
+def test_sig2sigma_values():
+    from scipy.stats import norm
+
+    assert sig2sigma(norm.sf(3.0)) == pytest.approx(3.0, rel=1e-9)
+    assert sig2sigma(norm.sf(8.0)) == pytest.approx(8.0, rel=1e-6)
+    # tiny probabilities go through the log-asymptotic branch
+    assert sig2sigma(1e-320) == pytest.approx(38.3, abs=0.5)
+    assert sf_hm(50.0) == pytest.approx(np.exp(-20.0))
+
+
+# ----------------------------------------------------------- templates
+
+
+def test_template_pdf_normalized():
+    for prim in (LCGaussian(), LCVonMises(), LCLorentzian()):
+        t = LCTemplate([prim], norms=[0.6], locs=[0.4], widths=[0.05])
+        grid = np.linspace(0, 1, 20001)[:-1]
+        integral = np.mean(t(grid))
+        assert integral == pytest.approx(1.0, rel=1e-3), prim.name
+
+
+def test_template_random_matches_pdf():
+    t = LCTemplate([LCGaussian()], norms=[0.8], locs=[0.35],
+                   widths=[0.04])
+    rng = np.random.default_rng(5)
+    draws = t.random(40000, rng=rng)
+    hist, edges = np.histogram(draws, bins=50, range=(0, 1),
+                               density=True)
+    centers = 0.5 * (edges[:-1] + edges[1:])
+    np.testing.assert_allclose(hist, t(centers), atol=0.35)
+
+
+def test_lcfitter_recovers_injected_template():
+    truth = LCTemplate([LCGaussian()], norms=[0.65], locs=[0.3],
+                       widths=[0.03])
+    rng = np.random.default_rng(6)
+    phases = truth.random(8000, rng=rng)
+    fit_t = LCTemplate([LCGaussian()], norms=[0.4], locs=[0.35],
+                       widths=[0.06])
+    fitter = LCFitter(fit_t, phases)
+    ll0 = fitter.loglikelihood()
+    res = fitter.fit()
+    assert res["loglikelihood"] > ll0
+    assert fit_t.locs[0] == pytest.approx(0.3, abs=0.005)
+    assert fit_t.widths[0] == pytest.approx(0.03, abs=0.005)
+    assert fit_t.norms[0] == pytest.approx(0.65, abs=0.05)
+
+
+def test_lcfitter_weighted():
+    truth = LCTemplate([LCVonMises()], norms=[0.7], locs=[0.6],
+                       widths=[0.05])
+    rng = np.random.default_rng(7)
+    sig = truth.random(3000, rng=rng)
+    bkg = rng.uniform(size=3000)
+    phases = np.concatenate([sig, bkg])
+    w = np.concatenate([np.full(3000, 0.95), np.full(3000, 0.05)])
+    fit_t = LCTemplate([LCVonMises()], norms=[0.5], locs=[0.55],
+                       widths=[0.08])
+    fitter = LCFitter(fit_t, phases, weights=w)
+    fitter.fit()
+    assert fit_t.locs[0] == pytest.approx(0.6, abs=0.01)
+
+
+# ------------------------------------------------------------- the CLI
+
+
+def test_photonphase_cli(tmp_path, pulsar_model):
+    from pint_tpu.scripts.photonphase import main
+
+    ev = tmp_path / "events.fits"
+    _write_pulsed_events(ev, pulsar_model, n=1200, frac_pulsed=0.8,
+                         width=0.02)
+    par = tmp_path / "model.par"
+    par.write_text(pulsar_model.as_parfile())
+    out = tmp_path / "out.fits"
+    npz = tmp_path / "phases.npz"
+    rc = main([str(ev), str(par), "--outfile", str(out),
+               "--npz", str(npz)])
+    assert rc == 0
+    cols, header = read_events_fits(out)
+    assert "PULSE_PHASE" in cols
+    assert np.all((cols["PULSE_PHASE"] >= 0)
+                  & (cols["PULSE_PHASE"] < 1))
+    d = np.load(npz)
+    np.testing.assert_allclose(d["phases"], cols["PULSE_PHASE"])
+    # the pulsation must be detected
+    from pint_tpu.eventstats import hm
+
+    assert hm(cols["PULSE_PHASE"]) > 100.0
