@@ -1,0 +1,226 @@
+"""Bayesian timing interface: lnprior / lnlikelihood / lnposterior /
+prior_transform over a TimingModel + TOAs.
+
+Reference: src/pint/bayesian.py (BayesianTiming). TPU-first redesign:
+the likelihood is a pure jitted function of the free-parameter vector —
+the dd phase chain, weighted-mean subtraction, and the noise-
+marginalized Gaussian likelihood fuse into one XLA program — and a
+vmapped batch evaluator scores whole walker populations/sample grids in
+one device call (the reference evaluates one point at a time under
+emcee).
+
+With the noise hyperparameters held fixed (the reference's default
+mode), the correlated-noise covariance C = N + F phi F^T is constant
+across likelihood calls, so its Woodbury Cholesky factor and log-
+determinant are computed once at construction; each call costs one
+phase evaluation plus two small matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["BayesianTiming"]
+
+LN2PI = float(np.log(2.0 * np.pi))
+
+
+class BayesianTiming:
+    """lnposterior machinery for sampling timing parameters (reference:
+    bayesian.BayesianTiming)."""
+
+    def __init__(self, model, toas):
+        self.model = model
+        self.toas = toas
+        self.param_labels: List[str] = list(model.free_params)
+        self.nparams = len(self.param_labels)
+        self._priors = [model.get_param(p).prior
+                        for p in self.param_labels]
+
+        phase_fn, _ = model._build_phase_fn()
+        cache = model.get_cache(toas)
+        free, frozen, th, tl, fh, fl = model._pack()
+        if free != self.param_labels:
+            raise ValueError(
+                "free_params / packed-parameter mismatch: "
+                f"{sorted(set(free) ^ set(self.param_labels))}")
+        if "F0" in free:
+            i = free.index("F0")
+            f0 = th[i] + tl[i]
+        else:
+            i = frozen.index("F0")
+            f0 = fh[i] + fl[i]
+        batch = cache["batch"]
+        sc = {k: v for k, v in cache.items() if k != "batch"}
+        tl_j, fh_j, fl_j = map(jnp.asarray, (tl, fh, fl))
+        self.theta0 = np.asarray(th, dtype=np.float64)
+
+        nvec = jnp.asarray(model.scaled_toa_uncertainty(toas) ** 2)
+        w = 1.0 / nvec
+        n = toas.ntoas
+        # ECORR rides the O(N) Sherman-Morrison segment path exactly as
+        # in the fit step (one rank-1 downdate per observing epoch);
+        # only the Fourier bases stay dense
+        seg = model.noise_model_ecorr_segments(toas)
+        if seg is not None:
+            eid_np, jvar_np, exclude = seg
+            eid = jnp.asarray(eid_np)
+            nseg = len(jvar_np)
+            s_seg = jax.ops.segment_sum(w, eid, num_segments=nseg)
+            g = jnp.asarray(jvar_np) / (1.0 + jnp.asarray(jvar_np)
+                                        * s_seg)
+            logdet_ecorr = float(jnp.sum(jnp.log1p(
+                jnp.asarray(jvar_np) * s_seg)))
+        else:
+            eid = g = None
+            nseg = 1
+            exclude = ()
+            logdet_ecorr = 0.0
+        F = model.noise_model_designmatrix(toas, exclude=exclude)
+        # constant noise machinery (hyperparameters fixed during
+        # timing-parameter sampling, as in the reference)
+        logdet_n = float(jnp.sum(jnp.log(nvec))) + logdet_ecorr
+        if F is None:
+            self._lnnorm = -0.5 * logdet_n - 0.5 * n * LN2PI
+            Fw = None
+            Lf = None
+            dS = None
+            EF = None
+        else:
+            phi = jnp.asarray(
+                model.noise_model_basis_weight(toas, exclude=exclude))
+            Fj = jnp.asarray(F)
+            Fw = Fj * w[:, None]
+            # Sff = F^T N_eff^-1 F + phi^-1 with the ECORR downdate
+            Sff = Fj.T @ Fw + jnp.diag(1.0 / phi)
+            if eid is not None:
+                EF = jax.ops.segment_sum(Fw, eid, num_segments=nseg)
+                Sff = Sff - EF.T @ (g[:, None] * EF)
+            else:
+                EF = None
+            # Jacobi-precondition before factorizing: raw Sff mixes
+            # O(1) data terms with 1/phi priors up to ~1e25 and a bare
+            # Cholesky loses ~4 digits of the quadratic form (see
+            # pint_tpu.gls._gls_chi2_kernel)
+            dS = jnp.sqrt(jnp.diagonal(Sff))
+            Lf = jax.scipy.linalg.cho_factor(
+                Sff / jnp.outer(dS, dS), lower=True)
+            # logdet C = logdet N_eff + sum ln phi + logdet Sff,
+            # logdet Sff = logdet Sp + 2 sum ln dS
+            logdet = (logdet_n
+                      + float(jnp.sum(jnp.log(phi)))
+                      + 2.0 * float(jnp.sum(jnp.log(
+                          jnp.diagonal(Lf[0]))))
+                      + 2.0 * float(jnp.sum(jnp.log(dS))))
+            self._lnnorm = -0.5 * logdet - 0.5 * n * LN2PI
+
+        lnnorm = self._lnnorm
+        th0_j = jnp.asarray(self.theta0)
+        self._tl0 = np.asarray(tl, dtype=np.float64)
+
+        def lnlike_core(tl_eff):
+            # the parameter point enters ONLY through the dd LOW word
+            # (tl_eff = tl0 + (theta - theta0), formed on the host):
+            # exact for every representable theta, where putting theta
+            # itself in the hi word would quantize perturbations of
+            # large parameters to ulp(value) — ~0.1 sigma for F0 at
+            # typical MSP precision. tl_eff is a jit INPUT, not a
+            # captured constant, so XLA cannot constant-fold the tiny
+            # low word away against th0.
+            frac_dd = phase_fn(th0_j, tl_eff, fh_j, fl_j, batch, sc)[0]
+            from pint_tpu.ops.dd import dd_frac
+
+            f = dd_frac(frac_dd)
+            frac = f.hi + f.lo
+            wmean = jnp.sum(frac * w) / jnp.sum(w)
+            r = (frac - wmean) / f0
+            rCr = jnp.sum(r * r * w)
+            if eid is not None:
+                wr_seg = jax.ops.segment_sum(w * r, eid,
+                                             num_segments=nseg)
+                rCr = rCr - jnp.sum(g * wr_seg ** 2)
+            if Fw is not None:
+                bF = Fw.T @ r
+                if EF is not None:
+                    bF = bF - EF.T @ (g * wr_seg)
+                bF = bF / dS
+                rCr = rCr - bF @ jax.scipy.linalg.cho_solve(Lf, bF)
+            return -0.5 * rCr + lnnorm
+
+        self._lnlike_core = jax.jit(lnlike_core)
+        self._lnlike_core_batch = jax.jit(jax.vmap(lnlike_core))
+
+        def _tl_eff(theta):
+            return jnp.asarray(
+                self._tl0 + (np.asarray(theta, dtype=np.float64)
+                             - self.theta0))
+
+        self._lnlike = lambda theta: self._lnlike_core(_tl_eff(theta))
+        self._lnlike_batch = lambda thetas: self._lnlike_core_batch(
+            jnp.asarray(self._tl0[None, :]
+                        + (np.asarray(thetas, dtype=np.float64)
+                           - self.theta0[None, :])))
+
+    # ------------------------------------------------------------ API
+
+    def lnprior(self, theta) -> float:
+        """Sum of per-parameter prior log-densities (reference:
+        BayesianTiming.lnprior). None priors (improper flat) contribute
+        exactly 0 and are skipped."""
+        theta = np.atleast_1d(np.asarray(theta, dtype=np.float64))
+        total = 0.0
+        for p, x in zip(self._priors, theta):
+            if p is not None:
+                total += float(p.logpdf(x))
+        return total
+
+    def prior_transform(self, cube) -> np.ndarray:
+        """Unit-cube -> parameter space via per-parameter ppf (for
+        nested samplers; reference: BayesianTiming.prior_transform).
+        Raises for parameters with improper (None) priors."""
+        cube = np.atleast_1d(np.asarray(cube, dtype=np.float64))
+        out = np.empty_like(cube)
+        for k, (p, q) in enumerate(zip(self._priors, cube)):
+            if p is None:
+                raise ValueError(
+                    f"parameter {self.param_labels[k]} has no proper "
+                    "prior; set one for prior_transform")
+            out[k] = float(p.ppf(q))
+        return out
+
+    def lnlikelihood(self, theta) -> float:
+        """Noise-marginalized Gaussian log-likelihood (reference:
+        BayesianTiming.lnlikelihood)."""
+        return float(self._lnlike(jnp.asarray(theta,
+                                              dtype=jnp.float64)))
+
+    def lnposterior(self, theta) -> float:
+        lp = self.lnprior(theta)
+        if not np.isfinite(lp):
+            return -np.inf
+        return lp + self.lnlikelihood(theta)
+
+    # batch/vmapped evaluation — one device call for a whole population
+
+    def lnlikelihood_batch(self, thetas) -> np.ndarray:
+        """(S,) log-likelihoods for an (S, nparams) sample batch in ONE
+        vmapped device call (no reference equivalent)."""
+        return np.asarray(self._lnlike_batch(
+            jnp.asarray(thetas, dtype=jnp.float64)))
+
+    def lnposterior_batch(self, thetas) -> np.ndarray:
+        thetas = np.atleast_2d(np.asarray(thetas, dtype=np.float64))
+        # priors vectorized per COLUMN over the batch (None = flat = 0)
+        lp = np.zeros(len(thetas))
+        for k, p in enumerate(self._priors):
+            if p is not None:
+                lp += np.asarray(p.logpdf(thetas[:, k]))
+        out = np.full(len(thetas), -np.inf)
+        ok = np.isfinite(lp)
+        if np.any(ok):
+            out[ok] = lp[ok] + self.lnlikelihood_batch(thetas[ok])
+        return out

@@ -1,0 +1,103 @@
+"""Chi-squared grids over frozen parameter pairs.
+
+Reference: src/pint/gridutils.py (grid_chisq, grid_chisq_derived) — the
+reference's ONLY intra-process parallelism, a ProcessPoolExecutor
+refitting the model at every grid node. TPU-first redesign: freeze the
+gridded parameters, build the fused fit step over the remaining free
+parameters once, and vmap it over all grid nodes — the whole grid
+(every node running `maxiter` full phase-chain + GLS refit iterations)
+is ONE jitted device call.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["grid_chisq", "grid_chisq_derived"]
+
+
+def _build_grid_eval(model, toas, parnames: Sequence[str],
+                     maxiter: int):
+    """(eval_fn, node_builder): eval_fn maps a (G,) vector of gridded-
+    parameter values to the refit chi2; vmap-ready."""
+    from pint_tpu.parallel.fit_step import build_fit_step
+
+    m = copy.deepcopy(model)
+    for name in parnames:
+        p = m.get_param(name)
+        if p.value is None:
+            raise ValueError(f"{name} has no value to grid around")
+        p.frozen = True
+    m.invalidate_cache()
+    # an empty remaining-free set is fine: the implicit Offset column is
+    # always profiled, so the step still returns a meaningful chi2
+    step_fn, args, names = build_fit_step(m, toas)
+    th0 = args[0]
+    _, frozen_names, _, _, fh0, fl0 = m._pack()
+    gidx = jnp.asarray([frozen_names.index(nm) for nm in parnames])
+    # grid values are absolute: zero the dd low part too, else a fitted
+    # parameter's residual lo (~eps*value, e.g. ~0.1 sigma for F0)
+    # silently shifts every node off its nominal coordinate
+    fl_z = jnp.asarray(fl0).at[gidx].set(0.0)
+
+    def eval_node(gvals):
+        fh = jnp.asarray(fh0).at[gidx].set(gvals)
+        th = th0
+
+        def one_iter(th):
+            dparams, cov, chi2, r = step_fn(
+                th, args[1], fh, fl_z, *args[4:])
+            # names[0] is the Offset column; the rest align with th
+            return th + dparams[1:], chi2
+
+        for _ in range(maxiter):
+            th, _ = one_iter(th)
+        _, chi2 = one_iter(th)  # chi2 at the refit point
+        return chi2
+
+    return eval_node, names
+
+
+def grid_chisq(model, toas, parnames: Sequence[str],
+               parvalues: Sequence[np.ndarray], maxiter: int = 2
+               ) -> np.ndarray:
+    """chi2 over the outer-product grid of ``parvalues`` with the
+    parameters in ``parnames`` held fixed at each node and every other
+    free parameter refit (reference: gridutils.grid_chisq; the
+    ProcessPoolExecutor is replaced by one vmapped device call).
+
+    Returns an array of shape (len(parvalues[0]), len(parvalues[1]),
+    ...) matching np.meshgrid(..., indexing='ij').
+    """
+    if len(parnames) != len(parvalues):
+        raise ValueError("parnames and parvalues must pair up")
+    grids = [np.asarray(v, dtype=np.float64) for v in parvalues]
+    mesh = np.meshgrid(*grids, indexing="ij")
+    nodes = np.stack([g.ravel() for g in mesh], axis=1)  # (S, G)
+    eval_node, _ = _build_grid_eval(model, toas, parnames, maxiter)
+    chi2 = jax.jit(jax.vmap(eval_node))(jnp.asarray(nodes))
+    return np.asarray(chi2).reshape(mesh[0].shape)
+
+
+def grid_chisq_derived(model, toas, parnames: Sequence[str],
+                       parfuncs: Sequence[Callable],
+                       gridvalues: Sequence[np.ndarray],
+                       maxiter: int = 2
+                       ) -> Tuple[np.ndarray, list]:
+    """Grid over derived quantities: ``parfuncs[k](*grid_coords)``
+    gives the value of ``parnames[k]`` at each node (reference:
+    gridutils.grid_chisq_derived). Returns (chi2, [param value arrays])."""
+    if not (len(parnames) == len(parfuncs) == len(gridvalues)):
+        raise ValueError("parnames, parfuncs, gridvalues must pair up")
+    grids = [np.asarray(v, dtype=np.float64) for v in gridvalues]
+    mesh = np.meshgrid(*grids, indexing="ij")
+    pvals = [np.asarray(f(*mesh), dtype=np.float64) for f in parfuncs]
+    nodes = np.stack([v.ravel() for v in pvals], axis=1)
+    eval_node, _ = _build_grid_eval(model, toas, parnames, maxiter)
+    chi2 = jax.jit(jax.vmap(eval_node))(jnp.asarray(nodes))
+    return np.asarray(chi2).reshape(mesh[0].shape), pvals

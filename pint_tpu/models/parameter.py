@@ -98,8 +98,23 @@ class Parameter:
         self.frozen = frozen
         self.aliases = list(aliases or [])
         self.uncertainty = uncertainty
+        self.prior = None  # None == improper flat (see prior_logpdf)
         self._dd = None
         self.value = value
+
+    # -- Bayesian hooks ------------------------------------------------
+    # (reference: Parameter.prior_pdf in src/pint/models/parameter.py)
+
+    def prior_logpdf(self, x=None):
+        """log prior density at x (default: the current value). A None
+        prior is the improper flat prior: logpdf 0 everywhere."""
+        v = self.value if x is None else x
+        if self.prior is None:
+            return 0.0
+        return self.prior.logpdf(v)
+
+    def prior_pdf(self, x=None):
+        return float(np.exp(self.prior_logpdf(x)))
 
     # -- value handling ------------------------------------------------
 

@@ -1,0 +1,84 @@
+"""Parameter prior distributions for Bayesian inference.
+
+Reference: src/pint/models/priors.py (Prior, UniformUnboundedRV,
+GaussianBoundedRV, prior_pdf hooks on Parameter). Here a prior is a
+small object with jnp-traceable logpdf and a ppf (for nested-sampling
+prior transforms); Parameter gains a ``prior`` attribute defaulting to
+an unbounded uniform.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Prior", "UniformPrior", "UniformUnboundedPrior",
+           "GaussianPrior"]
+
+
+class Prior:
+    """Base prior: improper flat over the whole real line (reference:
+    Prior with UniformUnboundedRV)."""
+
+    def logpdf(self, x):
+        return jnp.zeros_like(jnp.asarray(x, dtype=jnp.float64))
+
+    def pdf(self, x):
+        return jnp.exp(self.logpdf(x))
+
+    def ppf(self, q):
+        raise ValueError(
+            f"{type(self).__name__} is improper: no prior transform; "
+            "give the parameter a bounded prior for nested sampling")
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+class UniformUnboundedPrior(Prior):
+    """Explicit alias of the default improper flat prior."""
+
+
+class UniformPrior(Prior):
+    """Proper uniform on [lower, upper] (reference: UniformBoundedRV)."""
+
+    def __init__(self, lower: float, upper: float):
+        if not upper > lower:
+            raise ValueError("need upper > lower")
+        self.lower, self.upper = float(lower), float(upper)
+
+    def logpdf(self, x):
+        x = jnp.asarray(x, dtype=jnp.float64)
+        inside = (x >= self.lower) & (x <= self.upper)
+        return jnp.where(inside,
+                         -jnp.log(self.upper - self.lower), -jnp.inf)
+
+    def ppf(self, q):
+        return self.lower + (self.upper - self.lower) * jnp.asarray(q)
+
+    def __repr__(self):
+        return f"UniformPrior({self.lower}, {self.upper})"
+
+
+class GaussianPrior(Prior):
+    """Gaussian prior N(mu, sigma) (reference: GaussianBoundedRV without
+    the truncation; add bounds by composing with UniformPrior support if
+    needed)."""
+
+    def __init__(self, mu: float, sigma: float):
+        if not sigma > 0:
+            raise ValueError("need sigma > 0")
+        self.mu, self.sigma = float(mu), float(sigma)
+
+    def logpdf(self, x):
+        z = (jnp.asarray(x, dtype=jnp.float64) - self.mu) / self.sigma
+        return -0.5 * z * z - jnp.log(
+            self.sigma * jnp.sqrt(2.0 * jnp.pi))
+
+    def ppf(self, q):
+        from jax.scipy.special import ndtri
+
+        return self.mu + self.sigma * ndtri(jnp.asarray(q))
+
+    def __repr__(self):
+        return f"GaussianPrior({self.mu}, {self.sigma})"

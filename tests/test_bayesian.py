@@ -1,0 +1,234 @@
+"""Bayesian interface + chi2 grids (reference: src/pint/bayesian.py,
+src/pint/models/priors.py, src/pint/gridutils.py; oracle per SURVEY.md
+§4: posterior curvature must match the least-squares covariance on
+simulated data)."""
+
+import copy
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.bayesian import BayesianTiming
+from pint_tpu.gridutils import grid_chisq, grid_chisq_derived
+from pint_tpu.models import get_model
+from pint_tpu.models.priors import GaussianPrior, UniformPrior
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toa import merge_TOAs
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    par = """
+PSR J0005+0005
+RAJ 08:00:00.0
+DECJ 25:00:00.0
+F0 180.0 1
+F1 -2.5e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 12.0
+DMEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(par))
+        rng = np.random.default_rng(21)
+        tA = make_fake_toas_uniform(54000, 56000, 60, model,
+                                    freq_mhz=1400.0, add_noise=True,
+                                    rng=rng)
+        tB = make_fake_toas_uniform(54005, 55995, 60, model,
+                                    freq_mhz=820.0, add_noise=True,
+                                    rng=rng)
+        toas = merge_TOAs([tA, tB])
+        from pint_tpu.fitter import WLSFitter
+
+        m = copy.deepcopy(model)
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=2)
+    return m, toas, f
+
+
+# ------------------------------------------------------------- priors
+
+
+def test_prior_logpdfs():
+    u = UniformPrior(0.0, 2.0)
+    assert float(u.logpdf(1.0)) == pytest.approx(-np.log(2.0))
+    assert float(u.logpdf(3.0)) == -np.inf
+    assert float(u.ppf(0.25)) == pytest.approx(0.5)
+    g = GaussianPrior(1.0, 2.0)
+    assert float(g.logpdf(1.0)) == pytest.approx(
+        -np.log(2.0 * np.sqrt(2 * np.pi)))
+    assert float(g.ppf(0.5)) == pytest.approx(1.0, abs=1e-12)
+
+
+def test_parameter_prior_hook(fitted):
+    m, _, _ = fitted
+    p = m.get_param("F0")
+    assert p.prior_logpdf() == 0.0  # improper flat default
+    p.prior = GaussianPrior(p.value, 1e-9)
+    assert p.prior_logpdf(p.value) > 0  # sharp prior has big density
+    p.prior = None
+
+
+# --------------------------------------------------- likelihood shape
+
+
+def test_lnlikelihood_peaks_at_fit(fitted):
+    m, toas, f = fitted
+    bt = BayesianTiming(m, toas)
+    th0 = bt.theta0.copy()
+    ll0 = bt.lnlikelihood(th0)
+    i = bt.param_labels.index("F0")
+    sig = f.errors["F0"]
+    for off in (-5 * sig, 5 * sig):
+        th = th0.copy()
+        th[i] += off
+        assert bt.lnlikelihood(th) < ll0
+
+
+def test_posterior_matches_wls_covariance(fitted):
+    """The lnlike curvature along F0 equals the WLS information with
+    the other timing params fixed and the phase offset profiled out
+    (the likelihood subtracts the weighted mean, i.e. ML-fits the
+    offset): curv = A_ii - A_i0^2 / A_00 with A = cov^-1 over
+    [Offset, free...]."""
+    m, toas, f = fitted
+    bt = BayesianTiming(m, toas)
+    th0 = bt.theta0.copy()
+    i = bt.param_labels.index("F0")
+    names = ["Offset"] + list(m.free_params)
+    A = np.linalg.inv(f.parameter_covariance_matrix)
+    ii, oo = names.index("F0"), names.index("Offset")
+    info = A[ii, ii] - A[ii, oo] ** 2 / A[oo, oo]
+    h = 1.0 / np.sqrt(info)
+    # F0 perturbations quantize to ulp(F0) (~0.09 sigma); use the
+    # ACTUAL applied offsets in a non-uniform 3-point stencil
+    thm, thp = th0.copy(), th0.copy()
+    thm[i] -= h
+    thp[i] += h
+    qm, qp = thm[i] - th0[i], thp[i] - th0[i]
+    ll0 = bt.lnlikelihood(th0)
+    llm = bt.lnlikelihood(thm)
+    llp = bt.lnlikelihood(thp)
+    # non-uniform 3-point second derivative
+    curv = -2.0 * (qm * (llp - ll0) - qp * (llm - ll0)) \
+        / (qp * qm * (qp - qm))
+    assert curv == pytest.approx(info, rel=0.02)
+
+
+def test_lnprior_and_posterior(fitted):
+    m, toas, _ = fitted
+    bt = BayesianTiming(m, toas)
+    th0 = bt.theta0.copy()
+    assert bt.lnprior(th0) == 0.0
+    f0 = m.F0.value
+    m.get_param("F0").prior = UniformPrior(f0 - 1e-6, f0 + 1e-6)
+    bt2 = BayesianTiming(m, toas)
+    assert bt2.lnprior(th0) == pytest.approx(-np.log(2e-6))
+    th_bad = th0.copy()
+    th_bad[bt2.param_labels.index("F0")] += 1.0
+    assert bt2.lnposterior(th_bad) == -np.inf
+    # prior_transform round-trips the cube
+    m.get_param("F1").prior = UniformPrior(-3e-15, -2e-15)
+    bt3 = BayesianTiming(m, toas)
+    x = bt3.prior_transform(np.full(bt3.nparams, 0.5))
+    assert x[bt3.param_labels.index("F0")] == pytest.approx(f0)
+    m.get_param("F0").prior = None
+    m.get_param("F1").prior = None
+
+
+def test_batch_lnlikelihood_matches_scalar(fitted):
+    m, toas, f = fitted
+    bt = BayesianTiming(m, toas)
+    rng = np.random.default_rng(5)
+    sig = np.array([f.errors[p] for p in bt.param_labels])
+    thetas = bt.theta0[None, :] + sig[None, :] * \
+        rng.standard_normal((16, bt.nparams))
+    batch = bt.lnlikelihood_batch(thetas)
+    scalar = np.array([bt.lnlikelihood(t) for t in thetas])
+    np.testing.assert_allclose(batch, scalar, rtol=1e-10)
+
+
+def test_lnlikelihood_gls_consistent_with_chi2(fitted):
+    """With correlated noise, lnlike differences equal -chi2/2
+    differences of the marginalized GLS chi2."""
+    m0, toas0, _ = fitted
+    par = m0.as_parfile() + """
+EFAC -be X 1.1
+ECORR -be X 0.8
+TNREDAMP -13.5
+TNREDGAM 3.0
+TNREDC 5
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+    for f in toas0.flags:
+        f["be"] = "X"
+    from pint_tpu.residuals import Residuals
+
+    bt = BayesianTiming(m, toas0)
+    th0 = bt.theta0.copy()
+    i = bt.param_labels.index("F0")
+    th1 = th0.copy()
+    th1[i] += 2e-10
+    dll = bt.lnlikelihood(th1) - bt.lnlikelihood(th0)
+    chi0 = Residuals(toas0, m).chi2
+    m2 = copy.deepcopy(m)
+    # perturb by the ACTUAL f64-representable offset theta carries
+    m2.get_param("F0").add_delta(float(th1[i] - th0[i]))
+    m2.invalidate_cache(params_only=True)
+    chi1 = Residuals(toas0, m2).chi2
+    assert dll == pytest.approx(-0.5 * (chi1 - chi0), rel=1e-6)
+
+
+# --------------------------------------------------------------- grids
+
+
+def test_grid_chisq_minimum_at_fit(fitted):
+    m, toas, f = fitted
+    sig0, sig1 = f.errors["F0"], f.errors["F1"]
+    f0, f1 = m.F0.value, m.F1.value
+    g0 = f0 + np.linspace(-3, 3, 9) * sig0
+    g1 = f1 + np.linspace(-3, 3, 9) * sig1
+    chi2 = grid_chisq(m, toas, ("F0", "F1"), (g0, g1), maxiter=2)
+    assert chi2.shape == (9, 9)
+    kmin = np.unravel_index(np.argmin(chi2), chi2.shape)
+    assert kmin == (4, 4)  # grid center = fitted values
+    # chi2 rises by ~1 at the 1-sigma contour along each axis when the
+    # other params are refit: use the MARGINAL uncertainty
+    assert chi2[4, 4] < chi2[8, 4] and chi2[4, 4] < chi2[4, 8]
+    # index 8 = +3 sigma -> profile dchi2 ~= 9 (up to the f64 grid
+    # coordinates' ulp quantization of F0, ~0.07 sigma)
+    dchi_3sig = chi2[8, 4] - chi2[4, 4]
+    assert dchi_3sig == pytest.approx(9.0, rel=0.15)
+
+
+def test_grid_chisq_64x64_one_call(fitted):
+    m, toas, f = fitted
+    sig0 = f.errors["F0"]
+    g0 = m.F0.value + np.linspace(-2, 2, 64) * sig0
+    g1 = m.F1.value + np.linspace(-2, 2, 64) * f.errors["F1"]
+    chi2 = grid_chisq(m, toas, ("F0", "F1"), (g0, g1), maxiter=1)
+    assert chi2.shape == (64, 64)
+    assert np.all(np.isfinite(chi2))
+
+
+def test_grid_chisq_derived(fitted):
+    m, toas, f = fitted
+    sig0 = f.errors["F0"]
+    # grid over spin period P = 1/F0 via a derived transform
+    p0 = 1.0 / m.F0.value
+    pgrid = p0 + np.linspace(-1, 1, 5) * sig0 / m.F0.value ** 2
+    chi2, vals = grid_chisq_derived(
+        m, toas, ("F0",), (lambda P: 1.0 / P,), (pgrid,), maxiter=1)
+    assert chi2.shape == (5,)
+    assert np.argmin(chi2) == 2
+    np.testing.assert_allclose(vals[0], 1.0 / pgrid)
