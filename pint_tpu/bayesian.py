@@ -222,5 +222,10 @@ class BayesianTiming:
         out = np.full(len(thetas), -np.inf)
         ok = np.isfinite(lp)
         if np.any(ok):
-            out[ok] = lp[ok] + self.lnlikelihood_batch(thetas[ok])
+            # evaluate the FULL fixed-shape batch (masking would change
+            # the batch shape every step and force an XLA recompile per
+            # distinct in-bounds count); out-of-bounds rows are simply
+            # discarded
+            ll = self.lnlikelihood_batch(thetas)
+            out[ok] = lp[ok] + ll[ok]
         return out

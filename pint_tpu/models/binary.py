@@ -316,16 +316,18 @@ class BinaryDD(_KeplerBinary):
     def _shapiro_s(self, pv):
         return _v(pv, "SINI")
 
-    def binary_delay(self, pv, dt, M, nhat, ctx):
-        x, ecc, om = self._elements(pv, dt)
-        er = ecc * (1.0 + _v(pv, "DR"))
-        eth = ecc * (1.0 + _v(pv, "DTH"))
+    def _dd_core(self, pv, M, nhat, x, ecc, om, gamma, r_shap, s_shap,
+                 dr, dth):
+        """The full DD delay for explicit orbital elements — shared by
+        DD/DDS/DDH/DDGR/DDK, which differ only in how the elements and
+        Shapiro (r, s) are obtained."""
+        er = ecc * (1.0 + dr)
+        eth = ecc * (1.0 + dth)
         E = kepler_E(M, ecc)
         sE, cE = jnp.sin(E), jnp.cos(E)
         sw, cw = jnp.sin(om), jnp.cos(om)
         alpha = x * sw
         beta = x * jnp.sqrt(1.0 - eth * eth) * cw
-        gamma = _v(pv, "GAMMA")
         # Roemer + Einstein with inverse-timing correction
         Dre = alpha * (cE - er) + (beta + gamma) * sE
         Drep = -alpha * sE + (beta + gamma) * cE
@@ -334,11 +336,9 @@ class BinaryDD(_KeplerBinary):
         roemer = self._inverse_timing(
             Dre, Drep, Drepp, anhat, ecc * sE / (1.0 - ecc * cE))
         # Shapiro
-        r = TSUN * _v(pv, "M2")
-        s = self._shapiro_s(pv)
         sqr = jnp.sqrt(1.0 - ecc * ecc)
-        shap = -2.0 * r * jnp.log(
-            1.0 - ecc * cE - s * (sw * (cE - ecc) + sqr * cw * sE))
+        shap = -2.0 * r_shap * jnp.log(
+            1.0 - ecc * cE - s_shap * (sw * (cE - ecc) + sqr * cw * sE))
         # aberration (A0/B0, usually 0)
         a0, b0 = _v(pv, "A0"), _v(pv, "B0")
         nu = 2.0 * jnp.arctan2(
@@ -348,6 +348,12 @@ class BinaryDD(_KeplerBinary):
         aberr = a0 * (jnp.sin(omnu) + ecc * sw) + \
             b0 * (jnp.cos(omnu) + ecc * cw)
         return roemer + shap + aberr
+
+    def binary_delay(self, pv, dt, M, nhat, ctx):
+        x, ecc, om = self._elements(pv, dt)
+        return self._dd_core(pv, M, nhat, x, ecc, om, _v(pv, "GAMMA"),
+                             TSUN * _v(pv, "M2"), self._shapiro_s(pv),
+                             _v(pv, "DR"), _v(pv, "DTH"))
 
 
 class BinaryDDS(BinaryDD):
@@ -363,3 +369,250 @@ class BinaryDDS(BinaryDD):
 
     def _shapiro_s(self, pv):
         return 1.0 - jnp.exp(-_v(pv, "SHAPMAX"))
+
+
+class BinaryDDH(BinaryDD):
+    """DD with orthometric Shapiro parameters H3/STIG (reference:
+    binary_dd.BinaryDDH / DDH_model; Freire & Wex 2010): r = H3/STIG^3,
+    s = 2 STIG/(1 + STIG^2)."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.remove_param("M2")
+        self.remove_param("SINI")
+        self.add_param(floatParameter("H3", units="s",
+                                      description="3rd Shapiro harmonic"))
+        self.add_param(floatParameter("STIG", units="",
+                                      aliases=["VARSIGMA"]))
+
+    def validate(self):
+        super().validate()
+        if self.H3.value is None or self.STIG.value is None:
+            raise ValueError("DDH requires H3 and STIG")
+
+    def binary_delay(self, pv, dt, M, nhat, ctx):
+        x, ecc, om = self._elements(pv, dt)
+        h3, stig = _v(pv, "H3"), _v(pv, "STIG")
+        r = h3 / (stig * stig * stig)
+        s = 2.0 * stig / (1.0 + stig * stig)
+        return self._dd_core(pv, M, nhat, x, ecc, om, _v(pv, "GAMMA"),
+                             r, s, _v(pv, "DR"), _v(pv, "DTH"))
+
+
+class BinaryDDGR(BinaryDD):
+    """DD with general relativity supplying the post-Keplerian
+    parameters from the component masses (reference: binary_dd.BinaryDDGR
+    / DDGR_model, Damour & Deruelle 1986 paper II; Taylor & Weisberg
+    1989 for the PK expressions). MTOT and M2 replace OMDOT, GAMMA,
+    SINI, PBDOT(GR), DR, DTH, which become functions of the masses:
+
+        n      = 2 pi / Pb,  m = MTOT Tsun,  m2 = M2 Tsun,  m1 = m-m2
+        arr    = (m/n^2)^(1/3)   (relativistic semi-major axis, s)
+        omdot  = 3 n^(5/3) m^(2/3) / (1-e^2)          [rad/s]
+        gamma  = e m2 (m1 + 2 m2) n^(-1/3) m^(-4/3)   [s]
+        sini   = x m^(2/3) n^(2/3) / m2
+        pbdot  = -(192 pi/5) n^(5/3) m1 m2 m^(-1/3)
+                 (1 + 73/24 e^2 + 37/96 e^4)(1-e^2)^(-7/2)
+        dr     = (3 m1^2 + 6 m1 m2 + 2 m2^2)/(arr m)
+        dth    = (3.5 m1^2 + 6 m1 m2 + 2 m2^2)/(arr m)
+
+    XOMDOT [deg/yr] and XPBDOT add observed excesses on top of GR."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        for name in ("OMDOT", "GAMMA", "SINI", "DR", "DTH"):
+            self.remove_param(name)
+        self.add_param(floatParameter("MTOT", units="Msun",
+                                      aliases=["M"]))
+        self.add_param(floatParameter("XOMDOT", units="deg/yr",
+                                      value=0.0))
+        self.add_param(floatParameter("XPBDOT", units="s/s", value=0.0))
+
+    def validate(self):
+        super().validate()
+        if self.MTOT.value is None or self.M2.value is None:
+            raise ValueError("DDGR requires MTOT and M2")
+        if self.PB.value is None:
+            raise ValueError(
+                "DDGR requires PB (the GR post-Keplerian expressions "
+                "are not implemented for the FB series)")
+
+    def _gr_parameters(self, pv, ecc):
+        pb_s = _v(pv, "PB") * SECS_PER_DAY
+        n = TWOPI / pb_s
+        m = TSUN * _v(pv, "MTOT")
+        m2 = TSUN * _v(pv, "M2")
+        m1 = m - m2
+        x = _v(pv, "A1")
+        arr = (m / (n * n)) ** (1.0 / 3.0)
+        omdot = 3.0 * n ** (5.0 / 3.0) * m ** (2.0 / 3.0) \
+            / (1.0 - ecc * ecc)
+        gamma = ecc * m2 * (m1 + 2.0 * m2) * n ** (-1.0 / 3.0) \
+            * m ** (-4.0 / 3.0)
+        sini = x * m ** (2.0 / 3.0) * n ** (2.0 / 3.0) / m2
+        fe = (1.0 + (73.0 / 24.0) * ecc ** 2
+              + (37.0 / 96.0) * ecc ** 4) * (1.0 - ecc * ecc) ** -3.5
+        pbdot = -(192.0 * jnp.pi / 5.0) * n ** (5.0 / 3.0) * m1 * m2 \
+            * m ** (-1.0 / 3.0) * fe
+        dr = (3.0 * m1 ** 2 + 6.0 * m1 * m2 + 2.0 * m2 ** 2) / (arr * m)
+        dth = (3.5 * m1 ** 2 + 6.0 * m1 * m2 + 2.0 * m2 ** 2) / (arr * m)
+        return omdot, gamma, sini, pbdot, dr, dth
+
+    def _orbit(self, pv, dt):
+        # fold the GR + excess PBDOT into the mean-anomaly evolution
+        ecc0 = _v(pv, "ECC")
+        _, _, _, pbdot_gr, _, _ = self._gr_parameters(pv, ecc0)
+        pb_s = _v(pv, "PB") * SECS_PER_DAY
+        pbdot = _v(pv, "PBDOT") + pbdot_gr + _v(pv, "XPBDOT")
+        u = dt / pb_s
+        M = TWOPI * (u - 0.5 * pbdot * u * u)
+        nhat = (TWOPI / pb_s) * (1.0 - pbdot * u)
+        return M, nhat
+
+    def binary_delay(self, pv, dt, M, nhat, ctx):
+        ecc = _v(pv, "ECC") + _v(pv, "EDOT") * dt
+        omdot_gr, gamma, sini, _, dr, dth = self._gr_parameters(pv, ecc)
+        om = _v(pv, "OM") * DEG2RAD + omdot_gr * dt \
+            + _v(pv, "XOMDOT") * DEG2RAD * dt / SECS_PER_YEAR
+        x = _v(pv, "A1") + _v(pv, "A1DOT") * dt
+        return self._dd_core(pv, M, nhat, x, ecc, om, gamma,
+                             TSUN * _v(pv, "M2"), sini, dr, dth)
+
+
+class BinaryDDK(BinaryDD):
+    """DD with Kopeikin annual-orbital-parallax and proper-motion
+    corrections (reference: binary_ddk.BinaryDDK / DDK_model; Kopeikin
+    1995 ApJ 439 L5, Kopeikin 1996 ApJ 467 L93). KIN/KOM give the true
+    orbital orientation; the observed x = a sin(i) and omega pick up
+
+      K95 (annual-orbital parallax, needs PX and the observatory SSB
+      position r):  with the sky basis I0 (east) and J0 (north) and
+      d = 1/PX,
+        di    = (Delta_I0 sin KOM - Delta_J0 cos KOM)/d
+        domega= -(Delta_I0 cos KOM + Delta_J0 sin KOM)/(d sin KIN)
+      K96 (secular proper motion):
+        di    += (-mu_alpha sin KOM + mu_delta cos KOM) (t - T0)
+        domega+= (mu_alpha cos KOM + mu_delta sin KOM)/sin KIN (t - T0)
+
+    x scales exactly as sin(KIN + di)/sin(KIN); Shapiro s = sin(KIN +
+    di). Sign conventions follow the published equations; they cannot
+    be re-verified against the reference in this offline environment
+    (SURVEY.md §0) and are pinned by the tests' symmetry/limit checks.
+    Requires AstrometryEquatorial (RAJ/DECJ basis) and PX."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.remove_param("SINI")
+        self.add_param(floatParameter("KIN", units="deg",
+                                      description="orbital inclination"))
+        self.add_param(floatParameter("KOM", units="deg",
+                                      description="pos. angle of asc. node"))
+        from pint_tpu.models.parameter import boolParameter
+
+        self.add_param(boolParameter("K96", value=True,
+                                     description="include proper-motion "
+                                     "corrections"))
+
+    def validate(self):
+        super().validate()
+        if self.KIN.value is None or self.KOM.value is None:
+            raise ValueError("DDK requires KIN and KOM")
+        # the Kopeikin sky basis is built from RAJ/DECJ(+PMRA/PMDEC/PX),
+        # which default to 0 in pv — silently wrong with ecliptic
+        # astrometry, so refuse instead
+        parent = getattr(self, "_parent", None)
+        if parent is not None:
+            if "AstrometryEquatorial" not in parent.components:
+                raise ValueError(
+                    "DDK requires equatorial astrometry (RAJ/DECJ): "
+                    "the Kopeikin terms are computed in that basis")
+            px = parent.components["AstrometryEquatorial"].params.get(
+                "PX")
+            if px is None or px.value is None:
+                raise ValueError(
+                    "DDK requires PX (K95 terms scale as 1/distance)")
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        ctx["ssb_obs_pos"] = batch.ssb_obs_pos  # lt-s, for K95 terms
+        return super().delay(pv, batch, cache, ctx, delay_so_far)
+
+    def binary_delay(self, pv, dt, M, nhat, ctx):
+        from pint_tpu.models.astrometry import MAS_TO_RAD, PC_LS
+
+        x0, ecc, om = self._elements(pv, dt)
+        kin = _v(pv, "KIN") * DEG2RAD
+        kom = _v(pv, "KOM") * DEG2RAD
+        skom, ckom = jnp.sin(kom), jnp.cos(kom)
+        # sky basis at the (epoch) pulsar position
+        a0 = _v(pv, "RAJ")
+        d0 = _v(pv, "DECJ")
+        sa, ca = jnp.sin(a0), jnp.cos(a0)
+        sd, cd = jnp.sin(d0), jnp.cos(d0)
+        I0 = jnp.stack([-sa, ca, jnp.zeros_like(ca)])
+        J0 = jnp.stack([-sd * ca, -sd * sa, cd])
+        rvec = ctx.get("ssb_obs_pos")
+        di = jnp.zeros_like(dt)
+        domega = jnp.zeros_like(dt)
+        px = _v(pv, "PX")
+        if rvec is not None:
+            d_ls = PC_LS * 1.0e3 / (px + 1e-30)  # PX [mas] -> d [lt-s]
+            dI = rvec @ I0
+            dJ = rvec @ J0
+            di = di + (dI * skom - dJ * ckom) / d_ls
+            domega = domega - (dI * ckom + dJ * skom) / (
+                d_ls * jnp.sin(kin))
+        if self.K96.value:
+            mu_a = _v(pv, "PMRA") * MAS_TO_RAD / SECS_PER_YEAR
+            mu_d = _v(pv, "PMDEC") * MAS_TO_RAD / SECS_PER_YEAR
+            di = di + (-mu_a * skom + mu_d * ckom) * dt
+            domega = domega + (mu_a * ckom + mu_d * skom) \
+                / jnp.sin(kin) * dt
+        kin_eff = kin + di
+        x = x0 * jnp.sin(kin_eff) / jnp.sin(kin)
+        om = om + domega
+        sini = jnp.sin(kin_eff)
+        return self._dd_core(pv, M, nhat, x, ecc, om, _v(pv, "GAMMA"),
+                             TSUN * _v(pv, "M2"), sini,
+                             _v(pv, "DR"), _v(pv, "DTH"))
+
+
+class BinaryELL1k(BinaryELL1):
+    """ELL1 variant for fast periastron advance (reference:
+    binary_ell1.BinaryELL1k / ELL1k_model; Susobhanan et al. 2018):
+    OMDOT rotates (EPS1, EPS2) exactly and LNEDOT scales the
+    eccentricity, replacing the linear EPS1DOT/EPS2DOT drifts."""
+
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.remove_param("EPS1DOT")
+        self.remove_param("EPS2DOT")
+        self.add_param(floatParameter("OMDOT", units="deg/yr",
+                                      value=0.0))
+        self.add_param(floatParameter("LNEDOT", units="1/s", value=0.0))
+
+    def _roemer(self, pv, dt, Phi, nhat):
+        x = _v(pv, "A1") + _v(pv, "A1DOT") * dt
+        eps1_0 = _v(pv, "EPS1")
+        eps2_0 = _v(pv, "EPS2")
+        omdot = _v(pv, "OMDOT") * DEG2RAD / SECS_PER_YEAR
+        lnedot = _v(pv, "LNEDOT")
+        dom = omdot * dt
+        scale = 1.0 + lnedot * dt
+        cdo, sdo = jnp.cos(dom), jnp.sin(dom)
+        # rotate (eps2, eps1) = e(cos w, sin w) by dom, scale by e(t)/e0
+        eps1 = scale * (eps1_0 * cdo + eps2_0 * sdo)
+        eps2 = scale * (eps2_0 * cdo - eps1_0 * sdo)
+        sP, cP = jnp.sin(Phi), jnp.cos(Phi)
+        s2P, c2P = jnp.sin(2 * Phi), jnp.cos(2 * Phi)
+        Dre = x * (sP + 0.5 * (eps2 * s2P - eps1 * c2P) - 1.5 * eps1)
+        Drep = x * (cP + eps2 * c2P + eps1 * s2P)
+        Drepp = x * (-sP - 2.0 * eps2 * s2P + 2.0 * eps1 * c2P)
+        return self._inverse_timing(Dre, Drep, Drepp, nhat, 0.0)

@@ -133,8 +133,12 @@ class ModelBuilder:
         for ln in lines:
             if ln.key == "BINARY" and ln.tokens:
                 binary_name = ln.tokens[0]
-                cls_name = BINARY_COMPONENT_PREFIX + binary_name.upper()
-                if cls_name not in component_types:
+                # case-insensitive: the conventional par name for e.g.
+                # BinaryELL1k is "ELL1k"
+                by_upper = {c.upper(): c for c in component_types}
+                cls_name = by_upper.get(
+                    (BINARY_COMPONENT_PREFIX + binary_name).upper())
+                if cls_name is None:
                     raise NotImplementedError(
                         f"binary model {binary_name!r} is not implemented "
                         f"(known: {sorted(c for c in component_types if c.startswith('Binary'))})")

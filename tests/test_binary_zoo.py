@@ -1,0 +1,368 @@
+"""Binary zoo tail: DDH, DDGR, DDK, ELL1k + convert_binary
+(reference: src/pint/models/binary_dd.py, binary_ddk.py,
+binary_ell1.py, binaryconvert.py; test strategy per SURVEY.md §4.2:
+analytic/limit cross-checks + jacfwd-vs-finite-difference)."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.binaryconvert import convert_binary
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+TSUN = 4.925490947e-6
+
+
+def _model(binary: str, extra: str = "", f0="310.0") -> str:
+    return f"""
+PSR J1012+5307
+RAJ 10:12:33.43
+DECJ 53:07:02.5
+PMRA 2.6
+PMDEC -25.5
+PX 1.2
+F0 {f0} 1
+F1 -5e-16
+PEPOCH 55000
+POSEPOCH 55000
+DM 9.0
+DMEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+BINARY {binary}
+{extra}
+"""
+
+
+def _mk(binary, extra):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(io.StringIO(_model(binary, extra)))
+
+
+def _resids(model, toas):
+    return np.asarray(Residuals(toas, model,
+                                subtract_mean=True).time_resids)
+
+
+def _toas(model, n=80, seed=0):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rng = np.random.default_rng(seed)
+        return make_fake_toas_uniform(54100, 55900, n, model,
+                                      error_us=1.0, rng=rng)
+
+
+DD_KEPLER = """PB 0.6
+A1 1.45 1
+T0 55000.2
+ECC 0.02 1
+OM 47.0 1
+GAMMA 1e-4
+M2 0.3
+"""
+
+
+def test_ddh_matches_dd():
+    """DDH with (H3, STIG) mapped from (M2, SINI) gives the same delay
+    as DD (Freire & Wex 2010 exact orthometric mapping)."""
+    sini = 0.95
+    m2 = 0.3
+    cosi = np.sqrt(1 - sini ** 2)
+    stig = sini / (1 + cosi)
+    h3 = TSUN * m2 * stig ** 3
+    mdd = _mk("DD", DD_KEPLER + f"SINI {sini}\n")
+    mddh = _mk("DDH", DD_KEPLER.replace("M2 0.3\n", "")
+               + f"H3 {h3:.12e}\nSTIG {stig:.12f}\n")
+    toas = _toas(mdd)
+    r1, r2 = _resids(mdd, toas), _resids(mddh, toas)
+    np.testing.assert_allclose(r1, r2, atol=2e-12)
+
+
+def test_ddgr_matches_dd_with_computed_pk():
+    """DDGR's internally computed post-Keplerian parameters match a DD
+    model given the same values explicitly."""
+    mtot, m2, pb_d, ecc, a1 = 2.8, 1.3, 0.4, 0.17, 2.34
+    n = 2 * np.pi / (pb_d * 86400.0)
+    m = TSUN * mtot
+    m2s = TSUN * m2
+    m1 = m - m2s
+    arr = (m / n ** 2) ** (1 / 3)
+    omdot = 3 * n ** (5 / 3) * m ** (2 / 3) / (1 - ecc ** 2)  # rad/s
+    gamma = ecc * m2s * (m1 + 2 * m2s) * n ** (-1 / 3) * m ** (-4 / 3)
+    sini = a1 * m ** (2 / 3) * n ** (2 / 3) / m2s
+    fe = (1 + 73 / 24 * ecc ** 2 + 37 / 96 * ecc ** 4) \
+        * (1 - ecc ** 2) ** -3.5
+    pbdot = -(192 * np.pi / 5) * n ** (5 / 3) * m1 * m2s \
+        * m ** (-1 / 3) * fe
+    dr = (3 * m1 ** 2 + 6 * m1 * m2s + 2 * m2s ** 2) / (arr * m)
+    dth = (3.5 * m1 ** 2 + 6 * m1 * m2s + 2 * m2s ** 2) / (arr * m)
+    omdot_degyr = np.degrees(omdot) * 86400.0 * 365.25
+
+    kepler = (f"PB {pb_d}\nA1 {a1}\nT0 55000.1\nECC {ecc}\nOM 30.0\n")
+    mgr = _mk("DDGR", kepler + f"MTOT {mtot}\nM2 {m2}\n")
+    mdd = _mk("DD", kepler
+              + f"M2 {m2}\nSINI {sini:.15f}\nGAMMA {gamma:.15e}\n"
+              + f"OMDOT {omdot_degyr:.12f}\nPBDOT {pbdot:.9e}\n"
+              + f"DR {dr:.15e}\nDTH {dth:.15e}\n")
+    toas = _toas(mgr)
+    np.testing.assert_allclose(_resids(mgr, toas), _resids(mdd, toas),
+                               atol=5e-11)
+
+
+def test_ddgr_simulate_fit_recovers_mtot():
+    kepler = "PB 0.4\nA1 2.34 1\nT0 55000.1 1\nECC 0.17 1\nOM 30.0 1\n"
+    truth = _mk("DDGR", kepler + "MTOT 2.8 1\nM2 1.3\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rng = np.random.default_rng(4)
+        toas = make_fake_toas_uniform(54100, 55900, 150, truth,
+                                      error_us=1.0, add_noise=True,
+                                      rng=rng)
+    import copy
+
+    from pint_tpu.fitter import DownhillWLSFitter
+
+    m = copy.deepcopy(truth)
+    m.get_param("MTOT").add_delta(1e-4)
+    m.invalidate_cache(params_only=True)
+    f = DownhillWLSFitter(toas, m)
+    f.fit_toas()
+    assert abs(m.MTOT.value - 2.8) < 5 * f.errors["MTOT"]
+    assert f.errors["MTOT"] < 1e-4
+
+
+def test_ell1k_reduces_to_ell1():
+    base = ("PB 0.2\nA1 0.9 1\nTASC 55000.05\nEPS1 1.1e-5\n"
+            "EPS2 -0.4e-5\nM2 0.2\nSINI 0.9\n")
+    m1 = _mk("ELL1", base)
+    m2 = _mk("ELL1k", base + "OMDOT 0.0\nLNEDOT 0.0\n")
+    toas = _toas(m1)
+    np.testing.assert_allclose(_resids(m1, toas), _resids(m2, toas),
+                               atol=1e-13)
+
+
+def test_ell1k_omdot_matches_eps_dots_short_term():
+    """For small OMDOT over a short span, the exact ELL1k rotation
+    linearizes to ELL1's EPS1DOT/EPS2DOT drifts."""
+    eps1, eps2 = 1.1e-5, -0.4e-5
+    omdot_degyr = 1.5
+    omdot = np.radians(omdot_degyr) / (365.25 * 86400.0)  # rad/s
+    # d(eps1)/dt = eps2*omdot, d(eps2)/dt = -eps1*omdot
+    base = "PB 0.2\nA1 0.9\nTASC 55000.05\n"
+    mk_ = _mk("ELL1k", base + f"EPS1 {eps1}\nEPS2 {eps2}\n"
+              f"OMDOT {omdot_degyr}\nLNEDOT 0.0\n")
+    m_l = _mk("ELL1", base + f"EPS1 {eps1}\nEPS2 {eps2}\n"
+              f"EPS1DOT {eps2 * omdot:.6e}\nEPS2DOT {-eps1 * omdot:.6e}\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        toas = make_fake_toas_uniform(54950, 55050, 60, mk_,
+                                      error_us=1.0,
+                                      rng=np.random.default_rng(1))
+    # agreement to the 2nd-order rotation term x*e*(omdot*dt)^2/2
+    np.testing.assert_allclose(_resids(mk_, toas), _resids(m_l, toas),
+                               atol=1e-9)
+
+
+DDK_KEPLER = """PB 0.6
+A1 1.45 1
+T0 55000.2
+ECC 0.02
+OM 47.0
+M2 0.3
+"""
+
+
+def _zero_astrometry(par: str, px: str = "1e-9") -> str:
+    return par.replace("PMRA 2.6", "PMRA 0.0").replace(
+        "PMDEC -25.5", "PMDEC 0.0").replace("PX 1.2", f"PX {px}")
+
+
+def test_ddk_limits_to_dd():
+    """PX -> 0 (infinite distance) and PM = 0 kill the Kopeikin terms:
+    DDK == DD with SINI = sin(KIN). (Astrometry zeroed identically on
+    both sides so only the binary differs.)"""
+    kin = 71.0
+    sini = np.sin(np.radians(kin))
+    par_ddk = _zero_astrometry(_model(
+        "DDK", DDK_KEPLER + f"KIN {kin}\nKOM 90.0\nK96 0\n"))
+    par_dd = _zero_astrometry(_model(
+        "DD", DDK_KEPLER + f"SINI {sini:.15f}\n"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mddk = get_model(io.StringIO(par_ddk))
+        mdd = get_model(io.StringIO(par_dd))
+    toas = _toas(mdd)
+    np.testing.assert_allclose(_resids(mddk, toas), _resids(mdd, toas),
+                               atol=1e-11)
+
+
+def test_ddk_annual_orbital_parallax_signature():
+    """With PX on, the DDK-DD residual difference is nonzero and scales
+    linearly with PX (the K95 annual-orbital parallax terms)."""
+    kin = 71.0
+    sini = np.sin(np.radians(kin))
+    toas = None
+    diffs = []
+    for px in (1.0, 2.0):
+        par_ddk = _zero_astrometry(_model(
+            "DDK", DDK_KEPLER + f"KIN {kin}\nKOM 35.0\nK96 0\n"),
+            px=str(px))
+        par_dd = _zero_astrometry(_model(
+            "DD", DDK_KEPLER + f"SINI {sini:.15f}\n"), px=str(px))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mddk = get_model(io.StringIO(par_ddk))
+            mdd = get_model(io.StringIO(par_dd))
+        if toas is None:
+            toas = _toas(mdd)
+        d = _resids(mddk, toas) - _resids(mdd, toas)
+        d -= d.mean()
+        diffs.append(np.sqrt(np.mean(d ** 2)))
+    assert diffs[0] > 1e-10  # AOP signature present (sub-us but real)
+    # corrections scale as 1/d = PX
+    assert diffs[1] / diffs[0] == pytest.approx(2.0, rel=0.05)
+
+
+def test_ddk_proper_motion_term_grows_with_time():
+    kin = 71.0
+    par = _model("DDK", DDK_KEPLER + f"KIN {kin}\nKOM 35.0\nK96 1\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        mk96 = get_model(io.StringIO(par))
+        mk95 = get_model(io.StringIO(par.replace("K96 1", "K96 0")))
+    toas = _toas(mk95)
+    d = np.abs(_resids(mk96, toas) - _resids(mk95, toas))
+    # secular: grows away from T0
+    assert d[-1] > d[len(d) // 2]
+    assert d.max() > 1e-9
+
+
+def test_ddk_designmatrix_vs_finite_difference():
+    par = _model("DDK", DDK_KEPLER.replace("A1 1.45 1", "A1 1.45")
+                 + "KIN 71.0 1\nKOM 35.0 1\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+    toas = _toas(m)
+    M, names, _ = m.designmatrix(toas, incoffset=False)
+    M = np.asarray(M)
+    import copy
+
+    # steps sized so the FD rides above the dd phase-collapse quantum
+    # (~7e-15 s in residual) but below nonlinearity
+    for pname, step in (("KIN", 1e-2), ("KOM", 1e-2)):
+        j = names.index(pname)
+        mp = copy.deepcopy(m)
+        mp.get_param(pname).add_delta(step)
+        mp.invalidate_cache(params_only=True)
+        mm = copy.deepcopy(m)
+        mm.get_param(pname).add_delta(-step)
+        mm.invalidate_cache(params_only=True)
+        rp = np.asarray(Residuals(toas, mp,
+                                  subtract_mean=False).time_resids)
+        rm = np.asarray(Residuals(toas, mm,
+                                  subtract_mean=False).time_resids)
+        fd = (rp - rm) / (2 * step)
+        scale = np.max(np.abs(fd)) + 1e-30
+        np.testing.assert_allclose(M[:, j] / scale, fd / scale,
+                                   atol=5e-3, err_msg=pname)
+
+
+# ------------------------------------------------------ convert_binary
+
+
+def test_convert_ell1_dd_roundtrip():
+    base = ("PB 0.2\nA1 0.9 1\nTASC 55000.05\nEPS1 1.1e-5 1\n"
+            "EPS2 -0.4e-5 1\nM2 0.2\nSINI 0.9\n")
+    m = _mk("ELL1", base)
+    m.get_param("EPS1").uncertainty = 1e-8
+    m.get_param("EPS2").uncertainty = 1e-8
+    mdd = convert_binary(m, "DD")
+    assert "BinaryDD" in mdd.components
+    ecc = np.hypot(1.1e-5, -0.4e-5)
+    assert mdd.get_param("ECC").value == pytest.approx(ecc, rel=1e-12)
+    assert mdd.get_param("ECC").uncertainty is not None
+    back = convert_binary(mdd, "ELL1")
+    assert back.get_param("EPS1").value == pytest.approx(1.1e-5,
+                                                         rel=1e-10)
+    assert back.get_param("EPS2").value == pytest.approx(-0.4e-5,
+                                                         rel=1e-10)
+    assert back.get_param("TASC").value == pytest.approx(55000.05,
+                                                         abs=1e-9)
+
+
+def test_convert_ell1_dd_residuals_agree():
+    """ELL1 and its DD conversion agree at small e (SURVEY.md A.8e:
+    ~ns at e <= 1e-4; ELL1 is an O(e^2) expansion so the bound scales
+    as x e^2)."""
+    base = ("PB 0.2\nA1 0.9\nTASC 55000.05\nEPS1 0.7e-5\n"
+            "EPS2 -0.7e-5\nM2 0.2\nSINI 0.9\n")
+    m = _mk("ELL1", base)
+    mdd = convert_binary(m, "DD")
+    toas = _toas(m)
+    r1, r2 = _resids(m, toas), _resids(mdd, toas)
+    assert np.max(np.abs(r1 - r2)) < 2e-9
+
+
+def test_convert_ell1h_m2sini():
+    base = ("PB 0.2\nA1 0.9\nTASC 55000.05\nEPS1 1.1e-5\n"
+            "EPS2 -0.4e-5\n")
+    m = _mk("ELL1", base + "M2 0.2 1\nSINI 0.9\n")
+    mh = convert_binary(m, "ELL1H")
+    sini = 0.9
+    stig = sini / (1 + np.sqrt(1 - sini ** 2))
+    assert mh.get_param("STIG").value == pytest.approx(stig, rel=1e-12)
+    assert mh.get_param("H3").value == pytest.approx(
+        TSUN * 0.2 * stig ** 3, rel=1e-12)
+    # delays identical (exact mapping)
+    toas = _toas(m)
+    np.testing.assert_allclose(_resids(m, toas), _resids(mh, toas),
+                               atol=1e-12)
+    back = convert_binary(mh, "ELL1")
+    assert back.get_param("M2").value == pytest.approx(0.2, rel=1e-12)
+    assert back.get_param("SINI").value == pytest.approx(0.9, rel=1e-12)
+
+
+def test_convert_dd_dds():
+    m = _mk("DD", DD_KEPLER + "SINI 0.95\n")
+    mdds = convert_binary(m, "DDS")
+    assert mdds.get_param("SHAPMAX").value == pytest.approx(
+        -np.log(1 - 0.95), rel=1e-12)
+    toas = _toas(m)
+    np.testing.assert_allclose(_resids(m, toas), _resids(mdds, toas),
+                               atol=1e-13)
+    back = convert_binary(mdds, "DD")
+    assert back.get_param("SINI").value == pytest.approx(0.95,
+                                                        rel=1e-12)
+
+
+def test_convert_unknown_raises():
+    m = _mk("ELL1", "PB 0.2\nA1 0.9\nTASC 55000.05\nEPS1 1e-5\n"
+            "EPS2 1e-5\n")
+    with pytest.raises(ValueError):
+        convert_binary(m, "NOPE")
+
+
+def test_binary_parfile_roundtrip_new_models():
+    for binary, extra in (
+            ("DDH", DD_KEPLER.replace("M2 0.3\n", "")
+             + "H3 1e-7\nSTIG 0.7\n"),
+            ("DDGR", "PB 0.4\nA1 2.34\nT0 55000.1\nECC 0.17\nOM 30.0\n"
+             "MTOT 2.8\nM2 1.3\n"),
+            ("DDK", DDK_KEPLER + "KIN 71.0\nKOM 35.0\n"),
+            ("ELL1k", "PB 0.2\nA1 0.9\nTASC 55000.05\nEPS1 1e-5\n"
+             "EPS2 1e-5\nOMDOT 1.5\nLNEDOT 0.0\n")):
+        m = _mk(binary, extra)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m2 = get_model(io.StringIO(m.as_parfile()))
+        toas = _toas(m, n=20)
+        np.testing.assert_allclose(_resids(m, toas), _resids(m2, toas),
+                                   atol=1e-12, err_msg=binary)
