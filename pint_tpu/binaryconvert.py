@@ -77,6 +77,11 @@ def convert_binary(model: TimingModel, target: str) -> TimingModel:
     src_name, src = _binary_component(model)
     if src_name == cls_name:
         return copy.deepcopy(model)
+    if src_name == "BinaryDDGR":
+        raise ValueError(
+            "cannot convert from DDGR: its post-Keplerian parameters "
+            "are mass-derived, not explicit — evaluate them and build "
+            "a DD model directly if needed")
 
     new = copy.deepcopy(model)
     new.remove_component(src_name)

@@ -1,0 +1,612 @@
+"""Component-zoo tail: troposphere, chromatic variation (CM/CMX/
+CMWaveX), tabulated phase (IFUNC), piecewise spindown, piecewise solar
+wind (SWX), and per-system frequency-dependent jumps (FDJump).
+
+Reference: src/pint/models/troposphere_delay.py (TroposphereDelay),
+chromatic_model.py (ChromaticCM, ChromaticCMX), wavex.py (CMWaveX),
+ifunc.py (IFunc), piecewise.py (PiecewiseSpindown),
+solar_wind_dispersion.py (SolarWindDispersionX), fdjump.py (FDJump).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.components_extra import (
+    AU_M,
+    C_M_S,
+    PC_M,
+    SECS_PER_DAY,
+    _val,
+)
+from pint_tpu.models.dispersion import DMconst
+from pint_tpu.models.parameter import (
+    MJDParameter,
+    floatParameter,
+    intParameter,
+    maskParameter,
+    pairParameter,
+    prefixParameter,
+    split_prefixed_name,
+)
+from pint_tpu.models.timing_model import DelayComponent, PhaseComponent
+from pint_tpu.ops.dd import DD
+
+
+def chromatic_index(parent, default: float = 4.0) -> float:
+    """The model's chromatic spectral index alpha (TNCHROMIDX on the
+    ChromaticCM component), shared by CMX/CMWaveX/PLChromNoise."""
+    if parent is not None and "ChromaticCM" in parent.components:
+        v = parent.components["ChromaticCM"].TNCHROMIDX.value
+        if v is not None:
+            return float(v)
+    return default
+
+
+def solar_wind_geometry_host(toas, psr_dir) -> np.ndarray:
+    """Host-side solar-wind line-of-sight DM geometry factor [pc/cm^3
+    per cm^-3 of NE_SW]: (AU^2/pc)(pi - rho)/(r sin rho) with rho the
+    observer-frame Sun-pulsar elongation (shared by SWX and PLSWNoise;
+    device twin: SolarWindDispersion.dm_value_device)."""
+    s = np.asarray(toas.obs_sun_pos)
+    r_lts = np.linalg.norm(s, axis=-1)
+    cosr = np.sum(s * psr_dir, axis=-1) / r_lts
+    rho = np.arccos(np.clip(cosr, -1.0, 1.0))
+    r_m = r_lts * C_M_S
+    return (AU_M * AU_M / PC_M) * (np.pi - rho) / (
+        r_m * np.maximum(np.sin(rho), 1e-9))
+
+
+# --------------------------------------------------------- troposphere
+
+
+class TroposphereDelay(DelayComponent):
+    """Tropospheric propagation delay: zenith hydrostatic delay from a
+    standard atmosphere at the site, mapped to the line-of-sight
+    elevation with the Niell (1996) mapping functions (reference:
+    troposphere_delay.TroposphereDelay, which uses the same NMF + a
+    Davis et al. 1985 zenith delay).
+
+    Host precompute (prepare): per-TOA geocentric zenith unit vector in
+    GCRS (geocentric rather than geodetic zenith: the <=0.2 deg
+    difference changes the mapping negligibly), site latitude/height,
+    zenith delays, and day-of-year for the seasonal NMF term. Device:
+    elevation = asin(zenith . psr_dir) and the mapping-function
+    evaluation, so the delay responds to astrometry under jacfwd.
+
+    CORRECT_TROPOSPHERE (bool) gates the component like the reference.
+    """
+
+    category = "troposphere"
+    register = True
+
+    # Niell 1996 hydrostatic mapping coefficients at |lat| = 15..75 deg
+    _LAT_GRID = np.array([15.0, 30.0, 45.0, 60.0, 75.0])
+    _H_AVG = np.array([
+        [1.2769934e-3, 1.2683230e-3, 1.2465397e-3, 1.2196049e-3,
+         1.2045996e-3],
+        [2.9153695e-3, 2.9152299e-3, 2.9288445e-3, 2.9022565e-3,
+         2.9024912e-3],
+        [62.610505e-3, 62.837393e-3, 63.721774e-3, 63.824265e-3,
+         64.258455e-3]])
+    _H_AMP = np.array([
+        [0.0, 1.2709626e-5, 2.6523662e-5, 3.4000452e-5, 4.1202191e-5],
+        [0.0, 2.1414979e-5, 3.0160779e-5, 7.2562722e-5, 11.723375e-5],
+        [0.0, 9.0128400e-5, 4.3497037e-5, 84.795348e-5, 170.37206e-5]])
+    _H_HT = (2.53e-5, 5.49e-3, 1.14e-3)
+    _W = np.array([
+        [5.8021897e-4, 5.6794847e-4, 5.8118019e-4, 5.9727542e-4,
+         6.1641693e-4],
+        [1.4275268e-3, 1.5138625e-3, 1.4572752e-3, 1.5007428e-3,
+         1.7599082e-3],
+        [4.3472961e-2, 4.6729510e-2, 4.3908931e-2, 4.4626982e-2,
+         5.4736038e-2]])
+
+    def __init__(self):
+        super().__init__()
+        from pint_tpu.models.parameter import boolParameter
+
+        self.add_param(boolParameter("CORRECT_TROPOSPHERE", value=True))
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        from pint_tpu.observatory import get_observatory
+
+        n = toas.ntoas
+        zen = np.zeros((n, 3))
+        mask = np.zeros(n)
+        lat = np.zeros(n)
+        zhd = np.zeros(n)  # zenith hydrostatic delay [s]
+        h_km = np.zeros(n)
+        utc = toas.get_mjds()
+        tdb = toas.tdb_day + toas.tdb_frac[0] + toas.tdb_frac[1]
+        for site in set(toas.obs):
+            m = np.array([o == site for o in toas.obs])
+            obs = get_observatory(site)
+            xyz = getattr(obs, "itrf_xyz_m", None)
+            if xyz is None:
+                continue  # barycenter/geocenter: no troposphere
+            p, _ = obs.gcrs_posvel(utc[m], tdb[m])
+            r = np.linalg.norm(p, axis=-1, keepdims=True)
+            zen[m] = p / r
+            mask[m] = 1.0
+            rho = np.hypot(xyz[0], xyz[1])
+            glat = np.arctan2(xyz[2], rho)  # geocentric ~ geodetic here
+            h_m = np.linalg.norm(xyz) - 6371000.0
+            lat[m] = glat
+            h_km[m] = max(h_m, 0.0) / 1000.0
+            # standard atmosphere: P [hPa] at height, Davis et al. ZHD
+            p_hpa = 1013.25 * (1.0 - 2.2557e-5 * h_m) ** 5.2568
+            zhd_m = 0.0022768 * p_hpa / (
+                1.0 - 0.00266 * np.cos(2.0 * glat)
+                - 0.00028 * h_m / 1000.0)
+            zhd[m] = zhd_m / C_M_S
+        cache["tropo_zen"] = zen
+        cache["tropo_mask"] = mask
+        cache["tropo_lat"] = lat
+        cache["tropo_zhd"] = zhd
+        cache["tropo_h_km"] = h_km
+        # day of year from MJD (MJD 51544 = 2000-01-01)
+        doy = np.mod(utc - 51544.0, 365.25)
+        cache["tropo_doy"] = doy
+
+    @staticmethod
+    def _nmf(sin_el, a, b, c):
+        top = 1.0 + a / (1.0 + b / (1.0 + c))
+        bot = sin_el + a / (sin_el + b / (sin_el + c))
+        return top / bot
+
+    def _interp_coeff(self, table, abslat_deg):
+        """Piecewise-linear lat interpolation of an NMF coefficient
+        row (host grid, device latitude)."""
+        return jnp.interp(abslat_deg, jnp.asarray(self._LAT_GRID),
+                          jnp.asarray(table))
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.CORRECT_TROPOSPHERE.value:
+            return jnp.zeros_like(batch.freq_mhz)
+        zen = cache["tropo_zen"]
+        mask = cache["tropo_mask"]
+        ndir = ctx["psr_dir"]
+        sin_el = jnp.clip(jnp.sum(zen * ndir, axis=-1), 0.05, 1.0)
+        lat = cache["tropo_lat"]
+        abslat = jnp.abs(lat) * 180.0 / jnp.pi
+        doy = cache["tropo_doy"]
+        # southern-hemisphere seasonal phase shifts by half a year
+        phase = 2.0 * jnp.pi * (doy - 28.0) / 365.25
+        phase = jnp.where(lat < 0, phase + jnp.pi, phase)
+        cosph = jnp.cos(phase)
+        a = self._interp_coeff(self._H_AVG[0], abslat) \
+            - self._interp_coeff(self._H_AMP[0], abslat) * cosph
+        b = self._interp_coeff(self._H_AVG[1], abslat) \
+            - self._interp_coeff(self._H_AMP[1], abslat) * cosph
+        c = self._interp_coeff(self._H_AVG[2], abslat) \
+            - self._interp_coeff(self._H_AMP[2], abslat) * cosph
+        m_h = self._nmf(sin_el, a, b, c)
+        aht, bht, cht = self._H_HT
+        dm_ht = (1.0 / sin_el - self._nmf(sin_el, aht, bht, cht)) \
+            * cache["tropo_h_km"]
+        return mask * cache["tropo_zhd"] * (m_h + dm_ht)
+
+
+# ----------------------------------------------------------- chromatic
+
+
+class ChromaticCM(DelayComponent):
+    """Generalized chromatic delay (reference: chromatic_model.
+    ChromaticCM): delay = DMconst * CM(t) / nu^TNCHROMIDX with nu in
+    MHz and CM a Taylor series (CM, CM1, ...) about CMEPOCH."""
+
+    category = "chromatic"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter("CM", units="pc cm^-3 MHz^(a-2)",
+                                      value=0.0))
+        self.add_param(prefixParameter(prefix="CM", index=1,
+                                       index_str="1",
+                                       units="pc cm^-3 MHz^(a-2)/s"))
+        self.add_param(MJDParameter("CMEPOCH"))
+        self.add_param(floatParameter("TNCHROMIDX", units="", value=4.0,
+                                      aliases=["CMIDX"]))
+        self.cm_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("CM") and name[2:].isdigit() and \
+                    self.params[name].value is not None:
+                ids.append(int(name[2:]))
+        self.cm_ids = sorted(ids)
+
+    def _epoch(self):
+        if self.CMEPOCH.value is not None:
+            return self.CMEPOCH.value
+        return self._parent.PEPOCH.value
+
+    def cm_value_device(self, pv, batch, cache, ctx):
+        ref = self._parent.ref_day
+        tb = ctx.get("tb_days")
+        if tb is None:
+            tb = (batch.tdb_day - ref) + batch.tdb_frac.hi \
+                + batch.tdb_frac.lo
+            ctx["tb_days"] = tb
+        dt = (tb - (self._epoch() - ref)) * SECS_PER_DAY
+        cm = _val(pv, "CM") * jnp.ones_like(dt)
+        import math
+
+        for i in self.cm_ids:  # true i! even when the series has gaps
+            cm = cm + _val(pv, f"CM{i}") * dt ** i / math.factorial(i)
+        return cm
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        alpha = _val(pv, "TNCHROMIDX", 4.0)
+        cm = self.cm_value_device(pv, batch, cache, ctx)
+        out = DMconst * cm * bf ** -alpha * (1000.0 ** (alpha - 2.0))
+        # convention: CM is referenced to 1 GHz for alpha != 2 (the
+        # 1000^(alpha-2) factor makes alpha=2 coincide with DM in the
+        # usual MHz convention)
+        return jnp.where(jnp.isfinite(bf), out, 0.0)
+
+
+class ChromaticCMX(DelayComponent):
+    """Piecewise-constant chromatic variation over MJD windows:
+    CMX_0001/CMXR1_0001/CMXR2_0001 (reference: chromatic_model.
+    ChromaticCMX)."""
+
+    category = "chromatic_cmx"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(prefixParameter(prefix="CMX_", index=1,
+                                       index_str="0001",
+                                       units="pc cm^-3 MHz^(a-2)"))
+        self.add_param(prefixParameter(prefix="CMXR1_", index=1,
+                                       index_str="0001", units="MJD"))
+        self.add_param(prefixParameter(prefix="CMXR2_", index=1,
+                                       index_str="0001", units="MJD"))
+        self.cmx_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("CMX_"):
+                _, istr, idx = split_prefixed_name(name)
+                if self.params[name].value is not None:
+                    ids.append((idx, istr))
+        self.cmx_ids = sorted(ids)
+
+    def validate(self):
+        for idx, istr in self.cmx_ids:
+            for pre in ("CMXR1_", "CMXR2_"):
+                if f"{pre}{istr}" not in self.params or \
+                        self.params[f"{pre}{istr}"].value is None:
+                    raise ValueError(f"CMX_{istr} missing {pre}{istr}")
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        if not self.cmx_ids:
+            return
+        mjd = toas.get_mjds()
+        cols = []
+        for idx, istr in self.cmx_ids:
+            r1 = self.params[f"CMXR1_{istr}"].value
+            r2 = self.params[f"CMXR2_{istr}"].value
+            cols.append(((mjd >= r1) & (mjd <= r2)).astype(np.float64))
+        cache["cmx_masks"] = np.stack(cols, axis=-1)
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.cmx_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        alpha = chromatic_index(self._parent)
+        vals = jnp.stack([_val(pv, f"CMX_{istr}")
+                          for _, istr in self.cmx_ids])
+        cm = cache["cmx_masks"] @ vals
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        out = DMconst * cm * bf ** -alpha * (1000.0 ** (alpha - 2.0))
+        return jnp.where(jnp.isfinite(bf), out, 0.0)
+
+
+class CMWaveX(DelayComponent):
+    """Fourier chromatic variations (reference: wavex.CMWaveX):
+    CMWXFREQ_000n [1/d], CMWXSIN_/CMWXCOS_ [pc cm^-3 MHz^(a-2)]."""
+
+    category = "cmwavex"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(MJDParameter("CMWXEPOCH"))
+        for pre in ("CMWXFREQ_", "CMWXSIN_", "CMWXCOS_"):
+            self.add_param(prefixParameter(
+                prefix=pre, index=1, index_str="0001",
+                units="1/d" if pre == "CMWXFREQ_" else
+                "pc cm^-3 MHz^(a-2)"))
+        self.cmwx_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("CMWXFREQ_"):
+                _, istr, idx = split_prefixed_name(name)
+                if self.params[name].value is not None:
+                    ids.append((idx, istr))
+        self.cmwx_ids = sorted(ids)
+
+    def _epoch(self):
+        if self.CMWXEPOCH.value is not None:
+            return self.CMWXEPOCH.value
+        return self._parent.PEPOCH.value
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.cmwx_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        alpha = chromatic_index(self._parent)
+        ref = self._parent.ref_day
+        tb = ctx.get("tb_days")
+        if tb is None:
+            tb = (batch.tdb_day - ref) + batch.tdb_frac.hi \
+                + batch.tdb_frac.lo
+            ctx["tb_days"] = tb
+        t = tb - (self._epoch() - ref)  # days
+        cm = jnp.zeros_like(batch.freq_mhz)
+        for idx, istr in self.cmwx_ids:
+            arg = 2.0 * jnp.pi * _val(pv, f"CMWXFREQ_{istr}") * t
+            cm = cm + _val(pv, f"CMWXSIN_{istr}") * jnp.sin(arg) \
+                + _val(pv, f"CMWXCOS_{istr}") * jnp.cos(arg)
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        out = DMconst * cm * bf ** -alpha * (1000.0 ** (alpha - 2.0))
+        return jnp.where(jnp.isfinite(bf), out, 0.0)
+
+
+# ---------------------------------------------------- tabulated phase
+
+
+class IFunc(PhaseComponent):
+    """Tabulated phase offsets (reference: ifunc.IFunc): IFUNC<n> lines
+    carry (MJD, value-seconds) pairs; SIFUNC selects interpolation
+    (2 = linear, 0 = constant/nearest). phase += F0 * f(t). Values are
+    host-side table data (not fittable), matching their whitening use.
+    """
+
+    category = "ifunc"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(intParameter("SIFUNC", value=2))
+        self.add_param(pairParameter("IFUNC1", units="MJD s"))
+        self.ifunc_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("IFUNC") and name[5:].isdigit():
+                p = self.params[name]
+                if p.value is not None and tuple(p.value) != (0.0, 0.0):
+                    ids.append(int(name[5:]))
+        self.ifunc_ids = sorted(ids)
+
+    def validate(self):
+        if self.SIFUNC.value not in (None, 0, 2):
+            raise ValueError(
+                f"SIFUNC {self.SIFUNC.value}: only 0 (constant) and "
+                "2 (linear) are implemented (as in the reference)")
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        if not self.ifunc_ids:
+            return
+        pts = np.array([self.params[f"IFUNC{i}"].value
+                        for i in self.ifunc_ids])
+        order = np.argsort(pts[:, 0])
+        t_k, v_k = pts[order, 0], pts[order, 1]
+        mjd = toas.get_mjds()
+        mode = self.SIFUNC.value
+        mode = 2 if mode is None else int(mode)  # NOT `or`: 0 is valid
+        if mode == 2:
+            off = np.interp(mjd, t_k, v_k)
+        else:  # mode 0: nearest tabulated value
+            idx = np.abs(mjd[:, None] - t_k[None, :]).argmin(axis=1)
+            off = v_k[idx]
+        cache["ifunc_offset_s"] = off
+
+    def phase(self, pv, batch, cache, ctx, tb):
+        if not self.ifunc_ids:
+            z = jnp.zeros_like(batch.freq_mhz)
+            return DD(z, z)
+        f0 = _val(pv, "F0")
+        ph = f0 * cache["ifunc_offset_s"]
+        return DD(ph, jnp.zeros_like(ph))
+
+
+# ------------------------------------------------- piecewise spindown
+
+
+class PiecewiseSpindown(PhaseComponent):
+    """Piecewise spin solutions over MJD ranges (reference:
+    piecewise.PiecewiseSpindown): within [PWSTART_n, PWSTOP_n], extra
+    phase = PWPH_n + PWF0_n dt + PWF1_n dt^2/2 + PWF2_n dt^3/6 with dt
+    from PWEP_n."""
+
+    category = "piecewise_spindown"
+    register = True
+
+    PREFIXES = ("PWEP_", "PWSTART_", "PWSTOP_", "PWPH_", "PWF0_",
+                "PWF1_", "PWF2_")
+
+    def __init__(self):
+        super().__init__()
+        for pre in self.PREFIXES:
+            self.add_param(prefixParameter(
+                prefix=pre, index=1, index_str="1",
+                units={"PWEP_": "MJD", "PWSTART_": "MJD",
+                       "PWSTOP_": "MJD", "PWPH_": "turn",
+                       "PWF0_": "Hz", "PWF1_": "Hz/s",
+                       "PWF2_": "Hz/s^2"}[pre]))
+        self.pw_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("PWEP_"):
+                _, istr, idx = split_prefixed_name(name)
+                if self.params[name].value is not None:
+                    ids.append((idx, istr))
+        self.pw_ids = sorted(ids)
+
+    def validate(self):
+        for idx, istr in self.pw_ids:
+            for pre in ("PWSTART_", "PWSTOP_"):
+                if self.params.get(f"{pre}{istr}") is None or \
+                        self.params[f"{pre}{istr}"].value is None:
+                    raise ValueError(f"PWEP_{istr} missing {pre}{istr}")
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        if not self.pw_ids:
+            return
+        mjd = toas.get_mjds()
+        cols = []
+        for idx, istr in self.pw_ids:
+            r1 = self.params[f"PWSTART_{istr}"].value
+            r2 = self.params[f"PWSTOP_{istr}"].value
+            cols.append(((mjd >= r1) & (mjd <= r2)).astype(np.float64))
+        cache["pw_masks"] = np.stack(cols, axis=-1)
+
+    def phase(self, pv, batch, cache, ctx, tb):
+        z = jnp.zeros_like(batch.freq_mhz)
+        if not self.pw_ids:
+            return DD(z, z)
+        ref = self._parent.ref_day
+        total = z
+        for k, (idx, istr) in enumerate(self.pw_ids):
+            ep = pv[f"PWEP_{istr}"]
+            dt = (tb.hi + tb.lo) - ((ep.hi + ep.lo) - ref) * SECS_PER_DAY
+            ph = _val(pv, f"PWPH_{istr}") \
+                + _val(pv, f"PWF0_{istr}") * dt \
+                + _val(pv, f"PWF1_{istr}") * dt * dt / 2.0 \
+                + _val(pv, f"PWF2_{istr}") * dt ** 3 / 6.0
+            total = total + cache["pw_masks"][:, k] * ph
+        return DD(total, z)
+
+
+# ------------------------------------------------- piecewise solar wind
+
+
+class SolarWindDispersionX(DelayComponent):
+    """Piecewise solar-wind amplitude over MJD windows (reference:
+    solar_wind_dispersion.SolarWindDispersionX): SWXDM_0001 with
+    SWXR1_/SWXR2_ bounds. SWXDM is the window's solar-wind DM scale;
+    the per-TOA DM is SWXDM times the line-of-sight geometry factor
+    normalized to its maximum within the window (so SWXDM reads as the
+    max DM contribution in that window; the geometry is precomputed at
+    the nominal astrometry — its dependence on sky-position updates is
+    second order)."""
+
+    category = "solar_windx"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        for pre, unit in (("SWXDM_", "pc cm^-3"), ("SWXR1_", "MJD"),
+                          ("SWXR2_", "MJD")):
+            self.add_param(prefixParameter(prefix=pre, index=1,
+                                           index_str="0001", units=unit))
+        self.swx_ids: list = []
+
+    def setup(self):
+        ids = []
+        for name in self.params:
+            if name.startswith("SWXDM_"):
+                _, istr, idx = split_prefixed_name(name)
+                if self.params[name].value is not None:
+                    ids.append((idx, istr))
+        self.swx_ids = sorted(ids)
+
+    def validate(self):
+        for idx, istr in self.swx_ids:
+            for pre in ("SWXR1_", "SWXR2_"):
+                if self.params.get(f"{pre}{istr}") is None or \
+                        self.params[f"{pre}{istr}"].value is None:
+                    raise ValueError(f"SWXDM_{istr} missing {pre}{istr}")
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        if not self.swx_ids:
+            return
+        # host geometry at nominal astrometry (see class docstring)
+        geom = solar_wind_geometry_host(
+            toas, self._parent._host_psr_dir(toas))
+        mjd = toas.get_mjds()
+        cols = []
+        for idx, istr in self.swx_ids:
+            r1 = self.params[f"SWXR1_{istr}"].value
+            r2 = self.params[f"SWXR2_{istr}"].value
+            m = (mjd >= r1) & (mjd <= r2)
+            gmax = geom[m].max() if np.any(m) else 1.0
+            cols.append(np.where(m, geom / gmax, 0.0))
+        cache["swx_cols"] = np.stack(cols, axis=-1)
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.swx_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        vals = jnp.stack([_val(pv, f"SWXDM_{istr}")
+                          for _, istr in self.swx_ids])
+        dm = cache["swx_cols"] @ vals
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        return DMconst * dm / (bf * bf)
+
+
+# ----------------------------------------------------------- FD jumps
+
+
+class FDJump(DelayComponent):
+    """Per-system frequency-dependent delays (reference: fdjump.FDJump):
+    ``FD1JUMP -fe Rcvr_800 1e-5 1`` applies FD-order-1 terms to the
+    selected TOAs only; plain ``FDJUMP`` lines are order 1. delay =
+    sum_jumps value * ln(nu/GHz)^order * mask."""
+
+    category = "fdjump"
+    register = True
+
+    def __init__(self):
+        super().__init__()
+        self.fdjumps: list = []  # (order, param name)
+
+    def add_fdjump(self, order, key, key_value, value=0.0, frozen=True,
+                   index=None):
+        base = "FDJUMP" if order == 1 else f"FD{order}JUMP"
+        idx = index or (sum(1 for o, _ in self.fdjumps if o == order)
+                        + 1)
+        p = maskParameter(base, index=idx, key=key, key_value=key_value,
+                          value=value, frozen=frozen, units="s")
+        self.add_param(p)
+        self.setup()
+        return p
+
+    def setup(self):
+        self.fdjumps = []
+        for name in self.params:
+            if name.startswith("FDJUMP"):
+                self.fdjumps.append((1, name))
+            elif name.startswith("FD") and "JUMP" in name:
+                order = int(name[2:name.index("JUMP")])
+                self.fdjumps.append((order, name))
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        for order, name in self.fdjumps:
+            cache[f"mask_{name}"] = self.params[
+                name].select_mask(toas).astype(np.float64)
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        z = jnp.zeros_like(batch.freq_mhz)
+        if not self.fdjumps:
+            return z
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        logf = jnp.log(bf / 1000.0)
+        total = z
+        for order, name in self.fdjumps:
+            if name in pv:
+                total = total + _val(pv, name) * logf ** order * \
+                    cache[f"mask_{name}"]
+        return jnp.where(jnp.isfinite(bf), total, 0.0)

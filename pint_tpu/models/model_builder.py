@@ -51,6 +51,8 @@ MASK_FAMILIES: Dict[str, str] = {
     "DMEQUAD": "ScaleDmError",
     "FDJUMP": "FDJump",
 }
+# arbitrary-order FD jumps (FD1JUMP, FD2JUMP, ...) route via regex
+_FDJUMP_RE = re.compile(r"^FD(\d+)JUMP$")
 # canonical mask param name per alias
 MASK_CANONICAL = {"T2EFAC": "EFAC", "T2EQUAD": "EQUAD", "TNECORR": "ECORR"}
 
@@ -109,6 +111,7 @@ class ModelBuilder:
             pass
         try:
             import pint_tpu.models.components_extra  # noqa: F401
+            import pint_tpu.models.components_tail  # noqa: F401
         except ImportError:
             pass
         self.param_index = _build_param_index()
@@ -212,8 +215,8 @@ class ModelBuilder:
                 continue
 
             # 3. mask families (one param instance per line)
-            if key in MASK_FAMILIES:
-                cls_name = MASK_FAMILIES[key]
+            if key in MASK_FAMILIES or _FDJUMP_RE.match(key):
+                cls_name = MASK_FAMILIES.get(key, "FDJump")
                 if cls_name not in component_types:
                     unknown.append(key)
                     continue

@@ -40,9 +40,9 @@ from pint_tpu.models.timing_model import Component
 
 __all__ = [
     "NoiseComponent", "ScaleToaError", "ScaleDmError", "EcorrNoise",
-    "PLRedNoise", "PLDMNoise", "create_quantization_matrix",
-    "quantization_buckets", "create_fourier_design_matrix", "powerlaw",
-    "EcorrOverlapError",
+    "PLRedNoise", "PLDMNoise", "PLChromNoise", "PLSWNoise",
+    "create_quantization_matrix", "quantization_buckets",
+    "create_fourier_design_matrix", "powerlaw", "EcorrOverlapError",
 ]
 
 FYR = 1.0 / (86400.0 * 365.25)  # 1/yr in Hz
@@ -396,6 +396,112 @@ class PLDMNoise(NoiseComponent):
         t = _tdb_seconds(toas)
         F, freqs = create_fourier_design_matrix(t, nmodes)
         scale = (self.REF_FREQ_MHZ / toas.get_freqs()) ** 2
+        F = F * scale[:, None]
+        df = freqs[0]
+        phi = powerlaw(freqs, A, gamma) * df
+        return F, phi
+
+
+class PLChromNoise(NoiseComponent):
+    """Power-law chromatic noise with a general spectral index: the
+    red-noise Fourier basis scaled per row by (1400 MHz/nu)^alpha,
+    alpha = TNCHROMIDX from the ChromaticCM component (default 4)
+    (reference: PLChromNoise.pl_chrom_basis_weight_pair)."""
+
+    register = True
+    is_basis_noise = True
+
+    REF_FREQ_MHZ = 1400.0
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter(
+            "TNCHROMAMP", units="log10", aliases=["TNChromAmp"],
+            description="log10 chromatic-noise amplitude"))
+        self.add_param(floatParameter(
+            "TNCHROMGAM", units="", aliases=["TNChromGam"],
+            description="chromatic-noise spectral index"))
+        self.add_param(intParameter(
+            "TNCHROMC", value=30, aliases=["TNChromC"],
+            description="number of chromatic Fourier modes"))
+
+    def _alpha(self) -> float:
+        from pint_tpu.models.components_tail import chromatic_index
+
+        return chromatic_index(getattr(self, "_parent", None))
+
+    def validate(self):
+        if self.TNCHROMAMP.value is not None and \
+                self.TNCHROMGAM.value is None:
+            raise ValueError("TNCHROMAMP set without TNCHROMGAM")
+
+    def noise_basis_weight(self, toas):
+        if self.TNCHROMAMP.value is None:
+            return None
+        A = 10.0 ** self.TNCHROMAMP.value
+        gamma = self.TNCHROMGAM.value
+        nmodes = int(self.TNCHROMC.value or 30)
+        t = _tdb_seconds(toas)
+        F, freqs = create_fourier_design_matrix(t, nmodes)
+        scale = (self.REF_FREQ_MHZ / toas.get_freqs()) ** self._alpha()
+        F = F * np.where(np.isfinite(scale), scale, 0.0)[:, None]
+        df = freqs[0]
+        phi = powerlaw(freqs, A, gamma) * df
+        return F, phi
+
+
+class PLSWNoise(NoiseComponent):
+    """Power-law stochastic solar-wind noise: the Fourier basis scaled
+    per row by the solar-wind line-of-sight geometry times nu^-2
+    (reference: PLSWNoise.pl_sw_basis_weight_pair). Requires a
+    SolarWindDispersion component for the geometry."""
+
+    register = True
+    is_basis_noise = True
+
+    REF_FREQ_MHZ = 1400.0
+
+    def __init__(self):
+        super().__init__()
+        self.add_param(floatParameter(
+            "TNSWAMP", units="log10", aliases=["TNSWAmp"],
+            description="log10 solar-wind-noise amplitude"))
+        self.add_param(floatParameter(
+            "TNSWGAM", units="", aliases=["TNSWGam"],
+            description="solar-wind-noise spectral index"))
+        self.add_param(intParameter(
+            "TNSWC", value=10, aliases=["TNSWC"],
+            description="number of solar-wind Fourier modes"))
+
+    def validate(self):
+        if self.TNSWAMP.value is not None and \
+                self.TNSWGAM.value is None:
+            raise ValueError("TNSWAMP set without TNSWGAM")
+
+    def noise_basis_weight(self, toas):
+        if self.TNSWAMP.value is None:
+            return None
+        parent = getattr(self, "_parent", None)
+        if parent is None:
+            return None
+        A = 10.0 ** self.TNSWAMP.value
+        gamma = self.TNSWGAM.value
+        nmodes = int(self.TNSWC.value or 10)
+        t = _tdb_seconds(toas)
+        F, freqs = create_fourier_design_matrix(t, nmodes)
+        # geometry at nominal astrometry (second-order in updates):
+        # n_e -> DM conversion normalized at 90-degree elongation, 1 AU
+        from pint_tpu.models.components_extra import AU_M, PC_M
+        from pint_tpu.models.components_tail import (
+            solar_wind_geometry_host,
+        )
+
+        geom = solar_wind_geometry_host(toas,
+                                        parent._host_psr_dir(toas))
+        geom0 = (AU_M * AU_M / PC_M) * (np.pi / 2.0) / AU_M
+        fscale = (self.REF_FREQ_MHZ / toas.get_freqs()) ** 2
+        scale = (geom / geom0) * np.where(np.isfinite(fscale), fscale,
+                                          0.0)
         F = F * scale[:, None]
         df = freqs[0]
         phi = powerlaw(freqs, A, gamma) * df

@@ -61,10 +61,16 @@ DELAY_CATEGORY_ORDER = [
     "solar_system_shapiro",
     "troposphere",
     "solar_wind",
+    "solar_windx",
     "dispersion",
+    "chromatic",
+    "chromatic_cmx",
+    "cmwavex",
     "frequency_dependent",
+    "fdjump",
     "wavex",
-    "pulsar_system",  # binary
+    "pulsar_system",  # binary: must be LAST so delay_so_far includes
+    # every ISM/geometric delay when converting to pulsar-frame time
 ]
 PHASE_CATEGORY_ORDER = [
     "spindown",
@@ -408,6 +414,26 @@ class TimingModel:
         self._cache = cache
         self._cache_key = key
         return cache
+
+    def _host_psr_dir(self, toas) -> np.ndarray:
+        """Nominal host-side SSB->pulsar unit vector (N,3), ICRS, at
+        the catalog position (no proper motion): for host precomputes
+        whose dependence on astrometry updates is second order (e.g.
+        SWX geometry normalization)."""
+        eq = self.components.get("AstrometryEquatorial")
+        if eq is not None:
+            a0, d0 = eq.RAJ.value, eq.DECJ.value
+            n = np.array([np.cos(d0) * np.cos(a0),
+                          np.cos(d0) * np.sin(a0), np.sin(d0)])
+            return np.broadcast_to(n, (toas.ntoas, 3))
+        ec = self.components.get("AstrometryEcliptic")
+        if ec is not None:
+            l0, b0 = ec.ELONG.value, ec.ELAT.value
+            n_ecl = np.array([np.cos(b0) * np.cos(l0),
+                              np.cos(b0) * np.sin(l0), np.sin(b0)])
+            n = np.asarray(ec._ecl_matrix()) @ n_ecl
+            return np.broadcast_to(n, (toas.ntoas, 3))
+        raise ValueError("model has no astrometry component")
 
     def _make_tzr_toas(self, toas):
         """Build the one-TOA TZR set (reference:

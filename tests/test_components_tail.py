@@ -1,0 +1,356 @@
+"""Component-zoo tail tests: troposphere, CM/CMX/CMWaveX, IFUNC,
+piecewise spindown, SWX, FDJump, PLChrom/PLSW noise (reference test
+strategy: SURVEY.md §4.2/4.4 — designmatrix-vs-FD + simulate->fit
+recovery per component; FDJUMP must never silently drop)."""
+
+import copy
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toa import merge_TOAs
+
+BASE = """
+PSR J0009+0009
+RAJ 06:30:00.0
+DECJ 30:00:00.0
+F0 150.0 1
+F1 -1e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 18.0
+DMEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+
+# TZR-free base for model-vs-model difference tests: with an absolute
+# phase anchor, each model's OWN delay at the TZR point enters as a
+# constant offset that the per-component "expect" arrays don't model
+BASE_NOTZR = "\n".join(ln for ln in BASE.splitlines()
+                       if not ln.startswith("TZR")) + "\n"
+
+
+def _mk(extra: str = "", base: str = BASE):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(io.StringIO(base + extra))
+
+
+def _toas(model, n=60, obs="gbt", two_band=True, seed=0,
+          add_noise=False):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rng = np.random.default_rng(seed)
+        tA = make_fake_toas_uniform(54001, 55999, n - n // 2, model,
+                                    error_us=1.0, obs=obs,
+                                    freq_mhz=1400.0,
+                                    add_noise=add_noise, rng=rng)
+        tB = make_fake_toas_uniform(54002, 55998, n // 2, model,
+                                    error_us=1.0, obs=obs,
+                                    freq_mhz=820.0,
+                                    add_noise=add_noise, rng=rng)
+        return merge_TOAs([tA, tB]) if two_band else tA
+
+
+def _r(model, toas, subtract_mean=True):
+    return np.asarray(Residuals(toas, model,
+                                subtract_mean=subtract_mean).time_resids)
+
+
+def _recovery(extra, pname, delta, n=60, seed=3, base=BASE,
+              two_band=True):
+    """Simulate with truth, perturb pname, refit, require recovery."""
+    from pint_tpu.fitter import DownhillWLSFitter
+
+    truth = _mk(extra, base=base)
+    toas = _toas(truth, n=n, seed=seed, add_noise=True,
+                 two_band=two_band)
+    m = copy.deepcopy(truth)
+    m.get_param(pname).add_delta(delta)
+    m.invalidate_cache(params_only=True)
+    f = DownhillWLSFitter(toas, m)
+    f.fit_toas()
+    truthv = truth.get_param(pname).value
+    assert abs(m.get_param(pname).value - truthv) \
+        < 5 * f.errors[pname], pname
+    return f
+
+
+# --------------------------------------------------------- troposphere
+
+
+def test_troposphere_delay_properties():
+    m_on = _mk("CORRECT_TROPOSPHERE Y\n", base=BASE_NOTZR)
+    m_off = _mk("CORRECT_TROPOSPHERE N\n", base=BASE_NOTZR)
+    assert "TroposphereDelay" in m_on.components
+    toas = _toas(m_off, n=40, obs="gbt")
+    r_on = _r(m_on, toas, subtract_mean=False)
+    r_off = _r(m_off, toas, subtract_mean=False)
+    # a positive delay LOWERS the phase residual: d = -delay
+    d = r_off - r_on
+    # zenith hydrostatic delay ~7.7 ns; mapped delay is larger and
+    # always positive (adds path)
+    assert np.all(d > 5e-9)
+    assert np.all(d < 1e-6)
+    # a source transiting near zenith at GBT (dec ~ +38.4) maps closer
+    # to the zenith delay than a low-elevation one
+    lowdec = BASE_NOTZR.replace("DECJ 30:00:00.0", "DECJ -15:00:00.0")
+    m2_on = _mk("CORRECT_TROPOSPHERE Y\n", base=lowdec)
+    m2_off = _mk("CORRECT_TROPOSPHERE N\n", base=lowdec)
+    d2 = _r(m2_off, toas, subtract_mean=False) - \
+        _r(m2_on, toas, subtract_mean=False)
+    assert np.median(d2) > np.median(d)
+
+
+def test_troposphere_zero_at_barycenter():
+    m_on = _mk("CORRECT_TROPOSPHERE Y\n", base=BASE_NOTZR)
+    m_off = _mk("CORRECT_TROPOSPHERE N\n", base=BASE_NOTZR)
+    toas = _toas(m_off, n=20, obs="barycenter")
+    np.testing.assert_allclose(_r(m_on, toas, subtract_mean=False),
+                               _r(m_off, toas, subtract_mean=False),
+                               atol=1e-15)
+
+
+# ----------------------------------------------------------- chromatic
+
+
+def test_chromatic_cm_scaling():
+    """CM delay scales as nu^-alpha and reduces to the DM law at
+    alpha=2 (with the 1 GHz reference convention)."""
+    m = _mk("CM 0.02\nTNCHROMIDX 4\nCMEPOCH 55000\n", base=BASE_NOTZR)
+    toas = _toas(m, n=40)
+    m0 = _mk("", base=BASE_NOTZR)
+    d = _r(m, toas, subtract_mean=False) - _r(m0, toas,
+                                              subtract_mean=False)
+    f = np.asarray(toas.freq_mhz)
+    ratio = d[f < 1000].mean() / d[f > 1000].mean()  # sign cancels
+    assert ratio == pytest.approx((1400.0 / 820.0) ** 4, rel=0.05)
+
+
+def test_chromatic_cm_recovery():
+    _recovery("CM 0.02 1\nTNCHROMIDX 4\nCMEPOCH 55000\n", "CM", 1e-3)
+
+
+def test_cmx_windows():
+    m = _mk("CMX_0001 0.05 1\nCMXR1_0001 54000\nCMXR2_0001 54800\n"
+            "CMX_0002 -0.02 1\nCMXR1_0002 54800.5\nCMXR2_0002 56000\n",
+            base=BASE_NOTZR)
+    assert "ChromaticCMX" in m.components
+    toas = _toas(m, n=40)
+    m0 = _mk("", base=BASE_NOTZR)
+    d = _r(m, toas, subtract_mean=False) - _r(m0, toas,
+                                              subtract_mean=False)
+    d = -d  # positive delay lowers the residual
+    mjd = toas.get_mjds()
+    lo = np.asarray(toas.freq_mhz) < 1000
+    assert np.all(d[(mjd < 54800) & lo] > 0)
+    assert np.all(d[(mjd > 54801) & lo] < 0)
+
+
+def test_cmx_recovery():
+    _recovery("CMX_0001 0.05 1\nCMXR1_0001 54000\nCMXR2_0001 56000\n",
+              "CMX_0001", 2e-3)
+
+
+def test_cmwavex_delay():
+    m = _mk("CMWXEPOCH 55000\nCMWXFREQ_0001 0.005\n"
+            "CMWXSIN_0001 0.01 1\nCMWXCOS_0001 0.0\n")
+    assert "CMWaveX" in m.components
+    _recovery("CMWXEPOCH 55000\nCMWXFREQ_0001 0.005\n"
+              "CMWXSIN_0001 0.01 1\nCMWXCOS_0001 0.0 1\n",
+              "CMWXSIN_0001", 1e-3)
+
+
+# --------------------------------------------------------------- ifunc
+
+
+def test_ifunc_linear_interpolation():
+    m = _mk("SIFUNC 2\nIFUNC1 54000 0.0\nIFUNC2 55000 1e-5\n"
+            "IFUNC3 56000 0.0\n", base=BASE_NOTZR)
+    assert "IFunc" in m.components
+    toas = _toas(m, n=40)
+    m0 = _mk("", base=BASE_NOTZR)
+    d = _r(m, toas, subtract_mean=False) - _r(m0, toas,
+                                              subtract_mean=False)
+    mjd = toas.get_mjds()
+    expect = np.interp(mjd, [54000, 55000, 56000], [0.0, 1e-5, 0.0])
+    np.testing.assert_allclose(d, expect, atol=2e-11)
+
+
+def test_ifunc_constant_mode():
+    m = _mk("SIFUNC 0\nIFUNC1 54000 1e-5\nIFUNC2 55500 3e-5\n",
+            base=BASE_NOTZR)
+    toas = _toas(m, n=30)
+    m0 = _mk("", base=BASE_NOTZR)
+    d = _r(m, toas, subtract_mean=False) - _r(m0, toas,
+                                              subtract_mean=False)
+    mjd = toas.get_mjds()
+    expect = np.where(np.abs(mjd - 54000) < np.abs(mjd - 55500),
+                      1e-5, 3e-5)
+    np.testing.assert_allclose(d, expect, atol=2e-11)
+
+
+# ------------------------------------------------- piecewise spindown
+
+
+def test_piecewise_spindown_window():
+    m = _mk("PWEP_1 55000\nPWSTART_1 54800\nPWSTOP_1 55200\n"
+            "PWF0_1 1e-9\nPWF1_1 0\nPWF2_1 0\n", base=BASE_NOTZR)
+    assert "PiecewiseSpindown" in m.components
+    toas = _toas(m, n=60)
+    m0 = _mk("", base=BASE_NOTZR)
+    d = _r(m, toas, subtract_mean=False) - _r(m0, toas,
+                                              subtract_mean=False)
+    mjd = toas.get_mjds()
+    inside = (mjd >= 54800) & (mjd <= 55200)
+    # extra phase PWF0 * dt / F0 seconds inside the window, 0 outside
+    dt = (mjd - 55000.0) * 86400.0
+    expect = np.where(inside, 1e-9 * dt / 150.0, 0.0)
+    # expect uses UTC-days dt; the component uses barycentric seconds
+    # (up to ~500 s earlier) -> ~4e-9 s slop at the window edges
+    np.testing.assert_allclose(d, expect, atol=5e-9)
+
+
+def test_piecewise_spindown_recovery():
+    _recovery("PWEP_1 55000\nPWSTART_1 54300\nPWSTOP_1 55700\n"
+              "PWF0_1 1e-9 1\n", "PWF0_1", 3e-10)
+
+
+# ------------------------------------------------------------- SWX
+
+
+def test_swx_windows_and_recovery():
+    m = _mk("SWXDM_0001 1e-4 1\nSWXR1_0001 54000\nSWXR2_0001 56000\n",
+            base=BASE_NOTZR)
+    assert "SolarWindDispersionX" in m.components
+    toas = _toas(m, n=50)
+    m0 = _mk("", base=BASE_NOTZR)
+    d = _r(m, toas, subtract_mean=False) - _r(m0, toas,
+                                              subtract_mean=False)
+    d = -d  # positive delay lowers the residual
+    lo = np.asarray(toas.freq_mhz) < 1000
+    assert np.all(d[lo] > 0)
+    # normalized geometry: max delay equals DMconst*SWXDM/nu^2
+    from pint_tpu.models.dispersion import DMconst
+
+    assert d[lo].max() == pytest.approx(
+        DMconst * 1e-4 / 820.0 ** 2, rel=0.05)
+    _recovery("SWXDM_0001 1e-4 1\nSWXR1_0001 54000\nSWXR2_0001 56000\n",
+              "SWXDM_0001", 3e-5)
+
+
+# ------------------------------------------------------------ FDJump
+
+
+def test_fdjump_not_silently_dropped():
+    m = _mk("FDJUMP -grp L 1e-5 1\n")
+    assert "FDJump" in m.components
+    assert len(m.components["FDJump"].fdjumps) == 1
+
+
+def test_fdjump_applies_to_selected_toas():
+    m = _mk("FD1JUMP -grp L 1e-5 1\nFD2JUMP -grp L 3e-6 1\n",
+            base=BASE_NOTZR)
+    toas = _toas(m, n=40)
+    for i, fl in enumerate(toas.flags):
+        fl["grp"] = "L" if i % 2 == 0 else "S"
+    m0 = _mk("", base=BASE_NOTZR)
+    d = _r(m, toas, subtract_mean=False) - _r(m0, toas,
+                                              subtract_mean=False)
+    sel = np.array([fl["grp"] == "L" for fl in toas.flags])
+    f = np.asarray(toas.freq_mhz)
+    logf = np.log(f / 1000.0)
+    expect = np.where(sel, 1e-5 * logf + 3e-6 * logf ** 2, 0.0)
+    # positive delay lowers the residual; the component evaluates at
+    # the Doppler-shifted barycentric frequency (|dv/c| ~ 1e-4)
+    np.testing.assert_allclose(-d, expect, atol=5e-9)
+
+
+def test_fdjump_recovery():
+    from pint_tpu.fitter import DownhillWLSFitter
+
+    truth = _mk("FD1JUMP -grp L 1e-5 1\n")
+    toas = _toas(truth, n=60, add_noise=False)
+    for i, fl in enumerate(toas.flags):
+        fl["grp"] = "L" if i % 2 == 0 else "S"
+    rng = np.random.default_rng(5)
+    from pint_tpu.simulation import zero_residuals
+
+    toas = zero_residuals(toas, truth)
+    m = copy.deepcopy(truth)
+    m.get_param("FD1JUMP1").add_delta(5e-6)
+    m.invalidate_cache()
+    f = DownhillWLSFitter(toas, m)
+    f.fit_toas()
+    assert abs(m.get_param("FD1JUMP1").value - 1e-5) < 1e-8
+
+
+# ----------------------------------------------------- new noise terms
+
+
+def test_plchromnoise_basis():
+    m = _mk("CM 0.0\nTNCHROMIDX 4\nCMEPOCH 55000\n"
+            "TNCHROMAMP -13.0\nTNCHROMGAM 3.0\nTNCHROMC 8\n")
+    assert "PLChromNoise" in m.components
+    toas = _toas(m, n=40)
+    F = m.noise_model_designmatrix(toas)
+    phi = m.noise_model_basis_weight(toas)
+    assert F.shape == (40, 16)
+    assert phi.shape == (16,)
+    # rows at lower frequency have (1400/820)^4 larger amplitude
+    f = np.asarray(toas.freq_mhz)
+    hi_rows = np.abs(F[f > 1000]).max()
+    lo_rows = np.abs(F[f < 1000]).max()
+    assert lo_rows / hi_rows == pytest.approx((1400 / 820) ** 4,
+                                              rel=0.2)
+
+
+def test_plswnoise_basis():
+    m = _mk("NE_SW 4.0\nTNSWAMP -13.0\nTNSWGAM 2.0\nTNSWC 5\n")
+    assert "PLSWNoise" in m.components
+    toas = _toas(m, n=30)
+    F = m.noise_model_designmatrix(toas)
+    assert F.shape == (30, 10)
+    assert np.all(np.isfinite(F))
+    # GLS fitter runs with it
+    from pint_tpu.gls import GLSFitter
+
+    f = GLSFitter(toas, copy.deepcopy(m))
+    chi2 = f.fit_toas()
+    assert np.isfinite(chi2)
+
+
+# ----------------------------------------------- par round trip (all)
+
+
+def test_tail_components_parfile_roundtrip():
+    extras = [
+        "CORRECT_TROPOSPHERE Y\n",
+        "CM 0.02 1\nCM1 1e-10\nTNCHROMIDX 4\nCMEPOCH 55000\n",
+        "CMX_0001 0.05 1\nCMXR1_0001 54000\nCMXR2_0001 56000\n",
+        "SIFUNC 2\nIFUNC1 54000 0.0\nIFUNC2 56000 1e-5\n",
+        "PWEP_1 55000\nPWSTART_1 54800\nPWSTOP_1 55200\nPWF0_1 1e-9\n",
+        "SWXDM_0001 1e-4\nSWXR1_0001 54000\nSWXR2_0001 56000\n",
+        "FD1JUMP -grp L 1e-5\n",
+    ]
+    for extra in extras:
+        m = _mk(extra)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m2 = get_model(io.StringIO(m.as_parfile()))
+        toas = _toas(m, n=16)
+        for fl in toas.flags:
+            fl["grp"] = "L"
+        np.testing.assert_allclose(
+            _r(m, toas, subtract_mean=False),
+            _r(m2, toas, subtract_mean=False), atol=1e-12,
+            err_msg=extra)
