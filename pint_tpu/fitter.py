@@ -264,6 +264,8 @@ def fit_summary(fitter: Fitter) -> str:
     ]
     for name in m.free_params:
         p = m.get_param(name)
-        unc = f"{p.uncertainty:.3g}" if p.uncertainty is not None else "-"
-        lines.append(f"{name:<12} {p.value:>24.15g} {unc:>14} {p.units}")
+        # the parameter's own formatters: sexagesimal for angles, with
+        # the uncertainty in the same displayed units
+        lines.append(f"{name:<12} {p._format_value():>24} "
+                     f"{p._format_uncertainty():>14} {p.units}")
     return "\n".join(lines)

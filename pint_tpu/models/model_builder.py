@@ -152,11 +152,8 @@ class ModelBuilder:
             if key == "BINARY":
                 continue
             if key == "UNITS":
-                if toks and toks[0].upper() == "TCB":
-                    raise ValueError(
-                        "UNITS TCB par files are not supported — convert "
-                        "with tcb2tdb first (reference behavior: "
-                        "explicit refusal unless allow_tcb)")
+                # TCB is accepted here; get_model converts to TDB after
+                # the build (reference: allow_tcb conversion path)
                 get_comp("MiscParams").UNITS.value = toks[0] if toks else "TDB"
                 continue
 
@@ -302,14 +299,26 @@ def _param_by_name_or_alias(comp: Component, key: str):
     raise KeyError(key)
 
 
-def get_model(parfile, name="") -> TimingModel:
+def get_model(parfile, name="", allow_tcb=True) -> TimingModel:
     """Build a TimingModel from a par file path/handle/string
-    (reference: get_model)."""
+    (reference: get_model). UNITS TCB models are converted to TDB via
+    the IFTE_K linear scaling (reference: allow_tcb; pass
+    allow_tcb=False to refuse instead)."""
     lines = parse_parfile(parfile)
     model = ModelBuilder()(lines, name=name)
     psr = model.PSR.value
     if psr and not model.name:
         model.name = psr
+    if (model.UNITS.value or "TDB").upper() == "TCB":
+        if not allow_tcb:
+            raise ValueError("UNITS TCB refused (allow_tcb=False)")
+        from pint_tpu.models.tcb_conversion import convert_tcb_tdb
+
+        warnings.warn(
+            "par file is in TCB units: converted to TDB with the "
+            "IFTE_K linear scaling (periodic TDB-TCB terms ~ns are "
+            "not applied)")
+        model = convert_tcb_tdb(model)
     return model
 
 

@@ -164,6 +164,12 @@ class Parameter:
         return repr(float(v)) if isinstance(v, (float, np.floating)) \
             else str(v)
 
+    def _format_uncertainty(self) -> str:
+        """Uncertainty in the same units _format_value displays."""
+        if self.uncertainty is None:
+            return "-"
+        return f"{self.uncertainty:.3g}"
+
     # -- par-file I/O --------------------------------------------------
 
     def from_tokens(self, tokens: List[str]):
@@ -343,6 +349,17 @@ class AngleParameter(Parameter):
                 m = 0
                 h += 1
         return f"{sign}{h:02d}:{m:02d}:{s:.11f}"
+
+    def _format_uncertainty(self):
+        """Sexagesimal seconds (of RA hour / of arc), matching
+        _parse_unc and the par-file convention."""
+        if self.uncertainty is None:
+            return "-"
+        if self.units == "H:M:S":
+            return f"{self.uncertainty * (12.0 / np.pi) * 3600.0:.3g}"
+        if self.units == "D:M:S":
+            return f"{self.uncertainty * (180.0 / np.pi) * 3600.0:.3g}"
+        return f"{self.uncertainty * (180.0 / np.pi):.3g}"
 
 
 class maskParameter(floatParameter):

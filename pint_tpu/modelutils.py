@@ -1,0 +1,103 @@
+"""Model transformation helpers: ecliptic <-> equatorial astrometry.
+
+Reference: src/pint/modelutils.py (model_equatorial_to_ecliptic,
+model_ecliptic_to_equatorial). Positions rotate through the IAU
+obliquity matrix; proper motions rotate with the local tangent-plane
+Jacobian (position-angle rotation); PX/POSEPOCH carry over.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from pint_tpu.models.astrometry import (
+    AstrometryEcliptic,
+    AstrometryEquatorial,
+    icrs_to_ecliptic_matrix,
+)
+
+__all__ = ["model_ecliptic_to_equatorial",
+           "model_equatorial_to_ecliptic"]
+
+
+def _unit(lon, lat):
+    return np.array([np.cos(lat) * np.cos(lon),
+                     np.cos(lat) * np.sin(lon), np.sin(lat)])
+
+
+def _lonlat(v):
+    return float(np.arctan2(v[1], v[0]) % (2 * np.pi)), \
+        float(np.arcsin(np.clip(v[2], -1, 1)))
+
+
+def _basis(lon, lat):
+    """(east, north) unit vectors at (lon, lat)."""
+    e = np.array([-np.sin(lon), np.cos(lon), 0.0])
+    n = np.array([-np.sin(lat) * np.cos(lon),
+                  -np.sin(lat) * np.sin(lon), np.cos(lat)])
+    return e, n
+
+
+def _convert(model, to_ecliptic: bool):
+    src_name = "AstrometryEquatorial" if to_ecliptic else \
+        "AstrometryEcliptic"
+    src = model.components.get(src_name)
+    if src is None:
+        raise ValueError(f"model has no {src_name}")
+    if to_ecliptic:
+        M = icrs_to_ecliptic_matrix(84381.406)  # ecliptic <- ICRS
+        lon0, lat0 = src.RAJ.value, src.DECJ.value
+        pml, pmb = src.PMRA.value or 0.0, src.PMDEC.value or 0.0
+        dst = AstrometryEcliptic()
+        out_names = ("ELONG", "ELAT", "PMELONG", "PMELAT")
+    else:
+        M = np.asarray(src._ecl_matrix())  # ICRS <- ecliptic
+        lon0, lat0 = src.ELONG.value, src.ELAT.value
+        pml, pmb = src.PMELONG.value or 0.0, src.PMELAT.value or 0.0
+        dst = AstrometryEquatorial()
+        out_names = ("RAJ", "DECJ", "PMRA", "PMDEC")
+
+    v = M @ _unit(lon0, lat0)
+    lon1, lat1 = _lonlat(v)
+    # rotate the proper-motion vector: express (pm_east, pm_north) in
+    # the source basis as a 3-vector, rotate, project on the dest basis
+    e0, n0 = _basis(lon0, lat0)
+    pm_vec = M @ (pml * e0 + pmb * n0)
+    e1, n1 = _basis(lon1, lat1)
+    pm_lon, pm_lat = float(pm_vec @ e1), float(pm_vec @ n1)
+
+    new = copy.deepcopy(model)
+    new.remove_component(src_name)
+    new.add_component(dst, setup=False)
+    vals = (lon1, lat1, pm_lon, pm_lat)
+    for nm, val in zip(out_names, vals):
+        dst.params[nm].value = val
+    for nm_src, nm_dst in zip(
+            ("RAJ", "DECJ", "PMRA", "PMDEC") if to_ecliptic else
+            ("ELONG", "ELAT", "PMELONG", "PMELAT"), out_names):
+        sp = src.params[nm_src]
+        dst.params[nm_dst].frozen = sp.frozen
+        dst.params[nm_dst].uncertainty = sp.uncertainty
+    for shared in ("PX", "POSEPOCH", "PMRV"):
+        if shared in src.params and shared in dst.params:
+            sp, dp = src.params[shared], dst.params[shared]
+            dp.value, dp.frozen = sp.value, sp.frozen
+            dp.uncertainty = sp.uncertainty
+    dst.setup()
+    dst.validate()
+    new.invalidate_cache()
+    return new
+
+
+def model_equatorial_to_ecliptic(model):
+    """RAJ/DECJ model -> ELONG/ELAT model (reference:
+    modelutils.model_equatorial_to_ecliptic)."""
+    return _convert(model, to_ecliptic=True)
+
+
+def model_ecliptic_to_equatorial(model):
+    """ELONG/ELAT model -> RAJ/DECJ model (reference:
+    modelutils.model_ecliptic_to_equatorial)."""
+    return _convert(model, to_ecliptic=False)

@@ -1,0 +1,60 @@
+"""Quick barycentering of arbitrary times (reference:
+src/pint/scripts/pintbary.py): UTC MJDs at a site -> barycentric TDB
+MJDs for a given sky position (or par file)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pintbary", description="Barycenter times")
+    p.add_argument("mjds", nargs="+", type=float, help="UTC MJD(s)")
+    p.add_argument("--obs", default="gbt")
+    p.add_argument("--freq", type=float, default=float("inf"),
+                   help="MHz (dispersion removed if par has DM)")
+    p.add_argument("--parfile", default=None)
+    p.add_argument("--ra", default=None, help="hh:mm:ss.s")
+    p.add_argument("--dec", default=None, help="dd:mm:ss.s")
+    p.add_argument("--ephem", default=None)
+    args = p.parse_args(argv)
+
+    import io
+    import warnings
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.toa import get_TOAs_array
+
+    if args.parfile:
+        model = get_model(args.parfile)
+    elif args.ra and args.dec:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(io.StringIO(
+                f"PSR BARY\nRAJ {args.ra}\nDECJ {args.dec}\n"
+                f"F0 1.0\nPEPOCH 55000\nUNITS TDB\n"))
+    else:
+        p.error("give --parfile or --ra/--dec")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        toas = get_TOAs_array(np.asarray(args.mjds, dtype=np.float64),
+                              obs=args.obs, freqs=args.freq,
+                              errors=1.0,
+                              ephem=(args.ephem or model.EPHEM.value))
+    delay = np.asarray(model.delay(toas))
+    tdb = toas.tdb_day + toas.tdb_frac[0] + toas.tdb_frac[1]
+    bat = tdb - delay / 86400.0
+    for m_in, m_out in zip(args.mjds, bat):
+        print(f"{m_in:.10f} -> {m_out:.13f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

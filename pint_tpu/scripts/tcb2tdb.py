@@ -1,0 +1,32 @@
+"""Convert a TCB par file to TDB (reference:
+src/pint/scripts/tcb2tdb.py)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tcb2tdb", description="Convert a TCB par file to TDB")
+    p.add_argument("input_par")
+    p.add_argument("output_par")
+    args = p.parse_args(argv)
+
+    from pint_tpu.models import get_model
+
+    # get_model converts TCB -> TDB on load
+    model = get_model(args.input_par)
+    if (model.UNITS.value or "").upper() != "TDB":
+        raise SystemExit(f"conversion failed: UNITS={model.UNITS.value}")
+    with open(args.output_par, "w") as fh:
+        fh.write(model.as_parfile())
+    print(f"Wrote TDB par file to {args.output_par}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+"""Simulate fake TOAs to a tim file (reference:
+src/pint/scripts/zima.py)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="zima", description="Simulate TOAs from a timing model")
+    p.add_argument("parfile")
+    p.add_argument("timfile", help="output tim file")
+    p.add_argument("--ntoa", type=int, default=100)
+    p.add_argument("--startMJD", type=float, default=56000.0)
+    p.add_argument("--duration", type=float, default=400.0,
+                   help="days")
+    p.add_argument("--error", type=float, default=1.0,
+                   help="TOA uncertainty [us]")
+    p.add_argument("--obs", default="gbt")
+    p.add_argument("--freq", type=float, default=1400.0)
+    p.add_argument("--addnoise", action="store_true",
+                   help="add a white-noise draw at the TOA errors")
+    p.add_argument("--addcorrnoise", action="store_true",
+                   help="also draw the model's correlated noise")
+    p.add_argument("--inputtim", default=None,
+                   help="take MJDs/freqs/errors from this tim instead")
+    p.add_argument("--seed", type=int, default=None)
+    args = p.parse_args(argv)
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import (
+        make_fake_toas_fromtim,
+        make_fake_toas_uniform,
+    )
+
+    model = get_model(args.parfile)
+    rng = np.random.default_rng(args.seed)
+    if args.inputtim:
+        toas = make_fake_toas_fromtim(
+            args.inputtim, model, add_noise=args.addnoise,
+            add_correlated_noise=args.addcorrnoise, rng=rng)
+    else:
+        toas = make_fake_toas_uniform(
+            args.startMJD, args.startMJD + args.duration, args.ntoa,
+            model, error_us=args.error, obs=args.obs,
+            freq_mhz=args.freq, add_noise=args.addnoise,
+            add_correlated_noise=args.addcorrnoise, rng=rng)
+    toas.write_TOA_file(args.timfile)
+    print(f"Wrote {toas.ntoas} simulated TOAs to {args.timfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

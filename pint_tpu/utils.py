@@ -1,0 +1,89 @@
+"""Analysis utilities: F-test, DMX parsing/statistics, weighted stats.
+
+Reference: src/pint/utils.py (FTest, dmxparse, weighted_mean,
+split_prefixed_name, taylor_horner — the latter two live in
+pint_tpu.models.parameter / pint_tpu.ops.taylor here and are
+re-exported for API familiarity).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from pint_tpu.models.parameter import split_prefixed_name  # noqa: F401
+from pint_tpu.ops.taylor import taylor_horner  # noqa: F401
+
+__all__ = ["FTest", "weighted_mean", "dmxparse",
+           "split_prefixed_name", "taylor_horner"]
+
+
+def FTest(chi2_1: float, dof_1: int, chi2_2: float, dof_2: int) -> float:
+    """F-test probability that the chi2 improvement from model 1 to the
+    (larger) model 2 arises by chance (reference: utils.FTest). Small
+    values favor keeping model 2's extra parameters."""
+    from scipy.stats import f as fdist
+
+    delta_chi2 = chi2_1 - chi2_2
+    delta_dof = dof_1 - dof_2
+    if delta_dof <= 0 or dof_2 <= 0:
+        raise ValueError("model 2 must have more free parameters")
+    if delta_chi2 <= 0:
+        return 1.0
+    F = (delta_chi2 / delta_dof) / (chi2_2 / dof_2)
+    return float(fdist.sf(F, delta_dof, dof_2))
+
+
+def weighted_mean(arr, sigma, axis=None):
+    """(mean, stderr) with 1/sigma^2 weights (reference:
+    utils.weighted_mean)."""
+    arr = np.asarray(arr, dtype=np.float64)
+    w = 1.0 / np.asarray(sigma, dtype=np.float64) ** 2
+    wsum = np.sum(w, axis=axis)
+    mean = np.sum(arr * w, axis=axis) / wsum
+    return mean, np.sqrt(1.0 / wsum)
+
+
+def dmxparse(fitter) -> dict:
+    """Collect DMX windows from a fitted model: per-window value,
+    (covariance-corrected) uncertainty, epoch range and center
+    (reference: utils.dmxparse). Returns dict of arrays:
+    dmxs, dmx_verrs, dmxeps (centers), r1s, r2s, bins."""
+    model = fitter.model
+    comp = model.components.get("DispersionDMX")
+    if comp is None or not comp.dmx_ids:
+        raise ValueError("model has no DMX windows")
+    names = ["Offset"] + list(model.free_params)
+    cov = fitter.parameter_covariance_matrix
+    dmxs, verrs, eps, r1s, r2s, bins = [], [], [], [], [], []
+    # mean-subtraction covariance correction (reference dmxparse):
+    # var(DMX_i - <DMX>) needs the full DMX block of the covariance
+    free_dmx = [f"DMX_{istr}" for _, istr in comp.dmx_ids
+                if not comp.params[f"DMX_{istr}"].frozen]
+    idx = [names.index(nm) for nm in free_dmx] \
+        if cov is not None and all(nm in names for nm in free_dmx) \
+        else []
+    sub = cov[np.ix_(idx, idx)] if idx else None
+    mean_var = float(np.mean(sub)) if sub is not None and len(idx) \
+        else 0.0
+    k = 0
+    for _, istr in comp.dmx_ids:
+        p = comp.params[f"DMX_{istr}"]
+        r1 = comp.params[f"DMXR1_{istr}"].value
+        r2 = comp.params[f"DMXR2_{istr}"].value
+        dmxs.append(p.value)
+        r1s.append(r1)
+        r2s.append(r2)
+        eps.append(0.5 * (r1 + r2))
+        bins.append(istr)
+        if not p.frozen and sub is not None and k < len(idx):
+            var = sub[k, k] - 2.0 * float(np.mean(sub[k])) + mean_var
+            verrs.append(np.sqrt(max(var, 0.0)))
+            k += 1
+        else:
+            verrs.append(p.uncertainty if p.uncertainty else 0.0)
+    return {"dmxs": np.array(dmxs), "dmx_verrs": np.array(verrs),
+            "dmxeps": np.array(eps), "r1s": np.array(r1s),
+            "r2s": np.array(r2s), "bins": bins,
+            "mean_dmx": float(np.mean(dmxs))}

@@ -1,0 +1,284 @@
+"""CLIs end-to-end, TCB->TDB conversion, derived quantities, analysis
+utils, model transforms (reference: src/pint/scripts/ + utils.py +
+derived_quantities.py + modelutils.py; test strategy SURVEY.md §4.6)."""
+
+import copy
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR J0012+0012
+RAJ 03:30:00.0 1
+DECJ 22:00:00.0 1
+F0 312.0 1
+F1 -4e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 21.0 1
+DMEPOCH 55500
+TZRMJD 55500.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+
+def _write_fixture(tmp_path, seed=0):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(PAR))
+        rng = np.random.default_rng(seed)
+        from pint_tpu.toa import merge_TOAs
+
+        tA = make_fake_toas_uniform(55000, 56000, 40, model,
+                                    error_us=1.0, freq_mhz=1400.0,
+                                    add_noise=True, rng=rng)
+        tB = make_fake_toas_uniform(55001, 55999, 40, model,
+                                    error_us=1.0, freq_mhz=820.0,
+                                    add_noise=True, rng=rng)
+        toas = merge_TOAs([tA, tB])
+    par = tmp_path / "fix.par"
+    tim = tmp_path / "fix.tim"
+    par.write_text(model.as_parfile())
+    toas.write_TOA_file(tim)
+    return model, toas, par, tim
+
+
+# ----------------------------------------------------------- pintempo
+
+
+def test_pintempo_end_to_end(tmp_path, capsys):
+    from pint_tpu.scripts.pintempo import main
+
+    model, toas, par, tim = _write_fixture(tmp_path)
+    out = tmp_path / "post.par"
+    rc = main([str(par), str(tim), "--outfile", str(out),
+               "--fitter", "wls", "--maxiter", "2"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "Postfit" in text or "post" in text.lower() or \
+        "chi2" in text
+    m2 = get_model(str(out))
+    assert m2.F0.value == pytest.approx(model.F0.value, abs=1e-9)
+
+
+# --------------------------------------------------------------- zima
+
+
+def test_zima_roundtrip(tmp_path, capsys):
+    from pint_tpu.scripts.zima import main
+
+    model, toas, par, tim = _write_fixture(tmp_path)
+    sim = tmp_path / "sim.tim"
+    rc = main([str(par), str(sim), "--ntoa", "25", "--startMJD",
+               "55100", "--duration", "300", "--addnoise",
+               "--seed", "7"])
+    assert rc == 0
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.toa import get_TOAs
+
+    t2 = get_TOAs(str(sim), model=model)
+    assert t2.ntoas == 25
+    r = Residuals(t2, model)
+    # simulated with 1 us noise: residual rms should be of that order
+    assert 0.2e-6 < r.rms_weighted() < 5e-6
+
+
+# ------------------------------------------------------------ pintbary
+
+
+def test_pintbary(capsys):
+    from pint_tpu.scripts.pintbary import main
+
+    rc = main(["56000.0", "--obs", "gbt", "--ra", "03:30:00.0",
+               "--dec", "22:00:00.0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    line = out.strip().splitlines()[-1]
+    bat = float(line.split("->")[1])
+    # TDB-UTC ~ 69 s plus Roemer +-500 s: within 0.01 d of input
+    assert abs(bat - 56000.0) < 0.01
+
+
+# ----------------------------------------------------------- TCB<->TDB
+
+
+def test_tcb_conversion_roundtrip():
+    from pint_tpu.models.tcb_conversion import (
+        IFTE_K,
+        T0_MJD,
+        convert_tcb_tdb,
+    )
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(PAR))
+    m_tcb = convert_tcb_tdb(m, backwards=True)
+    assert m_tcb.UNITS.value == "TCB"
+    # frequency scales DOWN going to TCB (TCB seconds are shorter)
+    assert m_tcb.F0.value < m.F0.value
+    assert m_tcb.F0.value == pytest.approx(m.F0.value / IFTE_K,
+                                           rel=1e-15)
+    assert m_tcb.DM.value > m.DM.value
+    # epoch maps through the fixed point
+    assert m_tcb.PEPOCH.value == pytest.approx(
+        T0_MJD + (m.PEPOCH.value - T0_MJD) * IFTE_K, abs=1e-8)
+    back = convert_tcb_tdb(m_tcb)
+    assert back.F0.value == pytest.approx(m.F0.value, rel=1e-15)
+    assert back.PEPOCH.value == pytest.approx(m.PEPOCH.value, abs=1e-9)
+
+
+def test_get_model_converts_tcb(tmp_path):
+    par_tcb = PAR.replace("UNITS TDB", "UNITS TCB")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m = get_model(io.StringIO(par_tcb))
+    assert m.UNITS.value == "TDB"
+    assert any("TCB" in str(x.message) for x in w)
+    # refusal path still available
+    with pytest.raises(ValueError):
+        get_model(io.StringIO(par_tcb), allow_tcb=False)
+
+
+def test_tcb2tdb_cli(tmp_path):
+    from pint_tpu.scripts.tcb2tdb import main
+
+    src = tmp_path / "in.par"
+    dst = tmp_path / "out.par"
+    src.write_text(PAR.replace("UNITS TDB", "UNITS TCB"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = main([str(src), str(dst)])
+    assert rc == 0
+    m = get_model(str(dst))
+    assert m.UNITS.value == "TDB"
+
+
+# ---------------------------------------------------- compare_parfiles
+
+
+def test_compare_parfiles_cli(tmp_path, capsys):
+    from pint_tpu.scripts.compare_parfiles import main
+
+    p1 = tmp_path / "a.par"
+    p2 = tmp_path / "b.par"
+    p1.write_text(PAR)
+    p2.write_text(PAR.replace("F0 312.0", "F0 312.00001"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = main([str(p1), str(p2)])
+    assert rc == 0
+    assert "F0" in capsys.readouterr().out
+
+
+# --------------------------------------------------- derived quantities
+
+
+def test_derived_quantities_closed_form():
+    import pint_tpu.derived_quantities as dq
+
+    # PSR B1913+16-like: Pb=0.3230 d, x=2.3418 lt-s
+    f = dq.mass_funct(0.322997, 2.3418)
+    assert f == pytest.approx(0.1322, rel=1e-3)
+    # m_c from known masses/inclination solves the cubic consistently
+    mc = dq.companion_mass(0.322997, 2.3418, i_deg=47.2, mp=1.441)
+    f2 = dq.mass_funct2(1.441, mc, 47.2)
+    assert f2 == pytest.approx(f, rel=1e-9)
+    # GR omdot for B1913+16: 4.226595 deg/yr at masses 1.4398+1.3886
+    w = dq.omdot(1.4398, 1.3886, 0.322997448918, 0.6171334)
+    assert w == pytest.approx(4.2266, rel=2e-3)
+    # GR pbdot for B1913+16 ~= -2.40263e-12
+    pb = dq.pbdot(1.4398, 1.3886, 0.322997448918, 0.6171334)
+    assert pb == pytest.approx(-2.40263e-12, rel=2e-3)
+    # gamma for B1913+16 ~= 4.307 ms
+    g = dq.gamma(1.4398, 1.3886, 0.322997448918, 0.6171334)
+    assert g == pytest.approx(4.307e-3, rel=2e-3)
+    # spin quantities: Crab-like F0=30 Hz, F1=-3.86e-10
+    age = dq.pulsar_age(29.946923, -3.77535e-10)
+    assert age == pytest.approx(1254, rel=0.01)  # years
+    b = dq.pulsar_B(29.946923, -3.77535e-10)
+    assert b == pytest.approx(3.8e12, rel=0.05)
+    edot = dq.pulsar_edot(29.946923, -3.77535e-10)
+    assert edot == pytest.approx(4.46e31, rel=0.05)
+    # shklovskii: mu=10 mas/yr at 1 kpc
+    a = dq.shklovskii_factor(10.0, 1.0)
+    assert a == pytest.approx(2.43e-19, rel=0.01)
+
+
+def test_ftest_and_weighted_mean():
+    from pint_tpu.utils import FTest, weighted_mean
+
+    # large chi2 drop for 1 dof -> tiny probability
+    assert FTest(200.0, 100, 120.0, 99) < 1e-8
+    # no improvement -> 1.0
+    assert FTest(100.0, 100, 100.0, 99) == 1.0
+    m, e = weighted_mean([1.0, 3.0], [1.0, 1.0])
+    assert m == pytest.approx(2.0)
+    assert e == pytest.approx(1.0 / np.sqrt(2.0))
+    m2, _ = weighted_mean([1.0, 3.0], [1.0, 1e6])
+    assert m2 == pytest.approx(1.0, abs=1e-6)
+
+
+def test_dmxparse(tmp_path):
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.utils import dmxparse
+
+    par = PAR.replace("DM 21.0 1", "DM 21.0") + (
+        "DMX_0001 0.0 1\nDMXR1_0001 55000\nDMXR2_0001 55500\n"
+        "DMX_0002 0.0 1\nDMXR1_0002 55500.5\nDMXR2_0002 56000\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(par))
+        rng = np.random.default_rng(2)
+        from pint_tpu.toa import merge_TOAs
+
+        toas = merge_TOAs([
+            make_fake_toas_uniform(55000, 56000, 40, model,
+                                   error_us=1.0, freq_mhz=1400.0,
+                                   add_noise=True, rng=rng),
+            make_fake_toas_uniform(55001, 55999, 40, model,
+                                   error_us=1.0, freq_mhz=820.0,
+                                   add_noise=True, rng=rng)])
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=2)
+    d = dmxparse(f)
+    assert d["dmxs"].shape == (2,)
+    assert np.all(d["dmx_verrs"] > 0)
+    assert d["dmxeps"][0] == pytest.approx(55250.0)
+    assert d["bins"] == ["0001", "0002"]
+
+
+def test_model_ecliptic_equatorial_roundtrip():
+    from pint_tpu.modelutils import (
+        model_ecliptic_to_equatorial,
+        model_equatorial_to_ecliptic,
+    )
+    from pint_tpu.residuals import Residuals
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(PAR.replace(
+            "RAJ 03:30:00.0 1", "RAJ 03:30:00.0 1\nPMRA 11.0 1"
+        ).replace("DECJ 22:00:00.0 1",
+                  "DECJ 22:00:00.0 1\nPMDEC -7.0 1")))
+        toas = make_fake_toas_uniform(55000, 56000, 30, m,
+                                      error_us=1.0)
+    mec = model_equatorial_to_ecliptic(m)
+    assert "AstrometryEcliptic" in mec.components
+    r1 = np.asarray(Residuals(toas, m).time_resids)
+    r2 = np.asarray(Residuals(toas, mec).time_resids)
+    # same sky position: residuals agree to sub-ns
+    np.testing.assert_allclose(r1, r2, atol=2e-9)
+    back = model_ecliptic_to_equatorial(mec)
+    assert back.get_param("RAJ").value == pytest.approx(
+        m.get_param("RAJ").value, abs=1e-12)
+    assert back.get_param("PMRA").value == pytest.approx(11.0,
+                                                        rel=1e-9)
+    assert back.get_param("PMDEC").value == pytest.approx(-7.0,
+                                                          rel=1e-9)
