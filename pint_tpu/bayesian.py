@@ -24,9 +24,38 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["BayesianTiming"]
+__all__ = ["BayesianTiming", "build_batched_phase_eval"]
 
 LN2PI = float(np.log(2.0 * np.pi))
+
+
+def build_batched_phase_eval(model, toas):
+    """(theta0, frac_fn): the shared sampling plumbing. ``frac_fn`` is
+    a traceable function tl_eff -> fractional phase (f64, N), where
+    tl_eff = tl0 + (theta - theta0) formed on the HOST — the parameter
+    point enters only through the dd LOW word, so every representable
+    theta evaluates exactly (putting theta in the hi word would
+    quantize perturbations of large parameters to ulp(value), ~0.1
+    sigma for F0 at typical MSP precision). theta0 carries .tl0 as an
+    attribute-free second return: returns (theta0, tl0, frac_fn).
+    Used by BayesianTiming and PhotonMCMCFitter."""
+    phase_fn, _ = model._build_phase_fn()
+    cache = model.get_cache(toas)
+    free, frozen, th, tl, fh, fl = model._pack()
+    batch = cache["batch"]
+    sc = {k: v for k, v in cache.items() if k != "batch"}
+    tl_j, fh_j, fl_j = map(jnp.asarray, (tl, fh, fl))
+    th0 = np.asarray(th, dtype=np.float64)
+    th0_j = jnp.asarray(th0)
+
+    def frac_fn(tl_eff):
+        from pint_tpu.ops.dd import dd_frac
+
+        ph = phase_fn(th0_j, tl_eff, fh_j, fl_j, batch, sc)[0]
+        f = dd_frac(ph)
+        return f.hi + f.lo
+
+    return th0, np.asarray(tl, dtype=np.float64), frac_fn
 
 
 class BayesianTiming:
@@ -41,23 +70,14 @@ class BayesianTiming:
         self._priors = [model.get_param(p).prior
                         for p in self.param_labels]
 
-        phase_fn, _ = model._build_phase_fn()
-        cache = model.get_cache(toas)
-        free, frozen, th, tl, fh, fl = model._pack()
+        free = model._pack()[0]
         if free != self.param_labels:
             raise ValueError(
                 "free_params / packed-parameter mismatch: "
                 f"{sorted(set(free) ^ set(self.param_labels))}")
-        if "F0" in free:
-            i = free.index("F0")
-            f0 = th[i] + tl[i]
-        else:
-            i = frozen.index("F0")
-            f0 = fh[i] + fl[i]
-        batch = cache["batch"]
-        sc = {k: v for k, v in cache.items() if k != "batch"}
-        tl_j, fh_j, fl_j = map(jnp.asarray, (tl, fh, fl))
-        self.theta0 = np.asarray(th, dtype=np.float64)
+        f0 = float(model.F0.value)
+        self.theta0, self._tl0, frac_fn = build_batched_phase_eval(
+            model, toas)
 
         nvec = jnp.asarray(model.scaled_toa_uncertainty(toas) ** 2)
         w = 1.0 / nvec
@@ -119,23 +139,12 @@ class BayesianTiming:
             self._lnnorm = -0.5 * logdet - 0.5 * n * LN2PI
 
         lnnorm = self._lnnorm
-        th0_j = jnp.asarray(self.theta0)
-        self._tl0 = np.asarray(tl, dtype=np.float64)
 
         def lnlike_core(tl_eff):
-            # the parameter point enters ONLY through the dd LOW word
-            # (tl_eff = tl0 + (theta - theta0), formed on the host):
-            # exact for every representable theta, where putting theta
-            # itself in the hi word would quantize perturbations of
-            # large parameters to ulp(value) — ~0.1 sigma for F0 at
-            # typical MSP precision. tl_eff is a jit INPUT, not a
-            # captured constant, so XLA cannot constant-fold the tiny
-            # low word away against th0.
-            frac_dd = phase_fn(th0_j, tl_eff, fh_j, fl_j, batch, sc)[0]
-            from pint_tpu.ops.dd import dd_frac
-
-            f = dd_frac(frac_dd)
-            frac = f.hi + f.lo
+            # tl_eff is a jit INPUT, not a captured constant, so XLA
+            # cannot constant-fold the tiny low word away against th0
+            # (see build_batched_phase_eval)
+            frac = frac_fn(tl_eff)
             wmean = jnp.sum(frac * w) / jnp.sum(w)
             r = (frac - wmean) / f0
             rCr = jnp.sum(r * r * w)

@@ -1,0 +1,54 @@
+"""Runtime data / configuration access.
+
+Reference: src/pint/config.py (runtimefile, datadir, examplefile) +
+the env-var override set the reference honors ($PINT_CLOCK_OVERRIDE
+etc.; SURVEY.md §5 config row). Here:
+
+- data shipped with the package is embedded in source modules (sites,
+  leap seconds, nutation tables) — datadir() points at the package;
+- $PINT_TPU_CLOCK_DIR   : directory of TEMPO/TEMPO2 clock files
+- $PINT_TPU_EPHEM_DIR   : directory of SPK .bsp ephemeris kernels
+- $PINT_TPU_OBS_OVERRIDE: JSON file overriding the observatory table
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+__all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
+           "obs_override"]
+
+
+def datadir() -> Path:
+    """Package directory (embedded runtime data lives in modules)."""
+    return Path(__file__).resolve().parent
+
+
+def runtimefile(name: str) -> Path:
+    """Path of a runtime data file; checks the override dirs first
+    (reference: config.runtimefile)."""
+    for env in ("PINT_TPU_CLOCK_DIR", "PINT_TPU_EPHEM_DIR"):
+        d = os.environ.get(env)
+        if d and (Path(d) / name).exists():
+            return Path(d) / name
+    p = datadir() / "data" / name
+    if p.exists():
+        return p
+    raise FileNotFoundError(f"no runtime file {name!r}")
+
+
+def clock_dir() -> Optional[Path]:
+    d = os.environ.get("PINT_TPU_CLOCK_DIR")
+    return Path(d) if d else None
+
+
+def ephem_dir() -> Optional[Path]:
+    d = os.environ.get("PINT_TPU_EPHEM_DIR")
+    return Path(d) if d else None
+
+
+def obs_override() -> Optional[Path]:
+    d = os.environ.get("PINT_TPU_OBS_OVERRIDE")
+    return Path(d) if d else None

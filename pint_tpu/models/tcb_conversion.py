@@ -135,10 +135,15 @@ def convert_tcb_tdb(model, backwards: bool = False):
     if units != src:
         raise ValueError(f"model UNITS is {units}, expected {src}")
     K = 1.0 / IFTE_K if backwards else IFTE_K
-    # exact dd of 1/K: (1 - L_B) is exactly 1 + (-L_B) in dd
+    # exact dd factors: (1 - L_B) is exactly 1 + (-L_B) in dd
     one_minus = dd_np.add_f(dd_np.dd(1.0), -L_B)
-    K_dd_inv = one_minus if not backwards else dd_np.div(
-        dd_np.dd(1.0), one_minus)
+    inv_one_minus = dd_np.div(dd_np.dd(1.0), one_minus)
+    # K_dd multiplies values of positive time dimension; K_dd_inv maps
+    # epochs/intervals (forward: intervals shrink by (1-L_B))
+    if backwards:
+        K_dd, K_dd_inv = one_minus, inv_one_minus
+    else:
+        K_dd, K_dd_inv = inv_one_minus, one_minus
     new = copy.deepcopy(model)
     unclassified = []
     for comp in new.components.values():
@@ -154,7 +159,13 @@ def convert_tcb_tdb(model, backwards: bool = False):
                 unclassified.append(name)
                 continue
             if n:
-                p.value = p.value * K ** n
+                # scale in dd so long-precision values (F0 given to 20
+                # digits) keep their sub-ulp residue
+                f = K_dd_inv if n < 0 else K_dd
+                scaled = p.dd
+                for _ in range(abs(n)):
+                    scaled = dd_np.mul(scaled, f)
+                p.set_dd((float(scaled[0]), float(scaled[1])))
                 if p.uncertainty is not None:
                     p.uncertainty = p.uncertainty * K ** n
     if unclassified:

@@ -74,12 +74,35 @@ def _convert(model, to_ecliptic: bool):
     vals = (lon1, lat1, pm_lon, pm_lat)
     for nm, val in zip(out_names, vals):
         dst.params[nm].value = val
-    for nm_src, nm_dst in zip(
-            ("RAJ", "DECJ", "PMRA", "PMDEC") if to_ecliptic else
-            ("ELONG", "ELAT", "PMELONG", "PMELAT"), out_names):
+    # rotate the on-sky error ellipse (diagonal approximation): the
+    # east/north variances mix through the same position-angle rotation
+    # as the PM vector; longitude errors carry 1/cos(lat) coordinate
+    # factors (east = d(lon) cos(lat))
+    in_names = ("RAJ", "DECJ", "PMRA", "PMDEC") if to_ecliptic else \
+        ("ELONG", "ELAT", "PMELONG", "PMELAT")
+    c_rot = float((M @ e0) @ e1)
+    s_rot = float((M @ e0) @ n1)
+    sig_lon0 = src.params[in_names[0]].uncertainty
+    sig_lat0 = src.params[in_names[1]].uncertainty
+    if sig_lon0 is not None and sig_lat0 is not None:
+        ve0 = (sig_lon0 * np.cos(lat0)) ** 2
+        vn0 = sig_lat0 ** 2
+        ve1 = c_rot ** 2 * ve0 + s_rot ** 2 * vn0
+        vn1 = s_rot ** 2 * ve0 + c_rot ** 2 * vn0
+        dst.params[out_names[0]].uncertainty = float(
+            np.sqrt(ve1) / np.cos(lat1))
+        dst.params[out_names[1]].uncertainty = float(np.sqrt(vn1))
+    spm_lon = src.params[in_names[2]].uncertainty
+    spm_lat = src.params[in_names[3]].uncertainty
+    if spm_lon is not None and spm_lat is not None:
+        # PM components are already on-sky (mu_lon* includes cos lat)
+        ve1 = c_rot ** 2 * spm_lon ** 2 + s_rot ** 2 * spm_lat ** 2
+        vn1 = s_rot ** 2 * spm_lon ** 2 + c_rot ** 2 * spm_lat ** 2
+        dst.params[out_names[2]].uncertainty = float(np.sqrt(ve1))
+        dst.params[out_names[3]].uncertainty = float(np.sqrt(vn1))
+    for nm_src, nm_dst in zip(in_names, out_names):
         sp = src.params[nm_src]
         dst.params[nm_dst].frozen = sp.frozen
-        dst.params[nm_dst].uncertainty = sp.uncertainty
     for shared in ("PX", "POSEPOCH", "PMRV"):
         if shared in src.params and shared in dst.params:
             sp, dp = src.params[shared], dst.params[shared]

@@ -1,0 +1,94 @@
+"""MCMC-optimize a timing model against photon phases with a template
+likelihood (reference: src/pint/scripts/event_optimize.py; emcee pool
+replaced by the in-repo batched ensemble sampler)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+__all__ = ["main"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="event_optimize",
+        description="MCMC timing-model optimization on photon events")
+    p.add_argument("eventfile", help="barycentered event FITS")
+    p.add_argument("parfile")
+    p.add_argument("--mission", default=None)
+    p.add_argument("--weightcol", default=None)
+    p.add_argument("--ncomp", type=int, default=1,
+                   help="Gaussian components in the seed template")
+    p.add_argument("--nwalkers", type=int, default=32)
+    p.add_argument("--nsteps", type=int, default=200)
+    p.add_argument("--burn", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--outfile", default=None,
+                   help="write the optimized par file here")
+    args = p.parse_args(argv)
+
+    from pint_tpu.event_toas import get_event_weights, load_fits_TOAs
+    from pint_tpu.eventstats import h_sig, hmw
+    from pint_tpu.mcmc_fitter import PhotonMCMCFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.templates import LCFitter, LCGaussian, LCTemplate
+
+    model = get_model(args.parfile)
+    toas = load_fits_TOAs(args.eventfile, mission=args.mission,
+                          weightcolumn=args.weightcol,
+                          ephem=model.EPHEM.value,
+                          planets=bool(model.PLANET_SHAPIRO.value))
+    weights = get_event_weights(toas)
+    phases = np.mod(np.asarray(model.phase(toas).frac), 1.0)
+    h0 = hmw(phases, weights)
+    print(f"Read {toas.ntoas} photons; initial Htest {h0:.1f} "
+          f"({h_sig(h0):.1f} sigma)")
+
+    # seed template by ML on the initial phases; the peak location
+    # comes from the first Fourier harmonic (a far-off location seed
+    # collapses the ML fit into the uniform-background local minimum)
+    w = weights if weights is not None else np.ones_like(phases)
+    c1 = np.sum(w * np.exp(2j * np.pi * phases))
+    loc0 = float(np.angle(c1) / (2 * np.pi)) % 1.0
+    pulsed_frac = min(0.9, max(0.1,
+                               2.0 * np.abs(c1) / np.sum(w)))
+    ncomp = max(1, args.ncomp)
+    prims = [LCGaussian() for _ in range(ncomp)]
+    locs = [(loc0 + k / ncomp) % 1.0 for k in range(ncomp)]
+    template = LCTemplate(prims, norms=[pulsed_frac / ncomp] * ncomp,
+                          locs=locs, widths=[0.05] * ncomp)
+    tfit = LCFitter(template, phases, weights=weights)
+    res = tfit.fit()
+    print(f"Template ML: logL={res['loglikelihood']:.1f} "
+          f"locs={np.round(template.locs, 4)} "
+          f"norms={np.round(template.norms, 3)}")
+    if template.norms.sum() < 0.05:
+        print("WARNING: template collapsed to background — phases may "
+              "be unpulsed or the seed failed; aborting before MCMC")
+        return 1
+
+    rng = np.random.default_rng(args.seed)
+    fitter = PhotonMCMCFitter(toas, model, template, weights=weights,
+                              nwalkers=args.nwalkers, rng=rng)
+    lnmax = fitter.fit_toas(nsteps=args.nsteps, burn=args.burn)
+    print(f"MCMC done: acc="
+          f"{fitter.sampler.acceptance_fraction:.2f} "
+          f"max lnL={lnmax:.1f}")
+    phases2 = np.mod(np.asarray(model.phase(toas).frac), 1.0)
+    h1 = hmw(phases2, weights)
+    print(f"Final Htest {h1:.1f} ({h_sig(h1):.1f} sigma)")
+    for name in fitter.param_labels:
+        par = model.get_param(name)
+        print(f"  {name} = {par.value} +- {par.uncertainty:.3g}")
+    if args.outfile:
+        with open(args.outfile, "w") as fh:
+            fh.write(model.as_parfile())
+        print(f"Wrote {args.outfile}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

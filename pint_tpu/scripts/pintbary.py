@@ -42,6 +42,19 @@ def main(argv=None) -> int:
     else:
         p.error("give --parfile or --ra/--dec")
 
+    # barycentering stops at solar-system delays: strip any binary
+    # component (the reference pintbary likewise never removes the
+    # orbital delay)
+    binaries = [nm for nm in model.components
+                if nm.startswith("Binary")]
+    if binaries:
+        import copy
+
+        model = copy.deepcopy(model)
+        for nm in binaries:
+            model.remove_component(nm)
+        model.invalidate_cache()
+
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         toas = get_TOAs_array(np.asarray(args.mjds, dtype=np.float64),

@@ -1,0 +1,185 @@
+"""Ensemble sampler + MCMC fitters + logging/config modules
+(reference: src/pint/sampler.py, mcmc_fitter.py, logging.py,
+config.py; oracle: posterior moments must match the least-squares
+covariance on simulated data)."""
+
+import copy
+import io
+import logging as stdlib_logging
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.sampler import EnsembleSampler
+from pint_tpu.simulation import make_fake_toas_uniform
+from pint_tpu.toa import merge_TOAs
+
+
+# ------------------------------------------------------------ sampler
+
+
+def test_sampler_gaussian_target():
+    """The ensemble reproduces a 2-D Gaussian's moments."""
+    cov = np.array([[2.0, 0.6], [0.6, 1.0]])
+    icov = np.linalg.inv(cov)
+
+    def lp(x):
+        x = np.atleast_2d(x)
+        return -0.5 * np.einsum("si,ij,sj->s", x, icov, x)
+
+    rng = np.random.default_rng(0)
+    s = EnsembleSampler(40, 2, lp, rng=rng)
+    p0 = rng.standard_normal((40, 2))
+    s.run_mcmc(p0, 1500)
+    assert 0.2 < s.acceptance_fraction < 0.9
+    flat = s.get_chain(discard=500, flat=True)
+    est = np.cov(flat.T)
+    np.testing.assert_allclose(est, cov, rtol=0.15, atol=0.1)
+    assert np.abs(flat.mean(axis=0)).max() < 0.15
+
+
+def test_sampler_validates():
+    def lp(x):
+        return np.zeros(len(np.atleast_2d(x)))
+
+    with pytest.raises(ValueError):
+        EnsembleSampler(3, 2, lp)  # odd
+    with pytest.raises(ValueError):
+        EnsembleSampler(2, 2, lp)  # < 2*ndim
+    s = EnsembleSampler(8, 2, lambda x: np.full(
+        len(np.atleast_2d(x)), -np.inf))
+    with pytest.raises(ValueError):
+        s.run_mcmc(np.zeros((8, 2)), 5)
+
+
+# --------------------------------------------------------- MCMCFitter
+
+
+@pytest.fixture(scope="module")
+def fitted_problem():
+    par = """
+PSR J0014+0014
+RAJ 04:30:00.0
+DECJ 18:00:00.0
+F0 275.0 1
+F1 -3e-15 1
+PEPOCH 55500
+POSEPOCH 55500
+DM 14.0
+TZRMJD 55500.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(par))
+        rng = np.random.default_rng(9)
+        toas = merge_TOAs([
+            make_fake_toas_uniform(55000, 56000, 40, model,
+                                   error_us=1.0, freq_mhz=1400.0,
+                                   add_noise=True, rng=rng),
+            make_fake_toas_uniform(55001, 55999, 40, model,
+                                   error_us=1.0, freq_mhz=820.0,
+                                   add_noise=True, rng=rng)])
+        from pint_tpu.fitter import WLSFitter
+
+        m = copy.deepcopy(model)
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=2)
+    return model, m, toas, f
+
+
+def test_mcmc_fitter_matches_wls(fitted_problem):
+    from pint_tpu.mcmc_fitter import MCMCFitter
+
+    truth, mfit, toas, wls = fitted_problem
+    m = copy.deepcopy(mfit)
+    mc = MCMCFitter(toas, m, nwalkers=16,
+                    rng=np.random.default_rng(1))
+    chi2 = mc.fit_toas(nsteps=400)
+    assert np.isfinite(chi2)
+    assert mc.stats is not None
+    assert mc.sampler.acceptance_fraction > 0.1
+    for name in ("F0", "F1"):
+        # posterior width within a factor ~2 of the WLS sigma and the
+        # median consistent with the WLS solution
+        assert 0.4 < mc.errors[name] / wls.errors[name] < 2.5, name
+        assert abs(m.get_param(name).value
+                   - mfit.get_param(name).value) \
+            < 4 * wls.errors[name], name
+
+
+# --------------------------------------------- photon template MCMC
+
+
+def test_photon_mcmc_recovers_f0(fitted_problem):
+    from pint_tpu.mcmc_fitter import PhotonMCMCFitter
+    from pint_tpu.templates import LCGaussian, LCTemplate
+
+    truth, _, _, _ = fitted_problem
+    rng = np.random.default_rng(4)
+    template = LCTemplate([LCGaussian()], norms=[0.7], locs=[0.4],
+                          widths=[0.03])
+    # photons drawn on the truth model's phase grid
+    n = 1500
+    base = rng.uniform(55400, 55600, n)
+    phi = template.random(n, rng=rng)
+    f0 = truth.F0.value
+    f1 = truth.F1.value
+    pep = truth.PEPOCH.value
+    dt = (base - pep) * 86400.0
+    k = np.floor(dt * f0)
+    tsec = (k + phi) / f0 - 0.5 * f1 / f0 * ((k + phi) / f0) ** 2
+    mjd = pep + tsec / 86400.0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.toa import get_TOAs_array
+
+        toas = get_TOAs_array(np.sort(mjd), obs="barycenter",
+                              freqs=np.inf, errors=1.0)
+    m = copy.deepcopy(truth)
+    m.get_param("F1").frozen = True
+    m.invalidate_cache()
+    fitter = PhotonMCMCFitter(toas, m, template,
+                              nwalkers=16,
+                              rng=np.random.default_rng(2))
+    fitter.fit_toas(nsteps=150, scatter=2e-12)
+    # F0 recovered to sub-mHz (phase coherence over 200 d)
+    assert abs(m.F0.value - f0) < 5e-8
+    assert fitter.errors["F0"] < 1e-7
+
+
+# ------------------------------------------------------ logging/config
+
+
+def test_logging_setup_and_dedup(capsys):
+    import pint_tpu.logging as plog
+
+    buf = io.StringIO()
+    log = plog.setup(level="INFO", sink=buf)
+    for _ in range(5):
+        log.info("repeated message")
+    log.info("other message")
+    out = buf.getvalue()
+    assert out.count("repeated message") == 1
+    assert "other message" in out
+    # level filtering
+    log.debug("hidden")
+    assert "hidden" not in buf.getvalue()
+    assert isinstance(log, stdlib_logging.Logger)
+
+
+def test_config_env_overrides(tmp_path, monkeypatch):
+    import pint_tpu.config as cfg
+
+    assert cfg.datadir().name == "pint_tpu"
+    assert cfg.clock_dir() is None or cfg.clock_dir().exists() or True
+    monkeypatch.setenv("PINT_TPU_CLOCK_DIR", str(tmp_path))
+    assert cfg.clock_dir() == tmp_path
+    (tmp_path / "time_gbt.dat").write_text("# clock\n")
+    assert cfg.runtimefile("time_gbt.dat") == tmp_path / "time_gbt.dat"
+    with pytest.raises(FileNotFoundError):
+        cfg.runtimefile("nonexistent.dat")

@@ -197,6 +197,13 @@ UNITS TDB
     assert amp == pytest.approx(499.005, rel=2e-3)
 
 
-def test_tcb_refused():
+def test_tcb_converted_by_default_refused_on_request():
+    import warnings as _w
+
+    par = "PSR X\nF0 10\nPEPOCH 55000\nUNITS TCB\n"
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+    assert m.UNITS.value == "TDB"  # converted on load
     with pytest.raises(ValueError, match="TCB"):
-        get_model(io.StringIO("PSR X\nF0 10\nPEPOCH 55000\nUNITS TCB\n"))
+        get_model(io.StringIO(par), allow_tcb=False)
