@@ -1,0 +1,98 @@
+"""PTA batch benchmark (BASELINE.md config #5): 67 heterogeneous
+pulsars fit as ONE vmapped GLS solve per iteration on the accelerator.
+
+Not part of the driver's bench.py protocol (that measures the single-
+pulsar GLS north star); run manually:
+
+    python bench_pta.py [--npulsars 67] [--ntoa 100]
+
+Prints one JSON line {metric, value, unit, npulsars, ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import time
+import warnings
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_pulsar(k: int, ntoa: int):
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    binary = ""
+    if k % 3 == 1:  # a third of the array is ELL1 binaries
+        binary = (f"BINARY ELL1\nPB {0.4 + 0.02 * k}\nA1 1.3 1\n"
+                  "TASC 55000.05\nEPS1 1e-5 1\nEPS2 -2e-5 1\n")
+    par = f"""PSR J{1000 + k}
+RAJ {(k * 17) % 24}:{(k * 7) % 60:02d}:00.0 1
+DECJ {-30 + (k % 60)}:00:00.0 1
+F0 {120.0 + 11.0 * k} 1
+F1 {-1e-15 * (1 + k % 5)} 1
+PEPOCH 55000
+POSEPOCH 55000
+DM {5.0 + 0.7 * k} 1
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+{binary}"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        rng = np.random.default_rng(k)
+        t = make_fake_toas_uniform(54000, 56000, ntoa, m, error_us=1.0,
+                                   add_noise=True, rng=rng)
+    truth = {"F0": m.F0.value, "DM": m.get_param("DM").value}
+    m.F0.add_delta(1e-10)
+    m.invalidate_cache(params_only=True)
+    return m, t, truth
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--npulsars", type=int, default=67)
+    ap.add_argument("--ntoa", type=int, default=100)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from pint_tpu.parallel import fit_pta
+
+    log(f"backend: {jax.default_backend()}")
+    t0 = time.perf_counter()
+    pulsars = [build_pulsar(k, args.ntoa)
+               for k in range(args.npulsars)]
+    log(f"built {len(pulsars)} pulsars in "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    res = fit_pta([(t, m) for m, t, _ in pulsars], maxiter=2)
+    stats = fit_pta.last_stats
+    n_ok = sum(1 for (m, t, truth), r in zip(pulsars, res)
+               if abs(m.F0.value - truth["F0"])
+               < 5 * r["errors"]["F0"])
+    log(f"recovered F0 within 5 sigma: {n_ok}/{len(pulsars)}")
+    log(f"stats: {stats}")
+    print(json.dumps({
+        "metric": "pta_batch_fit_throughput",
+        "value": round(stats["toas_per_sec"], 1),
+        "unit": "TOA/s",
+        "npulsars": args.npulsars,
+        "ntoa_total": stats["ntoa_total"],
+        "device_solve_s": round(stats["device_solve_s"], 3),
+        "recovered": n_ok,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -154,13 +154,21 @@ def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,
     pulsar's linearized problem on the host (heterogeneous models), then
     solves ALL of them in one vmapped device call and applies the
     updates. Returns per-pulsar {chi2, errors} (models updated in
-    place)."""
+    place); the list carries aggregate stats in ``fit_pta.last_stats``
+    (SURVEY §5 scoreboard: total TOAs, wall time, TOAs/sec, device
+    solve time)."""
+    import time as _time
+
+    t_start = _time.perf_counter()
+    solve_s = 0.0
     out: List[dict] = [dict() for _ in pairs]
     for _ in range(max(1, maxiter)):
         problems = [build_problem(t, m, track_mode=track_mode)
                     for t, m in pairs]
         stacked = stack_problems(problems)
+        t0 = _time.perf_counter()
         dparams, cov, chi2 = pta_solve(stacked, mesh=mesh)
+        solve_s += _time.perf_counter() - t0
         for k, pr in enumerate(problems):
             names = pr.names
             x = dparams[k][:len(names)]
@@ -173,7 +181,9 @@ def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,
     problems = [build_problem(t, m, track_mode=track_mode)
                 for t, m in pairs]
     stacked = stack_problems(problems)
+    t0 = _time.perf_counter()
     dparams, cov, chi2 = pta_solve(stacked, mesh=mesh)
+    solve_s += _time.perf_counter() - t0
     for k, pr in enumerate(problems):
         errs = {}
         sig = np.sqrt(np.diag(cov[k]))
@@ -183,4 +193,12 @@ def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,
             pr.model.get_param(name).uncertainty = float(sig[j])
             errs[name] = float(sig[j])
         out[k] = {"chi2": float(chi2[k]), "errors": errs}
+    wall = _time.perf_counter() - t_start
+    ntoa_total = sum(t.ntoas for t, _ in pairs)
+    fit_pta.last_stats = {
+        "npulsars": len(pairs), "ntoa_total": ntoa_total,
+        "iterations": max(1, maxiter) + 1, "wall_time_s": wall,
+        "device_solve_s": solve_s,
+        "toas_per_sec": ntoa_total * (max(1, maxiter) + 1) / wall
+        if wall else 0.0}
     return out

@@ -167,10 +167,52 @@ def gast06(ut1_mjd, tt_mjd):
     return (gmst06(ut1_mjd, tt_mjd) + dpsi * np.cos(eps)) % (2 * np.pi)
 
 
+# ------------------------------------------------ EOP (IERS) hooks
+# The reference gets dUT1/polar motion from downloaded IERS tables via
+# astropy; offline they default to zero. set_eop installs a table (the
+# same pluggable pattern as clock files): UT1 = UTC + interp(dut1), and
+# polar motion rotates the ITRF vector before the Earth-rotation chain.
+
+_EOP = None  # (mjd, dut1_s, xp_rad, yp_rad) arrays or None
+
+
+def set_eop(mjd, dut1_s, xp_arcsec=None, yp_arcsec=None):
+    """Install an Earth-orientation table (reference analog: the IERS-A
+    table astropy downloads). Linear interpolation; outside the table
+    range the edge values hold."""
+    mjd = np.asarray(mjd, np.float64)
+    global _EOP
+    _EOP = (
+        mjd,
+        np.asarray(dut1_s, np.float64),
+        np.asarray(xp_arcsec, np.float64) * ASEC2RAD
+        if xp_arcsec is not None else np.zeros_like(mjd),
+        np.asarray(yp_arcsec, np.float64) * ASEC2RAD
+        if yp_arcsec is not None else np.zeros_like(mjd),
+    )
+
+
+def clear_eop():
+    global _EOP
+    _EOP = None
+
+
+def _eop_at(utc_mjd):
+    """(dut1_s, xp_rad, yp_rad) at the given UTC epochs."""
+    if _EOP is None:
+        z = np.zeros_like(np.asarray(utc_mjd, np.float64))
+        return z, z, z
+    mjd, dut1, xp, yp = _EOP
+    u = np.asarray(utc_mjd, np.float64)
+    return (np.interp(u, mjd, dut1), np.interp(u, mjd, xp),
+            np.interp(u, mjd, yp))
+
+
 def itrf_to_gcrs_posvel(itrf_xyz_m, utc_mjd, tt_mjd):
     """Observatory ITRF (x,y,z) [m] → GCRS position [m] and velocity [m/s]
     at the given epochs (reference: src/pint/erfautils.py
-    gcrs_posvel_from_itrf). UT1≈UTC; polar motion ≈ I.
+    gcrs_posvel_from_itrf). UT1 = UTC + dUT1 and polar motion from the
+    installed EOP table (zero without one — ≤40 cm / ≤1.3 ns Roemer).
 
     itrf_xyz_m: (3,) site vector. utc/tt_mjd: (N,) epochs.
     Returns pos (N,3), vel (N,3).
@@ -178,15 +220,24 @@ def itrf_to_gcrs_posvel(itrf_xyz_m, utc_mjd, tt_mjd):
     itrf = np.asarray(itrf_xyz_m, np.float64)
     utc_mjd = np.atleast_1d(np.asarray(utc_mjd, np.float64))
     tt_mjd = np.atleast_1d(np.asarray(tt_mjd, np.float64))
+    dut1, xp, yp = _eop_at(utc_mjd)
+    ut1_mjd = utc_mjd + dut1 / 86400.0
     # compute the nutation series once — shared by GAST and the N matrix
     eps = obliquity06(tt_mjd)
     dpsi, deps = nutation00b_truncated(tt_mjd)
-    gast = (gmst06(utc_mjd, tt_mjd) + dpsi * np.cos(eps)) % (2 * np.pi)
+    gast = (gmst06(ut1_mjd, tt_mjd) + dpsi * np.cos(eps)) % (2 * np.pi)
     # true-of-date equatorial coords of the site
     cg, sg = np.cos(gast), np.sin(gast)
     x, y, z = itrf
+    if _EOP is not None:
+        # small-angle polar motion ITRS→TIRS, W ≈ R2(xp) R1(yp)
+        # dropping the tiny s' term: r_TIRS = (x − xp z, y + yp z,
+        # z + xp x − yp y)
+        x, y, z = (x - xp * z,
+                   y + yp * z,
+                   z + xp * itrf[0] - yp * itrf[1])
     tod_pos = np.stack([cg * x - sg * y, sg * x + cg * y,
-                        np.full_like(cg, z)], -1)
+                        np.broadcast_to(z, cg.shape)], -1)
     # velocity: d/dt R3(−GAST) — Earth rotation dominates (precession
     # rates are ~1e-12 rad/s, negligible vs 7.3e-5)
     tod_vel = OMEGA_EARTH * np.stack(

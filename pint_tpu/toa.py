@@ -189,7 +189,13 @@ class TOAs:
 
     def compute_TDBs(self, ephem=None):
         """UTC(site) → TT → TDB per TOA (reference: TOAs.compute_TDBs).
-        Barycenter-site TOAs are already TDB and pass through."""
+        Barycenter-site TOAs are already TDB and pass through.
+
+        For ground sites the topocentric TDB−TT term
+        +(v_earth . r_obs)/c^2 (Moyer; diurnal, amplitude ~2.1 us) is
+        applied on top of the geocentric Fairhead–Bretagnon series —
+        the reference gets the same term via location-aware astropy
+        Time conversions."""
         tdb_day = np.array(self.mjd_day)
         fhi = np.array(self.mjd_frac[0])
         flo = np.array(self.mjd_frac[1])
@@ -201,6 +207,27 @@ class TOAs:
             frac = (self.mjd_frac[0][utc_mask], self.mjd_frac[1][utc_mask])
             tt = scales.utc_mjd_to_tt_mjd(day, frac)
             tdb = scales.tt_mjd_to_tdb_mjd(tt)
+            # topocentric term per ground site
+            tt_f64 = dd_np.to_f64(tt)
+            utc_f64 = (day + frac[0] + frac[1])
+            dt_topo = np.zeros_like(tt_f64)
+            sub_obs = [o for o, m in zip(self.obs, utc_mask) if m]
+            topo_sites = {o for o in sub_obs
+                          if getattr(get_observatory(o), "itrf_xyz_m",
+                                     None) is not None}
+            if topo_sites:
+                eph = get_ephemeris(ephem)
+                # earth velocity [m/s]; tt is within ~2 ms of tdb —
+                # far below the velocity's variation scale
+                _, v_earth = eph.ssb_posvel("earth", tt_f64)
+                for site in topo_sites:
+                    m = np.array([o == site for o in sub_obs])
+                    obs = get_observatory(site)
+                    r_m, _ = obs.gcrs_posvel(utc_f64[m], tt_f64[m])
+                    dt_topo[m] = np.sum(v_earth[m] * r_m,
+                                        axis=-1) / c_m_s ** 2
+            tdb = dd_np.add(tdb, dd_np.div_f(dd_np.dd(dt_topo),
+                                             SECS_PER_DAY))
             # renormalize to (int day, frac) — keep day integral for exact
             # downstream (day − epoch) arithmetic
             d = np.round(tdb[0])

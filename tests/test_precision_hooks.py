@@ -1,0 +1,85 @@
+"""Precision upgrades: EOP (dUT1/polar motion) hooks and the
+topocentric TDB-TT term (reference: astropy/IERS machinery the
+reference leans on — SURVEY.md §2b liberfa row, A.3)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.time import frames
+
+
+@pytest.fixture(autouse=True)
+def _clean_eop():
+    yield
+    frames.clear_eop()
+
+
+GBT = np.array([882589.65, -4924872.32, 3943729.35])
+
+
+def test_dut1_rotates_position():
+    utc = np.array([55000.0, 55000.3])
+    tt = utc + 66.184 / 86400.0
+    p0, v0 = frames.itrf_to_gcrs_posvel(GBT, utc, tt)
+    dut1 = 0.3
+    frames.set_eop(np.array([54000.0, 56000.0]),
+                   np.array([dut1, dut1]))
+    p1, v1 = frames.itrf_to_gcrs_posvel(GBT, utc, tt)
+    d = np.linalg.norm(p1 - p0, axis=-1)
+    # |dr| = omega * dut1 * rho (equatorial projection ~ 5e6 m)
+    rho = np.hypot(GBT[0], GBT[1])
+    expect = 7.292115e-5 * dut1 * rho
+    np.testing.assert_allclose(d, expect, rtol=1e-3)
+
+
+def test_polar_motion_shifts_position():
+    utc = np.array([55000.0])
+    tt = utc + 66.184 / 86400.0
+    p0, _ = frames.itrf_to_gcrs_posvel(GBT, utc, tt)
+    xp = 0.2  # arcsec
+    frames.set_eop(np.array([54000.0, 56000.0]),
+                   np.zeros(2), xp_arcsec=np.full(2, xp),
+                   yp_arcsec=np.zeros(2))
+    p1, _ = frames.itrf_to_gcrs_posvel(GBT, utc, tt)
+    d = np.linalg.norm(p1 - p0)
+    # small rotation: |dr| ~ xp * |r| (within a geometry factor)
+    xr = xp * np.pi / 180 / 3600 * np.linalg.norm(GBT)
+    assert 0.3 * xr < d < 1.5 * xr
+    # interpolation outside the table holds edge values (no blowups)
+    p2, _ = frames.itrf_to_gcrs_posvel(GBT, np.array([60000.0]),
+                                       np.array([60000.001]))
+    assert np.all(np.isfinite(p2))
+
+
+def test_topocentric_tdb_term():
+    """Ground-site TDB carries the Moyer (v_earth . r_obs)/c^2 term:
+    diurnal, amplitude <= ~2.1 us, absent at the geocenter."""
+    from pint_tpu.toa import get_TOAs_array
+
+    # quarter-day sampling over two days resolves the diurnal
+    mjds = 55000.0 + np.arange(0, 2, 0.125)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t_gbt = get_TOAs_array(mjds, obs="gbt", freqs=1400.0,
+                               errors=1.0)
+        t_geo = get_TOAs_array(mjds, obs="geocenter", freqs=1400.0,
+                               errors=1.0)
+    d_gbt = (t_gbt.tdb_day + t_gbt.tdb_frac[0] + t_gbt.tdb_frac[1]
+             - t_gbt.get_mjds()) * 86400.0
+    d_geo = (t_geo.tdb_day + t_geo.tdb_frac[0] + t_geo.tdb_frac[1]
+             - t_geo.get_mjds()) * 86400.0
+    topo = d_gbt - d_geo
+    assert np.max(np.abs(topo)) < 2.3e-6
+    assert np.max(np.abs(topo)) > 0.5e-6
+    # diurnal: sign flips within a day
+    assert topo.max() > 0 and topo.min() < 0
+    # geocenter itself has no topocentric term: pure FB series there
+    from pint_tpu.time import scales
+
+    tt = scales.utc_mjd_to_tt_mjd(t_geo.mjd_day, t_geo.mjd_frac)
+    fb = scales.tdb_minus_tt_seconds(t_geo.mjd_day
+                                     + t_geo.mjd_frac[0])
+    np.testing.assert_allclose(
+        d_geo, 66.184 + fb, atol=5e-6)
