@@ -53,16 +53,42 @@ def load_fits_TOAs(eventfile, mission: Optional[str] = None,
                    weightcolumn: Optional[str] = None,
                    minmjd: float = -np.inf, maxmjd: float = np.inf,
                    ephem: Optional[str] = None,
-                   planets: bool = False) -> TOAs:
-    """Read a FITS event table into barycentric TOAs (reference:
+                   planets: bool = False,
+                   orbit_file=None) -> TOAs:
+    """Read a FITS event table into TOAs (reference:
     event_toas.load_fits_TOAs). Photon weights (e.g. Fermi photon
-    probabilities) are attached as a per-TOA flag ``-weight``."""
+    probabilities) are attached as a per-TOA flag ``-weight``.
+
+    Barycentered files (TIMESYS=TDB) become '@' TOAs directly.
+    Un-barycentered TT files need ``orbit_file`` (or a previously
+    registered satellite observatory named after ``mission``): photon
+    times convert TT->UTC through the leap table and the spacecraft's
+    interpolated orbit supplies the observatory position."""
     cols, header = read_events_fits(eventfile)
     timesys = str(header.get("TIMESYS", "TT")).strip().upper()
+    obs_name = "barycenter"
     if timesys != "TDB":
-        raise NotImplementedError(
-            f"TIMESYS={timesys}: only barycentered (TDB) event files "
-            "are supported without a spacecraft orbit file")
+        from pint_tpu.observatory import get_observatory
+        from pint_tpu.observatory.satellite_obs import (
+            get_satellite_observatory,
+        )
+
+        if orbit_file is not None:
+            if mission is None:
+                mission = str(header.get("TELESCOP", "sat")).lower()
+            get_satellite_observatory(mission, orbit_file)
+            obs_name = mission.lower()
+        else:
+            try:
+                if mission is not None:
+                    get_observatory(mission.lower())
+                    obs_name = mission.lower()
+                else:
+                    raise KeyError("no mission")
+            except KeyError:
+                raise NotImplementedError(
+                    f"TIMESYS={timesys}: un-barycentered event files "
+                    "need a spacecraft orbit file (orbit_file=...)")
     key = next((k for k in cols if k.upper() == "TIME"), None)
     if key is None:
         raise ValueError("event table has no TIME column")
@@ -76,6 +102,19 @@ def load_fits_TOAs(eventfile, mission: Optional[str] = None,
     day = mjdrefi + day_off
     carry = np.floor(frac)
     day, frac = day + carry, frac - carry
+    if obs_name != "barycenter":
+        # photon TIME is TT; the TOA pipeline expects UTC —
+        # subtract TT-UTC = TAI-UTC + 32.184 s. The leap table must be
+        # evaluated at the UTC day: two-pass so photons within ~69 s
+        # after TT midnight on an adoption day get the pre-step offset
+        from pint_tpu.time.scales import TT_MINUS_TAI, tai_minus_utc
+
+        off = (tai_minus_utc(day) + TT_MINUS_TAI) / 86400.0
+        day_utc = day + np.floor(frac - off)
+        off = (tai_minus_utc(day_utc) + TT_MINUS_TAI) / 86400.0
+        frac = frac - off
+        carry = np.floor(frac)
+        day, frac = day + carry, frac - carry
     mjd_float = day + frac
     keep = (mjd_float >= minmjd) & (mjd_float <= maxmjd)
     day, frac = day[keep], frac[keep]
@@ -94,7 +133,7 @@ def load_fits_TOAs(eventfile, mission: Optional[str] = None,
 
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        t = get_TOAs_array((day, dd_np.dd(frac)), obs="barycenter",
+        t = get_TOAs_array((day, dd_np.dd(frac)), obs=obs_name,
                            freqs=np.inf, errors=0.0, flags=flags,
                            ephem=ephem, planets=planets)
     t.names = [f"photon{i}" for i in range(t.ntoas)]

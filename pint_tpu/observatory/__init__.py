@@ -124,6 +124,9 @@ def _ensure_builtins():
                     tempo_code=entry.get("tempo_code")))
     register_observatory(BarycenterObs())
     register_observatory(GeocenterObs())
+    from pint_tpu.observatory.satellite_obs import T2SpacecraftObs
+
+    register_observatory(T2SpacecraftObs())
 
 
 def get_observatory(name: str) -> Observatory:

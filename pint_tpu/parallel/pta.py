@@ -24,7 +24,14 @@ import numpy as np
 from pint_tpu.residuals import Residuals
 
 __all__ = ["PulsarProblem", "build_problem", "stack_problems",
-           "pta_solve", "fit_pta"]
+           "pta_solve", "fit_pta", "PTAFitResult"]
+
+
+class PTAFitResult(list):
+    """fit_pta's return: a list of per-pulsar results carrying the
+    aggregate timing scoreboard in ``.stats``."""
+
+    stats: dict = {}
 
 
 class PulsarProblem:
@@ -153,10 +160,11 @@ def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,
     """Batch-fit [(toas, model), ...]: each iteration assembles every
     pulsar's linearized problem on the host (heterogeneous models), then
     solves ALL of them in one vmapped device call and applies the
-    updates. Returns per-pulsar {chi2, errors} (models updated in
-    place); the list carries aggregate stats in ``fit_pta.last_stats``
-    (SURVEY §5 scoreboard: total TOAs, wall time, TOAs/sec, device
-    solve time)."""
+    updates. Returns a PTAFitResult (a list of per-pulsar
+    {chi2, errors}; models updated in place) whose ``.stats`` attribute
+    is the SURVEY §5 scoreboard: total TOAs, wall time, TOAs/sec,
+    device solve time. ``fit_pta.last_stats`` mirrors it for
+    convenience (last call wins — not safe across interleaved fits)."""
     import time as _time
 
     t_start = _time.perf_counter()
@@ -195,10 +203,12 @@ def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,
         out[k] = {"chi2": float(chi2[k]), "errors": errs}
     wall = _time.perf_counter() - t_start
     ntoa_total = sum(t.ntoas for t, _ in pairs)
-    fit_pta.last_stats = {
+    result = PTAFitResult(out)
+    result.stats = {
         "npulsars": len(pairs), "ntoa_total": ntoa_total,
         "iterations": max(1, maxiter) + 1, "wall_time_s": wall,
         "device_solve_s": solve_s,
         "toas_per_sec": ntoa_total * (max(1, maxiter) + 1) / wall
         if wall else 0.0}
-    return out
+    fit_pta.last_stats = result.stats
+    return result

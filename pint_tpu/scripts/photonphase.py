@@ -29,6 +29,9 @@ def main(argv=None) -> int:
     p.add_argument("--weightcol", default=None,
                    help="photon-weight column name (e.g. Fermi "
                         "MODEL_WEIGHT)")
+    p.add_argument("--orbfile", default=None,
+                   help="spacecraft orbit FITS (required for "
+                        "un-barycentered TT event files)")
     p.add_argument("--minmjd", type=float, default=-np.inf)
     p.add_argument("--maxmjd", type=float, default=np.inf)
     p.add_argument("--outfile", default=None,
@@ -47,7 +50,8 @@ def main(argv=None) -> int:
                           weightcolumn=args.weightcol,
                           minmjd=args.minmjd, maxmjd=args.maxmjd,
                           ephem=model.EPHEM.value,
-                          planets=bool(model.PLANET_SHAPIRO.value))
+                          planets=bool(model.PLANET_SHAPIRO.value),
+                          orbit_file=args.orbfile)
     print(f"Read {toas.ntoas} photons from {args.eventfile}")
 
     phase = model.phase(toas)

@@ -207,23 +207,33 @@ class TOAs:
             frac = (self.mjd_frac[0][utc_mask], self.mjd_frac[1][utc_mask])
             tt = scales.utc_mjd_to_tt_mjd(day, frac)
             tdb = scales.tt_mjd_to_tdb_mjd(tt)
-            # topocentric term per ground site
+            # topocentric term for every non-geocentric observer
+            # (ground sites AND satellites: a LEO r_obs ~6.8e6 m gives
+            # up to ~2.3 us); geocenter's zero position contributes 0
             tt_f64 = dd_np.to_f64(tt)
             utc_f64 = (day + frac[0] + frac[1])
             dt_topo = np.zeros_like(tt_f64)
             sub_obs = [o for o, m in zip(self.obs, utc_mask) if m]
-            topo_sites = {o for o in sub_obs
-                          if getattr(get_observatory(o), "itrf_xyz_m",
-                                     None) is not None}
-            if topo_sites:
+            sub_flags = [f for f, m in zip(self.flags, utc_mask) if m]
+            self._site_gcrs_cache = {}
+            if sub_obs:
                 eph = get_ephemeris(ephem)
                 # earth velocity [m/s]; tt is within ~2 ms of tdb —
                 # far below the velocity's variation scale
                 _, v_earth = eph.ssb_posvel("earth", tt_f64)
-                for site in topo_sites:
+                for site in set(sub_obs):
                     m = np.array([o == site for o in sub_obs])
                     obs = get_observatory(site)
-                    r_m, _ = obs.gcrs_posvel(utc_f64[m], tt_f64[m])
+                    if hasattr(obs, "posvel_from_flags"):
+                        r_m, v_m = obs.posvel_from_flags(
+                            [f for f, mm in zip(sub_flags, m) if mm])
+                    else:
+                        r_m, v_m = obs.gcrs_posvel(utc_f64[m],
+                                                   tt_f64[m])
+                    # reused by compute_posvels: the epoch difference
+                    # (TT vs TDB in the slow precession argument) is
+                    # ~2 ms * 1e-12 rad/s — far below any tolerance
+                    self._site_gcrs_cache[site] = (m, r_m, v_m)
                     dt_topo[m] = np.sum(v_earth[m] * r_m,
                                         axis=-1) / c_m_s ** 2
             tdb = dd_np.add(tdb, dd_np.div_f(dd_np.dd(dt_topo),
@@ -251,11 +261,25 @@ class TOAs:
         earth_pos, earth_vel = eph.ssb_posvel("earth", tdb)
         obs_pos = np.zeros((self.ntoas, 3))
         obs_vel = np.zeros((self.ntoas, 3))
+        cache = getattr(self, "_site_gcrs_cache", {})
         for site in set(self.obs):
             m = np.array([o == site for o in self.obs])
             obs = get_observatory(site)
             if obs.name == "barycenter":
                 # positions stay zero; earth contribution removed below
+                continue
+            cached = cache.get(site)
+            if cached is not None and \
+                    cached[0].sum() == int(m.sum()):
+                # computed in compute_TDBs at the same epochs
+                obs_pos[m] = cached[1]
+                obs_vel[m] = cached[2]
+                continue
+            if hasattr(obs, "posvel_from_flags"):  # T2SpacecraftObs
+                p, v = obs.posvel_from_flags(
+                    [f for f, mm in zip(self.flags, m) if mm])
+                obs_pos[m] = p
+                obs_vel[m] = v
                 continue
             p, v = obs.gcrs_posvel(utc[m], tdb[m])
             obs_pos[m] = p

@@ -1,0 +1,84 @@
+"""End-to-end from committed par/tim files: the NGC6440E-equivalent
+fixture (BASELINE.md config #1: 62 TOAs, 6 free params, WLS smoke
+test; reference fixture: tests/datafile/NGC6440E.par/.tim). The tim
+was generated from the par by this framework's own simulator (SURVEY
+§4 'Implication': self-consistency is the offline oracle), so the fit
+must recover the par values within uncertainties from the FILES alone.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+DATADIR = os.path.join(os.path.dirname(__file__), "datafile")
+PAR = os.path.join(DATADIR, "NGC6440E.par")
+TIM = os.path.join(DATADIR, "NGC6440E.tim")
+
+
+@pytest.fixture(scope="module")
+def loaded():
+    from pint_tpu.models import get_model_and_toas
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model_and_toas(PAR, TIM)
+
+
+def test_load_files(loaded):
+    model, toas = loaded
+    assert toas.ntoas == 62
+    # 5 free params + the implicit Offset column = config #1's "6"
+    assert set(model.free_params) == {"RAJ", "DECJ", "F0", "F1", "DM"}
+    assert model.name == "J1748-2021E"
+
+
+def test_prefit_residuals_reasonable(loaded):
+    from pint_tpu.residuals import Residuals
+
+    model, toas = loaded
+    r = Residuals(toas, model)
+    # simulated at the ~13-40 us error level
+    assert 2e-6 < r.rms_weighted() < 1e-4
+    assert 0.3 < r.reduced_chi2 < 3.0
+
+
+def test_wls_fit_recovers_parfile(loaded):
+    import copy
+    import io
+
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.models import get_model
+
+    model, toas = loaded
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        truth = get_model(PAR)
+    m = copy.deepcopy(model)
+    # perturb away from the par values, then require recovery
+    m.get_param("F0").add_delta(2e-9)
+    m.get_param("DM").add_delta(5e-3)
+    m.invalidate_cache(params_only=True)
+    f = WLSFitter(toas, m)
+    chi2 = f.fit_toas(maxiter=2)
+    assert f.resids.reduced_chi2 < 2.0
+    for name in ("F0", "F1", "DM"):
+        tv = truth.get_param(name).value
+        fv = m.get_param(name).value
+        err = f.errors[name]
+        assert abs(fv - tv) < 5 * err, name
+    # published-scale sanity (SURVEY A.8): F0 ~ 61.485 Hz, DM ~ 224
+    assert m.F0.value == pytest.approx(61.485476554, abs=1e-6)
+    assert m.get_param("DM").value == pytest.approx(223.9, abs=0.3)
+
+
+def test_pintempo_on_fixture(tmp_path, capsys):
+    from pint_tpu.scripts.pintempo import main
+
+    out = tmp_path / "post.par"
+    rc = main([PAR, TIM, "--outfile", str(out), "--fitter", "wls",
+               "--maxiter", "2"])
+    assert rc == 0
+    assert "chi2" in capsys.readouterr().out
+    assert out.exists()

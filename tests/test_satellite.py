@@ -1,0 +1,168 @@
+"""Satellite observatories + spacecraft TOAs + phaseogram (reference:
+src/pint/observatory/satellite_obs.py, special_locations.py
+T2SpacecraftObs, plot_utils.py)."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.fits import write_events_fits
+from pint_tpu.models import get_model
+
+NICER_MJDREF = (56658, 7.775925925925926e-4)
+
+PAR = """
+PSR J0030+0451
+RAJ 00:30:27.4
+DECJ 04:51:39.7
+F0 205.53069927
+F1 -4.3e-16
+PEPOCH 56500
+POSEPOCH 56500
+DM 4.33
+TZRMJD 56500.0
+TZRSITE @
+TZRFRQ inf
+UNITS TDB
+"""
+
+
+def _write_orbit(path, mjd0, mjd1, dt_s=30.0):
+    """Circular 550-km LEO in the equatorial plane, ECI meters."""
+    mjdrefi, mjdreff = NICER_MJDREF
+    t0 = ((mjd0 - mjdrefi) - mjdreff) * 86400.0
+    t1 = ((mjd1 - mjdrefi) - mjdreff) * 86400.0
+    t = np.arange(t0, t1 + dt_s, dt_s)
+    r = 6.921e6  # m
+    period = 2 * np.pi * np.sqrt(r ** 3 / 3.986004418e14)
+    ang = 2 * np.pi * t / period
+    cols = {"TIME": t, "POS_X": r * np.cos(ang),
+            "POS_Y": r * np.sin(ang), "POS_Z": np.zeros_like(t)}
+    write_events_fits(path, cols, header_extra={
+        "TELESCOP": "NICER", "MJDREFI": mjdrefi, "MJDREFF": mjdreff,
+        "TIMESYS": "TT"}, extname="SC_DATA")
+    return period
+
+
+def test_satellite_obs_interpolation(tmp_path):
+    from pint_tpu.observatory.satellite_obs import SatelliteObs
+
+    orb = tmp_path / "orb.fits"
+    period = _write_orbit(orb, 56500.0, 56500.5)
+    obs = SatelliteObs("nicertest", str(orb))
+    tq = np.array([56500.1, 56500.2])
+    p, v = obs.gcrs_posvel(tq, tq)
+    np.testing.assert_allclose(np.linalg.norm(p, axis=-1), 6.921e6,
+                               rtol=1e-4)
+    # orbital speed ~ 2 pi r / P
+    np.testing.assert_allclose(np.linalg.norm(v, axis=-1),
+                               2 * np.pi * 6.921e6 / period, rtol=1e-3)
+    with pytest.raises(ValueError):
+        obs.gcrs_posvel(np.array([56600.0]), np.array([56600.0]))
+
+
+def test_tt_events_with_orbit(tmp_path):
+    """Un-barycentered TT photons + orbit file phase up under the model
+    that generated them (the full satellite pipeline: TT->UTC clock
+    chain, orbit positions, Roemer/Shapiro barycentering)."""
+    from pint_tpu.event_toas import load_fits_TOAs
+    from pint_tpu.eventstats import hm
+    from pint_tpu.simulation import zero_residuals
+    from pint_tpu.toa import get_TOAs_array
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(PAR))
+        orb = tmp_path / "orb.fits"
+        _write_orbit(orb, 56499.9, 56502.1)
+        # simulate: pick arrival UTC times at the spacecraft such that
+        # the model phase is ~0 there, by zero_residuals on TOAs that
+        # use the orbit observatory
+        from pint_tpu.observatory.satellite_obs import (
+            get_satellite_observatory,
+        )
+
+        get_satellite_observatory("nicersim", str(orb))
+        rng = np.random.default_rng(3)
+        mjds = np.sort(rng.uniform(56500.0, 56502.0, 400))
+        toas = get_TOAs_array(mjds, obs="nicersim", freqs=np.inf,
+                              errors=1.0)
+        toas = zero_residuals(toas, model)
+        # photons at phase 0 (+ narrow jitter)
+        utc = toas.mjd_day + toas.mjd_frac[0] + toas.mjd_frac[1]
+        # convert back to mission TT seconds for the event file
+        from pint_tpu.time.scales import TT_MINUS_TAI, tai_minus_utc
+
+        tt = utc + (tai_minus_utc(toas.mjd_day) + TT_MINUS_TAI) / 86400.0
+        mjdrefi, mjdreff = NICER_MJDREF
+        ev = tmp_path / "ev.fits"
+        write_events_fits(ev, {"TIME": ((tt - mjdrefi) - mjdreff)
+                               * 86400.0},
+                          header_extra={"TIMESYS": "TT",
+                                        "TELESCOP": "NICER",
+                                        "MJDREFI": mjdrefi,
+                                        "MJDREFF": mjdreff})
+        t2 = load_fits_TOAs(ev, mission="nicer2",
+                            orbit_file=str(orb))
+        phases = np.mod(np.asarray(model.phase(t2).frac) + 0.5,
+                        1.0) - 0.5
+    # all photons at phase ~0 => enormous H-test
+    assert np.percentile(np.abs(phases), 90) < 0.02
+    assert hm(np.mod(phases, 1.0)) > 1000
+
+
+def test_tt_events_without_orbit_raise(tmp_path):
+    from pint_tpu.event_toas import load_fits_TOAs
+
+    ev = tmp_path / "ev.fits"
+    write_events_fits(ev, {"TIME": np.arange(10.0)},
+                      header_extra={"TIMESYS": "TT",
+                                    "MJDREFI": NICER_MJDREF[0],
+                                    "MJDREFF": NICER_MJDREF[1]})
+    with pytest.raises(NotImplementedError):
+        load_fits_TOAs(ev)
+
+
+def test_t2spacecraft_obs_flags():
+    from pint_tpu.toa import get_TOAs_array
+
+    flags = [{"telx": "0.01", "tely": "-0.02", "telz": "0.005"}
+             for _ in range(4)]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t = get_TOAs_array(np.linspace(56500, 56501, 4),
+                           obs="stl_geo", freqs=1400.0, errors=1.0,
+                           flags=flags)
+    # observatory position = geocenter + flag offset (lt-s)
+    # compare against geocenter TOAs
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        tg = get_TOAs_array(np.linspace(56500, 56501, 4),
+                            obs="geocenter", freqs=1400.0, errors=1.0)
+    d = t.ssb_obs_pos - tg.ssb_obs_pos  # meters
+    C = 299792458.0
+    np.testing.assert_allclose(
+        d, np.tile([0.01 * C, -0.02 * C, 0.005 * C], (4, 1)),
+        atol=1.0)
+    # missing flags raise
+    with pytest.raises(ValueError):
+        get_TOAs_array(np.array([56500.0]), obs="stl_geo",
+                       freqs=1400.0, errors=1.0, flags=[{}])
+
+
+def test_phaseogram(tmp_path):
+    from pint_tpu.plot_utils import phaseogram, phaseogram_binned
+
+    rng = np.random.default_rng(0)
+    mjds = np.sort(rng.uniform(56000, 56100, 2000))
+    phases = np.mod(0.3 + 0.03 * rng.standard_normal(2000), 1.0)
+    out = tmp_path / "pg.png"
+    fig = phaseogram(mjds, phases, plotfile=str(out), title="test")
+    assert out.stat().st_size > 5000
+    out2 = tmp_path / "pgb.png"
+    phaseogram_binned(mjds, phases,
+                      weights=rng.uniform(0.2, 1, 2000),
+                      plotfile=str(out2))
+    assert out2.stat().st_size > 5000
