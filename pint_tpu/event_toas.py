@@ -103,18 +103,10 @@ def load_fits_TOAs(eventfile, mission: Optional[str] = None,
     carry = np.floor(frac)
     day, frac = day + carry, frac - carry
     if obs_name != "barycenter":
-        # photon TIME is TT; the TOA pipeline expects UTC —
-        # subtract TT-UTC = TAI-UTC + 32.184 s. The leap table must be
-        # evaluated at the UTC day: two-pass so photons within ~69 s
-        # after TT midnight on an adoption day get the pre-step offset
-        from pint_tpu.time.scales import TT_MINUS_TAI, tai_minus_utc
+        # photon TIME is TT; the TOA pipeline expects UTC
+        from pint_tpu.time.scales import tt_mjd_to_utc_mjd
 
-        off = (tai_minus_utc(day) + TT_MINUS_TAI) / 86400.0
-        day_utc = day + np.floor(frac - off)
-        off = (tai_minus_utc(day_utc) + TT_MINUS_TAI) / 86400.0
-        frac = frac - off
-        carry = np.floor(frac)
-        day, frac = day + carry, frac - carry
+        day, frac = tt_mjd_to_utc_mjd(day, frac)
     mjd_float = day + frac
     keep = (mjd_float >= minmjd) & (mjd_float <= maxmjd)
     day, frac = day[keep], frac[keep]

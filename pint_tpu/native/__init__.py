@@ -1,0 +1,91 @@
+"""Native (C++) host kernels, loaded via ctypes.
+
+The TPU compute path is JAX/XLA; these accelerate the *host* runtime
+around it (the role C extensions play in the reference's dependency
+stack — astropy's fast time parser, ERFA). Kernels compile lazily with
+g++ on first use and cache the .so next to the source; every native
+kernel has a pure-Python twin that produces bit-identical results, so
+missing compilers only cost speed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import warnings
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["mjdparse_native", "native_available"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    src = os.path.join(_DIR, "mjdparse.cpp")
+    so = os.path.join(_DIR, "_mjdparse.so")
+    if not os.path.exists(so) or \
+            os.path.getmtime(so) < os.path.getmtime(src):
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-o", so, src],
+                check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError) as e:
+            warnings.warn(f"native mjdparse build failed ({e}); "
+                          "using the pure-Python parser")
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError as e:
+        warnings.warn(f"native mjdparse load failed ({e})")
+        return None
+    lib.parse_mjd_batch.restype = ctypes.c_longlong
+    lib.parse_mjd_batch.argtypes = [
+        ctypes.c_char_p,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ctypes.c_longlong,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+    ]
+    _LIB = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _build_and_load() is not None
+
+
+def mjdparse_native(strings):
+    """Batch-parse decimal MJD strings natively; returns
+    (days, (fhi, flo)) or None when the native kernel is unavailable.
+    Raises ValueError on a malformed string (same contract as the
+    Python parser)."""
+    lib = _build_and_load()
+    if lib is None:
+        return None
+    n = len(strings)
+    enc = [s.encode("ascii", "replace") for s in strings]
+    offs = np.empty(n, dtype=np.int64)
+    pos = 0
+    parts = []
+    for i, b in enumerate(enc):
+        offs[i] = pos
+        parts.append(b)
+        pos += len(b) + 1
+    buf = b"\x00".join(parts) + b"\x00"
+    day = np.empty(n)
+    fhi = np.empty(n)
+    flo = np.empty(n)
+    bad = lib.parse_mjd_batch(buf, offs, n, day, fhi, flo)
+    if bad >= 0:
+        raise ValueError(f"bad MJD string {strings[bad]!r}")
+    return day, (fhi, flo)

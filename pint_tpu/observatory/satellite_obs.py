@@ -92,13 +92,19 @@ class SatelliteObs(Observatory):
         pos = np.stack([np.interp(tq, self._t_mjd, self._pos_m[:, k])
                         for k in range(3)], axis=-1)
         dt = 1.0 / 86400.0  # 1 s
-        pos_p = np.stack([np.interp(tq + dt, self._t_mjd,
+        # clamp the stencil inside the table (np.interp would silently
+        # hold the endpoint value, halving the velocity near the edges)
+        # and divide by the time actually spanned
+        tp = np.minimum(tq + dt, self._t_mjd[-1])
+        tm = np.maximum(tq - dt, self._t_mjd[0])
+        pos_p = np.stack([np.interp(tp, self._t_mjd,
                                     self._pos_m[:, k])
                           for k in range(3)], axis=-1)
-        pos_m_ = np.stack([np.interp(tq - dt, self._t_mjd,
+        pos_m_ = np.stack([np.interp(tm, self._t_mjd,
                                      self._pos_m[:, k])
                            for k in range(3)], axis=-1)
-        vel = (pos_p - pos_m_) / 2.0
+        span_s = (tp - tm) * 86400.0
+        vel = (pos_p - pos_m_) / span_s[:, None]
         return pos, vel
 
 

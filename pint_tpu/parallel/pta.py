@@ -31,7 +31,9 @@ class PTAFitResult(list):
     """fit_pta's return: a list of per-pulsar results carrying the
     aggregate timing scoreboard in ``.stats``."""
 
-    stats: dict = {}
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.stats: dict = {}
 
 
 class PulsarProblem:

@@ -47,15 +47,28 @@ def parse_mjd_string(s: str):
         b = fp[15:30]
         frac = dd_np.div(dd_np.dd(float(int(a))), dd_np.dd(10.0 ** len(a)))
         if b:
-            fb = dd_np.div(dd_np.dd(float(int(b))), dd_np.dd(10.0 ** len(fp)))
+            # divide by 10^len(b) then 10^15: both divisors exact in
+            # f64 (10^k exact only to k=22), keeping the native C++
+            # kernel bit-identical
+            fb = dd_np.div(dd_np.dd(float(int(b))),
+                           dd_np.dd(10.0 ** len(b)))
+            fb = dd_np.div(fb, dd_np.dd(10.0 ** 15))
             frac = dd_np.add(frac, fb)
     if neg:
         return -day, dd_np.neg(frac)
     return day, frac
 
 
-def parse_mjd_strings(strings):
-    """Vector parse → (int_days f64 array, frac dd pair of arrays)."""
+def parse_mjd_strings(strings, use_native: bool = True):
+    """Vector parse → (int_days f64 array, frac dd pair of arrays).
+    Large batches go through the native C++ kernel when available
+    (bit-identical results; pint_tpu/native/mjdparse.cpp)."""
+    if use_native and len(strings) >= 256:
+        from pint_tpu.native import mjdparse_native
+
+        out = mjdparse_native(strings)
+        if out is not None:
+            return out
     days = np.empty(len(strings))
     fhi = np.empty(len(strings))
     flo = np.empty(len(strings))

@@ -83,6 +83,22 @@ def utc_mjd_to_tt_mjd(day, frac):
     return dd_np.add(mjd, dd_np.div_f(dd_np.dd(off), SECS_PER_DAY))
 
 
+def tt_mjd_to_utc_mjd(day, frac):
+    """TT (f64 day, f64 frac) -> pulsar-MJD UTC (day, frac), both f64
+    pairs normalized to frac in [0, 1). Inverse of utc_mjd_to_tt_mjd;
+    the leap table is evaluated at the UTC day, via a two-pass so
+    epochs within ~69 s after TT midnight on a leap-adoption day get
+    the pre-step offset."""
+    day = np.asarray(day, np.float64)
+    frac = np.asarray(frac, np.float64)
+    off = (tai_minus_utc(day) + TT_MINUS_TAI) / SECS_PER_DAY
+    day_utc = day + np.floor(frac - off)
+    off = (tai_minus_utc(day_utc) + TT_MINUS_TAI) / SECS_PER_DAY
+    f = frac - off
+    carry = np.floor(f)
+    return day + carry, f - carry
+
+
 def tdb_minus_tt_seconds(tt_mjd_f64):
     """Truncated Fairhead–Bretagnon TDB−TT [s] at TT MJD(s) (f64 is ample:
     the series slope is ~1e-7 s/s, so µs-level argument error is harmless).

@@ -1,0 +1,64 @@
+"""Native C++ MJD parser: bit-identical to the Python dd parser and
+substantially faster (pint_tpu/native/; host-runtime acceleration in
+the role astropy's C time parser plays for the reference)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu.native import mjdparse_native, native_available
+from pint_tpu.time.mjd import parse_mjd_string, parse_mjd_strings
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="no g++ toolchain")
+
+
+def _random_mjd_strings(n, rng):
+    days = rng.integers(40000, 60000, n)
+    out = []
+    for d in days:
+        nd = int(rng.integers(0, 25))
+        frac = "".join(rng.choice(list("0123456789"), nd)) if nd else ""
+        out.append(f"{d}.{frac}" if frac else str(d))
+    return out
+
+
+def test_native_bit_identical():
+    rng = np.random.default_rng(0)
+    strs = _random_mjd_strings(3000, rng)
+    strs += ["-1234.5", "58000.000000000000000001", "0.5", "58000"]
+    d_n, (h_n, l_n) = mjdparse_native(strs)
+    d_p = np.empty(len(strs))
+    h_p = np.empty(len(strs))
+    l_p = np.empty(len(strs))
+    for i, s in enumerate(strs):
+        d_p[i], (h_p[i], l_p[i]) = parse_mjd_string(s)
+    assert np.array_equal(d_n, d_p)
+    assert np.array_equal(h_n, h_p)  # exact — same dd operations
+    assert np.array_equal(l_n, l_p)
+
+
+def test_native_rejects_bad_strings():
+    with pytest.raises(ValueError):
+        mjdparse_native(["58000.5", "not_a_number"])
+    with pytest.raises(ValueError):
+        mjdparse_native(["58000.5e3"])
+
+
+def test_parse_mjd_strings_uses_native_and_is_faster():
+    rng = np.random.default_rng(1)
+    strs = [f"{d}.{f:016d}" for d, f in zip(
+        rng.integers(50000, 60000, 20000),
+        rng.integers(0, 10 ** 16, 20000))]
+    t0 = time.perf_counter()
+    d1, (h1, l1) = parse_mjd_strings(strs)  # native path
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    d2, (h2, l2) = parse_mjd_strings(strs, use_native=False)
+    t_python = time.perf_counter() - t0
+    assert np.array_equal(d1, d2)
+    assert np.array_equal(h1, h2)
+    assert np.array_equal(l1, l2)
+    assert t_native < t_python / 3, \
+        f"native {t_native:.3f}s vs python {t_python:.3f}s"
