@@ -362,6 +362,50 @@ class AngleParameter(Parameter):
         return f"{self.uncertainty * (180.0 / np.pi):.3g}"
 
 
+class funcParameter(Parameter):
+    """Read-only parameter derived from other model parameters
+    (reference: funcParameter): ``func`` maps the values of ``params``
+    (looked up on the attached model) to this parameter's value.
+    Never fittable; excluded from par files."""
+
+    def __init__(self, name, func, params, units: str = "",
+                 description: str = "", **kw):
+        self._func = func
+        self._source_params = tuple(params)
+        self._model = None
+        super().__init__(name, value=None, units=units,
+                         description=description, frozen=True, **kw)
+        # the overriding value setter stores nothing; inherited members
+        # (__repr__, quantity) still read _value
+        self._value = None
+
+    def attach(self, model):
+        self._model = model
+        return self
+
+    @property
+    def value(self):
+        if self._model is None:
+            return None
+        vals = []
+        for nm in self._source_params:
+            p = self._model.get_param(nm)
+            if p.value is None:
+                return None
+            vals.append(p.value)
+        return self._func(*vals)
+
+    @value.setter
+    def value(self, v):
+        if v is not None:
+            raise AttributeError(
+                f"{self.name} is derived ({self._source_params}); "
+                "set its source parameters instead")
+
+    def as_parfile_line(self):
+        return ""  # derived: never written
+
+
 class maskParameter(floatParameter):
     """Parameter applying to a TOA subset selected by flag/MJD/freq/tel
     (reference: maskParameter; e.g. ``JUMP -fe L-wide 0.000216 1``).

@@ -34,13 +34,23 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     so = os.path.join(_DIR, "_mjdparse.so")
     if not os.path.exists(so) or \
             os.path.getmtime(so) < os.path.getmtime(src):
+        tmp = f"{so}.{os.getpid()}.tmp"
         try:
+            # -ffp-contract=off: FMA contraction would break the
+            # bit-identical contract with the non-FMA numpy mirror on
+            # FMA-default targets (aarch64)
             subprocess.run(
-                ["g++", "-O2", "-shared", "-fPIC", "-o", so, src],
+                ["g++", "-O2", "-ffp-contract=off", "-shared",
+                 "-fPIC", "-o", tmp, src],
                 check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)  # atomic vs concurrent builders
         except (OSError, subprocess.SubprocessError) as e:
             warnings.warn(f"native mjdparse build failed ({e}); "
                           "using the pure-Python parser")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return None
     try:
         lib = ctypes.CDLL(so)
@@ -73,7 +83,11 @@ def mjdparse_native(strings):
     if lib is None:
         return None
     n = len(strings)
-    enc = [s.encode("ascii", "replace") for s in strings]
+    enc = []
+    for s in strings:
+        if "\x00" in s:
+            raise ValueError(f"bad MJD string {s!r}")
+        enc.append(s.encode("ascii", "replace"))
     offs = np.empty(n, dtype=np.int64)
     pos = 0
     parts = []

@@ -119,6 +119,7 @@ long long parse_mjd_batch(const char *buf, const long long *offs,
       ip = ip * 10 + (*p - '0');
       ++ip_digits;
       ++p;
+      if (ip_digits > 18) return i;  // would overflow long long
     }
     int fp_digits = 0;
     char fp[31];

@@ -35,7 +35,7 @@ def parse_mjd_string(s: str):
     else:
         ip, fp = s, ""
     if (not ip and not fp) or (ip and not ip.isdigit()) or \
-            (fp and not fp.isdigit()):
+            (fp and not fp.isdigit()) or len(ip) > 18:
         # isdigit() also rejects int()-tolerated junk like '1_5' or '+5'
         raise ValueError(f"bad MJD string {s!r}")
     day = float(int(ip)) if ip else 0.0

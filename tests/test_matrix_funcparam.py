@@ -1,0 +1,111 @@
+"""Labeled matrices + funcParameter (reference: src/pint/pint_matrix.py
+DesignMatrix/CovarianceMatrix; parameter.funcParameter)."""
+
+import copy
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.models.parameter import funcParameter
+from pint_tpu.pint_matrix import (
+    CovarianceMatrix,
+    DesignMatrix,
+    combine_design_matrices_by_param,
+    combine_design_matrices_by_quantity,
+)
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR J0020+0020
+RAJ 02:00:00.0 1
+DECJ 10:00:00.0 1
+F0 99.0 1
+F1 -1e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 7.0 1
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+BINARY ELL1
+PB 1.2
+A1 2.0
+TASC 55000.1
+EPS1 1e-5
+EPS2 2e-5
+M2 0.25
+SINI 0.92
+"""
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(PAR))
+        rng = np.random.default_rng(1)
+        toas = make_fake_toas_uniform(54500, 55500, 50, model,
+                                      error_us=1.0, add_noise=True,
+                                      rng=rng)
+        from pint_tpu.fitter import WLSFitter
+
+        m = copy.deepcopy(model)
+        f = WLSFitter(toas, m)
+        f.fit_toas(maxiter=2)
+    return m, toas, f
+
+
+def test_design_matrix_labels(fitted):
+    m, toas, f = fitted
+    dm = DesignMatrix.from_model(m, toas)
+    assert dm.labels[0] == "Offset"
+    assert set(dm.derivative_params()) == set(m.free_params)
+    assert dm.shape == (toas.ntoas, len(m.free_params) + 1)
+    col = dm.get_column("F0")
+    M, names, _ = m.designmatrix(toas)
+    np.testing.assert_array_equal(col, np.asarray(M)[:,
+                                                     names.index("F0")])
+
+
+def test_covariance_and_correlation(fitted):
+    m, toas, f = fitted
+    cm = CovarianceMatrix.from_fitter(f)
+    corr = cm.to_correlation()
+    d = np.diag(corr.matrix)
+    np.testing.assert_allclose(d, 1.0, atol=1e-12)
+    assert np.all(np.abs(corr.matrix) <= 1.0 + 1e-12)
+    txt = cm.prettyprint()
+    assert "F0" in txt and "Offset" in txt
+    assert "1.000" in txt
+
+
+def test_combiners(fitted):
+    m, toas, f = fitted
+    dm = DesignMatrix.from_model(m, toas)
+    stacked = combine_design_matrices_by_quantity([dm, dm])
+    assert stacked.shape == (2 * toas.ntoas, dm.shape[1])
+    other = DesignMatrix(np.ones((toas.ntoas, 1)), ["EXTRA"], ["s"])
+    wide = combine_design_matrices_by_param([dm, other])
+    assert wide.labels[-1] == "EXTRA"
+    with pytest.raises(ValueError):
+        combine_design_matrices_by_param([dm, dm])  # duplicate cols
+
+
+def test_func_parameter(fitted):
+    import pint_tpu.derived_quantities as dq
+
+    m, toas, f = fitted
+    p = funcParameter("MF", lambda pb, a1: dq.mass_funct(pb, a1),
+                      ("PB", "A1"), units="Msun").attach(m)
+    assert p.value == pytest.approx(dq.mass_funct(1.2, 2.0))
+    assert p.frozen
+    assert p.as_parfile_line() == ""
+    with pytest.raises(AttributeError):
+        p.value = 3.0
+    # unattached -> None
+    q = funcParameter("MF2", lambda x: x, ("PB",))
+    assert q.value is None
