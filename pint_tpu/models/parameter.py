@@ -26,7 +26,8 @@ from pint_tpu.ops import dd_np
 __all__ = [
     "Parameter", "floatParameter", "MJDParameter", "AngleParameter",
     "strParameter", "boolParameter", "intParameter", "maskParameter",
-    "prefixParameter", "pairParameter", "split_prefixed_name",
+    "prefixParameter", "pairParameter", "funcParameter",
+    "split_prefixed_name",
 ]
 
 
@@ -375,9 +376,20 @@ class funcParameter(Parameter):
         self._model = None
         super().__init__(name, value=None, units=units,
                          description=description, frozen=True, **kw)
-        # the overriding value setter stores nothing; inherited members
-        # (__repr__, quantity) still read _value
         self._value = None
+
+    @property
+    def quantity(self):
+        # keep the PINT-compat alias pointing at the derived value
+        # (the inherited property reads _value, which is always None)
+        return self.value
+
+    @quantity.setter
+    def quantity(self, v):
+        if v is not None:
+            raise AttributeError(
+                f"{self.name} is derived ({self._source_params}); "
+                "set its source parameters instead")
 
     def attach(self, model):
         self._model = model

@@ -398,7 +398,9 @@ class TimingModel:
 
     def get_cache(self, toas) -> dict:
         """Host-precomputed per-batch arrays (masks, TZR mini-batch)."""
-        key = id(toas)
+        # per-state serial, not id(): ids are reused after GC and a
+        # TOAs can be mutated in place (see toa.TOAs._touch)
+        key = getattr(toas, "cache_key", None) or id(toas)
         if self._cache is not None and self._cache_key == key:
             return self._cache
         batch = toas.to_batch()

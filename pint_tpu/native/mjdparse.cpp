@@ -116,10 +116,10 @@ long long parse_mjd_batch(const char *buf, const long long *offs,
     long long ip = 0;
     int ip_digits = 0;
     while (*p >= '0' && *p <= '9') {
+      if (ip_digits >= 18) return i;  // next accumulate would overflow
       ip = ip * 10 + (*p - '0');
       ++ip_digits;
       ++p;
-      if (ip_digits > 18) return i;  // would overflow long long
     }
     int fp_digits = 0;
     char fp[31];

@@ -35,6 +35,9 @@ class PintMatrix:
             raise ValueError("matrix/labels shape mismatch: "
                              f"{self.matrix.shape} vs "
                              f"{len(self.labels)} labels")
+        if len(self.units) != len(self.labels):
+            raise ValueError("units/labels length mismatch: "
+                             f"{len(self.units)} vs {len(self.labels)}")
 
     @property
     def shape(self):
@@ -106,6 +109,9 @@ def combine_design_matrices_by_quantity(matrices) -> DesignMatrix:
         if m.labels != first.labels:
             raise ValueError("parameter columns differ: "
                              f"{m.labels} vs {first.labels}")
+        if m.units != first.units:
+            raise ValueError("parameter column units differ: "
+                             f"{m.units} vs {first.units}")
     return DesignMatrix(
         np.concatenate([m.matrix for m in matrices], axis=0),
         first.labels, first.units,

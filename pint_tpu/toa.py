@@ -13,6 +13,7 @@ and never squeezed through a single float64.
 
 from __future__ import annotations
 
+import itertools
 import warnings
 from typing import Dict, List, NamedTuple, Optional
 
@@ -29,6 +30,11 @@ from pint_tpu.time import mjd as mjdmod
 from pint_tpu.time import scales
 
 SECS_PER_DAY = 86400.0
+
+# Monotonic token identifying a TOAs *state* (object identity is not
+# enough: Python reuses ids after GC, and a TOAs can be mutated in
+# place by the pipeline). TimingModel keys its per-batch cache on this.
+_TOAS_SERIAL = itertools.count(1)
 
 # Planets used by PLANET_SHAPIRO, in reference order
 # (src/pint/models/solar_system_shapiro.py _ss_obj_delay callers).
@@ -86,6 +92,15 @@ class TOAs:
         self.obs_planet_pos = None  # dict name -> (N,3) m
         self.ephem = None
         self.planets = False
+        self._serial = next(_TOAS_SERIAL)
+
+    def _touch(self):
+        """Mark this TOAs state as changed (invalidates model caches)."""
+        self._serial = next(_TOAS_SERIAL)
+
+    @property
+    def cache_key(self):
+        return self._serial
 
     # ---------------- basic container protocol ----------------
 
@@ -132,6 +147,7 @@ class TOAs:
         pn = np.asarray(ph.int)
         for f, p in zip(self.flags, pn):
             f["pn"] = repr(float(p))
+        self._touch()
 
     def select(self, mask):
         """Boolean-mask subset (new TOAs object; reference: TOAs.select
@@ -156,6 +172,7 @@ class TOAs:
             (self.tdb_frac[0][idx], self.tdb_frac[1][idx])
         out.obs_planet_pos = None if self.obs_planet_pos is None else \
             {k: v[idx] for k, v in self.obs_planet_pos.items()}
+        out._serial = next(_TOAS_SERIAL)
         return out
 
     def first_MJD(self):
@@ -186,6 +203,7 @@ class TOAs:
         for f, c in zip(self.flags, corr):
             f["clkcorr"] = repr(float(c))
         self.clock_applied = True
+        self._touch()
 
     def compute_TDBs(self, ephem=None):
         """UTC(site) → TT → TDB per TOA (reference: TOAs.compute_TDBs).
@@ -246,6 +264,7 @@ class TOAs:
             fhi[utc_mask] = rest[0]
             flo[utc_mask] = rest[1]
         self.tdb_day = tdb_day
+        self._touch()
         self.tdb_frac = (fhi, flo)
 
     def compute_posvels(self, ephem=None, planets=False):
@@ -299,6 +318,7 @@ class TOAs:
             for pl in PLANETS:
                 p, _ = eph.ssb_posvel(pl, tdb)
                 self.obs_planet_pos[pl] = p - ssb_obs_pos
+        self._touch()
 
     # ---------------- device view ----------------
 
@@ -390,6 +410,7 @@ def merge_TOAs(toas_list: List[TOAs]) -> TOAs:
     else:
         out.obs_planet_pos = {
             k: np.concatenate([p[k] for p in pls]) for k in pls[0]}
+    out._serial = next(_TOAS_SERIAL)
     return out
 
 
