@@ -1,57 +1,100 @@
 """Photon pulse-profile templates + maximum-likelihood fitting.
 
 Reference: src/pint/templates/ (lcprimitives.py LCGaussian/...,
-lctemplate.py LCTemplate, lcfitters.py LCFitter) — ~4k LoC of numpy
-class machinery there. TPU-first redesign: a template is a pure
-function of a flat parameter vector; the unbinned weighted photon
-log-likelihood and its gradient are one jitted XLA reduction over the
-photon axis, and the ML fit is gradient-based (the reference uses
-scipy simplex/L-BFGS per-primitive bookkeeping).
+lctemplate.py LCTemplate, lcfitters.py LCFitter, lcnorm.py NormAngles)
+— ~4k LoC of numpy class machinery there. TPU-first redesign: a
+template is a pure function of a flat parameter vector; the unbinned
+weighted photon log-likelihood and its gradient are one jitted XLA
+reduction over the photon axis, and the ML fit is gradient-based
+L-BFGS over that kernel (the reference uses scipy simplex/L-BFGS with
+per-primitive gradient bookkeeping).
 
-Parameterization (one flat f64 vector `theta`):
-    theta = [logits (m+1,) | locs (m,) | log_widths (m,)]
+Parameterization (one flat f64 vector ``theta``):
+    theta = [logits (m+1,) | locs (m,) | log_shapes (sum n_shape,)]
 softmax(logits) -> [background, norm_1..norm_m]: normalizations are
 positive and sum to 1 with the background taking the remainder, so no
-constrained optimizer is needed.
+constrained optimizer is needed (the reference's NormAngles spherical
+parameterization solves the same problem; softmax is the standard
+unconstrained simplex map and is smooth for autodiff). Shape
+parameters (widths) live in log space so they stay positive.
 """
 
 from __future__ import annotations
 
-from functools import partial
+import math
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["LCPrimitive", "LCGaussian", "LCVonMises", "LCLorentzian",
-           "LCTemplate", "LCFitter"]
+__all__ = ["LCPrimitive", "LCGaussian", "LCGaussian2", "LCVonMises",
+           "LCLorentzian", "LCLorentzian2", "LCTopHat",
+           "LCTemplate", "LCFitter", "GaussianPrior",
+           "read_template", "write_template", "make_template"]
 
 
 class LCPrimitive:
     """One peak shape: a normalized pdf on phase [0,1) with a location
-    and a width parameter (reference: lcprimitives.LCPrimitive)."""
+    and ``n_shape`` positive shape parameters (reference:
+    lcprimitives.LCPrimitive)."""
 
     name = "prim"
+    n_shape = 1
 
     @staticmethod
-    def pdf(phi, loc, width):  # pragma: no cover - abstract
+    def pdf(phi, loc, shape):  # pragma: no cover - abstract
+        """shape is a (n_shape,) slice of exp(log_shapes)."""
         raise NotImplementedError
+
+    @classmethod
+    def fwhm(cls, shape) -> float:
+        """Full width at half max in phase units (reference:
+        LCPrimitive.fwhm); default assumes shape[0] is a Gaussian-like
+        sigma."""
+        return float(2.0 * math.sqrt(2.0 * math.log(2.0)) * shape[0])
 
 
 class LCGaussian(LCPrimitive):
     """Wrapped Gaussian peak (reference: lcprimitives.LCGaussian).
-    width = sigma in phase units; wrapping summed over +-3 turns."""
+    shape[0] = sigma in phase units; wrapping summed over +-3 turns."""
 
     name = "gaussian"
 
     @staticmethod
-    def pdf(phi, loc, width):
+    def pdf(phi, loc, shape):
+        width = shape[0]
         d = phi - loc
         ns = jnp.arange(-3.0, 4.0)
-        z = (d[..., None] + ns) / width[..., None]
+        z = (d[..., None] + ns) / width
         g = jnp.exp(-0.5 * z * z)
         return jnp.sum(g, axis=-1) / (width * jnp.sqrt(2 * jnp.pi))
+
+
+class LCGaussian2(LCPrimitive):
+    """Two-sided (asymmetric) wrapped Gaussian: sigma_left below the
+    peak, sigma_right above, continuous at the peak with overall unit
+    normalization 2/(sl+sr) scaling (reference:
+    lcprimitives.LCGaussian2)."""
+
+    name = "gaussian2"
+    n_shape = 2
+
+    @staticmethod
+    def pdf(phi, loc, shape):
+        sl, sr = shape[0], shape[1]
+        d = phi - loc
+        ns = jnp.arange(-3.0, 4.0)
+        dn = d[..., None] + ns
+        sig = jnp.where(dn < 0, sl, sr)
+        g = jnp.exp(-0.5 * (dn / sig) ** 2)
+        norm = jnp.sqrt(2 * jnp.pi) * 0.5 * (sl + sr)
+        return jnp.sum(g, axis=-1) / norm
+
+    @classmethod
+    def fwhm(cls, shape) -> float:
+        k = 2.0 * math.sqrt(2.0 * math.log(2.0))
+        return float(0.5 * k * (shape[0] + shape[1]))
 
 
 class LCVonMises(LCPrimitive):
@@ -62,7 +105,8 @@ class LCVonMises(LCPrimitive):
     name = "vonmises"
 
     @staticmethod
-    def pdf(phi, loc, width):
+    def pdf(phi, loc, shape):
+        width = shape[0]
         kappa = 1.0 / (2.0 * jnp.pi * width) ** 2
         val = jnp.exp(kappa * (jnp.cos(2 * jnp.pi * (phi - loc)) - 1.0))
         norm = jax.scipy.special.i0e(kappa)  # e^-k I0(k): overflow-safe
@@ -76,13 +120,80 @@ class LCLorentzian(LCPrimitive):
     name = "lorentzian"
 
     @staticmethod
-    def pdf(phi, loc, width):
+    def pdf(phi, loc, shape):
+        width = shape[0]
         rho = jnp.exp(-2.0 * jnp.pi * width)
         c = jnp.cos(2.0 * jnp.pi * (phi - loc))
         return (1.0 - rho ** 2) / (1.0 + rho ** 2 - 2.0 * rho * c)
 
+    @classmethod
+    def fwhm(cls, shape) -> float:
+        return float(2.0 * shape[0])
 
-_PRIM_TYPES = {c.name: c for c in (LCGaussian, LCVonMises, LCLorentzian)}
+
+class LCLorentzian2(LCPrimitive):
+    """Two-sided wrapped Lorentzian: HWHM gamma_left below the peak,
+    gamma_right above (reference: lcprimitives.LCLorentzian2). Built
+    from two half wrapped-Cauchy lobes, each lobe weighted so the
+    composite is continuous at the peak and integrates to 1."""
+
+    name = "lorentzian2"
+    n_shape = 2
+
+    @staticmethod
+    def pdf(phi, loc, shape):
+        gl, gr = shape[0], shape[1]
+
+        def half(width, c):
+            rho = jnp.exp(-2.0 * jnp.pi * width)
+            val = (1.0 - rho ** 2) / (1.0 + rho ** 2 - 2.0 * rho * c)
+            peak = (1.0 + rho) / (1.0 - rho)   # value at phase == loc
+            return val, peak
+
+        # signed phase distance in (-0.5, 0.5]
+        d = jnp.mod(phi - loc + 0.5, 1.0) - 0.5
+        c = jnp.cos(2.0 * jnp.pi * d)
+        vl, pl = half(gl, c)
+        vr, pr = half(gr, c)
+        # scale each lobe to a common peak height, then normalize:
+        # each full wrapped-Cauchy integrates to 1, so each half-lobe
+        # (scaled by s) integrates to s/2.
+        sl = 1.0 / pl
+        sr = 1.0 / pr
+        val = jnp.where(d < 0, sl * vl, sr * vr)
+        return val / (0.5 * (sl + sr))
+
+    @classmethod
+    def fwhm(cls, shape) -> float:
+        return float(shape[0] + shape[1])
+
+
+class LCTopHat(LCPrimitive):
+    """Smoothed top hat: product of two logistic edges of 1% of the
+    width, full width = shape[0] in phase (reference:
+    lcprimitives.LCTopHat — exact box there; smoothed here so the ML
+    fit stays differentiable)."""
+
+    name = "tophat"
+
+    @staticmethod
+    def pdf(phi, loc, shape):
+        width = shape[0]
+        k = 100.0 / width  # edge sharpness: 1% of the width
+        d = jnp.mod(phi - loc + 0.5, 1.0) - 0.5
+        box = jax.nn.sigmoid(k * (d + width / 2)) * \
+            jax.nn.sigmoid(-k * (d - width / 2))
+        # normalization of the product of sigmoids ~ width for k*w >> 1
+        return box / width
+
+    @classmethod
+    def fwhm(cls, shape) -> float:
+        return float(shape[0])
+
+
+_PRIM_TYPES = {c.name: c for c in
+               (LCGaussian, LCGaussian2, LCVonMises, LCLorentzian,
+                LCLorentzian2, LCTopHat)}
 
 
 class LCTemplate:
@@ -92,44 +203,61 @@ class LCTemplate:
 
     def __init__(self, primitives: Sequence[LCPrimitive],
                  norms: Sequence[float], locs: Sequence[float],
-                 widths: Sequence[float]):
+                 widths):
         self.primitives = list(primitives)
         m = len(self.primitives)
-        assert len(norms) == len(locs) == len(widths) == m
+        shapes = [np.atleast_1d(np.asarray(w, dtype=np.float64))
+                  for w in widths]
+        for p, s in zip(self.primitives, shapes):
+            if s.shape != (p.n_shape,):
+                raise ValueError(
+                    f"{p.name} needs {p.n_shape} shape params, "
+                    f"got {s.shape}")
+        assert len(norms) == len(locs) == m
+        self._shape_sizes = [p.n_shape for p in self.primitives]
         self.theta = self.pack(np.asarray(norms, dtype=np.float64),
                                np.asarray(locs, dtype=np.float64),
-                               np.asarray(widths, dtype=np.float64))
+                               shapes)
 
     # ---- flat parameter vector ------------------------------------
 
     @staticmethod
-    def pack(norms, locs, widths) -> np.ndarray:
+    def pack(norms, locs, shapes: List[np.ndarray]) -> np.ndarray:
         bg = 1.0 - np.sum(norms)
         if bg <= 0:
             raise ValueError("norms must sum to < 1")
         logits = np.log(np.concatenate([[bg], norms]))
-        return np.concatenate([logits, locs, np.log(widths)])
+        return np.concatenate([logits, locs,
+                               np.log(np.concatenate(shapes))])
 
-    def unpack(self, theta) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    def unpack(self, theta):
         m = len(self.primitives)
         p = jax.nn.softmax(jnp.asarray(theta[:m + 1]))
         locs = jnp.mod(jnp.asarray(theta[m + 1:2 * m + 1]), 1.0)
-        widths = jnp.exp(jnp.asarray(theta[2 * m + 1:]))
-        return p[1:], locs, widths
+        flat = jnp.exp(jnp.asarray(theta[2 * m + 1:]))
+        shapes, off = [], 0
+        for n in self._shape_sizes:
+            shapes.append(flat[off:off + n])
+            off += n
+        return p[1:], locs, shapes
 
     # ---- evaluation ------------------------------------------------
 
     def _pdf_fn(self):
         prim_pdfs = [p.pdf for p in self.primitives]
+        sizes = list(self._shape_sizes)
         m = len(prim_pdfs)
 
         def pdf(theta, phi):
             p = jax.nn.softmax(theta[:m + 1])
             locs = theta[m + 1:2 * m + 1]
-            widths = jnp.exp(theta[2 * m + 1:])
+            flat = jnp.exp(theta[2 * m + 1:])
             val = p[0] * jnp.ones_like(phi)
+            off = 0
             for k, f in enumerate(prim_pdfs):
-                val = val + p[k + 1] * f(phi, locs[k], widths[k])
+                val = val + p[k + 1] * f(phi, locs[k],
+                                         flat[off:off + sizes[k]])
+                off += sizes[k]
             return val
 
         return pdf
@@ -148,16 +276,55 @@ class LCTemplate:
         return np.asarray(self.unpack(self.theta)[1])
 
     @property
-    def widths(self) -> np.ndarray:
-        return np.asarray(self.unpack(self.theta)[2])
+    def widths(self) -> List[np.ndarray]:
+        return [np.asarray(s) for s in self.unpack(self.theta)[2]]
+
+    # ---- profile statistics (reference: LCTemplate delta/Delta) ----
+
+    def fwhms(self) -> List[float]:
+        return [p.fwhm(s) for p, s in
+                zip(self.primitives, self.widths)]
+
+    def delta(self) -> Optional[float]:
+        """Phase of the highest-amplitude peak (reference:
+        LCTemplate.delta: radio-to-peak offset)."""
+        if not self.primitives:
+            return None
+        k = int(np.argmax(self.norms))
+        return float(self.locs[k])
+
+    def Delta(self) -> Optional[float]:
+        """Separation of the two strongest peaks in phase (reference:
+        LCTemplate.Delta)."""
+        if len(self.primitives) < 2:
+            return None
+        order = np.argsort(self.norms)[::-1]
+        a, b = self.locs[order[0]], self.locs[order[1]]
+        d = abs(a - b)
+        return float(min(d, 1.0 - d))
+
+    def rotate(self, dphi: float):
+        """Shift every peak location by dphi (mod 1), in place
+        (reference: LCTemplate.rotate)."""
+        m = len(self.primitives)
+        th = np.asarray(self.theta).copy()
+        th[m + 1:2 * m + 1] = np.mod(th[m + 1:2 * m + 1] + dphi, 1.0)
+        self.theta = th
+
+    def integrate(self, ph1: float, ph2: float, n: int = 2001) -> float:
+        """Trapezoid integral of the pdf on [ph1, ph2] (reference:
+        LCTemplate.integrate); used for binned likelihoods."""
+        xs = np.linspace(ph1, ph2, n)
+        return float(np.trapezoid(self(xs), xs))
 
     def random(self, n: int,
                rng: Optional[np.random.Generator] = None) -> np.ndarray:
         """Draw n photon phases from the template (for simulation
         tests; reference: LCTemplate.random)."""
         rng = rng or np.random.default_rng()
-        norms, locs, widths = (np.asarray(x) for x in
-                               self.unpack(self.theta))
+        norms = self.norms
+        locs = self.locs
+        shapes = self.widths
         bg = 1.0 - norms.sum()
         comp = rng.choice(len(norms) + 1, size=n,
                           p=np.concatenate([[bg], norms]))
@@ -167,32 +334,115 @@ class LCTemplate:
             nk = int(idx.sum())
             if nk == 0:
                 continue
+            s = shapes[k]
             if isinstance(prim, LCGaussian):
-                draw = rng.normal(locs[k], widths[k], size=nk)
+                draw = rng.normal(locs[k], s[0], size=nk)
+            elif isinstance(prim, LCGaussian2):
+                side = rng.uniform(size=nk) < s[0] / (s[0] + s[1])
+                mag = np.abs(rng.normal(0.0, 1.0, size=nk))
+                draw = locs[k] + np.where(side, -mag * s[0], mag * s[1])
             elif isinstance(prim, LCVonMises):
-                kappa = 1.0 / (2 * np.pi * widths[k]) ** 2
+                kappa = 1.0 / (2 * np.pi * s[0]) ** 2
                 draw = locs[k] + rng.vonmises(0.0, kappa, size=nk) / (
                     2 * np.pi)
-            else:  # Lorentzian
-                draw = locs[k] + widths[k] * np.tan(
-                    np.pi * (rng.uniform(size=nk) - 0.5)) / (2 * np.pi)
+            elif isinstance(prim, LCTopHat):
+                draw = locs[k] + s[0] * (rng.uniform(size=nk) - 0.5)
+            elif isinstance(prim, LCLorentzian2):
+                side = rng.uniform(size=nk) < s[0] / (s[0] + s[1])
+                mag = np.abs(np.tan(np.pi * (rng.uniform(size=nk)
+                                             - 0.5)))
+                draw = locs[k] + np.where(side, -mag * s[0],
+                                          mag * s[1])
+            else:  # Lorentzian: Cauchy with HWHM already in phase
+                draw = locs[k] + s[0] * np.tan(
+                    np.pi * (rng.uniform(size=nk) - 0.5))
             out[idx] = draw
         return np.mod(out, 1.0)
 
+    def __str__(self):
+        lines = []
+        for p, nrm, loc, sh in zip(self.primitives, self.norms,
+                                   self.locs, self.widths):
+            ss = " ".join(f"{x:.6g}" for x in sh)
+            lines.append(f"{p.name:<12} norm={nrm:.4f} loc={loc:.4f} "
+                         f"shape=[{ss}]")
+        lines.append(f"background   {1.0 - self.norms.sum():.4f}")
+        return "\n".join(lines)
 
-@partial(jax.jit, static_argnames=("pdf_id",))
-def _nll_cached(theta, phases, weights, pdf_id):  # pragma: no cover
-    raise RuntimeError("placeholder; replaced per-template below")
+
+def make_template(spec: Sequence[Tuple[str, float, float, object]]
+                  ) -> LCTemplate:
+    """Build from (name, norm, loc, width-or-widths) rows; names are
+    the primitive ``name`` attributes ('gaussian', 'vonmises', ...)."""
+    prims, norms, locs, widths = [], [], [], []
+    for name, nrm, loc, w in spec:
+        try:
+            prims.append(_PRIM_TYPES[name]())
+        except KeyError:
+            raise ValueError(f"unknown primitive {name!r}; know "
+                             f"{sorted(_PRIM_TYPES)}") from None
+        norms.append(nrm)
+        locs.append(loc)
+        widths.append(w)
+    return LCTemplate(prims, norms, locs, widths)
+
+
+# ---- template file I/O (reference: lcprimitives prim_io /
+# lctemplate.prim_io read/write of .gauss profile files) -------------
+
+def write_template(template: LCTemplate, path: str):
+    """Plain-text profile file: one primitive per line,
+    ``name norm loc shape...``, '#' comments."""
+    with open(path, "w") as fh:
+        fh.write("# pint_tpu pulse-profile template\n")
+        fh.write("# name norm loc shape_params...\n")
+        for p, nrm, loc, sh in zip(template.primitives, template.norms,
+                                   template.locs, template.widths):
+            ss = " ".join(repr(float(x)) for x in sh)
+            fh.write(f"{p.name} {float(nrm)!r} {float(loc)!r} {ss}\n")
+
+
+def read_template(path: str) -> LCTemplate:
+    spec = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            toks = line.split()
+            name = toks[0].lower()
+            vals = [float(t) for t in toks[1:]]
+            if len(vals) < 3:
+                raise ValueError(f"bad template line: {line!r}")
+            spec.append((name, vals[0], vals[1],
+                         vals[2] if len(vals) == 3 else vals[2:]))
+    if not spec:
+        raise ValueError(f"no primitives found in {path}")
+    return make_template(spec)
+
+
+class GaussianPrior:
+    """Gaussian penalty on selected theta entries (reference:
+    lcfitters' location/width priors keeping peaks from wandering)."""
+
+    def __init__(self, indices, means, sigmas):
+        self.indices = jnp.asarray(np.asarray(indices, dtype=np.int64))
+        self.means = jnp.asarray(np.asarray(means, dtype=np.float64))
+        self.sigmas = jnp.asarray(np.asarray(sigmas, dtype=np.float64))
+
+    def nll(self, theta):
+        z = (theta[self.indices] - self.means) / self.sigmas
+        return 0.5 * jnp.sum(z * z)
 
 
 class LCFitter:
     """Unbinned weighted ML template fitter (reference:
     lcfitters.LCFitter). loglikelihood = sum_i log(w_i f(phi_i) +
-    (1-w_i)); optimization is jitted gradient descent with backtracking
-    (no scipy dependency on the device path)."""
+    (1-w_i)); the photon-axis reduction is one jitted XLA program and
+    the optimizer is host L-BFGS-B over the device value-and-grad."""
 
     def __init__(self, template: LCTemplate, phases,
-                 weights=None):
+                 weights=None, prior: Optional[GaussianPrior] = None):
         self.template = template
         self.phases = jnp.asarray(np.mod(phases, 1.0))
         self.weights = (jnp.ones_like(self.phases) if weights is None
@@ -201,32 +451,100 @@ class LCFitter:
 
         def nll(theta):
             f = pdf(theta, self.phases)
-            return -jnp.sum(jnp.log(self.weights * f
-                                    + (1.0 - self.weights)))
+            val = -jnp.sum(jnp.log(self.weights * f
+                                   + (1.0 - self.weights)))
+            if prior is not None:
+                val = val + prior.nll(theta)
+            return val
 
         self._nll = jax.jit(nll)
         self._valgrad = jax.jit(jax.value_and_grad(nll))
+        self._hess = jax.jit(jax.hessian(nll))
 
     def loglikelihood(self, theta=None) -> float:
         theta = self.template.theta if theta is None else theta
         return -float(self._nll(jnp.asarray(theta)))
 
-    def fit(self, maxiter: int = 500) -> dict:
-        """ML fit: host L-BFGS-B over the jitted device
-        value-and-grad (the reduction over the photon axis is the hot
-        part and runs as one XLA program per evaluation); updates the
-        template's theta in place."""
+    def fit(self, maxiter: int = 500, compute_errors: bool = True
+            ) -> dict:
+        """ML fit; updates the template's theta in place. With
+        compute_errors, invert the exact autodiff Hessian at the
+        optimum for the theta covariance (reference: LCFitter's
+        hess_errors)."""
         from scipy.optimize import minimize
 
         def f(x):
             v, g = self._valgrad(jnp.asarray(x))
             return float(v), np.asarray(g, dtype=np.float64)
 
+        # dense BFGS: theta is tiny (3m+1) and scipy 1.17's L-BFGS-B
+        # line search stalls on the phase-periodic landscape
         res = minimize(f, np.asarray(self.template.theta), jac=True,
-                       method="L-BFGS-B",
-                       options={"maxiter": maxiter})
+                       method="BFGS",
+                       options={"maxiter": maxiter, "gtol": 1e-6})
         self.template.theta = np.asarray(res.x)
-        return {"loglikelihood": -float(res.fun),
+        gnorm = float(np.linalg.norm(res.jac))
+        # BFGS often ends with "precision loss" right at the optimum;
+        # a small gradient relative to |logL| is convergence
+        out = {"loglikelihood": -float(res.fun),
+               "iterations": int(res.nit),
+               "grad_norm": gnorm,
+               "success": bool(res.success)
+               or gnorm < 1e-4 * max(1.0, abs(float(res.fun)))}
+        if compute_errors:
+            H = np.asarray(self._hess(jnp.asarray(res.x)))
+            try:
+                cov = np.linalg.inv(H)
+                err = np.sqrt(np.maximum(np.diag(cov), 0.0))
+            except np.linalg.LinAlgError:
+                cov = None
+                err = np.full(len(res.x), np.nan)
+            out["theta_cov"] = cov
+            out["theta_err"] = err
+        return out
+
+    # ---- binned fit (reference: LCFitter chi-squared path) ---------
+
+    def fit_binned(self, nbins: int = 64, maxiter: int = 500) -> dict:
+        """Weighted binned Poisson-chi2 fit: faster for huge photon
+        sets; bins the weighted phase histogram once on the host, then
+        minimizes chi2 against bin-center pdf values."""
+        from scipy.optimize import minimize
+
+        w = np.asarray(self.weights)
+        ph = np.asarray(self.phases)
+        hist, edges = np.histogram(ph, bins=nbins, range=(0.0, 1.0),
+                                   weights=w)
+        var, _ = np.histogram(ph, bins=nbins, range=(0.0, 1.0),
+                              weights=w * w)
+        centers = 0.5 * (edges[:-1] + edges[1:])
+        wsum = w.sum()
+        pdf = self.template._pdf_fn()
+        cj = jnp.asarray(centers)
+        hj = jnp.asarray(hist)
+        vj = jnp.asarray(np.maximum(var, 1e-12))
+
+        def chi2(theta):
+            mu = pdf(theta, cj) * (wsum / nbins)
+            return jnp.sum((hj - mu) ** 2 / vj)
+
+        vg = jax.jit(jax.value_and_grad(chi2))
+
+        def f(x):
+            v, g = vg(jnp.asarray(x))
+            return float(v), np.asarray(g, dtype=np.float64)
+
+        res = minimize(f, np.asarray(self.template.theta), jac=True,
+                       method="BFGS",
+                       options={"maxiter": maxiter, "gtol": 1e-6})
+        self.template.theta = np.asarray(res.x)
+        gnorm = float(np.linalg.norm(res.jac))
+        return {"chi2": float(res.fun), "nbins": nbins,
                 "iterations": int(res.nit),
-                "grad_norm": float(np.linalg.norm(res.jac)),
-                "success": bool(res.success)}
+                "success": bool(res.success)
+                or gnorm < 1e-4 * max(1.0, abs(float(res.fun)))}
+
+    def __str__(self):
+        return (f"LCFitter: {len(np.asarray(self.phases))} photons, "
+                f"logL={self.loglikelihood():.2f}\n"
+                f"{self.template}")

@@ -1,0 +1,138 @@
+"""Expanded template-zoo tests: multi-shape primitives, file I/O,
+Hessian errors, binned fit, profile statistics.
+
+Reference behaviors: src/pint/templates/lcprimitives.py (LCGaussian2,
+LCLorentzian2, LCTopHat), lctemplate.py (delta/Delta/rotate), and
+lcfitters.py (hessian errors, chi-squared binned path)."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.templates import (
+    GaussianPrior,
+    LCFitter,
+    LCGaussian,
+    LCGaussian2,
+    LCLorentzian2,
+    LCTemplate,
+    LCTopHat,
+    make_template,
+    read_template,
+    write_template,
+)
+
+
+GRID = np.linspace(0, 1, 20001)[:-1]
+
+
+@pytest.mark.parametrize("spec", [
+    ("gaussian2", [0.03, 0.06]),
+    ("lorentzian2", [0.02, 0.05]),
+    ("tophat", [0.2]),
+])
+def test_new_primitives_normalized(spec):
+    name, w = spec
+    t = make_template([(name, 0.7, 0.45, w)])
+    integral = np.mean(t(GRID))
+    assert integral == pytest.approx(1.0, rel=2e-2), name
+
+
+def test_gaussian2_asymmetry():
+    t = make_template([("gaussian2", 0.9, 0.5, [0.02, 0.08])])
+    pdf = t(GRID)
+    peak = GRID[np.argmax(pdf)]
+    assert peak == pytest.approx(0.5, abs=0.005)
+    # mass right of the peak ~ sr/(sl+sr) of the pulsed part
+    pulsed = pdf - pdf.min()
+    right = pulsed[(GRID > 0.5) & (GRID < 0.9)].sum()
+    left = pulsed[(GRID > 0.1) & (GRID < 0.5)].sum()
+    assert right / (right + left) == pytest.approx(0.8, abs=0.05)
+
+
+def test_template_file_roundtrip(tmp_path):
+    t = make_template([
+        ("gaussian", 0.5, 0.3, 0.03),
+        ("gaussian2", 0.2, 0.7, [0.02, 0.05]),
+    ])
+    path = tmp_path / "profile.txt"
+    write_template(t, str(path))
+    t2 = read_template(str(path))
+    np.testing.assert_allclose(t2(GRID), t(GRID), rtol=1e-10)
+    assert [p.name for p in t2.primitives] == ["gaussian", "gaussian2"]
+
+
+def test_profile_statistics():
+    t = make_template([
+        ("gaussian", 0.5, 0.2, 0.03),
+        ("gaussian", 0.3, 0.6, 0.05),
+    ])
+    assert t.delta() == pytest.approx(0.2)
+    assert t.Delta() == pytest.approx(0.4)
+    fw = t.fwhms()
+    assert fw[0] == pytest.approx(2.3548 * 0.03, rel=1e-3)
+    t.rotate(0.25)
+    assert t.delta() == pytest.approx(0.45)
+    # integrate over the whole cycle -> 1
+    assert t.integrate(0.0, 1.0) == pytest.approx(1.0, rel=1e-3)
+
+
+def test_fit_reports_errors():
+    truth = LCTemplate([LCGaussian()], norms=[0.6], locs=[0.3],
+                       widths=[0.03])
+    rng = np.random.default_rng(11)
+    phases = truth.random(6000, rng=rng)
+    fit_t = LCTemplate([LCGaussian()], norms=[0.4], locs=[0.33],
+                       widths=[0.05])
+    f = LCFitter(fit_t, phases)
+    res = f.fit()
+    assert res["success"]
+    assert res["theta_err"].shape == fit_t.theta.shape
+    assert np.all(np.isfinite(res["theta_err"]))
+    # loc is theta[m+1] = theta[2]; 1-sigma should be small and the
+    # recovered loc within ~4 sigma of truth
+    m = 1
+    loc_err = res["theta_err"][m + 1]
+    assert 1e-4 < loc_err < 0.01
+    assert abs(fit_t.locs[0] - 0.3) < 5 * loc_err + 1e-3
+
+
+def test_binned_fit_recovers():
+    truth = LCTemplate([LCGaussian()], norms=[0.7], locs=[0.55],
+                       widths=[0.04])
+    rng = np.random.default_rng(12)
+    phases = truth.random(20000, rng=rng)
+    fit_t = LCTemplate([LCGaussian()], norms=[0.5], locs=[0.5],
+                       widths=[0.07])
+    f = LCFitter(fit_t, phases)
+    res = f.fit_binned(nbins=64)
+    assert res["success"]
+    assert fit_t.locs[0] == pytest.approx(0.55, abs=0.01)
+    assert fit_t.widths[0][0] == pytest.approx(0.04, abs=0.01)
+
+
+def test_gaussian_prior_pins_location():
+    truth = LCTemplate([LCGaussian()], norms=[0.6], locs=[0.3],
+                       widths=[0.03])
+    rng = np.random.default_rng(13)
+    phases = truth.random(2000, rng=rng)
+    fit_t = LCTemplate([LCGaussian()], norms=[0.5], locs=[0.42],
+                       widths=[0.05])
+    # very tight prior holding loc at its (wrong) initial value
+    prior = GaussianPrior([2], [0.42], [1e-5])
+    f = LCFitter(fit_t, phases, prior=prior)
+    f.fit(compute_errors=False)
+    assert fit_t.locs[0] == pytest.approx(0.42, abs=1e-3)
+
+
+def test_gaussian2_ml_recovery():
+    truth = make_template([("gaussian2", 0.7, 0.4, [0.02, 0.06])])
+    rng = np.random.default_rng(14)
+    phases = truth.random(20000, rng=rng)
+    fit_t = make_template([("gaussian2", 0.5, 0.42, [0.04, 0.04])])
+    f = LCFitter(fit_t, phases)
+    res = f.fit(compute_errors=False)
+    assert res["loglikelihood"] > -np.inf
+    assert fit_t.locs[0] == pytest.approx(0.4, abs=0.01)
+    sl, sr = fit_t.widths[0]
+    assert sl == pytest.approx(0.02, abs=0.01)
+    assert sr == pytest.approx(0.06, abs=0.015)
