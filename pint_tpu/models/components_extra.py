@@ -200,7 +200,12 @@ class WaveX(DelayComponent):
 
     def add_wavex_component(self, freq_per_day, index=None, wxsin=0.0,
                             wxcos=0.0, frozen=False):
-        index = index or (len(self.wavex_ids) + 1)
+        # next slot = one past the highest USED index, not the count:
+        # with non-contiguous indices (e.g. 0001+0003) the count would
+        # land on and overwrite an existing slot
+        if index is None:
+            index = (max((i for i, _ in self.wavex_ids), default=0)
+                     + 1)
         istr = f"{index:04d}"
         for pre, val, frz in (("WXFREQ_", freq_per_day, True),
                               ("WXSIN_", wxsin, frozen),
@@ -273,6 +278,32 @@ class DMWaveX(DelayComponent):
                                        index_str="0001",
                                        units="pc cm^-3"))
         self.dmwavex_ids: list = []
+
+    def add_dmwavex_component(self, freq_per_day, index=None,
+                              dmwxsin=0.0, dmwxcos=0.0, frozen=False):
+        """Fill or create one Fourier slot; next index is one past the
+        highest existing slot (mirrors WaveX.add_wavex_component)."""
+        if index is None:
+            highest = [split_prefixed_name(nm)[2]
+                       for nm in self.params
+                       if nm.startswith("DMWXFREQ_")
+                       and self.params[nm].value is not None]
+            index = (max(highest) if highest else 0) + 1
+        istr = f"{index:04d}"
+        for pre, val, frz in (("DMWXFREQ_", freq_per_day, True),
+                              ("DMWXSIN_", dmwxsin, frozen),
+                              ("DMWXCOS_", dmwxcos, frozen)):
+            name = f"{pre}{istr}"
+            if name in self.params:
+                p = self.params[name]
+                p.value = val
+                p.frozen = frz
+            else:
+                self.add_param(prefixParameter(
+                    prefix=pre, index=index, index_str=istr, value=val,
+                    frozen=frz, units=self.params[f"{pre}0001"].units))
+        self.setup()
+        return index
 
     def setup(self):
         ids = []

@@ -16,7 +16,11 @@ from pint_tpu.models.parameter import split_prefixed_name  # noqa: F401
 from pint_tpu.ops.taylor import taylor_horner  # noqa: F401
 
 __all__ = ["FTest", "weighted_mean", "dmxparse",
-           "split_prefixed_name", "taylor_horner"]
+           "split_prefixed_name", "taylor_horner",
+           "format_uncertainty", "dmx_ranges", "add_dmx_ranges",
+           "wavex_setup", "dmwavex_setup",
+           "akaike_information_criterion",
+           "bayesian_information_criterion", "PosVel"]
 
 
 def FTest(chi2_1: float, dof_1: int, chi2_2: float, dof_2: int) -> float:
@@ -87,3 +91,166 @@ def dmxparse(fitter) -> dict:
             "dmxeps": np.array(eps), "r1s": np.array(r1s),
             "r2s": np.array(r2s), "bins": bins,
             "mean_dmx": float(np.mean(dmxs))}
+
+
+def format_uncertainty(value: float, unc: Optional[float],
+                       sig_digits: int = 2) -> str:
+    """Compact parenthesized-uncertainty notation used in pulsar
+    publication tables: 1.234567(89) means 1.234567 +- 0.000089
+    (reference: pintpublish's table formatting). With no uncertainty,
+    plain repr of the value."""
+    if unc is None or not np.isfinite(unc) or unc <= 0:
+        return repr(float(value))
+    exp = int(np.floor(np.log10(unc)))
+    # decimals so the uncertainty shows sig_digits digits
+    dec = max(0, sig_digits - 1 - exp)
+    udigits = int(round(unc * 10 ** dec))
+    if udigits >= 10 ** sig_digits:  # rounding bumped a digit
+        udigits //= 10
+        dec -= 1
+        if dec < 0:
+            dec = 0
+            udigits = int(round(unc))
+    if dec == 0:
+        return f"{value:.0f}({udigits})"
+    return f"{value:.{dec}f}({udigits})"
+
+
+def dmx_ranges(toas, max_window_days: float = 14.0,
+               min_gap_days: float = 0.1):
+    """Auto-generate DMX windows from TOA epochs: cluster MJDs into
+    groups no wider than ``max_window_days``, one (r1, r2) window per
+    group padded by ``min_gap_days`` (reference: utils.dmx_ranges)."""
+    mjds = np.sort(np.unique(np.asarray(toas.get_mjds())))
+    if len(mjds) == 0:
+        return []
+    clusters = []
+    start = prev = mjds[0]
+    for m in mjds[1:]:
+        if m - start > max_window_days:
+            clusters.append((start, prev))
+            start = m
+        prev = m
+    clusters.append((start, prev))
+    # pad, but never past the midpoint to the neighboring cluster —
+    # densely sampled data would otherwise get overlapping windows
+    # (a TOA in two windows makes two degenerate DMX columns)
+    ranges = []
+    for i, (c1, c2) in enumerate(clusters):
+        lo = c1 - min_gap_days
+        hi = c2 + min_gap_days
+        if i > 0:
+            lo = max(lo, 0.5 * (clusters[i - 1][1] + c1))
+        if i < len(clusters) - 1:
+            hi = min(hi, 0.5 * (c2 + clusters[i + 1][0]))
+        ranges.append((lo, hi))
+    return ranges
+
+
+def add_dmx_ranges(model, toas, max_window_days: float = 14.0,
+                   frozen: bool = False) -> int:
+    """Attach auto-generated DMX windows to the model's DispersionDMX
+    component (created if absent); returns the number of windows."""
+    from pint_tpu.models.dispersion import DispersionDMX
+
+    comp = model.components.get("DispersionDMX")
+    if comp is None:
+        comp = DispersionDMX()
+        model.add_component(comp, setup=False)
+    # one past the highest existing index: the count would collide
+    # with (and overwrite) existing windows when indices have gaps
+    start = max((i for i, _ in comp.dmx_ids), default=0)
+    ranges = dmx_ranges(toas, max_window_days=max_window_days)
+    for k, (r1, r2) in enumerate(ranges):
+        comp.add_dmx_range(start + k + 1, r1, r2, value=0.0,
+                           frozen=frozen)
+    comp.setup()
+    model.invalidate_cache()
+    return len(ranges)
+
+
+def wavex_setup(model, t_span_days: float, n_freqs: int,
+                frozen: bool = False) -> list:
+    """Attach a WaveX component with harmonically spaced frequencies
+    k/T, k=1..n (reference: utils.wavex_setup). Returns the
+    frequencies in 1/day."""
+    from pint_tpu.models.components_extra import WaveX
+
+    comp = model.components.get("WaveX")
+    if comp is None:
+        comp = WaveX()
+        model.add_component(comp, setup=False)
+    freqs = [k / t_span_days for k in range(1, n_freqs + 1)]
+    for f in freqs:
+        comp.add_wavex_component(f, frozen=frozen)
+    comp.setup()
+    model.invalidate_cache()
+    return freqs
+
+
+def dmwavex_setup(model, t_span_days: float, n_freqs: int,
+                  frozen: bool = False) -> list:
+    """Attach a DMWaveX component with frequencies k/T (reference:
+    utils.dmwavex_setup)."""
+    from pint_tpu.models.components_extra import DMWaveX
+
+    comp = model.components.get("DMWaveX")
+    if comp is None:
+        comp = DMWaveX()
+        model.add_component(comp, setup=False)
+    freqs = [k / t_span_days for k in range(1, n_freqs + 1)]
+    for f in freqs:
+        comp.add_dmwavex_component(f, frozen=frozen)
+    comp.setup()
+    model.invalidate_cache()
+    return freqs
+
+
+def akaike_information_criterion(fitter) -> float:
+    """AIC = 2k + chi2 for the fitted model (Gaussian likelihood up to
+    a constant; reference: utils.akaike_information_criterion)."""
+    k = len(fitter.model.free_params)
+    return 2.0 * k + float(fitter.resids.chi2)
+
+
+def bayesian_information_criterion(fitter) -> float:
+    """BIC = k ln N + chi2 (reference: utils.bic)."""
+    k = len(fitter.model.free_params)
+    n = fitter.toas.ntoas
+    return k * float(np.log(n)) + float(fitter.resids.chi2)
+
+
+class PosVel:
+    """Minimal 6-vector position/velocity with frame bookkeeping
+    (reference: utils.PosVel): supports +/- chaining with
+    origin/destination checking, dot products, and numpy access."""
+
+    def __init__(self, pos, vel, origin=None, obj=None):
+        self.pos = np.asarray(pos, dtype=np.float64)
+        self.vel = np.asarray(vel, dtype=np.float64)
+        self.origin = origin
+        self.obj = obj
+
+    def __add__(self, other: "PosVel") -> "PosVel":
+        if self.obj is not None and other.origin is not None and \
+                self.obj != other.origin:
+            raise ValueError(
+                f"cannot chain {self.origin}->{self.obj} with "
+                f"{other.origin}->{other.obj}")
+        return PosVel(self.pos + other.pos, self.vel + other.vel,
+                      origin=self.origin, obj=other.obj)
+
+    def __sub__(self, other: "PosVel") -> "PosVel":
+        if self.origin is not None and other.origin is not None and \
+                self.origin != other.origin:
+            raise ValueError("subtraction needs a common origin")
+        return PosVel(self.pos - other.pos, self.vel - other.vel,
+                      origin=other.obj, obj=self.obj)
+
+    def __neg__(self) -> "PosVel":
+        return PosVel(-self.pos, -self.vel, origin=self.obj,
+                      obj=self.origin)
+
+    def __repr__(self):
+        return (f"PosVel({self.origin or '?'} -> {self.obj or '?'}, "
+                f"|r|={np.linalg.norm(self.pos, axis=-1)!r})")

@@ -1,0 +1,315 @@
+"""New misc CLIs (convert_parfile, t2binary2pint, pintpublish) and
+utils additions (format_uncertainty, dmx_ranges, wavex setup, AIC/BIC,
+PosVel). Reference: src/pint/scripts/convert_parfile.py,
+t2binary2pint.py, pintpublish.py; src/pint/utils.py."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+BINPAR = """
+PSR J1012+5307
+RAJ 10:12:33.43 1
+DECJ 53:07:02.6 1
+F0 190.2678 1
+F1 -6.2e-16 1
+PEPOCH 55500
+DM 9.02
+BINARY ELL1
+PB 0.60467 1
+A1 0.581816 1
+TASC 55000.1 1
+EPS1 1e-5 1
+EPS2 -2e-5 1
+TZRMJD 55500.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+T2PAR = """
+PSR J1713+0747
+RAJ 17:13:49.53 1
+DECJ 07:47:37.5 1
+F0 218.8118 1
+F1 -4.08e-16 1
+PEPOCH 55500
+DM 15.99
+BINARY T2
+PB 67.8251 1
+A1 32.3424 1
+T0 55000.0 1
+ECC 7.49e-5 1
+OM 176.19 1
+M2 0.29 1
+KIN 71.7 1
+KOM 91.0 1
+UNITS TDB
+"""
+
+
+def test_convert_parfile_binary(tmp_path, capsys):
+    from pint_tpu.scripts.convert_parfile import main
+
+    par = tmp_path / "ell1.par"
+    par.write_text(BINPAR.strip() + "\n")
+    out = tmp_path / "dd.par"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert main([str(par), "-o", str(out), "--binary", "DD"]) == 0
+        m = get_model(str(out))
+    assert "BinaryDD" in m.components
+    # eccentricity recovered from EPS1/EPS2
+    ecc = np.hypot(1e-5, 2e-5)
+    assert m.get_param("ECC").value == pytest.approx(ecc, rel=1e-6)
+
+
+def test_convert_parfile_stdout_passthrough(tmp_path, capsys):
+    from pint_tpu.scripts.convert_parfile import main
+
+    par = tmp_path / "ell1.par"
+    par.write_text(BINPAR.strip() + "\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert main([str(par)]) == 0
+    out = capsys.readouterr().out
+    assert "BINARY" in out and "ELL1" in out
+
+
+def test_t2binary2pint_ddk(tmp_path, capsys):
+    from pint_tpu.scripts.t2binary2pint import main, t2_to_native_parfile
+
+    converted = t2_to_native_parfile(T2PAR)
+    assert "BINARY DDK" in converted
+    # IAU -> DT92: KIN 180-71.7, KOM 90-91
+    assert "108.3" in converted
+    assert "-1.0" in converted
+
+    par = tmp_path / "t2.par"
+    par.write_text(T2PAR.strip() + "\n")
+    out = tmp_path / "native.par"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert main([str(par), str(out)]) == 0
+        m = get_model(str(out))
+    assert "BinaryDDK" in m.components
+    assert m.get_param("KIN").value == pytest.approx(108.3)
+
+
+def test_t2binary2pint_non_t2_passthrough():
+    from pint_tpu.scripts.t2binary2pint import t2_to_native_parfile
+
+    assert t2_to_native_parfile(BINPAR) == BINPAR
+
+
+def test_pintpublish(tmp_path, capsys):
+    from pint_tpu.scripts.pintpublish import main
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BINPAR))
+        rng = np.random.default_rng(3)
+        toas = make_fake_toas_uniform(55000, 56000, 60, model,
+                                      error_us=1.0, freq_mhz=1400.0,
+                                      add_noise=True, rng=rng)
+    par = tmp_path / "pub.par"
+    tim = tmp_path / "pub.tim"
+    par.write_text(model.as_parfile())
+    toas.write_TOA_file(tim)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert main([str(par), str(tim)]) == 0
+    out = capsys.readouterr().out
+    assert r"\begin{tabular}" in out
+    assert "F0" in out
+    assert "Mass function" in out
+
+
+# ------------------------------------------------------------- utils
+
+
+def test_format_uncertainty():
+    from pint_tpu.utils import format_uncertainty
+
+    assert format_uncertainty(1.234567, 0.000089) == "1.234567(89)"
+    assert format_uncertainty(1.234567, 0.00012) == "1.23457(12)"
+    assert format_uncertainty(312.5, 2.4) == "312.5(24)"
+    assert format_uncertainty(312.5, 24.0) == "312(24)"
+    assert format_uncertainty(5.0, None) == "5.0"
+    # rounding that bumps a digit: 0.0999 -> shows as (10) at 2 digits
+    s = format_uncertainty(1.5, 0.0999)
+    assert "(" in s
+
+
+def test_dmx_ranges_and_add(tmp_path):
+    from pint_tpu.utils import add_dmx_ranges, dmx_ranges
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BINPAR))
+        rng = np.random.default_rng(4)
+        toas = make_fake_toas_uniform(55000, 55100, 50, model,
+                                      error_us=1.0, rng=rng)
+    ranges = dmx_ranges(toas, max_window_days=14.0)
+    assert len(ranges) >= 6
+    mjds = np.asarray(toas.get_mjds())
+    for r1, r2 in ranges:
+        assert r2 > r1
+        assert r2 - r1 <= 14.0 + 0.3
+    # every TOA falls inside exactly one window
+    counts = sum(((mjds >= r1) & (mjds <= r2)).astype(int)
+                 for r1, r2 in ranges)
+    assert np.all(counts == 1)
+
+    n = add_dmx_ranges(model, toas, max_window_days=14.0)
+    assert n == len(ranges)
+    comp = model.components["DispersionDMX"]
+    assert len(comp.dmx_ids) == n
+
+
+def test_wavex_setup_roundtrip():
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.utils import wavex_setup
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BINPAR))
+        freqs = wavex_setup(model, t_span_days=1000.0, n_freqs=3)
+        assert freqs == pytest.approx([1e-3, 2e-3, 3e-3])
+        comp = model.components["WaveX"]
+        assert len(comp.wavex_ids) == 3
+        # model still evaluates with the new (zero-amplitude) modes
+        rng = np.random.default_rng(5)
+        toas = make_fake_toas_uniform(55000, 56000, 30, model,
+                                      error_us=1.0, rng=rng)
+        r = Residuals(toas, model)
+        assert np.all(np.isfinite(r.time_resids))
+
+
+def test_dmwavex_setup():
+    from pint_tpu.utils import dmwavex_setup
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BINPAR))
+        freqs = dmwavex_setup(model, t_span_days=500.0, n_freqs=2)
+    comp = model.components["DMWaveX"]
+    got = sorted(comp.params[nm].value for nm in comp.params
+                 if nm.startswith("DMWXFREQ_")
+                 and comp.params[nm].value is not None)
+    assert got == pytest.approx(freqs)
+
+
+def test_aic_bic():
+    from pint_tpu.fitter import WLSFitter
+    from pint_tpu.utils import (akaike_information_criterion,
+                                bayesian_information_criterion)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BINPAR))
+        rng = np.random.default_rng(6)
+        toas = make_fake_toas_uniform(55000, 56000, 50, model,
+                                      error_us=1.0, add_noise=True,
+                                      rng=rng)
+        f = WLSFitter(toas, model)
+        f.fit_toas(maxiter=2)
+    aic = akaike_information_criterion(f)
+    bic = bayesian_information_criterion(f)
+    k = len(model.free_params)
+    assert aic == pytest.approx(2 * k + float(f.resids.chi2))
+    assert bic > aic  # ln(50) > 2
+
+
+def test_dmx_ranges_dense_no_overlap():
+    """Dense sampling must not produce overlapping windows (two
+    degenerate DMX columns)."""
+    from pint_tpu.utils import dmx_ranges
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BINPAR))
+        rng = np.random.default_rng(8)
+        toas = make_fake_toas_uniform(55000, 55040, 800, model,
+                                      error_us=1.0, rng=rng)
+    ranges = dmx_ranges(toas, max_window_days=14.0)
+    mjds = np.asarray(toas.get_mjds())
+    counts = sum(((mjds >= r1) & (mjds <= r2)).astype(int)
+                 for r1, r2 in ranges)
+    assert np.all(counts == 1)
+    for (a1, a2), (b1, b2) in zip(ranges, ranges[1:]):
+        assert a2 <= b1
+
+
+def test_add_dmx_noncontiguous_indices():
+    """Existing DMX_0003 must survive adding auto windows (index is
+    one past the max, not the count)."""
+    from pint_tpu.utils import add_dmx_ranges
+
+    par = BINPAR + ("DMX_0001 0.001 1\nDMXR1_0001 54000\n"
+                    "DMXR2_0001 54010\n"
+                    "DMX_0003 0.003 1\nDMXR1_0003 54500\n"
+                    "DMXR2_0003 54510\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(par))
+        rng = np.random.default_rng(9)
+        toas = make_fake_toas_uniform(55000, 55030, 10, model,
+                                      error_us=1.0, rng=rng)
+        add_dmx_ranges(model, toas, max_window_days=14.0)
+    comp = model.components["DispersionDMX"]
+    assert comp.params["DMX_0003"].value == pytest.approx(0.003)
+    assert comp.params["DMXR1_0003"].value == pytest.approx(54500)
+    new_idx = [i for i, _ in comp.dmx_ids]
+    assert min(i for i in new_idx if i > 3) == 4
+
+
+def test_wavex_add_noncontiguous_indices():
+    from pint_tpu.models.components_extra import WaveX
+
+    par = BINPAR + ("WXFREQ_0001 0.001\nWXSIN_0001 1e-6 1\n"
+                    "WXCOS_0001 1e-6 1\n"
+                    "WXFREQ_0003 0.003\nWXSIN_0003 2e-6 1\n"
+                    "WXCOS_0003 2e-6 1\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(par))
+    comp = model.components["WaveX"]
+    idx = comp.add_wavex_component(0.005)
+    assert idx == 4
+    assert comp.params["WXFREQ_0003"].value == pytest.approx(0.003)
+
+
+def test_lorentzian_random_matches_pdf():
+    """Regression: draws were ~2pi too narrow vs the wrapped-Cauchy
+    pdf."""
+    from pint_tpu.templates import make_template
+
+    t = make_template([("lorentzian", 0.9, 0.5, 0.03)])
+    rng = np.random.default_rng(10)
+    draws = t.random(60000, rng=rng)
+    hist, edges = np.histogram(draws, bins=50, range=(0, 1),
+                               density=True)
+    centers = 0.5 * (edges[:-1] + edges[1:])
+    np.testing.assert_allclose(hist, t(centers), atol=0.45)
+
+
+def test_posvel_chaining():
+    from pint_tpu.utils import PosVel
+
+    a = PosVel([1, 0, 0], [0, 1, 0], origin="ssb", obj="earth")
+    b = PosVel([0, 1, 0], [0, 0, 1], origin="earth", obj="obs")
+    c = a + b
+    assert c.origin == "ssb" and c.obj == "obs"
+    np.testing.assert_allclose(c.pos, [1, 1, 0])
+    with pytest.raises(ValueError):
+        _ = b + a  # obs -> ssb mismatch
+    d = -a
+    assert d.origin == "earth" and d.obj == "ssb"
+    e = a - a
+    assert e.origin == "earth" and e.obj == "earth"
