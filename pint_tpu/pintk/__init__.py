@@ -1,0 +1,60 @@
+"""Interactive fitting GUI (reference: src/pint/pintk/: the `pintk`
+script with PlkWidget + par/tim editors over a Pulsar facade).
+
+Architecture: ALL behavior lives in headless classes —
+:class:`pint_tpu.pintk.pulsar.Pulsar` (fit/select/delete/jump/undo),
+:class:`pint_tpu.pintk.plk.PlkState` (axes/colors/box-select),
+``ParEditState``/``TimEditState`` — and the Tk widgets are thin shells,
+so the whole GUI logic runs under pytest without a display and the
+same facade is scriptable from notebooks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pint_tpu.pintk.pulsar import Pulsar  # noqa: F401
+
+__all__ = ["Pulsar", "main"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pintk", description="Interactive timing-model fitter")
+    p.add_argument("parfile")
+    p.add_argument("timfile")
+    p.add_argument("--fitter", default="auto",
+                   choices=["auto", "wls", "gls", "downhill",
+                            "downhill_gls"])
+    args = p.parse_args(argv)
+
+    try:
+        import tkinter as tk
+    except ImportError as e:  # pragma: no cover - env without Tk
+        raise SystemExit(f"pintk needs tkinter: {e}")
+
+    from pint_tpu.pintk.paredit import ParWidget
+    from pint_tpu.pintk.plk import PlkWidget
+    from pint_tpu.pintk.timedit import TimWidget
+
+    pulsar = Pulsar(args.parfile, args.timfile, fitter=args.fitter)
+
+    root = tk.Tk()
+    root.title(f"pintk: {pulsar.name}")
+    plk = PlkWidget(root, pulsar)
+    plk.frame.pack(side=tk.LEFT, fill=tk.BOTH, expand=1)
+
+    side = tk.Frame(root)
+    side.pack(side=tk.RIGHT, fill=tk.BOTH)
+    par = ParWidget(side, pulsar, on_apply=plk.update_plot)
+    par.frame.pack(side=tk.TOP, fill=tk.BOTH, expand=1)
+    tim = TimWidget(side, pulsar, on_apply=plk.update_plot)
+    tim.frame.pack(side=tk.BOTTOM, fill=tk.BOTH, expand=1)
+
+    root.mainloop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""plk-style residual-plot widget (reference: src/pint/pintk/plk.py
+PlkWidget): matplotlib canvas embedded in Tk with rectangle selection,
+fit/undo/delete/jump buttons, axis choices, and color modes.
+
+All plotting state transforms live on PlkState (headless-testable);
+the Tk widget is a thin shell so the module imports fine without a
+display (tkinter is only touched inside PlkWidget.__init__).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.pintk.colormodes import point_colors
+
+__all__ = ["PlkState", "PlkWidget", "XAXIS_CHOICES", "YAXIS_CHOICES"]
+
+XAXIS_CHOICES = ["mjd", "orbital_phase", "serial", "frequency"]
+YAXIS_CHOICES = ["residual", "residual_phase"]
+
+
+class PlkState:
+    """Pure plotting state: which axes, color mode, and the derived
+    arrays for the current Pulsar."""
+
+    def __init__(self, pulsar):
+        self.pulsar = pulsar
+        self.xaxis = "mjd"
+        self.yaxis = "residual"
+        self.color_mode = "default"
+        self.show_prefit = False
+
+    # -------------------------------------------------------- arrays
+
+    def _jump_ids(self):
+        from pint_tpu.pintk.pulsar import GUI_JUMP_FLAG
+
+        return [int(f.get(GUI_JUMP_FLAG, 0))
+                for f in self.pulsar.all_toas.flags]
+
+    def xy(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, dict]:
+        """(x, y, yerr, data) for the current axis selection."""
+        data = self.pulsar.plot_data(postfit=not self.show_prefit
+                                     and self.pulsar.fitted)
+        data["jump_ids"] = self._jump_ids()
+        if self.xaxis == "mjd":
+            x = data["mjds"]
+        elif self.xaxis == "orbital_phase":
+            x = data.get("orbital_phase")
+            if x is None:
+                raise ValueError("model has no binary: no orbital "
+                                 "phase axis")
+        elif self.xaxis == "serial":
+            x = np.arange(len(data["mjds"]), dtype=float)
+        elif self.xaxis == "frequency":
+            x = data["freqs"]
+        else:
+            raise ValueError(f"unknown x axis {self.xaxis!r}")
+        y = data["resids_us"]
+        yerr = data["errors_us"]
+        if self.yaxis == "residual_phase":
+            f0 = self.pulsar.model.F0.value
+            y = y * 1e-6 * f0
+            yerr = yerr * 1e-6 * f0
+        return np.asarray(x, dtype=float), np.asarray(y), \
+            np.asarray(yerr), data
+
+    def colors(self, data) -> list:
+        return point_colors(self.color_mode, data)
+
+    def select_rectangle(self, x1, x2, y1=None, y2=None,
+                         extend: bool = False) -> int:
+        """Box selection in current axis coordinates; returns the
+        number of selected points."""
+        x, y, _, _ = self.xy()
+        lo, hi = min(x1, x2), max(x1, x2)
+        m = (x >= lo) & (x <= hi)
+        if y1 is not None and y2 is not None:
+            ylo, yhi = min(y1, y2), max(y1, y2)
+            m &= (y >= ylo) & (y <= yhi)
+        if extend:
+            m |= self.pulsar.selected
+        self.pulsar.select(m)
+        return int(m.sum())
+
+    def title(self, data: Optional[dict] = None) -> str:
+        if data is None:
+            data = self.pulsar.plot_data(postfit=self.pulsar.fitted
+                                         and not self.show_prefit)
+        kind = "post-fit" if self.pulsar.fitted and \
+            not self.show_prefit else "pre-fit"
+        return (f"{self.pulsar.name}  {kind}  "
+                f"wrms={data['rms_us']:.3f} us  "
+                f"chi2={data['chi2']:.2f}")
+
+
+class PlkWidget:
+    """Tk shell over PlkState (requires a display)."""
+
+    def __init__(self, master, pulsar):
+        import tkinter as tk
+
+        from matplotlib.backends.backend_tkagg import (
+            FigureCanvasTkAgg, NavigationToolbar2Tk)
+        from matplotlib.figure import Figure
+        from matplotlib.widgets import RectangleSelector
+
+        self.state = PlkState(pulsar)
+        self.frame = tk.Frame(master)
+        top = tk.Frame(self.frame)
+        top.pack(side=tk.TOP, fill=tk.X)
+
+        tk.Button(top, text="Fit", command=self.fit).pack(
+            side=tk.LEFT)
+        tk.Button(top, text="Undo", command=self.undo).pack(
+            side=tk.LEFT)
+        tk.Button(top, text="Delete", command=self.delete).pack(
+            side=tk.LEFT)
+        tk.Button(top, text="Jump", command=self.jump).pack(
+            side=tk.LEFT)
+        tk.Button(top, text="Unjump", command=self.unjump).pack(
+            side=tk.LEFT)
+        tk.Button(top, text="Pulse numbers",
+                  command=self.track_pn).pack(side=tk.LEFT)
+        tk.Button(top, text="Random models",
+                  command=self.random_models).pack(side=tk.LEFT)
+
+        self.xvar = tk.StringVar(value=self.state.xaxis)
+        tk.OptionMenu(top, self.xvar, *XAXIS_CHOICES,
+                      command=self.set_xaxis).pack(side=tk.LEFT)
+        self.cvar = tk.StringVar(value=self.state.color_mode)
+        from pint_tpu.pintk.colormodes import COLOR_MODES
+
+        tk.OptionMenu(top, self.cvar, *COLOR_MODES,
+                      command=self.set_color_mode).pack(side=tk.LEFT)
+
+        self.fig = Figure(figsize=(9, 5))
+        self.ax = self.fig.add_subplot(111)
+        self.canvas = FigureCanvasTkAgg(self.fig, master=self.frame)
+        self.canvas.get_tk_widget().pack(side=tk.TOP, fill=tk.BOTH,
+                                         expand=1)
+        NavigationToolbar2Tk(self.canvas, self.frame)
+        self.selector = RectangleSelector(self.ax, self._on_select,
+                                          useblit=True, button=[1])
+        self._random_curves = None
+        self.update_plot()
+
+    # ------------------------------------------------------- actions
+
+    def _on_select(self, eclick, erelease):
+        self.state.select_rectangle(eclick.xdata, erelease.xdata,
+                                    eclick.ydata, erelease.ydata,
+                                    extend=eclick.key == "shift")
+        self.update_plot()
+
+    def fit(self):
+        self.state.pulsar.fit()
+        self._random_curves = None
+        self.update_plot()
+
+    def undo(self):
+        self.state.pulsar.undo()
+        self._random_curves = None  # TOA count may have changed
+        self.update_plot()
+
+    def delete(self):
+        self.state.pulsar.delete_TOAs()
+        self._random_curves = None
+        self.update_plot()
+
+    def jump(self):
+        self.state.pulsar.jump_selection()
+        self.update_plot()
+
+    def unjump(self):
+        self.state.pulsar.unjump_selection()
+        self.update_plot()
+
+    def track_pn(self):
+        self.state.pulsar.compute_pulse_numbers()
+        self.update_plot()
+
+    def random_models(self):
+        self._random_curves = self.state.pulsar.random_models(n=10)
+        self.update_plot()
+
+    def set_xaxis(self, value):
+        self.state.xaxis = value
+        self.update_plot()
+
+    def set_color_mode(self, value):
+        self.state.color_mode = value
+        self.update_plot()
+
+    # ---------------------------------------------------------- draw
+
+    def update_plot(self):
+        x, y, yerr, data = self.state.xy()
+        self.ax.clear()
+        colors = self.state.colors(data)
+        self.ax.errorbar(x, y, yerr=yerr, fmt="none", ecolor="#bbbbbb",
+                         zorder=1)
+        self.ax.scatter(x, y, c=colors, s=12, zorder=2)
+        sel = data["selected"]
+        if sel.any():
+            self.ax.scatter(x[sel], y[sel], facecolors="none",
+                            edgecolors="#e34a33", s=60, zorder=3)
+        if self._random_curves is not None and \
+                self.state.xaxis == "mjd":
+            for curve in self._random_curves:
+                if len(curve) != len(x):  # TOAs changed under us
+                    self._random_curves = None
+                    break
+                self.ax.plot(x, np.asarray(curve) * 1e6,
+                             color="#31a354", alpha=0.3, zorder=0)
+        self.ax.set_xlabel(self.state.xaxis)
+        self.ax.set_ylabel("residual (us)"
+                           if self.state.yaxis == "residual"
+                           else "residual (turns)")
+        self.ax.set_title(self.state.title(data))
+        self.ax.grid(alpha=0.2)
+        self.canvas.draw_idle()

@@ -21,12 +21,13 @@ def main(argv=None) -> int:
                    help="target binary parameterization "
                         "(e.g. ELL1, ELL1H, DD, DDS, DDK, BT)")
     p.add_argument("--allow-tcb", action="store_true",
-                   help="accept a TCB par file (converted to TDB)")
+                   help="accept a TCB par file (converted to TDB); "
+                        "without this flag TCB input is refused")
     args = p.parse_args(argv)
 
     from pint_tpu.models import get_model
 
-    model = get_model(args.input_par)
+    model = get_model(args.input_par, allow_tcb=args.allow_tcb)
     if args.binary:
         from pint_tpu.binaryconvert import convert_binary
 

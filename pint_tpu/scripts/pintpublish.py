@@ -29,8 +29,7 @@ def publish_table(fitter, include_fixed: bool = False) -> str:
     rows.append(rf"TOAs & {fitter.toas.ntoas} \\")
     rows.append(rf"Weighted RMS (\,$\mu$s) & "
                 rf"{res.rms_weighted() * 1e6:.3f} \\")
-    dof = fitter.toas.ntoas - len(model.free_params) - 1
-    rows.append(rf"$\chi^2$/dof & {float(res.chi2):.2f}/{dof} \\")
+    rows.append(rf"$\chi^2$/dof & {float(res.chi2):.2f}/{res.dof} \\")
     rows.append(r"\hline")
     rows.append(r"\multicolumn{2}{c}{Fitted parameters} \\")
     rows.append(r"\hline")
@@ -53,7 +52,8 @@ def publish_table(fitter, include_fixed: bool = False) -> str:
         rows.append(r"\hline")
         rows.append(r"\multicolumn{2}{c}{Fixed parameters} \\")
         rows.append(r"\hline")
-        for nm, p in model.params.items():
+        for nm in model.params:  # params is a list of names
+            p = model.get_param(nm)
             if p.frozen and p.value is not None and \
                     not isinstance(p.value, (str, bool)):
                 try:

@@ -1,0 +1,244 @@
+"""pintk GUI logic, driven headless through the Pulsar facade and the
+state classes (reference behaviors: src/pint/pintk/pulsar.py Pulsar,
+plk.py PlkWidget selection/axes, paredit/timedit apply paths)."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """
+PSR J0613-0200
+RAJ 06:13:43.97 1
+DECJ -02:00:47.2 1
+F0 326.6005670 1
+F1 -1.023e-15 1
+PEPOCH 55500
+DM 38.78
+BINARY ELL1
+PB 1.198512 1
+A1 1.09144 1
+TASC 55000.1 1
+EPS1 2e-6 1
+EPS2 -3e-6 1
+TZRMJD 55500.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+
+@pytest.fixture(scope="module")
+def psr_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("pintk")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(PAR))
+        rng = np.random.default_rng(21)
+        toas = make_fake_toas_uniform(55000, 56000, 50, model,
+                                      error_us=1.0, freq_mhz=1400.0,
+                                      add_noise=True, rng=rng)
+    par = d / "psr.par"
+    tim = d / "psr.tim"
+    par.write_text(model.as_parfile())
+    toas.write_TOA_file(tim)
+    return str(par), str(tim)
+
+
+@pytest.fixture()
+def psr(psr_files):
+    from pint_tpu.pintk import Pulsar
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return Pulsar(*psr_files)
+
+
+def test_load_and_fit(psr):
+    assert psr.all_toas.ntoas == 50
+    assert not psr.fitted
+    pre_rms = psr.prefit_resids.rms_weighted()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        psr.fit()
+    assert psr.fitted
+    assert psr.postfit_resids.rms_weighted() <= pre_rms * 1.01
+    # undo restores the unfitted state
+    assert psr.undo()
+    assert not psr.fitted
+
+
+def test_selection_and_delete(psr):
+    psr.select_mjd_range(55000, 55200)
+    n_sel = int(psr.selected.sum())
+    assert n_sel > 0
+    removed = psr.delete_TOAs()
+    assert removed == n_sel
+    assert psr.all_toas.ntoas == 50 - n_sel
+    assert psr.undo()
+    assert psr.all_toas.ntoas == 50
+
+
+def test_jump_unjump_roundtrip(psr):
+    psr.select_mjd_range(55400, 55600)
+    n_sel = int(psr.selected.sum())
+    assert n_sel > 2
+    name = psr.jump_selection()
+    assert name.startswith("JUMP")
+    comp = psr.model.components["PhaseJump"]
+    assert name in comp.params
+    # jumped TOAs carry the flag
+    from pint_tpu.pintk.pulsar import GUI_JUMP_FLAG
+
+    tagged = sum(1 for f in psr.all_toas.flags if GUI_JUMP_FLAG in f)
+    assert tagged == n_sel
+    # the jump parameter is fittable and absorbs an offset:
+    # fitting with the jump free keeps chi2 finite
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        psr.fit()
+    assert np.isfinite(float(psr.postfit_resids.chi2))
+    removed = psr.unjump_selection()
+    assert removed == 1
+    tagged = sum(1 for f in psr.all_toas.flags if GUI_JUMP_FLAG in f)
+    assert tagged == 0
+
+
+def test_jump_changes_model(psr):
+    """A jumped block with an injected offset is recovered by the
+    free JUMP parameter."""
+    mjds = np.asarray(psr.all_toas.get_mjds())
+    block = (mjds >= 55500)
+    # inject a 50 us offset into the block by shifting the TOAs
+    from pint_tpu.ops import dd_np
+
+    psr.all_toas.mjd_frac = dd_np.add(
+        psr.all_toas.mjd_frac,
+        dd_np.div_f(dd_np.dd(np.where(block, 50e-6, 0.0)), 86400.0))
+    psr.all_toas.tdb_frac = dd_np.add(
+        psr.all_toas.tdb_frac,
+        dd_np.div_f(dd_np.dd(np.where(block, 50e-6, 0.0)), 86400.0))
+    psr.all_toas._touch()
+    psr.select(block)
+    psr.jump_selection()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        psr.fit()
+    comp = psr.model.components["PhaseJump"]
+    jp = comp.params[comp.jumps[-1]]
+    assert abs(jp.value) == pytest.approx(50e-6, rel=0.2)
+
+
+def test_pulse_number_tracking(psr):
+    psr.compute_pulse_numbers()
+    assert psr.track_mode == "use_pulse_numbers"
+    pn = psr.all_toas.get_pulse_numbers()
+    assert pn is not None and len(pn) == 50
+    r = psr.prefit_resids
+    assert np.all(np.isfinite(r.time_resids))
+    psr.reset_pulse_numbers()
+    assert psr.all_toas.get_pulse_numbers() is None
+
+
+def test_random_models(psr):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        psr.fit()
+        curves = psr.random_models(n=5, rng=np.random.default_rng(3))
+    curves = np.asarray(curves)
+    assert curves.shape == (5, 50)
+    assert np.all(np.isfinite(curves))
+
+
+def test_plot_data_and_orbital_phase(psr):
+    data = psr.plot_data(postfit=False)
+    assert set(data) >= {"mjds", "resids_us", "errors_us", "freqs",
+                         "obs", "selected", "rms_us", "chi2"}
+    assert "orbital_phase" in data  # binary model
+    assert np.all((data["orbital_phase"] >= 0)
+                  & (data["orbital_phase"] < 1))
+
+
+def test_plk_state_axes_and_selection(psr):
+    from pint_tpu.pintk.plk import PlkState
+
+    st = PlkState(psr)
+    x, y, yerr, data = st.xy()
+    assert len(x) == len(y) == len(yerr) == 50
+    st.xaxis = "orbital_phase"
+    x2, _, _, _ = st.xy()
+    assert np.all((x2 >= 0) & (x2 < 1))
+    st.xaxis = "serial"
+    x3, _, _, _ = st.xy()
+    assert x3[0] == 0 and x3[-1] == 49
+    # box selection in mjd coords
+    st.xaxis = "mjd"
+    n = st.select_rectangle(55000, 55100)
+    assert n == int(psr.selected.sum()) > 0
+    n2 = st.select_rectangle(55900, 56000, extend=True)
+    assert n2 > n
+    # phase y-axis conversion
+    st.yaxis = "residual_phase"
+    _, yp, _, _ = st.xy()
+    f0 = psr.model.F0.value
+    np.testing.assert_allclose(yp, y * 1e-6 * f0, rtol=1e-12)
+    assert "wrms" in st.title()
+
+
+def test_color_modes(psr):
+    from pint_tpu.pintk.colormodes import COLOR_MODES, point_colors
+    from pint_tpu.pintk.plk import PlkState
+
+    st = PlkState(psr)
+    _, _, _, data = st.xy()
+    for mode in COLOR_MODES:
+        cols = point_colors(mode, data)
+        assert len(cols) == 50
+    with pytest.raises(ValueError):
+        point_colors("nope", data)
+
+
+def test_par_edit_apply(psr):
+    from pint_tpu.pintk.paredit import ParEditState
+
+    st = ParEditState(psr)
+    text = st.current_text()
+    assert "F0" in text
+    # edit F0 slightly and apply
+    new = text.replace("326.6005670", "326.6005680")
+    st.apply(new)
+    assert psr.model.F0.value == pytest.approx(326.6005680)
+    assert not psr.fitted
+    # malformed par raises (GUI surfaces the error)
+    with pytest.raises(Exception):
+        st.apply("PSR\nF0 not_a_number\n")
+
+
+def test_tim_edit_roundtrip(psr):
+    from pint_tpu.pintk.timedit import TimEditState
+
+    st = TimEditState(psr)
+    text = st.current_text()
+    assert "FORMAT 1" in text
+    # drop the last TOA line and apply
+    lines = [ln for ln in text.strip().splitlines()]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        st.apply("\n".join(lines[:-1]) + "\n")
+    assert psr.all_toas.ntoas == 49
+    assert psr.undo()
+    assert psr.all_toas.ntoas == 50
+
+
+def test_widgets_importable_headless():
+    # the Tk widget classes must import (not instantiate) without a
+    # display
+    from pint_tpu.pintk import plk, paredit, timedit  # noqa: F401
+
+    assert hasattr(plk, "PlkWidget")
+    assert hasattr(paredit, "ParWidget")
+    assert hasattr(timedit, "TimWidget")
