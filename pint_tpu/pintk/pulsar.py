@@ -297,19 +297,23 @@ class Pulsar:
         """Replace the model from edited par text (the ParWidget apply
         path). TOAs are re-barycentered only if EPHEM changed."""
         from pint_tpu.models import get_model
-        from pint_tpu.toa import get_TOAs
 
         self._push_undo()
         old_ephem = self.model.EPHEM.value
+        old_planets = bool(self.model.PLANET_SHAPIRO.value)
         self.model = get_model(io.StringIO(text))
         self.prefit_model = copy.deepcopy(self.model)
-        if self.model.EPHEM.value != old_ephem:
+        new_planets = bool(self.model.PLANET_SHAPIRO.value)
+        if self.model.EPHEM.value != old_ephem or \
+                new_planets != old_planets:
+            # re-barycenter the TOAs we HAVE (not the on-disk tim:
+            # that would resurrect deleted TOAs and drop jump flags)
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")
-                self.all_toas = get_TOAs(
-                    self.timfile, model=self.model,
+                self.all_toas.compute_TDBs(
+                    ephem=self.model.EPHEM.value)
+                self.all_toas.compute_posvels(
                     ephem=self.model.EPHEM.value,
-                    planets=bool(self.model.PLANET_SHAPIRO.value))
-            self.selected = np.zeros(self.all_toas.ntoas, dtype=bool)
+                    planets=new_planets)
         self.fitted = False
         self._fitter_obj = None

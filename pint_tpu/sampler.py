@@ -99,3 +99,40 @@ class EnsembleSampler:
             raise ValueError("run_mcmc first")
         c = self.chain[discard::thin]
         return c.reshape(-1, self.ndim) if flat else c
+
+    def get_autocorr_time(self, c: float = 5.0) -> np.ndarray:
+        """Integrated autocorrelation time per parameter, estimated
+        from the walker-averaged chain with Sokal's self-consistent
+        window M >= c*tau (the estimator emcee uses; reference:
+        event_optimize's convergence reporting)."""
+        if self.chain is None:
+            raise ValueError("run_mcmc first")
+        nsteps = self.chain.shape[0]
+        taus = np.empty(self.ndim)
+        for d in range(self.ndim):
+            # mean over walkers first: GW ensembles are exchangeable
+            x = self.chain[:, :, d].mean(axis=1)
+            x = x - x.mean()
+            # FFT autocorrelation
+            n = 1 << (2 * nsteps - 1).bit_length()
+            f = np.fft.rfft(x, n=n)
+            acf = np.fft.irfft(f * np.conjugate(f), n=n)[:nsteps]
+            if acf[0] <= 0:
+                taus[d] = np.nan
+                continue
+            acf = acf / acf[0]
+            cumtau = 2.0 * np.cumsum(acf) - 1.0
+            window = np.arange(nsteps) >= c * cumtau
+            m = np.argmax(window) if window.any() else nsteps - 1
+            taus[d] = max(cumtau[m], 1.0)
+        return taus
+
+    def converged(self, factor: float = 50.0, tau=None) -> bool:
+        """emcee's rule of thumb: the chain is long enough when
+        nsteps > factor * max(tau). Pass a precomputed ``tau`` to
+        avoid re-running the FFT autocorrelation."""
+        tau = self.get_autocorr_time() if tau is None else \
+            np.asarray(tau)
+        if not np.all(np.isfinite(tau)):
+            return False
+        return self.chain.shape[0] > factor * float(np.max(tau))

@@ -22,12 +22,18 @@ def main(argv=None) -> int:
     p.add_argument("--weightcol", default=None)
     p.add_argument("--ncomp", type=int, default=1,
                    help="Gaussian components in the seed template")
+    p.add_argument("--template", default=None,
+                   help="profile template file (see "
+                        "pint_tpu.templates.read_template); skips the "
+                        "automatic template seeding")
     p.add_argument("--nwalkers", type=int, default=32)
     p.add_argument("--nsteps", type=int, default=200)
     p.add_argument("--burn", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--outfile", default=None,
                    help="write the optimized par file here")
+    p.add_argument("--chains-npz", default=None,
+                   help="dump the full walker chain + lnprob here")
     args = p.parse_args(argv)
 
     from pint_tpu.event_toas import get_event_weights, load_fits_TOAs
@@ -47,28 +53,37 @@ def main(argv=None) -> int:
     print(f"Read {toas.ntoas} photons; initial Htest {h0:.1f} "
           f"({h_sig(h0):.1f} sigma)")
 
-    # seed template by ML on the initial phases; the peak location
-    # comes from the first Fourier harmonic (a far-off location seed
-    # collapses the ML fit into the uniform-background local minimum)
-    w = weights if weights is not None else np.ones_like(phases)
-    c1 = np.sum(w * np.exp(2j * np.pi * phases))
-    loc0 = float(np.angle(c1) / (2 * np.pi)) % 1.0
-    pulsed_frac = min(0.9, max(0.1,
-                               2.0 * np.abs(c1) / np.sum(w)))
-    ncomp = max(1, args.ncomp)
-    prims = [LCGaussian() for _ in range(ncomp)]
-    locs = [(loc0 + k / ncomp) % 1.0 for k in range(ncomp)]
-    template = LCTemplate(prims, norms=[pulsed_frac / ncomp] * ncomp,
-                          locs=locs, widths=[0.05] * ncomp)
-    tfit = LCFitter(template, phases, weights=weights)
-    res = tfit.fit()
-    print(f"Template ML: logL={res['loglikelihood']:.1f} "
-          f"locs={np.round(template.locs, 4)} "
-          f"norms={np.round(template.norms, 3)}")
-    if template.norms.sum() < 0.05:
-        print("WARNING: template collapsed to background — phases may "
-              "be unpulsed or the seed failed; aborting before MCMC")
-        return 1
+    if args.template:
+        from pint_tpu.templates import read_template
+
+        template = read_template(args.template)
+        print(f"Read template from {args.template}:\n{template}")
+    else:
+        # seed template by ML on the initial phases; the peak location
+        # comes from the first Fourier harmonic (a far-off location
+        # seed collapses the ML fit into the uniform-background local
+        # minimum)
+        w = weights if weights is not None else np.ones_like(phases)
+        c1 = np.sum(w * np.exp(2j * np.pi * phases))
+        loc0 = float(np.angle(c1) / (2 * np.pi)) % 1.0
+        pulsed_frac = min(0.9, max(0.1,
+                                   2.0 * np.abs(c1) / np.sum(w)))
+        ncomp = max(1, args.ncomp)
+        prims = [LCGaussian() for _ in range(ncomp)]
+        locs = [(loc0 + k / ncomp) % 1.0 for k in range(ncomp)]
+        template = LCTemplate(prims,
+                              norms=[pulsed_frac / ncomp] * ncomp,
+                              locs=locs, widths=[0.05] * ncomp)
+        tfit = LCFitter(template, phases, weights=weights)
+        res = tfit.fit()
+        print(f"Template ML: logL={res['loglikelihood']:.1f} "
+              f"locs={np.round(template.locs, 4)} "
+              f"norms={np.round(template.norms, 3)}")
+        if template.norms.sum() < 0.05:
+            print("WARNING: template collapsed to background — phases "
+                  "may be unpulsed or the seed failed; aborting "
+                  "before MCMC")
+            return 1
 
     rng = np.random.default_rng(args.seed)
     fitter = PhotonMCMCFitter(toas, model, template, weights=weights,
@@ -77,6 +92,18 @@ def main(argv=None) -> int:
     print(f"MCMC done: acc="
           f"{fitter.sampler.acceptance_fraction:.2f} "
           f"max lnL={lnmax:.1f}")
+    tau = fitter.sampler.get_autocorr_time()
+    conv = fitter.sampler.converged(tau=tau)
+    print(f"autocorr time (steps): max {np.nanmax(tau):.1f}; "
+          f"chain {'converged' if conv else 'SHORT'}"
+          f" by the nsteps > 50*tau rule")
+    if args.chains_npz:
+        np.savez(args.chains_npz,
+                 chain=fitter.sampler.chain,
+                 lnprob=fitter.sampler.lnprob,
+                 labels=np.array(fitter.param_labels),
+                 tau=tau)
+        print(f"Wrote {args.chains_npz}")
     phases2 = np.mod(np.asarray(model.phase(toas).frac), 1.0)
     h1 = hmw(phases2, weights)
     print(f"Final Htest {h1:.1f} ({h_sig(h1):.1f} sigma)")

@@ -38,6 +38,8 @@ def main(argv=None) -> int:
                    help="write a FITS copy with a PULSE_PHASE column")
     p.add_argument("--npz", default=None,
                    help="write phases (+weights) to this .npz")
+    p.add_argument("--plotfile", default=None,
+                   help="write a phaseogram png here")
     args = p.parse_args(argv)
 
     from pint_tpu.event_toas import get_event_weights, load_fits_TOAs
@@ -63,6 +65,14 @@ def main(argv=None) -> int:
     wtxt = " (weighted)" if weights is not None else ""
     print(f"Htest{wtxt}: {h:.2f}  ({sig:.2f} sigma)")
 
+    if args.plotfile:
+        from pint_tpu.plot_utils import phaseogram
+
+        phaseogram(np.asarray(toas.get_mjds()), phases,
+                   weights=weights,
+                   title=f"{model.name or ''} H={h:.1f}",
+                   plotfile=args.plotfile)
+        print(f"Wrote {args.plotfile}")
     if args.npz:
         np.savez(args.npz, phases=phases,
                  weights=(weights if weights is not None

@@ -14,6 +14,7 @@ and never squeezed through a single float64.
 from __future__ import annotations
 
 import itertools
+import os
 import warnings
 from typing import Dict, List, NamedTuple, Optional
 
@@ -346,6 +347,71 @@ class TOAs:
             pulse_number=jnp.asarray(pn),
         )
 
+    def to_npz(self, path):
+        """Columnar snapshot of the fully-processed TOA table
+        (reference: TOAs pickling via usepickle — npz here: no
+        arbitrary code execution on load, stable across versions)."""
+        import json
+
+        arrays = {
+            "mjd_day": self.mjd_day,
+            "mjd_frac_hi": self.mjd_frac[0],
+            "mjd_frac_lo": self.mjd_frac[1],
+            "freq_mhz": self.freq_mhz,
+            "error_us": self.error_us,
+            "obs": np.array(self.obs),
+            "names": np.array(self.names),
+            "flags_json": np.array(json.dumps(self.flags)),
+            "meta_json": np.array(json.dumps({
+                "clock_applied": bool(self.clock_applied),
+                "ephem": self.ephem,
+                "planets": bool(self.planets)})),
+        }
+        for col in ("tdb_day", "ssb_obs_pos", "ssb_obs_vel",
+                    "obs_sun_pos"):
+            v = getattr(self, col)
+            if v is not None:
+                arrays[col] = v
+        if self.tdb_frac is not None:
+            arrays["tdb_frac_hi"] = self.tdb_frac[0]
+            arrays["tdb_frac_lo"] = self.tdb_frac[1]
+        if self.obs_planet_pos is not None:
+            arrays["planet_names"] = np.array(
+                sorted(self.obs_planet_pos))
+            for k, v in self.obs_planet_pos.items():
+                arrays[f"planet_{k}"] = v
+        np.savez_compressed(path, **arrays)
+
+    @classmethod
+    def from_npz(cls, path) -> "TOAs":
+        import json
+
+        with np.load(path, allow_pickle=False) as z:
+            out = object.__new__(cls)
+            out.mjd_day = z["mjd_day"]
+            out.mjd_frac = (z["mjd_frac_hi"], z["mjd_frac_lo"])
+            out.freq_mhz = z["freq_mhz"]
+            out.error_us = z["error_us"]
+            out.obs = [str(o) for o in z["obs"]]
+            out.names = [str(n) for n in z["names"]]
+            out.flags = json.loads(str(z["flags_json"]))
+            meta = json.loads(str(z["meta_json"]))
+            out.clock_applied = meta["clock_applied"]
+            out.ephem = meta["ephem"]
+            out.planets = meta["planets"]
+            for col in ("tdb_day", "ssb_obs_pos", "ssb_obs_vel",
+                        "obs_sun_pos"):
+                setattr(out, col, z[col] if col in z.files else None)
+            out.tdb_frac = (z["tdb_frac_hi"], z["tdb_frac_lo"]) \
+                if "tdb_frac_hi" in z.files else None
+            out.obs_planet_pos = None
+            if "planet_names" in z.files:
+                out.obs_planet_pos = {
+                    str(k): z[f"planet_{k}"]
+                    for k in z["planet_names"]}
+        out._serial = next(_TOAS_SERIAL)
+        return out
+
     def write_TOA_file(self, path):
         """Round-trip back to a FORMAT-1 tim file. Clock corrections, if
         applied, are subtracted so the file matches the original site
@@ -416,21 +482,53 @@ def merge_TOAs(toas_list: List[TOAs]) -> TOAs:
 
 def get_TOAs(timfile, ephem=None, planets=False, model=None,
              include_gps=True, include_bipm=True, bipm_version="BIPM2021",
-             limits="warn") -> TOAs:
+             limits="warn", usecache=False, cachedir=None) -> TOAs:
     """One-call ingestion pipeline: parse → clock → TDB → posvels
-    (reference: src/pint/toa.py get_TOAs)."""
+    (reference: src/pint/toa.py get_TOAs).
+
+    With ``usecache`` (reference: usepickle), the fully-processed TOAs
+    are stored as a columnar npz next to the tim file (or in
+    ``cachedir``), keyed on a hash of the tim content and every
+    pipeline knob; a stale or mismatched cache is rebuilt silently."""
     if model is not None:
         if ephem is None:
             ephem = getattr(model, "EPHEM", None) and model.EPHEM.value
         if not planets:
             ps = getattr(model, "PLANET_SHAPIRO", None)
             planets = bool(ps is not None and ps.value)
+    cache_path = None
+    if usecache and isinstance(timfile, (str, os.PathLike)):
+        import hashlib
+
+        fpath = os.fspath(timfile)
+        try:
+            with open(fpath, "rb") as fh:
+                digest = hashlib.sha256(fh.read())
+        except OSError:
+            digest = None
+        if digest is not None:
+            digest.update(repr((ephem, planets, include_gps,
+                                include_bipm, bipm_version)).encode())
+            base = os.path.basename(fpath)
+            cdir = cachedir or os.path.dirname(os.path.abspath(fpath))
+            cache_path = os.path.join(
+                cdir, f".{base}.{digest.hexdigest()[:16]}.npz")
+            if os.path.exists(cache_path):
+                try:
+                    return TOAs.from_npz(cache_path)
+                except Exception:
+                    pass  # corrupt/old cache: rebuild below
     t = TOAs(parse_tim(timfile))
     t.apply_clock_corrections(include_gps=include_gps,
                               include_bipm=include_bipm,
                               bipm_version=bipm_version, limits=limits)
     t.compute_TDBs(ephem=ephem)
     t.compute_posvels(ephem=ephem, planets=planets)
+    if cache_path is not None:
+        try:
+            t.to_npz(cache_path)
+        except OSError:
+            pass  # read-only dir: caching is best-effort
     return t
 
 
@@ -464,6 +562,7 @@ def get_TOAs_array(mjds, obs="barycenter", freqs=np.inf, errors=1.0,
     out.flags = [dict(f) for f in flags] if flags is not None \
         else [{} for _ in range(n)]
     out.names = [f"fake{i}" for i in range(n)]
+    out._serial = next(_TOAS_SERIAL)
     out.clock_applied = False
     out.tdb_day = None
     out.tdb_frac = None

@@ -1,0 +1,132 @@
+"""event_optimize CLI end-to-end, sampler autocorrelation
+diagnostics, and photonphase --plotfile (reference:
+src/pint/scripts/event_optimize.py MCMC + autocorr checks)."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.fits import write_events_fits
+from pint_tpu.models import get_model
+
+NICER_MJDREF = (56658, 7.775925925925926e-4)
+
+PAR = """
+PSR J0030+0451
+RAJ 00:30:27.4
+DECJ 04:51:39.7
+F0 205.53069927 1
+F1 -4.3e-16
+PEPOCH 56500
+POSEPOCH 56500
+DM 4.33
+DMEPOCH 56500
+TZRMJD 56500.0
+TZRSITE @
+TZRFRQ inf
+UNITS TDB
+"""
+
+
+@pytest.fixture(scope="module")
+def model():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(io.StringIO(PAR))
+
+
+def _write_pulsed_events(path, model, n=1500, seed=2, width=0.02):
+    rng = np.random.default_rng(seed)
+    mjd0, mjd1 = 56450.0, 56550.0
+    f0 = model.F0.value
+    base = rng.uniform(mjd0, mjd1, n)
+    pulsed = rng.uniform(size=n) < 0.8
+    phi_t = np.where(pulsed,
+                     np.mod(0.4 + width * rng.standard_normal(n), 1.0),
+                     rng.uniform(size=n))
+    pep = model.PEPOCH.value
+    dt = (base - pep) * 86400.0
+    k = np.floor(dt * f0)
+    f1 = model.F1.value or 0.0
+    tsec = (k + phi_t) / f0 - 0.5 * f1 / f0 * ((k + phi_t) / f0) ** 2
+    mjd = pep + tsec / 86400.0
+    mjdrefi, mjdreff = NICER_MJDREF
+    times = np.sort(((mjd - mjdrefi) - mjdreff) * 86400.0)
+    write_events_fits(path, {"TIME": times}, header_extra={
+        "TIMESYS": "TDB", "TIMEREF": "SOLARSYSTEM",
+        "MJDREFI": mjdrefi, "MJDREFF": mjdreff, "TELESCOP": "NICER",
+        "TIMEZERO": 0.0, "TIMEUNIT": "s"})
+
+
+def test_event_optimize_with_template_file(tmp_path, model, capsys):
+    from pint_tpu.scripts.event_optimize import main
+    from pint_tpu.templates import make_template, write_template
+
+    ev = tmp_path / "ev.fits"
+    _write_pulsed_events(ev, model)
+    par = tmp_path / "m.par"
+    par.write_text(model.as_parfile())
+    tfile = tmp_path / "prof.txt"
+    write_template(make_template([("gaussian", 0.8, 0.4, 0.02)]),
+                   str(tfile))
+    out = tmp_path / "opt.par"
+    chains = tmp_path / "chains.npz"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = main([str(ev), str(par), "--mission", "nicer",
+                   "--template", str(tfile),
+                   "--nwalkers", "8", "--nsteps", "40",
+                   "--seed", "5",
+                   "--outfile", str(out),
+                   "--chains-npz", str(chains)])
+    assert rc == 0
+    txt = capsys.readouterr().out
+    assert "Read template" in txt
+    assert "autocorr" in txt
+    m2 = get_model(str(out))
+    # F0 stays near truth (the sampler must not wander off)
+    assert m2.F0.value == pytest.approx(205.53069927, abs=5e-7)
+    d = np.load(chains)
+    assert d["chain"].shape == (40, 8, 1)
+    assert d["lnprob"].shape == (40, 8)
+    assert list(d["labels"]) == ["F0"]
+    assert d["tau"].shape == (1,)
+
+
+def test_autocorr_time_scaling():
+    """White-noise chains have tau ~= 1; strongly correlated chains
+    have tau >> 1."""
+    from pint_tpu.sampler import EnsembleSampler
+
+    s = EnsembleSampler.__new__(EnsembleSampler)
+    s.ndim = 2
+    rng = np.random.default_rng(0)
+    white = rng.standard_normal((2000, 8, 1))
+    # AR(1) with phi=0.95 -> tau ~ (1+phi)/(1-phi) ~ 39
+    ar = np.empty((2000, 8, 1))
+    ar[0] = rng.standard_normal((8, 1))
+    for t in range(1, 2000):
+        ar[t] = 0.95 * ar[t - 1] + rng.standard_normal((8, 1))
+    s.chain = np.concatenate([white, ar], axis=2)
+    tau = s.get_autocorr_time()
+    assert tau[0] < 3.0
+    assert tau[1] > 15.0
+    assert not s.converged(factor=1000.0)  # ar chain too short at 1000x
+
+
+def test_photonphase_plotfile(tmp_path, model):
+    pytest.importorskip("matplotlib")
+    from pint_tpu.scripts.photonphase import main
+
+    ev = tmp_path / "ev.fits"
+    _write_pulsed_events(ev, model, n=800)
+    par = tmp_path / "m.par"
+    par.write_text(model.as_parfile())
+    png = tmp_path / "phaseogram.png"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = main([str(ev), str(par), "--plotfile", str(png)])
+    assert rc == 0
+    assert png.exists() and png.stat().st_size > 1000

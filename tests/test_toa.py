@@ -143,3 +143,52 @@ def test_write_roundtrip_mjd_precision(tmp_path):
     back = parse_tim(str(p))
     # MJD strings survive the clock-correction round trip to ~ps
     assert back[0].mjd_str.startswith("53478.28587141921")
+
+
+def test_toas_npz_cache_roundtrip(tmp_path):
+    """usecache: first get_TOAs builds + saves, second loads the npz;
+    both produce identical pipelines (reference: usepickle)."""
+    import io as _io
+    import warnings
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.toa import TOAs, get_TOAs
+
+    par = ("PSR J0001+0001\nRAJ 0:01:00 1\nDECJ 1:00:00 1\n"
+           "F0 100.0 1\nPEPOCH 55500\nDM 10.0\nUNITS TDB\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(_io.StringIO(par))
+        rng = np.random.default_rng(3)
+        t0 = make_fake_toas_uniform(55000, 55100, 20, model,
+                                    error_us=1.5, obs="gbt", rng=rng)
+    tim = tmp_path / "c.tim"
+    t0.write_TOA_file(tim)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        a = get_TOAs(str(tim), ephem="de421", usecache=True)
+    caches = list(tmp_path.glob(".c.tim.*.npz"))
+    assert len(caches) == 1
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        b = get_TOAs(str(tim), ephem="de421", usecache=True)
+    np.testing.assert_array_equal(a.mjd_day, b.mjd_day)
+    np.testing.assert_array_equal(a.mjd_frac[0], b.mjd_frac[0])
+    np.testing.assert_array_equal(a.tdb_frac[1], b.tdb_frac[1])
+    np.testing.assert_array_equal(a.ssb_obs_pos, b.ssb_obs_pos)
+    assert a.obs == b.obs
+    assert a.flags == b.flags
+    assert b.clock_applied
+    # distinct cache keys invalidate: different pipeline knobs rebuild
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        get_TOAs(str(tim), ephem="de421", usecache=True,
+                 include_bipm=False)
+    assert len(list(tmp_path.glob(".c.tim.*.npz"))) == 2
+    # direct npz round-trip API
+    p = tmp_path / "snap.npz"
+    a.to_npz(p)
+    c = TOAs.from_npz(p)
+    assert c.ntoas == a.ntoas
+    np.testing.assert_array_equal(c.ssb_obs_vel, a.ssb_obs_vel)
