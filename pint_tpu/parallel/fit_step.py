@@ -35,7 +35,27 @@ def _pad_to(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def build_fit_step(model, toas, pad_to: Optional[int] = None):
+def _use_f32_matmul(flag: Optional[bool]) -> bool:
+    """Resolve the normal-equation matmul precision. Precedence:
+    explicit ``matmul_f32`` argument > $PINT_TPU_GLS_MATMUL (f32/f64)
+    > auto. Auto = f32 on TPU (f64 there is software-emulated and
+    bypasses the MXU; the equilibrated normal equations only need
+    ~1e-7 relative accuracy, which HIGHEST-precision f32 MXU passes
+    deliver), f64 elsewhere."""
+    import os
+
+    if flag is not None:
+        return bool(flag)
+    env = os.environ.get("PINT_TPU_GLS_MATMUL", "").lower()
+    if env in ("f32", "float32"):
+        return True
+    if env in ("f64", "float64"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def build_fit_step(model, toas, pad_to: Optional[int] = None,
+                   matmul_f32: Optional[bool] = None):
     """(step_fn, args, names): step_fn is pure and jittable,
 
         step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid)
@@ -59,6 +79,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None):
     batch = cache["batch"]
     sc = {k: v for k, v in cache.items() if k != "batch"}
     n = toas.ntoas
+    f32mm = _use_f32_matmul(matmul_f32)
 
     nvec_np = model.scaled_toa_uncertainty(toas) ** 2
     # ECORR rides the Sherman-Morrison segment path (one rank-1
@@ -122,7 +143,8 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None):
         M = jnp.concatenate([ones, jac * valid[:, None]], axis=1)
         r = r * valid
         Fv = F * valid[:, None]
-        return _gls_core(M, Fv, phi, r, nvec, valid, eid, jvar, nseg)
+        return _gls_core(M, Fv, phi, r, nvec, valid, eid, jvar, nseg,
+                         f32mm=f32mm)
 
     args = (jnp.asarray(th), jnp.asarray(tl), jnp.asarray(fh),
             jnp.asarray(fl), batch, sc, jnp.asarray(F_np),
@@ -145,7 +167,20 @@ def _pad_leaf(a: np.ndarray, pad: int) -> np.ndarray:
     return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1), mode="edge")
 
 
-def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int):
+def _symm_mm(X, Y, f32: bool):
+    """X.T @ Y with optional f32 inputs at HIGHEST matmul precision
+    (on TPU: 6-pass bf16 through the MXU, ~f32-exact; f64 matmuls
+    there are software-emulated and an order of magnitude slower).
+    Result is always f64."""
+    if not f32:
+        return X.T @ Y
+    out = jax.lax.dot(X.astype(jnp.float32).T, Y.astype(jnp.float32),
+                      precision=jax.lax.Precision.HIGHEST)
+    return out.astype(jnp.float64)
+
+
+def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
+              f32mm: bool = False):
     """The basis-Woodbury solve (same algebra as pint_tpu.gls), inlined
     so the whole iteration fuses into one XLA program.
 
@@ -174,18 +209,28 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int):
     norm = jnp.where(norm == 0, 1.0, norm)
     Mn = Ms / norm[None, :]
     big = jnp.concatenate([Mn, F], axis=1)
-    bigw = big * w[:, None]
-    Sigma = big.T @ bigw
-    b = bigw.T @ r
-    rCr = jnp.sum(r * r * w)
+    # symmetric sqrt(w) split: keeps the f32-cast entries well-scaled
+    # (big*w spans ~1e12 from the weights; big*sqrt(w) only ~1e6) and
+    # makes Sigma exactly symmetric by construction
+    sw = jnp.sqrt(w)
+    bigs = big * sw[:, None]
+    rs = r * sw
+    Sigma = _symm_mm(bigs, bigs, f32mm)
+    b = _symm_mm(bigs, rs[:, None], f32mm)[:, 0]
+    rCr = jnp.sum(rs * rs)
     if nseg > 1:  # static: no ECORR -> skip the dead downdate entirely
-        # epoch contractions (Sherman-Morrison downdate)
+        # epoch contractions (Sherman-Morrison downdate); the O(N p)
+        # segment sums stay f64 (elementwise, cheap) — only the
+        # (nseg x p)^T (nseg x p) contraction rides the matmul path
         s_seg = jax.ops.segment_sum(w, eid, num_segments=nseg)
         g = jvar / (1.0 + jvar * s_seg)
-        E = jax.ops.segment_sum(bigw, eid, num_segments=nseg)
+        E = jax.ops.segment_sum(big * w[:, None], eid,
+                                num_segments=nseg)
         wr_seg = jax.ops.segment_sum(w * r, eid, num_segments=nseg)
-        Sigma = Sigma - E.T @ (g[:, None] * E)
-        b = b - E.T @ (g * wr_seg)
+        sg = jnp.sqrt(g)
+        Eg = E * sg[:, None]
+        Sigma = Sigma - _symm_mm(Eg, Eg, f32mm)
+        b = b - Eg.T @ (sg * wr_seg)
         rCr = rCr - jnp.sum(g * wr_seg ** 2)
     q = F.shape[1]
     prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi]) if q else \

@@ -295,7 +295,9 @@ class Pulsar:
 
     def update_model_from_text(self, text: str):
         """Replace the model from edited par text (the ParWidget apply
-        path). TOAs are re-barycentered only if EPHEM changed."""
+        path). The live TOAs are re-barycentered in place when EPHEM
+        changes, and planet positions are (re)computed when either
+        EPHEM or PLANET_SHAPIRO changes."""
         from pint_tpu.models import get_model
 
         self._push_undo()
@@ -304,14 +306,17 @@ class Pulsar:
         self.model = get_model(io.StringIO(text))
         self.prefit_model = copy.deepcopy(self.model)
         new_planets = bool(self.model.PLANET_SHAPIRO.value)
-        if self.model.EPHEM.value != old_ephem or \
-                new_planets != old_planets:
-            # re-barycenter the TOAs we HAVE (not the on-disk tim:
-            # that would resurrect deleted TOAs and drop jump flags)
+        ephem_changed = self.model.EPHEM.value != old_ephem
+        if ephem_changed or new_planets != old_planets:
+            # recompute on the TOAs we HAVE (not the on-disk tim:
+            # that would resurrect deleted TOAs and drop jump flags);
+            # the TDB chain only depends on the ephemeris, so a pure
+            # PLANET_SHAPIRO toggle skips straight to posvels
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")
-                self.all_toas.compute_TDBs(
-                    ephem=self.model.EPHEM.value)
+                if ephem_changed:
+                    self.all_toas.compute_TDBs(
+                        ephem=self.model.EPHEM.value)
                 self.all_toas.compute_posvels(
                     ephem=self.model.EPHEM.value,
                     planets=new_planets)

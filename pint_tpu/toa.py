@@ -347,13 +347,15 @@ class TOAs:
             pulse_number=jnp.asarray(pn),
         )
 
-    def to_npz(self, path):
+    def to_npz(self, path, cache_key=None):
         """Columnar snapshot of the fully-processed TOA table
         (reference: TOAs pickling via usepickle — npz here: no
         arbitrary code execution on load, stable across versions)."""
         import json
 
-        arrays = {
+        arrays = {} if cache_key is None else \
+            {"cache_key": np.array(cache_key)}
+        arrays |= {
             "mjd_day": self.mjd_day,
             "mjd_frac_hi": self.mjd_frac[0],
             "mjd_frac_lo": self.mjd_frac[1],
@@ -496,9 +498,11 @@ def get_TOAs(timfile, ephem=None, planets=False, model=None,
         if not planets:
             ps = getattr(model, "PLANET_SHAPIRO", None)
             planets = bool(ps is not None and ps.value)
-    cache_path = None
+    cache_path = cache_key = None
     if usecache and isinstance(timfile, (str, os.PathLike)):
         import hashlib
+
+        from pint_tpu import __version__
 
         fpath = os.fspath(timfile)
         try:
@@ -507,15 +511,27 @@ def get_TOAs(timfile, ephem=None, planets=False, model=None,
         except OSError:
             digest = None
         if digest is not None:
-            digest.update(repr((ephem, planets, include_gps,
-                                include_bipm, bipm_version)).encode())
+            # key = tim content + every pipeline knob + the package
+            # version + clock/EOP override dirs, so numerics fixes and
+            # swapped correction tables invalidate old caches
+            digest.update(repr((
+                ephem, planets, include_gps, include_bipm,
+                bipm_version, __version__,
+                os.environ.get("PINT_TPU_CLOCK_DIR"),
+                os.environ.get("PINT_TPU_EPHEM_DIR"))).encode())
+            cache_key = digest.hexdigest()
             base = os.path.basename(fpath)
             cdir = cachedir or os.path.dirname(os.path.abspath(fpath))
-            cache_path = os.path.join(
-                cdir, f".{base}.{digest.hexdigest()[:16]}.npz")
+            # ONE cache file per tim file; the key lives inside so a
+            # mismatch overwrites in place instead of accumulating
+            cache_path = os.path.join(cdir, f".{base}.toacache.npz")
             if os.path.exists(cache_path):
                 try:
-                    return TOAs.from_npz(cache_path)
+                    with np.load(cache_path,
+                                 allow_pickle=False) as z:
+                        ok = str(z["cache_key"]) == cache_key
+                    if ok:
+                        return TOAs.from_npz(cache_path)
                 except Exception:
                     pass  # corrupt/old cache: rebuild below
     t = TOAs(parse_tim(timfile))
@@ -526,7 +542,7 @@ def get_TOAs(timfile, ephem=None, planets=False, model=None,
     t.compute_posvels(ephem=ephem, planets=planets)
     if cache_path is not None:
         try:
-            t.to_npz(cache_path)
+            t.to_npz(cache_path, cache_key=cache_key)
         except OSError:
             pass  # read-only dir: caching is best-effort
     return t

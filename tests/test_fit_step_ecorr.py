@@ -103,3 +103,23 @@ def test_segment_chi2_matches_marginalized(ecorr_problem):
     _, _, chi2_seg, _ = jax.jit(step_fn)(*args)
     chi2_dense = Residuals(toas, model).chi2
     assert float(chi2_seg) == pytest.approx(chi2_dense, rel=1e-8)
+
+
+def test_f32_matmul_path_agrees(ecorr_problem):
+    """The f32-MXU normal-equation path (auto-enabled on TPU, where
+    f64 matmuls are software-emulated) must agree with the f64 path to
+    well below a parameter sigma."""
+    model, toas = ecorr_problem
+    step64, args64, names = build_fit_step(model, toas,
+                                           matmul_f32=False)
+    step32, args32, _ = build_fit_step(model, toas, matmul_f32=True)
+    dp64, cov64, chi264, _ = jax.jit(step64)(*args64)
+    dp32, cov32, chi232, _ = jax.jit(step32)(*args32)
+    sigma = np.sqrt(np.diag(np.asarray(cov64)))
+    # parameter steps agree to <1e-4 sigma
+    np.testing.assert_array_less(
+        np.abs(np.asarray(dp32) - np.asarray(dp64)), 1e-4 * sigma)
+    # uncertainties agree to 0.1%
+    np.testing.assert_allclose(np.sqrt(np.diag(np.asarray(cov32))),
+                               sigma, rtol=1e-3)
+    assert float(chi232) == pytest.approx(float(chi264), rel=1e-4)

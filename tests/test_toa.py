@@ -168,7 +168,7 @@ def test_toas_npz_cache_roundtrip(tmp_path):
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         a = get_TOAs(str(tim), ephem="de421", usecache=True)
-    caches = list(tmp_path.glob(".c.tim.*.npz"))
+    caches = list(tmp_path.glob(".c.tim.toacache.npz"))
     assert len(caches) == 1
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
@@ -180,12 +180,15 @@ def test_toas_npz_cache_roundtrip(tmp_path):
     assert a.obs == b.obs
     assert a.flags == b.flags
     assert b.clock_applied
-    # distinct cache keys invalidate: different pipeline knobs rebuild
+    # a knob change invalidates and overwrites IN PLACE (one cache
+    # file per tim, never an accumulation of hashed siblings)
+    mtime0 = caches[0].stat().st_mtime_ns
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        get_TOAs(str(tim), ephem="de421", usecache=True,
-                 include_bipm=False)
-    assert len(list(tmp_path.glob(".c.tim.*.npz"))) == 2
+        c2 = get_TOAs(str(tim), ephem="de421", usecache=True,
+                      include_bipm=False)
+    assert len(list(tmp_path.glob(".c.tim*.npz"))) == 1
+    assert caches[0].stat().st_mtime_ns != mtime0
     # direct npz round-trip API
     p = tmp_path / "snap.npz"
     a.to_npz(p)
