@@ -21,7 +21,7 @@ from pint_tpu.bayesian import BayesianTiming
 from pint_tpu.fitter import Fitter
 from pint_tpu.sampler import EnsembleSampler
 
-__all__ = ["MCMCFitter", "PhotonMCMCFitter"]
+__all__ = ["MCMCFitter", "PhotonMCMCFitter", "CompositeMCMCFitter"]
 
 
 class MCMCFitter(Fitter):
@@ -115,15 +115,20 @@ class PhotonMCMCFitter:
             return jnp.sum(jnp.log(w * dens + (1.0 - w)))
 
         self._core_batch = jax.jit(jax.vmap(lnlike_core))
-
-        def lp_batch(thetas):
-            tl_eff = self._tl0[None, :] + (
-                np.asarray(thetas, dtype=np.float64)
-                - self.theta0[None, :])
-            return np.asarray(self._core_batch(jnp.asarray(tl_eff)))
-
         self.sampler = EnsembleSampler(self.nwalkers, self.nparams,
-                                       lp_batch, rng=self.rng)
+                                       self._lp_batch, rng=self.rng)
+
+    def _photon_lnlike_batch(self, thetas: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        tl_eff = self._tl0[None, :] + (
+            np.asarray(thetas, dtype=np.float64)
+            - self.theta0[None, :])
+        return np.asarray(self._core_batch(jnp.asarray(tl_eff)))
+
+    def _lp_batch(self, thetas: np.ndarray) -> np.ndarray:
+        """Log posterior per walker; subclasses compose extra terms."""
+        return self._photon_lnlike_batch(thetas)
 
     def fit_toas(self, nsteps: int = 300, burn: Optional[int] = None,
                  scatter: float = 1e-9, progress: bool = False):
@@ -143,3 +148,34 @@ class PhotonMCMCFitter:
             self.errors[name] = float(std[k])
         self.model.invalidate_cache(params_only=True)
         return float(np.max(self.sampler.lnprob))
+
+
+class CompositeMCMCFitter(PhotonMCMCFitter):
+    """Joint radio-TOA + photon-event posterior over one timing model
+    (reference: mcmc_fitter.CompositeMCMCFitter): lnpost(theta) =
+    lnpost_TOA(theta; radio toas, priors) + lnL_photon(theta; event
+    phases, template). Both terms are batched device calls over the
+    walker ensemble, so the composite costs two XLA programs per
+    half-step regardless of walker count. The two TOA sets are
+    independent data on the SAME free-parameter vector
+    (model.free_params ordering everywhere; BayesianTiming validates
+    the packed order itself)."""
+
+    def __init__(self, toas_radio, toas_events, model, template,
+                 weights=None, nwalkers: int = 32,
+                 rng: Optional[np.random.Generator] = None):
+        super().__init__(toas_events, model, template,
+                         weights=weights, nwalkers=nwalkers, rng=rng)
+        self.toas = toas_radio
+        self.toas_events = toas_events
+        self.bt = BayesianTiming(model, toas_radio)
+
+    def _lp_batch(self, thetas: np.ndarray) -> np.ndarray:
+        thetas = np.asarray(thetas, dtype=np.float64)
+        lp = np.asarray(self.bt.lnposterior_batch(thetas),
+                        dtype=np.float64)
+        finite = np.isfinite(lp)
+        if finite.any():
+            ph = self._photon_lnlike_batch(thetas)
+            lp = np.where(finite, lp + ph, lp)
+        return lp

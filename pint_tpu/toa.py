@@ -382,13 +382,30 @@ class TOAs:
                 sorted(self.obs_planet_pos))
             for k, v in self.obs_planet_pos.items():
                 arrays[f"planet_{k}"] = v
-        np.savez_compressed(path, **arrays)
+        # atomic: concurrent readers of a shared cache path must never
+        # see a half-written file
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                np.savez_compressed(fh, **arrays)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
     @classmethod
-    def from_npz(cls, path) -> "TOAs":
+    def from_npz(cls, path, expect_key=None) -> "TOAs":
+        """Load a snapshot. ``expect_key``: verify the embedded cache
+        key from the SAME open file handle the arrays come from (a
+        separate check-then-load would race a concurrent overwrite of
+        the shared cache path)."""
         import json
 
         with np.load(path, allow_pickle=False) as z:
+            if expect_key is not None and (
+                    "cache_key" not in z.files
+                    or str(z["cache_key"]) != expect_key):
+                raise ValueError("cache key mismatch")
             out = object.__new__(cls)
             out.mjd_day = z["mjd_day"]
             out.mjd_frac = (z["mjd_frac_hi"], z["mjd_frac_lo"])
@@ -527,13 +544,13 @@ def get_TOAs(timfile, ephem=None, planets=False, model=None,
             cache_path = os.path.join(cdir, f".{base}.toacache.npz")
             if os.path.exists(cache_path):
                 try:
-                    with np.load(cache_path,
-                                 allow_pickle=False) as z:
-                        ok = str(z["cache_key"]) == cache_key
-                    if ok:
-                        return TOAs.from_npz(cache_path)
+                    # key checked and arrays read under ONE open: a
+                    # concurrent overwrite can't swap the file between
+                    # validation and load
+                    return TOAs.from_npz(cache_path,
+                                         expect_key=cache_key)
                 except Exception:
-                    pass  # corrupt/old cache: rebuild below
+                    pass  # stale/corrupt cache: rebuild below
     t = TOAs(parse_tim(timfile))
     t.apply_clock_corrections(include_gps=include_gps,
                               include_bipm=include_bipm,
@@ -543,6 +560,18 @@ def get_TOAs(timfile, ephem=None, planets=False, model=None,
     if cache_path is not None:
         try:
             t.to_npz(cache_path, cache_key=cache_key)
+            # sweep hashed-sibling caches from the old naming scheme
+            # (and any strays) so snapshots never accumulate
+            import glob as _glob
+
+            base = os.path.basename(os.fspath(timfile))
+            for old in _glob.glob(os.path.join(
+                    os.path.dirname(cache_path), f".{base}.*.npz")):
+                if os.path.abspath(old) != os.path.abspath(cache_path):
+                    try:
+                        os.unlink(old)
+                    except OSError:
+                        pass
         except OSError:
             pass  # read-only dir: caching is best-effort
     return t

@@ -183,3 +183,45 @@ def test_config_env_overrides(tmp_path, monkeypatch):
     assert cfg.runtimefile("time_gbt.dat") == tmp_path / "time_gbt.dat"
     with pytest.raises(FileNotFoundError):
         cfg.runtimefile("nonexistent.dat")
+
+
+def test_composite_mcmc_joint_posterior(fitted_problem):
+    """Composite radio+photon posterior: adding photon data must not
+    bias F0 away from truth and should not broaden the radio-only
+    posterior (reference: CompositeMCMCFitter)."""
+    from pint_tpu.mcmc_fitter import CompositeMCMCFitter
+    from pint_tpu.templates import LCGaussian, LCTemplate
+
+    truth, _, toas_radio, _ = fitted_problem
+    rng = np.random.default_rng(9)
+    template = LCTemplate([LCGaussian()], norms=[0.7], locs=[0.4],
+                          widths=[0.03])
+    n = 1200
+    base = rng.uniform(55400, 55600, n)
+    phi = template.random(n, rng=rng)
+    f0 = truth.F0.value
+    f1 = truth.F1.value
+    pep = truth.PEPOCH.value
+    dt = (base - pep) * 86400.0
+    k = np.floor(dt * f0)
+    tsec = (k + phi) / f0 - 0.5 * f1 / f0 * ((k + phi) / f0) ** 2
+    mjd = pep + tsec / 86400.0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.toa import get_TOAs_array
+
+        toas_ev = get_TOAs_array(np.sort(mjd), obs="barycenter",
+                                 freqs=np.inf, errors=1.0)
+        m = copy.deepcopy(truth)
+        for nm in m.free_params:
+            if nm != "F0":
+                m.get_param(nm).frozen = True
+        m.invalidate_cache()
+        fitter = CompositeMCMCFitter(
+            toas_radio, toas_ev, m, template,
+            nwalkers=8, rng=np.random.default_rng(10))
+        lnmax = fitter.fit_toas(nsteps=60)
+    assert np.isfinite(lnmax)
+    assert m.F0.value == pytest.approx(truth.F0.value,
+                                       abs=5 * m.F0.uncertainty)
+    assert 0 < m.F0.uncertainty < 1e-5

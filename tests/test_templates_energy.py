@@ -1,0 +1,66 @@
+"""Energy-dependent template tests (reference behaviors:
+src/pint/templates/lceprimitives.py slope parameterization)."""
+
+import numpy as np
+import pytest
+
+from pint_tpu.templates import LCGaussian, LCTemplate, make_template
+from pint_tpu.templates.energy import LCEnergyFitter, LCEnergyTemplate
+
+
+def test_pdf_normalized_at_each_energy():
+    base = make_template([("gaussian", 0.5, 0.3, 0.04),
+                          ("vonmises", 0.2, 0.7, 0.05)])
+    et = LCEnergyTemplate(base, e0_kev=1.0,
+                          dloc=[0.05, -0.02], dlogw=[0.3, 0.0],
+                          dlogits=[0.0, 0.4, -0.2])
+    grid = np.linspace(0, 1, 10001)[:-1]
+    for e in (0.3, 1.0, 5.0):
+        vals = et(grid, np.full(grid.shape, e))
+        assert np.mean(vals) == pytest.approx(1.0, rel=1e-3), e
+
+
+def test_base_template_matches_at_e0():
+    base = make_template([("gaussian", 0.6, 0.25, 0.03)])
+    et = LCEnergyTemplate(base, e0_kev=2.0, dloc=[0.1], dlogw=[0.5])
+    grid = np.linspace(0, 1, 501)
+    np.testing.assert_allclose(
+        et(grid, np.full(grid.shape, 2.0)), base(grid), rtol=1e-10)
+    bt = et.base_template()
+    np.testing.assert_allclose(bt(grid), base(grid), rtol=1e-10)
+
+
+def test_peak_moves_with_energy():
+    base = make_template([("gaussian", 0.8, 0.4, 0.03)])
+    et = LCEnergyTemplate(base, e0_kev=1.0, dloc=[0.1])
+    grid = np.linspace(0, 1, 4001)[:-1]
+    lo = grid[np.argmax(et(grid, np.full(grid.shape, 0.1)))]
+    hi = grid[np.argmax(et(grid, np.full(grid.shape, 10.0)))]
+    assert lo == pytest.approx(0.3, abs=0.005)   # x = -1 decade
+    assert hi == pytest.approx(0.5, abs=0.005)   # x = +1 decade
+
+
+def test_energy_fit_recovers_slope():
+    rng = np.random.default_rng(31)
+    truth = LCEnergyTemplate(
+        make_template([("gaussian", 0.7, 0.35, 0.03)]),
+        e0_kev=1.0, dloc=[0.08])
+    n = 15000
+    energies = 10.0 ** rng.uniform(-1, 1, n)  # 0.1..10 keV
+    phases = truth.random(n, energies, rng=rng)
+    fit = LCEnergyTemplate(
+        make_template([("gaussian", 0.5, 0.38, 0.05)]), e0_kev=1.0)
+    f = LCEnergyFitter(fit, phases, energies)
+    res = f.fit()
+    assert res["success"]
+    m = fit.m
+    dloc = float(fit.theta[4 * m + 2])
+    loc0 = float(np.mod(fit.theta[m + 1], 1.0))
+    assert loc0 == pytest.approx(0.35, abs=0.01)
+    assert dloc == pytest.approx(0.08, abs=0.02)
+
+
+def test_rejects_multishape_primitives():
+    t = make_template([("gaussian2", 0.5, 0.4, [0.02, 0.05])])
+    with pytest.raises(ValueError):
+        LCEnergyTemplate(t)
