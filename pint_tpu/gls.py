@@ -37,11 +37,13 @@ from pint_tpu.residuals import Residuals
 __all__ = ["GLSFitter", "DownhillGLSFitter", "gls_solve_np"]
 
 
-@jax.jit
-def _gls_kernel(M, F, phi, r, nvec):
+@partial(jax.jit, static_argnames=("f32mm",))
+def _gls_kernel(M, F, phi, r, nvec, f32mm: bool = False):
     """Basis-Woodbury GLS solve. Returns (dparams, cov_pp, chi2,
     noise_resid, xhat_full, ok) — ok False when the Cholesky produced
-    non-finite values (caller falls back to SVD)."""
+    non-finite values (caller falls back to SVD). With ``f32mm`` the
+    normal-equation matmuls run in f32 at HIGHEST precision (the TPU
+    MXU path; see pint_tpu.parallel.fit_step._use_f32_matmul)."""
     p = M.shape[1]
     w = 1.0 / nvec                       # N^-1 diagonal
     # two-stage column scaling: sum(M^2*w) can exceed the exponent
@@ -54,12 +56,15 @@ def _gls_kernel(M, F, phi, r, nvec):
     norm = jnp.sqrt(jnp.sum(Ms * Ms * w[:, None], axis=0))
     norm = jnp.where(norm == 0, 1.0, norm)
     Mn = Ms / norm[None, :]
+    from pint_tpu.parallel.fit_step import _symm_mm
+
     big = jnp.concatenate([Mn, F], axis=1)        # (N, p+q)
-    bigw = big * w[:, None]
-    Sigma = big.T @ bigw                           # (p+q, p+q)
+    sw = jnp.sqrt(w)
+    bigs = big * sw[:, None]
+    Sigma = _symm_mm(bigs, bigs, f32mm)            # (p+q, p+q)
     prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
     Sigma = Sigma + jnp.diag(prior)
-    b = bigw.T @ r                                 # (p+q,)
+    b = _symm_mm(bigs, (r * sw)[:, None], f32mm)[:, 0]   # (p+q,)
     # Jacobi-preconditioned Cholesky: raw Sigma mixes O(1) data terms
     # with 1/phi priors (~1e25); unit-diagonal scaling keeps the
     # factorization stable, notably on TPU's non-IEEE emulated f64
@@ -248,7 +253,10 @@ class GLSFitter(Fitter):
             x, cov, chi2, noise, _ = _gls_kernel_svd(
                 M, Fb, phi, r, nvec, threshold=float(threshold))
         else:
-            x, cov, chi2, noise, _, ok = _gls_kernel(M, Fb, phi, r, nvec)
+            from pint_tpu.parallel.fit_step import _use_f32_matmul
+
+            x, cov, chi2, noise, _, ok = _gls_kernel(
+                M, Fb, phi, r, nvec, f32mm=_use_f32_matmul(None))
             if not bool(ok):
                 x, cov, chi2, noise, _ = _gls_kernel_svd(
                     M, Fb, phi, r, nvec)

@@ -119,9 +119,15 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         F_np = padn(F_np)
         nvec_np = padn(nvec_np, fill=1.0)  # avoid 0-division; masked out
         valid_np = padn(valid_np)
-        # padded rows carry w=0 so their segment routing is irrelevant
+        # padded rows carry w=0 so their segment routing is irrelevant;
+        # route them to the zero-variance 'no epoch' slot (nseg-1) so
+        # a time-sorted eid stays sorted through the padding
         eid_np = np.concatenate(
-            [eid_np, np.zeros(pad, np.int32)])
+            [eid_np, np.full(pad, nseg - 1, np.int32)])
+
+    # TOAs are time-ordered so epoch ids are usually monotone; verify
+    # on the host and let the segment sums skip their device-side sort
+    eid_sorted = bool(np.all(np.diff(eid_np) >= 0))
 
     def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
                 eid, jvar):
@@ -144,7 +150,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         r = r * valid
         Fv = F * valid[:, None]
         return _gls_core(M, Fv, phi, r, nvec, valid, eid, jvar, nseg,
-                         f32mm=f32mm)
+                         f32mm=f32mm, eid_sorted=eid_sorted)
 
     args = (jnp.asarray(th), jnp.asarray(tl), jnp.asarray(fh),
             jnp.asarray(fl), batch, sc, jnp.asarray(F_np),
@@ -180,7 +186,7 @@ def _symm_mm(X, Y, f32: bool):
 
 
 def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
-              f32mm: bool = False):
+              f32mm: bool = False, eid_sorted: bool = False):
     """The basis-Woodbury solve (same algebra as pint_tpu.gls), inlined
     so the whole iteration fuses into one XLA program.
 
@@ -222,11 +228,14 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
         # epoch contractions (Sherman-Morrison downdate); the O(N p)
         # segment sums stay f64 (elementwise, cheap) — only the
         # (nseg x p)^T (nseg x p) contraction rides the matmul path
-        s_seg = jax.ops.segment_sum(w, eid, num_segments=nseg)
+        def seg(x):
+            return jax.ops.segment_sum(x, eid, num_segments=nseg,
+                                       indices_are_sorted=eid_sorted)
+
+        s_seg = seg(w)
         g = jvar / (1.0 + jvar * s_seg)
-        E = jax.ops.segment_sum(big * w[:, None], eid,
-                                num_segments=nseg)
-        wr_seg = jax.ops.segment_sum(w * r, eid, num_segments=nseg)
+        E = seg(big * w[:, None])
+        wr_seg = seg(w * r)
         sg = jnp.sqrt(g)
         Eg = E * sg[:, None]
         Sigma = Sigma - _symm_mm(Eg, Eg, f32mm)

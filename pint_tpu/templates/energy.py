@@ -68,12 +68,23 @@ class LCEnergyTemplate:
         m = len(self.primitives)
         self.e0_kev = float(e0_kev)
         base = np.asarray(template.theta, dtype=np.float64)
-        z = np.zeros
+
+        def slopes(v, n, name):
+            if v is None:
+                return np.zeros(n)
+            v = np.asarray(v, dtype=np.float64)
+            if v.shape != (n,):
+                raise ValueError(
+                    f"{name} needs shape ({n},), got {v.shape} — a "
+                    "wrong length would silently shift every slope "
+                    "slice in theta")
+            return v
+
         self.theta = np.concatenate([
             base,
-            z(m + 1) if dlogits is None else np.asarray(dlogits),
-            z(m) if dloc is None else np.asarray(dloc),
-            z(m) if dlogw is None else np.asarray(dlogw)])
+            slopes(dlogits, m + 1, "dlogits"),
+            slopes(dloc, m, "dloc"),
+            slopes(dlogw, m, "dlogw")])
 
     @property
     def m(self) -> int:
@@ -126,6 +137,10 @@ class LCEnergyTemplate:
         on a fine grid — exact enough for tests/simulation)."""
         rng = rng or np.random.default_rng()
         energies_kev = np.asarray(energies_kev, dtype=np.float64)
+        if energies_kev.shape != (n,):
+            raise ValueError(
+                f"energies_kev must have shape ({n},) matching n; "
+                f"got {energies_kev.shape}")
         grid = np.linspace(0.0, 1.0, 2049)
         centers = 0.5 * (grid[:-1] + grid[1:])
         pdf = self._pdf_fn()

@@ -383,8 +383,12 @@ class TOAs:
             for k, v in self.obs_planet_pos.items():
                 arrays[f"planet_{k}"] = v
         # atomic: concurrent readers of a shared cache path must never
-        # see a half-written file
-        tmp = f"{path}.{os.getpid()}.tmp"
+        # see a half-written file; tmp name is unique per thread too
+        import threading
+        import uuid
+
+        tmp = (f"{path}.{os.getpid()}.{threading.get_ident()}."
+               f"{uuid.uuid4().hex[:8]}.tmp")
         try:
             with open(tmp, "wb") as fh:
                 np.savez_compressed(fh, **arrays)
@@ -561,13 +565,17 @@ def get_TOAs(timfile, ephem=None, planets=False, model=None,
         try:
             t.to_npz(cache_path, cache_key=cache_key)
             # sweep hashed-sibling caches from the old naming scheme
-            # (and any strays) so snapshots never accumulate
+            # ONLY (exact `.{base}.<16 hex>.npz` names — a loose glob
+            # would eat sibling tim files' valid caches, e.g.
+            # `.x.tim.bak.toacache.npz` matching `.x.tim.*`)
             import glob as _glob
+            import re as _re
 
-            base = os.path.basename(os.fspath(timfile))
+            pat = _re.compile(
+                _re.escape(f".{base}.") + r"[0-9a-f]{16}\.npz$")
             for old in _glob.glob(os.path.join(
                     os.path.dirname(cache_path), f".{base}.*.npz")):
-                if os.path.abspath(old) != os.path.abspath(cache_path):
+                if pat.search(os.path.basename(old)):
                     try:
                         os.unlink(old)
                     except OSError:

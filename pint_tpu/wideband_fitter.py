@@ -86,7 +86,10 @@ class WidebandTOAFitter(Fitter):
             x, cov, chi2, noise, _ = _gls_kernel_svd(
                 *args, threshold=float(threshold))
         else:
-            x, cov, chi2, noise, _, ok = _gls_kernel(*args)
+            from pint_tpu.parallel.fit_step import _use_f32_matmul
+
+            x, cov, chi2, noise, _, ok = _gls_kernel(
+                *args, f32mm=_use_f32_matmul(None))
             if not bool(ok):
                 x, cov, chi2, noise, _ = _gls_kernel_svd(*args)
         return (-np.asarray(x), np.asarray(cov), float(chi2),
