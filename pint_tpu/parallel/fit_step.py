@@ -125,10 +125,6 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         eid_np = np.concatenate(
             [eid_np, np.full(pad, nseg - 1, np.int32)])
 
-    # TOAs are time-ordered so epoch ids are usually monotone; verify
-    # on the host and let the segment sums skip their device-side sort
-    eid_sorted = bool(np.all(np.diff(eid_np) >= 0))
-
     def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
                 eid, jvar):
         def phase_f64(thx):
@@ -150,7 +146,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         r = r * valid
         Fv = F * valid[:, None]
         return _gls_core(M, Fv, phi, r, nvec, valid, eid, jvar, nseg,
-                         f32mm=f32mm, eid_sorted=eid_sorted)
+                         f32mm=f32mm)
 
     args = (jnp.asarray(th), jnp.asarray(tl), jnp.asarray(fh),
             jnp.asarray(fl), batch, sc, jnp.asarray(F_np),
@@ -186,7 +182,7 @@ def _symm_mm(X, Y, f32: bool):
 
 
 def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
-              f32mm: bool = False, eid_sorted: bool = False):
+              f32mm: bool = False):
     """The basis-Woodbury solve (same algebra as pint_tpu.gls), inlined
     so the whole iteration fuses into one XLA program.
 
@@ -228,9 +224,12 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
         # epoch contractions (Sherman-Morrison downdate); the O(N p)
         # segment sums stay f64 (elementwise, cheap) — only the
         # (nseg x p)^T (nseg x p) contraction rides the matmul path
+        # NOTE: no indices_are_sorted hint — eid is a runtime argument
+        # of the advertised-pure step_fn, and a baked-in sortedness
+        # promise would silently corrupt the downdate for any caller
+        # substituting a re-ordered eid
         def seg(x):
-            return jax.ops.segment_sum(x, eid, num_segments=nseg,
-                                       indices_are_sorted=eid_sorted)
+            return jax.ops.segment_sum(x, eid, num_segments=nseg)
 
         s_seg = seg(w)
         g = jvar / (1.0 + jvar * s_seg)
