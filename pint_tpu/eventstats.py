@@ -47,19 +47,13 @@ def _z2_terms(phases, weights, m: int):
     from pint_tpu.ops.pallas_kernels import (pallas_available,
                                              z2_harmonics_pallas)
 
-    if phases.shape[0] >= _PALLAS_MIN_N and pallas_available():
+    if phases.shape[0] >= _PALLAS_MIN_N and m <= 128 and \
+            pallas_available():
         c, s = z2_harmonics_pallas(phases, weights, m=m)
     else:
         c, s = _z2_sums(phases, weights, m)
     norm = jnp.sum(weights ** 2)
     return 2.0 * (c ** 2 + s ** 2) / norm
-
-
-def _z2_harmonics(phases, weights, m: int):
-    """Back-compat alias used by tests: finalized per-harmonic terms
-    via the jnp path."""
-    c, s = _z2_sums(phases, weights, m)
-    return 2.0 * (c ** 2 + s ** 2) / jnp.sum(weights ** 2)
 
 
 def z2m(phases, m: int = 2, weights=None) -> float:

@@ -114,13 +114,28 @@ _clock_cache: dict = {}
 
 
 def find_clock_file(name, fmt="tempo2"):
-    """Locate `name` under $PINT_TPU_CLOCK_DIR; zero-fallback otherwise,
-    warning once per file name (mirrors the reference's missing-clock
-    warning policy in src/pint/observatory/topo_obs.py). Parsed files
-    are cached per (path, fmt)."""
-    clock_dir = os.environ.get("PINT_TPU_CLOCK_DIR")
+    """Locate `name` in the clock mirror (flat file under
+    $PINT_TPU_CLOCK_DIR, or anywhere inside a nested
+    pulsar-clock-corrections clone via the global-corrections Index);
+    zero-fallback otherwise, warning once per file name (mirrors the
+    reference's missing-clock warning policy in
+    src/pint/observatory/topo_obs.py). Parsed files are cached per
+    (path, fmt)."""
+    from pint_tpu.observatory.global_clock_corrections import (
+        clock_mirror, get_index)
+
+    clock_dir = clock_mirror()
     if clock_dir:
         cand = os.path.join(clock_dir, name)
+        if not os.path.exists(cand):
+            # nested mirror layout (T2runtime/clock/...): consult the
+            # repository index
+            try:
+                idx = get_index()
+                if name in idx:
+                    cand = idx[name].path
+            except FileNotFoundError:
+                pass
         if os.path.exists(cand):
             key = (os.path.abspath(cand), fmt)
             if key not in _clock_cache:

@@ -78,8 +78,14 @@ def z2_harmonics_pallas(phases, weights, m: int = 20,
                         interpret: bool = False):
     """(cos_sums (m,), sin_sums (m,)) of sum_i w_i e^{2 pi i k phi_i},
     k = 1..m, streamed through VMEM in (64, 128) photon tiles."""
-    from jax.experimental import pallas as pl_mod  # noqa: F401
-
+    if pl is None:
+        raise ImportError(
+            "jax.experimental.pallas is unavailable in this jax "
+            "build; use the jnp path (pint_tpu.eventstats)")
+    if m > _LANES:
+        raise ValueError(
+            f"m={m} exceeds the {_LANES}-lane accumulator (the "
+            "one-hot scatter would silently drop harmonics)")
     phases = jnp.asarray(phases, dtype=jnp.float32).ravel()
     weights = jnp.asarray(weights, dtype=jnp.float32).ravel()
     n = phases.shape[0]

@@ -1,0 +1,81 @@
+"""Global clock-corrections mirror machinery (reference:
+src/pint/observatory/global_clock_corrections.py, download replaced by
+a local mirror per the zero-egress build)."""
+
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.observatory.global_clock_corrections import (
+    Index,
+    get_clock_correction_file,
+    set_clock_mirror,
+    update_clock_files,
+)
+
+CLK = "# UTC(gbt) UTC\n50000.0 0.0\n60000.0 1e-6\n"
+
+
+@pytest.fixture()
+def mirror(tmp_path):
+    d = tmp_path / "mirror"
+    (d / "T2runtime" / "clock").mkdir(parents=True)
+    (d / "T2runtime" / "clock" / "gbt2gps.clk").write_text(CLK)
+    (d / "time_gbt.dat").write_text("  50000.0 0.0\n")
+    set_clock_mirror(str(d))
+    yield d
+    set_clock_mirror(None)
+
+
+def test_index_discovers_files(mirror):
+    idx = Index()
+    assert "gbt2gps.clk" in idx
+    assert "time_gbt.dat" in idx
+    assert idx["gbt2gps.clk"].path.endswith("gbt2gps.clk")
+
+
+def test_index_txt_controls_contents_and_intervals(mirror):
+    (mirror / "index.txt").write_text(
+        "# name interval_days\n"
+        "T2runtime/clock/gbt2gps.clk 7\n"
+        "missing.clk 7\n")
+    with pytest.warns(UserWarning, match="lacks it"):
+        idx = Index()
+    assert "gbt2gps.clk" in idx
+    assert "time_gbt.dat" not in idx  # not listed
+    assert idx["gbt2gps.clk"].update_interval_days == 7
+
+
+def test_staleness_warns_and_raises(mirror):
+    path = mirror / "T2runtime" / "clock" / "gbt2gps.clk"
+    old = time.time() - 400 * 86400
+    os.utime(path, (old, old))
+    with pytest.warns(UserWarning, match="days old"):
+        p = get_clock_correction_file("gbt2gps.clk")
+    assert os.path.exists(p)
+    with pytest.raises(RuntimeError, match="refresh the mirror"):
+        get_clock_correction_file("gbt2gps.clk", limits="error")
+    report = None
+    with pytest.warns(UserWarning, match="stale clock files"):
+        report = update_clock_files()
+    assert report["gbt2gps.clk"] is False
+    assert report["time_gbt.dat"] is True
+
+
+def test_no_mirror_is_a_loud_error(tmp_path, monkeypatch):
+    set_clock_mirror(None)
+    monkeypatch.delenv("PINT_TPU_CLOCK_DIR", raising=False)
+    with pytest.raises(FileNotFoundError, match="no network access"):
+        Index()
+
+
+def test_fresh_file_resolves_and_evaluates(mirror):
+    from pint_tpu.observatory.clock import ClockFile
+
+    p = get_clock_correction_file("gbt2gps.clk")
+    cf = ClockFile.read(p, fmt="tempo2")
+    v = cf.evaluate(np.array([55000.0]))
+    assert 0.0 < v[0] < 1e-6
