@@ -64,6 +64,19 @@ def main():
     ap.add_argument("--ntoa", type=int, default=100)
     args = ap.parse_args()
 
+    import os
+    import sys
+
+    if not os.environ.get("PINT_TPU_BENCH_FALLBACK") and \
+            os.environ.get("PALLAS_AXON_POOL_IPS"):
+        from bench import accelerator_responsive, cpu_fallback_env
+
+        if not accelerator_responsive():
+            log("accelerator backend unresponsive; re-running on CPU")
+            os.execvpe(sys.executable,
+                       [sys.executable, __file__] + sys.argv[1:],
+                       cpu_fallback_env())
+
     import jax
 
     jax.config.update("jax_enable_x64", True)

@@ -44,10 +44,10 @@ def _z2_terms(phases, weights, m: int):
     1989 weighted form). The trig sums come from the pallas streaming
     kernel on TPU for large photon sets (Fermi-scale), jnp elsewhere;
     the normalization is applied in ONE place for both."""
-    from pint_tpu.ops.pallas_kernels import (pallas_available,
+    from pint_tpu.ops.pallas_kernels import (_LANES, pallas_available,
                                              z2_harmonics_pallas)
 
-    if phases.shape[0] >= _PALLAS_MIN_N and m <= 128 and \
+    if phases.shape[0] >= _PALLAS_MIN_N and m <= _LANES and \
             pallas_available():
         c, s = z2_harmonics_pallas(phases, weights, m=m)
     else:

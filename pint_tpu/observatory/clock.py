@@ -129,13 +129,20 @@ def find_clock_file(name, fmt="tempo2"):
         cand = os.path.join(clock_dir, name)
         if not os.path.exists(cand):
             # nested mirror layout (T2runtime/clock/...): consult the
-            # repository index
+            # repository index; on a miss, refresh once in case the
+            # file landed after the cached walk. A broken mirror must
+            # degrade to the zero fallback below, never crash ingestion
             try:
                 idx = get_index()
+                if name not in idx:
+                    idx = get_index(refresh=True)
                 if name in idx:
                     cand = idx[name].path
             except FileNotFoundError:
                 pass
+            except Exception as e:
+                warnings.warn(f"clock mirror index unusable ({e}); "
+                              "falling back", stacklevel=2)
         if os.path.exists(cand):
             key = (os.path.abspath(cand), fmt)
             if key not in _clock_cache:

@@ -39,15 +39,17 @@ def set_clock_mirror(path: Optional[str]):
     _INDEX_CACHE.clear()
 
 
-def get_index(mirror: Optional[str] = None) -> "Index":
+def get_index(mirror: Optional[str] = None,
+              refresh: bool = False) -> "Index":
     """Cached Index for the configured mirror (one tree walk per
-    mirror per session, not per lookup)."""
+    mirror per session, not per lookup); ``refresh`` forces a re-walk
+    (e.g. after dropping a new file into the mirror)."""
     m = mirror or clock_mirror()
     if m is None:
         raise FileNotFoundError(
             "no clock mirror configured: set $PINT_TPU_CLOCK_DIR or "
             "call set_clock_mirror()")
-    if m not in _INDEX_CACHE:
+    if refresh or m not in _INDEX_CACHE:
         _INDEX_CACHE[m] = Index(m)
     return _INDEX_CACHE[m]
 

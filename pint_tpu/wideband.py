@@ -43,6 +43,10 @@ def has_wideband_dm(toas) -> bool:
                for v in toas.get_flag_value("pp_dm"))
 
 
+__all__ = ["get_wideband_dm", "has_wideband_dm", "DMResiduals",
+           "CombinedResiduals", "WidebandTOAResiduals"]
+
+
 class DMResiduals:
     """DM-channel residuals: measured DM (flags) minus model DM value at
     each TOA (reference: residuals.DMResiduals)."""
@@ -84,5 +88,50 @@ class DMResiduals:
     @property
     def chi2(self) -> float:
         return float(np.sum((self.resids / self.dm_errors) ** 2))
+
+
+class CombinedResiduals:
+    """Stack of heterogeneous residual channels with a combined chi2
+    (reference: residuals.CombinedResiduals)."""
+
+    def __init__(self, residual_objs):
+        self.residual_objs = list(residual_objs)
+
+    @property
+    def chi2(self) -> float:
+        return float(sum(r.chi2 for r in self.residual_objs))
+
+    @property
+    def resids(self) -> np.ndarray:
+        parts = []
+        for r in self.residual_objs:
+            v = getattr(r, "time_resids", None)
+            parts.append(np.asarray(v if v is not None else r.resids))
+        return np.concatenate(parts)
+
+
+class WidebandTOAResiduals(CombinedResiduals):
+    """Joint TOA + DM residuals of a wideband data set (reference:
+    residuals.WidebandTOAResiduals): .toa is the phase/time channel,
+    .dm the DM-measurement channel."""
+
+    def __init__(self, toas, model, subtract_mean: bool = True,
+                 track_mode=None):
+        from pint_tpu.residuals import Residuals
+
+        self.toas = toas
+        self.model = model
+        self.toa = Residuals(toas, model, subtract_mean=subtract_mean,
+                             track_mode=track_mode)
+        self.dm = DMResiduals(toas, model)
+        super().__init__([self.toa, self.dm])
+
+    @property
+    def dof(self) -> int:
+        return 2 * self.toas.ntoas - len(self.model.free_params) - 1
+
+    @property
+    def reduced_chi2(self) -> float:
+        return self.chi2 / self.dof
 
 

@@ -167,3 +167,20 @@ def test_dmefac_scales_dm_errors():
         f["fe"] = "L-wide"
     sig = m.scaled_dm_uncertainty(t)
     np.testing.assert_allclose(sig, 2.5 * 2e-4)
+
+
+def test_wideband_toa_residuals_class():
+    """WidebandTOAResiduals combines the TOA and DM channels
+    (reference: residuals.WidebandTOAResiduals)."""
+    from pint_tpu.wideband import (CombinedResiduals, DMResiduals,
+                                   WidebandTOAResiduals)
+
+    model, toas = _sim_wb()
+    wr = WidebandTOAResiduals(toas, model)
+    assert wr.chi2 == pytest.approx(wr.toa.chi2 + wr.dm.chi2)
+    assert wr.resids.shape == (2 * toas.ntoas,)
+    assert wr.dof == 2 * toas.ntoas - len(model.free_params) - 1
+    assert wr.reduced_chi2 == pytest.approx(wr.chi2 / wr.dof)
+    # generic combiner works over arbitrary channels
+    cr = CombinedResiduals([wr.toa, DMResiduals(toas, model)])
+    assert cr.chi2 == pytest.approx(wr.chi2)
