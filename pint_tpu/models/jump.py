@@ -25,13 +25,42 @@ class PhaseJump(PhaseComponent):
 
     def add_jump(self, index=None, key=None, key_value=(), value=0.0,
                  frozen=True, uncertainty=None):
-        index = index if index is not None else len(self.jumps) + 1
+        if index is None:
+            # one past the highest used index — the count would land
+            # on an existing slot when indices are non-contiguous
+            index = max((self.params[n].index for n in self.jumps),
+                        default=0) + 1
         p = maskParameter("JUMP", index=index, key=key,
                           key_value=key_value, value=value, frozen=frozen,
                           uncertainty=uncertainty, units="s")
         self.add_param(p)
         self.jumps.append(p.name)
         return p
+
+    def tim_jumps_to_params(self, toas) -> list:
+        """Create one free JUMP parameter per distinct ``-tim_jump``
+        flag value found on the TOAs (the flags the tim parser writes
+        for JUMP/JUMP blocks), skipping ids already covered by an
+        existing -tim_jump JUMP parameter (reference:
+        PhaseJump.jump_flags_to_params). Returns the new parameters."""
+        ids = sorted({f["tim_jump"] for f in toas.flags
+                      if "tim_jump" in f}, key=str)
+        covered = {p.key_value[0] for p in self.get_jump_param_objects()
+                   if getattr(p, "key", None) == "-tim_jump"
+                   and p.key_value}
+        new = []
+        for jid in ids:
+            if str(jid) in covered:
+                continue
+            new.append(self.add_jump(key="-tim_jump",
+                                     key_value=(str(jid),),
+                                     value=0.0, frozen=False))
+        if new:
+            self.setup()
+            parent = getattr(self, "_parent", None)
+            if parent is not None:
+                parent.invalidate_cache()
+        return new
 
     def setup(self):
         self.jumps = sorted(

@@ -724,6 +724,21 @@ class TimingModel:
         for c in self.components.values():
             c.validate()
 
+    def jump_flags_to_params(self, toas) -> list:
+        """One free JUMP per distinct tim-file JUMP block (the
+        ``-tim_jump`` flags the tim parser writes), creating the
+        PhaseJump component if needed (reference:
+        TimingModel/PhaseJump jump_flags_to_params)."""
+        from pint_tpu.models.jump import PhaseJump
+
+        comp = self.components.get("PhaseJump")
+        if comp is None:
+            if not any("tim_jump" in f for f in toas.flags):
+                return []
+            comp = PhaseJump()
+            self.add_component(comp)
+        return comp.tim_jumps_to_params(toas)
+
     def compare(self, other: "TimingModel") -> str:
         """Parameter-by-parameter diff (reference: TimingModel.compare)."""
         rows = []

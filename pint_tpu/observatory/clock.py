@@ -110,6 +110,7 @@ class ZeroClockFile(ClockFile):
 
 
 _warned_missing = set()
+_refresh_missed = set()  # names already refresh-walked and not found
 _clock_cache: dict = {}
 
 
@@ -129,20 +130,26 @@ def find_clock_file(name, fmt="tempo2"):
         cand = os.path.join(clock_dir, name)
         if not os.path.exists(cand):
             # nested mirror layout (T2runtime/clock/...): consult the
-            # repository index; on a miss, refresh once in case the
-            # file landed after the cached walk. A broken mirror must
-            # degrade to the zero fallback below, never crash ingestion
+            # repository index; on a miss, refresh ONCE PER NAME in
+            # case the file landed after the cached walk (a hot
+            # ingestion loop must not re-walk the mirror per lookup).
+            # A broken mirror degrades to the zero fallback below —
+            # loudly, once — never crashing ingestion
             try:
                 idx = get_index()
-                if name not in idx:
+                if name not in idx and name not in _refresh_missed:
+                    _refresh_missed.add(name)
                     idx = get_index(refresh=True)
                 if name in idx:
                     cand = idx[name].path
             except FileNotFoundError:
                 pass
             except Exception as e:
-                warnings.warn(f"clock mirror index unusable ({e}); "
-                              "falling back", stacklevel=2)
+                if "mirror-index" not in _warned_missing:
+                    _warned_missing.add("mirror-index")
+                    warnings.warn(
+                        f"clock mirror index unusable ({e}); "
+                        "falling back", stacklevel=2)
         if os.path.exists(cand):
             key = (os.path.abspath(cand), fmt)
             if key not in _clock_cache:

@@ -37,6 +37,11 @@ def set_clock_mirror(path: Optional[str]):
     global _MIRROR
     _MIRROR = path
     _INDEX_CACHE.clear()
+    # forget per-name miss memos so a re-pointed/refreshed mirror is
+    # re-consulted for previously-missing files
+    from pint_tpu.observatory import clock as _clock
+
+    _clock._refresh_missed.clear()
 
 
 def get_index(mirror: Optional[str] = None,

@@ -16,7 +16,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["get_wideband_dm", "has_wideband_dm", "DMResiduals"]
+__all__ = ["get_wideband_dm", "has_wideband_dm", "DMResiduals",
+           "CombinedResiduals", "WidebandTOAResiduals"]
 
 
 def get_wideband_dm(toas) -> Tuple[np.ndarray, np.ndarray]:
@@ -41,10 +42,6 @@ def get_wideband_dm(toas) -> Tuple[np.ndarray, np.ndarray]:
 def has_wideband_dm(toas) -> bool:
     return all(v is not None
                for v in toas.get_flag_value("pp_dm"))
-
-
-__all__ = ["get_wideband_dm", "has_wideband_dm", "DMResiduals",
-           "CombinedResiduals", "WidebandTOAResiduals"]
 
 
 class DMResiduals:

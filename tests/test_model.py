@@ -207,3 +207,48 @@ def test_tcb_converted_by_default_refused_on_request():
     assert m.UNITS.value == "TDB"  # converted on load
     with pytest.raises(ValueError, match="TCB"):
         get_model(io.StringIO(par), allow_tcb=False)
+
+
+def test_jump_flags_to_params():
+    """tim-file JUMP blocks (-tim_jump flags) become free JUMP
+    parameters selecting exactly the blocked TOAs (reference:
+    jump_flags_to_params)."""
+    import io as _io
+
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+    from pint_tpu.toa import get_TOAs
+
+    par = ("PSR J0J0+0J0\nRAJ 5:00:00 1\nDECJ 5:00:00 1\nF0 99.0 1\n"
+           "PEPOCH 55500\nDM 5.0\nUNITS TDB\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(_io.StringIO(par))
+        rng = np.random.default_rng(12)
+        toas = make_fake_toas_uniform(55000, 56000, 30, model,
+                                      error_us=1.0, add_noise=True,
+                                      rng=rng)
+    # write a tim with a JUMP block around the middle ten TOAs
+    lines = ["FORMAT 1"]
+    mjds = np.asarray(toas.get_mjds())
+    for i in range(30):
+        if i == 10:
+            lines.append("JUMP")
+        if i == 20:
+            lines.append("JUMP")
+        lines.append(f" fake{i} 1400.0 {mjds[i]:.12f} 1.0 @")
+    tim = "\n".join(lines) + "\n"
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t2 = get_TOAs(_io.StringIO(tim), model=model)
+    tagged = [i for i, f in enumerate(t2.flags) if "tim_jump" in f]
+    assert tagged == list(range(10, 20))
+    new = model.jump_flags_to_params(t2)
+    assert len(new) == 1
+    assert not new[0].frozen
+    comp = model.components["PhaseJump"]
+    # idempotent: calling again adds nothing
+    assert model.jump_flags_to_params(t2) == []
+    # the new JUMP selects exactly the tagged TOAs
+    mask = new[0].select_mask(t2)
+    assert list(np.flatnonzero(mask)) == tagged
