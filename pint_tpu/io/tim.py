@@ -96,7 +96,8 @@ def _parse_princeton_line(line: str) -> Optional[TimTOA]:
                   obs=obs, name=name)
 
 
-def parse_tim(source, _depth: int = 0) -> List[TimTOA]:
+def parse_tim(source, _depth: int = 0,
+              _jump_base: int = 0) -> List[TimTOA]:
     """Parse a .tim file (path, file object, or literal multi-line string).
 
     INCLUDE is followed relative to the including file's directory.
@@ -111,7 +112,10 @@ def parse_tim(source, _depth: int = 0) -> List[TimTOA]:
     efac = 1.0
     equad_us = 0.0
     jump_active = False
-    jump_count = 0
+    # jump ids number ACROSS include boundaries: an included file's
+    # JUMP blocks are physically independent of the includer's, and a
+    # reused -tim_jump id would merge them into one fitted parameter
+    jump_count = _jump_base
 
     for raw in lines:
         line = raw.rstrip("\n")
@@ -140,7 +144,13 @@ def parse_tim(source, _depth: int = 0) -> List[TimTOA]:
                 inc = parts[1]
                 if not os.path.isabs(inc):
                     inc = os.path.join(base_dir, inc)
-                toas.extend(parse_tim(inc, _depth=_depth + 1))
+                sub = parse_tim(inc, _depth=_depth + 1,
+                                _jump_base=jump_count)
+                for t in sub:
+                    jid = t.flags.get("tim_jump")
+                    if jid is not None:
+                        jump_count = max(jump_count, int(jid))
+                toas.extend(sub)
             elif head == "TIME" and len(parts) > 1:
                 time_offset_s += float(parts[1])
             elif head == "EFAC" and len(parts) > 1:

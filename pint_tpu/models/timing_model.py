@@ -724,20 +724,27 @@ class TimingModel:
         for c in self.components.values():
             c.validate()
 
+    def get_or_create_component(self, name: str):
+        """components[name], constructing and attaching it from the
+        registry when absent (used by jump conversion and the GUI)."""
+        comp = self.components.get(name)
+        if comp is None:
+            comp = component_types[name]()
+            self.add_component(comp)
+        return comp
+
     def jump_flags_to_params(self, toas) -> list:
         """One free JUMP per distinct tim-file JUMP block (the
         ``-tim_jump`` flags the tim parser writes), creating the
         PhaseJump component if needed (reference:
         TimingModel/PhaseJump jump_flags_to_params)."""
-        from pint_tpu.models.jump import PhaseJump
+        import pint_tpu.models.jump  # register PhaseJump  # noqa: F401
 
-        comp = self.components.get("PhaseJump")
-        if comp is None:
-            if not any("tim_jump" in f for f in toas.flags):
-                return []
-            comp = PhaseJump()
-            self.add_component(comp)
-        return comp.tim_jumps_to_params(toas)
+        if "PhaseJump" not in self.components and \
+                not any("tim_jump" in f for f in toas.flags):
+            return []
+        return self.get_or_create_component(
+            "PhaseJump").tim_jumps_to_params(toas)
 
     def compare(self, other: "TimingModel") -> str:
         """Parameter-by-parameter diff (reference: TimingModel.compare)."""

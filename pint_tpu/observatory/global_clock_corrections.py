@@ -37,11 +37,13 @@ def set_clock_mirror(path: Optional[str]):
     global _MIRROR
     _MIRROR = path
     _INDEX_CACHE.clear()
-    # forget per-name miss memos so a re-pointed/refreshed mirror is
-    # re-consulted for previously-missing files
+    # forget per-name miss memos AND warn-once sentinels so a
+    # re-pointed mirror is re-consulted for previously-missing files
+    # and a broken replacement mirror still warns loudly
     from pint_tpu.observatory import clock as _clock
 
     _clock._refresh_missed.clear()
+    _clock._warned_missing.clear()
 
 
 def get_index(mirror: Optional[str] = None,

@@ -136,14 +136,9 @@ class Pulsar:
         return int(mask.sum())
 
     def _jump_component(self):
-        from pint_tpu.models.jump import PhaseJump
+        import pint_tpu.models.jump  # register PhaseJump # noqa: F401
 
-        comp = self.model.components.get("PhaseJump")
-        if comp is None:
-            comp = PhaseJump()
-            self.model.add_component(comp, setup=False)
-            comp.setup()
-        return comp
+        return self.model.get_or_create_component("PhaseJump")
 
     def jump_selection(self, mask=None) -> Optional[str]:
         """JUMP the masked (default selected) TOAs: tag them with a

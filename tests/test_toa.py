@@ -195,3 +195,25 @@ def test_toas_npz_cache_roundtrip(tmp_path):
     c = TOAs.from_npz(p)
     assert c.ntoas == a.ntoas
     np.testing.assert_array_equal(c.ssb_obs_vel, a.ssb_obs_vel)
+
+
+def test_include_jump_blocks_get_distinct_ids(tmp_path):
+    """JUMP blocks in INCLUDE'd tim files are physically independent of
+    the includer's and must not share -tim_jump ids."""
+    from pint_tpu.io.tim import parse_tim
+
+    inner = tmp_path / "inner.tim"
+    inner.write_text("FORMAT 1\nJUMP\n in1 1400.0 55010.0 1.0 @\n"
+                     "JUMP\n in2 1400.0 55011.0 1.0 @\n")
+    outer = tmp_path / "outer.tim"
+    outer.write_text("FORMAT 1\nJUMP\n a 1400.0 55000.0 1.0 @\nJUMP\n"
+                     f"INCLUDE {inner.name}\n"
+                     "JUMP\n b 1400.0 55020.0 1.0 @\nJUMP\n"
+                     " c 1400.0 55030.0 1.0 @\n")
+    toas = parse_tim(str(outer))
+    ids = {t.name: t.flags.get("tim_jump") for t in toas}
+    assert ids["a"] == "1"
+    assert ids["in1"] == "2"
+    assert ids["b"] == "3"
+    assert ids["c"] is None
+    assert len({v for v in ids.values() if v}) == 3
