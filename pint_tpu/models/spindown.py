@@ -27,9 +27,16 @@ class Spindown(PhaseComponent):
 
     def __init__(self):
         super().__init__()
-        self.add_param(floatParameter("F0", units="Hz", frozen=True,
-                                      description="spin frequency"))
-        self.add_param(floatParameter("F1", units="Hz/s^1", value=0.0))
+        f0 = self.add_param(floatParameter(
+            "F0", units="Hz", frozen=True,
+            description="spin frequency"))
+        f1 = self.add_param(floatParameter("F1", units="Hz/s^1",
+                                           value=0.0))
+        # F0/F1 stay floatParameters (their dd packing differs from
+        # the F2+ prefix family) but still belong to the 'F' prefix
+        # family for get_prefix_mapping enumeration, as in PINT
+        f0.prefix, f0.index = "F", 0
+        f1.prefix, f1.index = "F", 1
         self.add_param(MJDParameter(
             "PEPOCH", description="epoch of spin parameters"))
 

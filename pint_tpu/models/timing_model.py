@@ -254,6 +254,40 @@ class TimingModel:
     def _ordered_components(self):
         return sorted(self.components.values(), key=_category_rank)
 
+    # -------- introspection helpers (reference: TimingModel API) ------
+
+    def get_params_of_type(self, param_type: str) -> List[str]:
+        """Parameter names whose class name matches ``param_type``
+        (e.g. 'maskParameter', 'prefixParameter'; reference:
+        TimingModel.get_params_of_type_top)."""
+        want = param_type.lower()
+        out = []
+        for c in self.components.values():
+            for p in c.params.values():
+                if type(p).__name__.lower() == want:
+                    out.append(p.name)
+        return out
+
+    def get_prefix_mapping(self, prefix: str) -> Dict[int, str]:
+        """{index: name} for every parameter of the given prefix
+        family (reference: TimingModel.get_prefix_mapping), e.g.
+        get_prefix_mapping('DMX_') -> {1: 'DMX_0001', ...}."""
+        out: Dict[int, str] = {}
+        for c in self.components.values():
+            for p in c.params.values():
+                if getattr(p, "prefix", None) == prefix:
+                    out[p.index] = p.name
+        return dict(sorted(out.items()))
+
+    @property
+    def components_by_category(self) -> Dict[str, List[str]]:
+        """{category: [component names]} in evaluation order
+        (reference: TimingModel.get_components_by_category)."""
+        out: Dict[str, List[str]] = {}
+        for c in self._ordered_components():
+            out.setdefault(c.category, []).append(type(c).__name__)
+        return out
+
     def get_param(self, name: str) -> Parameter:
         for c in self.components.values():
             if name in c.params:

@@ -252,3 +252,29 @@ def test_jump_flags_to_params():
     # the new JUMP selects exactly the tagged TOAs
     mask = new[0].select_mask(t2)
     assert list(np.flatnonzero(mask)) == tagged
+
+
+def test_introspection_helpers():
+    """get_params_of_type / get_prefix_mapping / components_by_category
+    (reference: TimingModel introspection API)."""
+    import io as _io
+
+    from pint_tpu.models import get_model
+
+    par = ("PSR JINTRO\nRAJ 1:00:00 1\nDECJ 2:00:00 1\nF0 100 1\n"
+           "F1 -1e-15 1\nPEPOCH 55000\nDM 10 1\n"
+           "DMX_0001 1e-3 1\nDMXR1_0001 54000\nDMXR2_0001 54100\n"
+           "DMX_0003 2e-3 1\nDMXR1_0003 54200\nDMXR2_0003 54300\n"
+           "JUMP -grp a 1e-6 1\nUNITS TDB\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(_io.StringIO(par))
+    masks = m.get_params_of_type("maskParameter")
+    assert "JUMP1" in masks
+    dmx = m.get_prefix_mapping("DMX_")
+    assert dmx == {1: "DMX_0001", 3: "DMX_0003"}
+    fmap = m.get_prefix_mapping("F")
+    assert fmap[0] == "F0" and fmap[1] == "F1"
+    cats = m.components_by_category
+    assert "Spindown" in cats["spindown"]
+    assert any("Astrometry" in n for n in cats["astrometry"])
