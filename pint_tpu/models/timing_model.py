@@ -257,14 +257,16 @@ class TimingModel:
     # -------- introspection helpers (reference: TimingModel API) ------
 
     def get_params_of_type(self, param_type: str) -> List[str]:
-        """Parameter names whose class name matches ``param_type``
-        (e.g. 'maskParameter', 'prefixParameter'; reference:
-        TimingModel.get_params_of_type_top)."""
+        """Parameter names whose class (or any base class) matches
+        ``param_type`` (e.g. 'maskParameter', 'floatParameter' — the
+        latter includes the mask/prefix subclasses, matching
+        reference: TimingModel.get_params_of_type_top)."""
         want = param_type.lower()
         out = []
         for c in self.components.values():
             for p in c.params.values():
-                if type(p).__name__.lower() == want:
+                if any(cls.__name__.lower() == want
+                       for cls in type(p).__mro__):
                     out.append(p.name)
         return out
 

@@ -126,3 +126,53 @@ def test_simulation_zero_residuals(sim):
                                     obs="gbt", add_noise=False)
     r = Residuals(t0, m, subtract_mean=False)
     assert np.max(np.abs(r.time_resids)) < 1e-9
+
+
+def test_ecorr_average():
+    """Epoch-averaged residuals (reference: Residuals.ecorr_average):
+    per-ECORR-epoch weighted means with the epoch jitter folded into
+    the averaged error."""
+    import io as _io
+
+    from pint_tpu.models import get_model
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    par = ("PSR JAVG\nRAJ 2:00:00 1\nDECJ 2:00:00 1\nF0 200.0 1\n"
+           "PEPOCH 55000\nDM 15\nEFAC -be X 1.0\nECORR -be X 2.0\n"
+           "UNITS TDB\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(_io.StringIO(par))
+        rng = np.random.default_rng(3)
+        centers = np.linspace(54000, 55000, 10)
+        mjds = (centers[:, None]
+                + np.array([0.0, 0.01, 0.02, 0.03])[None, :]).ravel()
+        # one lone TOA far from every epoch
+        mjds = np.concatenate([mjds, [55500.0]])
+        t = make_fake_toas_fromMJDs(mjds, m, error_us=1.0,
+                                    add_noise=True, rng=rng)
+        for f in t.flags:
+            f["be"] = "X"
+        m.invalidate_cache()
+        res = Residuals(t, m)
+        avg = res.ecorr_average()
+    assert len(avg["mjds"]) == 11  # 10 epochs + 1 unaveraged loner
+    assert np.all(np.diff(avg["mjds"]) > 0)
+    assert avg["n"].sum() == 41
+    # averaged error: sqrt(sigma^2/4 + ecorr^2) for 4 x 1us + 2us
+    expect = np.sqrt((1e-6) ** 2 / 4 + (2e-6) ** 2)
+    four = avg["n"] == 4
+    np.testing.assert_allclose(avg["errors"][four], expect, rtol=1e-6)
+    # the loner keeps its single-TOA error, no jitter folded in
+    lone = avg["n"] == 1
+    np.testing.assert_allclose(avg["errors"][lone], 1e-6, rtol=1e-6)
+    # averaged residual equals the hand-computed weighted mean
+    idx0 = avg["indices"][0]
+    r = res.time_resids
+    np.testing.assert_allclose(avg["time_resids"][0],
+                               np.mean(r[idx0]), rtol=1e-12)
+    # gap-clustering path (no noise model consulted) finds the same
+    # epochs here
+    avg2 = res.ecorr_average(use_noise_model=False)
+    assert len(avg2["mjds"]) == 11
