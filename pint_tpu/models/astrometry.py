@@ -163,4 +163,4 @@ class AstrometryEcliptic(Astrometry):
         cl, sl = jnp.cos(lam), jnp.sin(lam)
         cb, sb = jnp.cos(bet), jnp.sin(bet)
         n_ecl = jnp.stack([cb * cl, cb * sl, sb], axis=-1)
-        return n_ecl @ jnp.asarray(self._ecl_matrix()).T
+        return n_ecl @ jnp.asarray(self._ecl_matrix(), n_ecl.dtype).T

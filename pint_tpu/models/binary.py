@@ -43,7 +43,16 @@ from pint_tpu.models.parameter import (
     prefixParameter,
 )
 from pint_tpu.models.timing_model import DelayComponent
-from pint_tpu.ops.dd import dd_add_f, dd_mul_f, dd_sub_f, dd_sub, dd_to_f64
+from pint_tpu.ops.dd import (
+    DD,
+    dd_add_f,
+    dd_div_f,
+    dd_frac,
+    dd_mul_f,
+    dd_sub,
+    dd_sub_f,
+    dd_to_f64,
+)
 
 SECS_PER_DAY = 86400.0
 SECS_PER_YEAR = 365.25 * SECS_PER_DAY
@@ -126,35 +135,56 @@ class PulsarBinary(DelayComponent):
     # -- orbit machinery ----------------------------------------------
 
     def _dt(self, pv, batch, delay_so_far):
-        """Barycentric seconds since the orbital epoch."""
+        """Barycentric seconds since the orbital epoch, as DD. Kept in
+        dd through the mean-anomaly computation: collapsing to a single
+        float first loses the orbit count's low bits (fatal in the f32
+        Jacobian path, where a plain float holds only 24 bits of
+        ~1e8 s), and dd costs nothing here."""
         ref = self._parent.ref_day
         tb = dd_mul_f(dd_add_f(batch.tdb_frac, batch.tdb_day - ref),
                       SECS_PER_DAY)
         epoch = pv[self.epoch_param]
         eref = dd_mul_f(dd_add_f(dd_sub_f(epoch, ref), 0.0), SECS_PER_DAY)
-        return dd_to_f64(dd_sub(tb, eref)) - delay_so_far
+        return dd_sub_f(dd_sub(tb, eref), delay_so_far)
 
-    def _orbit(self, pv, dt):
-        """(M, nhat): mean anomaly/phase [rad] and dM/dt [rad/s]."""
-        if self.fb_terms:
-            from pint_tpu.ops.taylor import taylor_horner, \
-                taylor_horner_deriv
+    def _mean_anomaly(self, dt_dd, pb_s, pbdot):
+        """Reduced mean anomaly M ∈ [-π, π] and nhat = dM/dt.
 
-            coeffs = [jnp.zeros(())] + [_v(pv, n) for n in self.fb_terms]
-            M = TWOPI * taylor_horner(dt, coeffs)
-            nhat = TWOPI * taylor_horner_deriv(dt, coeffs, 1)
-            return M, nhat
-        pb_s = _v(pv, "PB") * SECS_PER_DAY
-        pbdot = _v(pv, "PBDOT")
-        u = dt / pb_s
-        M = TWOPI * (u - 0.5 * pbdot * u * u)
+        The orbit count u = dt/PB reaches ~1e4; computing it in dd and
+        reducing mod 1 turn *before* the trig keeps sin/cos arguments
+        O(1) — numerically better on every backend (TPU's emulated-f64
+        range reduction is only ~2^-48) and required for the f32
+        Jacobian path. The reduction is exact algebra: every downstream
+        use of M is periodic."""
+        u_dd = dd_div_f(dt_dd, pb_s)
+        u = dd_to_f64(u_dd)
+        orbits = dd_sub_f(u_dd, 0.5 * pbdot * u * u)
+        M = TWOPI * dd_to_f64(dd_frac(orbits))
         nhat = (TWOPI / pb_s) * (1.0 - pbdot * u)
         return M, nhat
 
+    def _orbit(self, pv, dt_dd):
+        """(M, nhat): reduced mean anomaly/phase [rad] and dM/dt
+        [rad/s], from DD dt."""
+        if self.fb_terms:
+            from pint_tpu.ops.taylor import dd_taylor_horner, \
+                taylor_horner_deriv
+
+            zero = jnp.zeros_like(dt_dd.hi)
+            coeffs = [DD(zero, zero)] + [pv[n] for n in self.fb_terms]
+            orbits = dd_taylor_horner(dt_dd, coeffs)
+            M = TWOPI * dd_to_f64(dd_frac(orbits))
+            dt = dd_to_f64(dt_dd)
+            plain = [jnp.zeros(())] + [_v(pv, n) for n in self.fb_terms]
+            nhat = TWOPI * taylor_horner_deriv(dt, plain, 1)
+            return M, nhat
+        pb_s = _v(pv, "PB") * SECS_PER_DAY
+        return self._mean_anomaly(dt_dd, pb_s, _v(pv, "PBDOT"))
+
     def delay(self, pv, batch, cache, ctx, delay_so_far):
-        dt = self._dt(pv, batch, delay_so_far)
-        M, nhat = self._orbit(pv, dt)
-        return self.binary_delay(pv, dt, M, nhat, ctx)
+        dt_dd = self._dt(pv, batch, delay_so_far)
+        M, nhat = self._orbit(pv, dt_dd)
+        return self.binary_delay(pv, dd_to_f64(dt_dd), M, nhat, ctx)
 
     def binary_delay(self, pv, dt, M, nhat, ctx):
         raise NotImplementedError
@@ -462,16 +492,13 @@ class BinaryDDGR(BinaryDD):
         dth = (3.5 * m1 ** 2 + 6.0 * m1 * m2 + 2.0 * m2 ** 2) / (arr * m)
         return omdot, gamma, sini, pbdot, dr, dth
 
-    def _orbit(self, pv, dt):
+    def _orbit(self, pv, dt_dd):
         # fold the GR + excess PBDOT into the mean-anomaly evolution
         ecc0 = _v(pv, "ECC")
         _, _, _, pbdot_gr, _, _ = self._gr_parameters(pv, ecc0)
         pb_s = _v(pv, "PB") * SECS_PER_DAY
         pbdot = _v(pv, "PBDOT") + pbdot_gr + _v(pv, "XPBDOT")
-        u = dt / pb_s
-        M = TWOPI * (u - 0.5 * pbdot * u * u)
-        nhat = (TWOPI / pb_s) * (1.0 - pbdot * u)
-        return M, nhat
+        return self._mean_anomaly(dt_dd, pb_s, pbdot)
 
     def binary_delay(self, pv, dt, M, nhat, ctx):
         ecc = _v(pv, "ECC") + _v(pv, "EDOT") * dt

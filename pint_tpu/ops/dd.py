@@ -31,7 +31,13 @@ import jax.numpy as jnp
 Arr = jax.Array
 FloatLike = Union[float, Arr]
 
-_SPLITTER = 134217729.0  # 2**27 + 1, Veltkamp splitting constant for f64
+# Veltkamp splitting constants: 2**ceil(p/2) + 1 for a p-bit mantissa.
+# dd is dtype-generic: f64 pairs give ~2^-104 (the precision path), f32
+# pairs ("dd32") give ~2^-48 — the same effective precision as TPU's
+# software-emulated f64, but in native-speed f32 vector ops. The f32
+# Jacobian path (parallel/fit_step) runs the whole phase chain in dd32.
+_SPLITTER_F64 = 134217729.0   # 2**27 + 1
+_SPLITTER_F32 = 4097.0        # 2**12 + 1
 
 
 class DD(NamedTuple):
@@ -67,21 +73,33 @@ class DD(NamedTuple):
         return dd_neg(self)
 
 
+def _float_dtype(*xs):
+    """f32 only when every operand is f32; anything else promotes to
+    f64 (so a deliberately-carried f64 compensation term is never
+    silently truncated)."""
+    dts = [jnp.asarray(x).dtype for x in xs]
+    if all(dt == jnp.float32 for dt in dts):
+        return jnp.float32
+    return jnp.float64
+
+
 def _as_dd(x) -> DD:
     if isinstance(x, DD):
         return x
-    x = jnp.asarray(x, dtype=jnp.float64)
+    x = jnp.asarray(x, dtype=_float_dtype(x))
     return DD(x, jnp.zeros_like(x))
 
 
 def dd(hi, lo=0.0) -> DD:
-    """Construct a DD from one or two float64 values (renormalized).
+    """Construct a DD from one or two float values (renormalized);
+    dtype follows the inputs (f64 unless both are f32).
 
     Uses full two-sum: callers may pass unnormalized (hi, lo) of any
     relative magnitude.
     """
+    dt = _float_dtype(hi, lo)
     hi, lo = jnp.broadcast_arrays(
-        jnp.asarray(hi, dtype=jnp.float64), jnp.asarray(lo, dtype=jnp.float64)
+        jnp.asarray(hi, dtype=dt), jnp.asarray(lo, dtype=dt)
     )
     s = two_sum(hi, lo)
     return _quick_two_sum(s.hi, s.lo)
@@ -89,7 +107,8 @@ def dd(hi, lo=0.0) -> DD:
 
 def dd_from_parts(hi, lo) -> DD:
     """Trusted constructor: caller guarantees (hi, lo) already normalized."""
-    return DD(jnp.asarray(hi, jnp.float64), jnp.asarray(lo, jnp.float64))
+    dt = _float_dtype(hi, lo)
+    return DD(jnp.asarray(hi, dt), jnp.asarray(lo, dt))
 
 
 def dd_to_f64(a: DD) -> Arr:
@@ -116,7 +135,9 @@ def _quick_two_sum(a: Arr, b: Arr) -> DD:
 
 
 def _split(a: Arr):
-    t = _SPLITTER * a
+    splitter = (_SPLITTER_F32 if jnp.asarray(a).dtype == jnp.float32
+                else _SPLITTER_F64)
+    t = splitter * a
     a_hi = t - (t - a)
     a_lo = a - a_hi
     return a_hi, a_lo
@@ -214,17 +235,17 @@ def dd_abs(a: DD) -> DD:
 # f64-mixed fast paths (second operand an ordinary float64)
 
 def dd_add_f(a: DD, b: FloatLike) -> DD:
-    b = jnp.asarray(b, jnp.float64)
+    b = jnp.asarray(b, a.hi.dtype)
     s = two_sum(a.hi, b)
     return _quick_two_sum(s.hi, s.lo + a.lo)
 
 
 def dd_sub_f(a: DD, b: FloatLike) -> DD:
-    return dd_add_f(a, -jnp.asarray(b, jnp.float64))
+    return dd_add_f(a, -jnp.asarray(b, a.hi.dtype))
 
 
 def dd_mul_f(a: DD, b: FloatLike) -> DD:
-    b = jnp.asarray(b, jnp.float64)
+    b = jnp.asarray(b, a.hi.dtype)
     p = two_prod(a.hi, b)
     return _quick_two_sum(p.hi, p.lo + a.lo * b)
 
@@ -240,13 +261,17 @@ def dd_div_f(a: DD, b: FloatLike) -> DD:
 
 @jax.custom_jvp
 def dd_round(a: DD) -> DD:
-    """Round to nearest integer, returned as DD (exact)."""
-    n = jnp.round(a.hi)
-    # hi - n is exact (Sterbenz) whenever |hi - n| <= 0.5 ulp-scale; the
-    # residual plus lo decides whether rounding must be bumped by one.
-    r = (a.hi - n) + a.lo
-    bump = jnp.where(r > 0.5, 1.0, 0.0) + jnp.where(r < -0.5, -1.0, 0.0)
-    return dd(n + bump)
+    """Round to nearest integer, returned as DD (exact).
+
+    Works at any |lo|/1 ratio: when ulp(hi) > 1 (dd32 at large
+    magnitude) the residual-plus-lo correction is itself a multi-unit
+    integer, so it is rounded rather than clamped to ±1, and the two
+    pieces are recombined exactly via two-sum in the dd() constructor."""
+    n1 = jnp.round(a.hi)
+    s = two_sum(a.hi, -n1)
+    r = (s.hi + a.lo) + s.lo
+    bump = jnp.round(r)
+    return dd(n1, bump)
 
 
 @dd_round.defjvp
@@ -264,16 +289,23 @@ def dd_frac(a: DD) -> DD:
     This is the "phase.frac" of the reference's Phase class — residuals in
     turns. d(frac)/dx == 1 away from half-integers, which the JVP encodes.
     """
-    n = jnp.round(a.hi)
-    s = two_sum(a.hi, -n)
-    # s.hi may be ≪ a.lo when a is nearly integer — full two_sum required.
-    f0 = two_sum(s.hi, a.lo)
-    f = _quick_two_sum(f0.hi, f0.lo + s.lo)
+    # first integer strip of hi (two_sum remainder is exact)
+    n1 = jnp.round(a.hi)
+    s = two_sum(a.hi, -n1)
+    # fold in lo; when ulp(hi) > 1 (dd32 at large magnitude) |lo| can
+    # span many units, so a second integer strip of the recombined
+    # value is required before the final half-boundary shift
+    t = two_sum(s.hi, a.lo)
+    vhi, vlo = t.hi, t.lo + s.lo
+    n2 = jnp.round(vhi)
+    s2 = two_sum(vhi, -n2)
+    f0 = two_sum(s2.hi, vlo)
+    f = _quick_two_sum(f0.hi, f0.lo + s2.lo)
     # renormalize into [-0.5, 0.5]
     shift = jnp.where(f.hi > 0.5, 1.0, 0.0) + jnp.where(f.hi < -0.5, -1.0, 0.0)
-    s2 = two_sum(f.hi, -shift)
-    f1 = two_sum(s2.hi, f.lo)
-    return _quick_two_sum(f1.hi, f1.lo + s2.lo)
+    s3 = two_sum(f.hi, -shift)
+    f1 = two_sum(s3.hi, f.lo)
+    return _quick_two_sum(f1.hi, f1.lo + s3.lo)
 
 
 @dd_frac.defjvp
@@ -304,6 +336,41 @@ def dd_le(a: DD, b: DD) -> Arr:
 
 def dd_where(cond: Arr, a: DD, b: DD) -> DD:
     return DD(jnp.where(cond, a.hi, b.hi), jnp.where(cond, a.lo, b.lo))
+
+
+# ----------------------------------------------------------------------
+# f64 <-> dd32 conversion (the f32 Jacobian path's input packing)
+# ----------------------------------------------------------------------
+
+def f64_to_dd32(x) -> DD:
+    """Split a float64 value into an f32 pair (hi, lo) with
+    hi + lo == x to ~2^-48 relative — the dd32 representation the f32
+    design-matrix path consumes. Host- or device-side."""
+    import numpy as np
+
+    if isinstance(x, jax.Array):
+        hi = x.astype(jnp.float32)
+        lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+        return DD(hi, lo)
+    x = np.asarray(x, np.float64)
+    hi = x.astype(np.float32)
+    lo = (x - hi.astype(np.float64)).astype(np.float32)
+    return DD(hi, lo)
+
+
+def dd_to_dd32(a: DD) -> DD:
+    """Narrow a dd64 to dd32 (~2^-48): hi32 takes the top 24 bits,
+    lo32 the next 24 plus whatever of a.lo still fits."""
+    import numpy as np
+
+    if isinstance(a.hi, jax.Array):
+        hi = a.hi.astype(jnp.float32)
+        rem = (a.hi - hi.astype(jnp.float64)) + a.lo
+        return DD(hi, rem.astype(jnp.float32))
+    hi = np.asarray(a.hi, np.float64).astype(np.float32)
+    rem = (np.asarray(a.hi, np.float64) - hi.astype(np.float64)) \
+        + np.asarray(a.lo, np.float64)
+    return DD(hi, rem.astype(np.float32))
 
 
 def dd_sum(a: DD, axis=None) -> DD:

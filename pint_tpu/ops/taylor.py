@@ -34,9 +34,11 @@ def taylor_horner_deriv(dt, coeffs: Sequence, deriv_order: int = 1):
     """deriv_order-th derivative of taylor_horner wrt dt (f64)."""
     coeffs = list(coeffs)
     n = len(coeffs)
+    dt = jnp.asarray(dt)
+    if dt.dtype not in (jnp.float32, jnp.float64):
+        dt = dt.astype(jnp.float64)
     if n <= deriv_order:
-        return jnp.zeros_like(jnp.asarray(dt, jnp.float64))
-    dt = jnp.asarray(dt, jnp.float64)
+        return jnp.zeros_like(dt)
     # derivative shifts the series: result = sum_{i>=d} c_i dt^{i-d}/(i-d)!
     fact = [math.factorial(i - deriv_order) for i in range(deriv_order, n)]
     cs = [float(coeffs[i]) if not hasattr(coeffs[i], "shape") else coeffs[i]
@@ -66,5 +68,5 @@ def dd_taylor_horner(dt: DD, coeffs: Sequence) -> DD:
         if isinstance(ci, DD):
             acc = dd_add(acc, dd_div_f(ci, fct) if fct != 1.0 else ci)
         else:
-            acc = dd_add_f(acc, jnp.asarray(ci, jnp.float64) / fct)
+            acc = dd_add_f(acc, jnp.asarray(ci, dt.hi.dtype) / fct)
     return acc

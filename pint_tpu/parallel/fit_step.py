@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.ops.dd import dd_frac
+from pint_tpu.ops.dd import DD, dd_frac, dd_to_dd32
 
 __all__ = ["build_fit_step", "build_sharded_fit_step", "toa_sharding"]
 
@@ -35,18 +35,15 @@ def _pad_to(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def _use_f32_matmul(flag: Optional[bool]) -> bool:
-    """Resolve the normal-equation matmul precision. Precedence:
-    explicit ``matmul_f32`` argument > $PINT_TPU_GLS_MATMUL (f32/f64)
-    > auto. Auto = f32 on TPU (f64 there is software-emulated and
-    bypasses the MXU; the equilibrated normal equations only need
-    ~1e-7 relative accuracy, which HIGHEST-precision f32 MXU passes
-    deliver), f64 elsewhere."""
+def _resolve_f32(flag: Optional[bool], env_name: str) -> bool:
+    """Shared f32/f64 mode resolution: explicit argument > env var
+    (f32/f64) > auto (f32 on TPU — f64 there is software-emulated and
+    bypasses the MXU/VPU fast paths — f64 elsewhere)."""
     import os
 
     if flag is not None:
         return bool(flag)
-    env = os.environ.get("PINT_TPU_GLS_MATMUL", "").lower()
+    env = os.environ.get(env_name, "").lower()
     if env in ("f32", "float32"):
         return True
     if env in ("f64", "float64"):
@@ -54,8 +51,48 @@ def _use_f32_matmul(flag: Optional[bool]) -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _use_f32_matmul(flag: Optional[bool]) -> bool:
+    """Normal-equation matmul precision ($PINT_TPU_GLS_MATMUL): the
+    equilibrated normal equations only need ~1e-7 relative accuracy,
+    which HIGHEST-precision f32 MXU passes deliver."""
+    return _resolve_f32(flag, "PINT_TPU_GLS_MATMUL")
+
+
+def _use_f32_jac(flag: Optional[bool]) -> bool:
+    """Design-matrix (jacfwd) precision ($PINT_TPU_JAC).
+
+    The f32 path evaluates the Jacobian by re-tracing the SAME phase
+    chain with f32 inputs: dd ops degrade to dd32 (f32 pairs, ~2^-48 —
+    the same effective precision TPU's software-emulated f64 delivers,
+    at native VPU speed), and everything else runs plain f32. Design
+    columns only need ~1e-6 relative accuracy (they feed equilibrated
+    normal equations already computed in f32 on the MXU), while the
+    residual path keeps the full-precision f64/dd chain."""
+    return _resolve_f32(flag, "PINT_TPU_JAC")
+
+
+def _tree_to32(tree):
+    """Cast every f64 leaf of a pytree to f32, converting DD pairs via
+    dd_to_dd32 (splitting, not truncating, so 48 bits survive)."""
+    def conv(x):
+        if isinstance(x, DD):
+            return dd_to_dd32(x)
+        x = jnp.asarray(x)
+        return x.astype(jnp.float32) if x.dtype == jnp.float64 else x
+
+    return jax.tree.map(conv, tree, is_leaf=lambda x: isinstance(x, DD))
+
+
+def _split32(hi, lo=None):
+    """Device-side f64(+f64) -> dd32 split: (f32 head, f32 remainder).
+    Thin wrapper over dd_to_dd32 returning the pair unpacked."""
+    d = dd_to_dd32(DD(hi, jnp.zeros_like(hi) if lo is None else lo))
+    return d.hi, d.lo
+
+
 def build_fit_step(model, toas, pad_to: Optional[int] = None,
-                   matmul_f32: Optional[bool] = None):
+                   matmul_f32: Optional[bool] = None,
+                   jac_f32: Optional[bool] = None):
     """(step_fn, args, names): step_fn is pure and jittable,
 
         step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid)
@@ -80,6 +117,40 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     sc = {k: v for k, v in cache.items() if k != "batch"}
     n = toas.ntoas
     f32mm = _use_f32_matmul(matmul_f32)
+    jac32 = _use_f32_jac(jac_f32)
+
+    # Per-free-param scale for the f32 Jacobian: F_i (i>=2) columns are
+    # dt^{i+1}/(i+1)! and overflow f32 range from i=4; differentiating
+    # w.r.t. u_i = F_i * 2^e instead keeps scaled columns ~O(dt). The
+    # step's outputs are mapped back (dtheta = s*du) in f64. Scales are
+    # powers of two so s32 == s64 exactly, and each exponent is chosen
+    # inside the window where BOTH the scaled column stays in normal
+    # f32 range AND the tangent seed s/(i+1)! inside the dd Horner
+    # stays normal (TPU flushes subnormals to zero). When no window
+    # exists (F8+ at decade spans) the whole step falls back to the
+    # f64 Jacobian — correct, just slower.
+    scale_np = np.ones(len(free))
+    if jac32:
+        import math
+
+        mjd = np.asarray(batch.tdb_day) + np.asarray(batch.tdb_frac.hi)
+        T = max(float(np.max(np.abs(mjd - model.ref_day))) * 86400.0, 1.0)
+        L = math.log2(T)
+        for i, nm in enumerate(free):
+            p = model.get_param(nm)
+            if getattr(p, "prefix", None) == "F" and \
+                    getattr(p, "index", 0) >= 2:
+                idx = p.index
+                lf = math.log2(math.factorial(idx + 1))
+                e_hi = 122.0 - lf              # tangent seed normal
+                e_lo = (idx + 1) * L - lf - 120.0  # column in range
+                if e_lo > e_hi:
+                    jac32 = False
+                    scale_np[:] = 1.0
+                    break
+                e = int(min(max(round(idx * L), math.ceil(e_lo), 0),
+                            math.floor(e_hi), 126))
+                scale_np[i] = 2.0 ** (-e)
 
     nvec_np = model.scaled_toa_uncertainty(toas) ** 2
     # ECORR rides the Sherman-Morrison segment path (one rank-1
@@ -140,13 +211,40 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         w = valid / nvec
         wmean = jnp.sum(frac * w) / jnp.sum(w)
         r = (frac - wmean) / f0
-        jac = jax.jacfwd(phase_f64)(th) / f0
-        ones = (valid / f0)[:, None]
-        M = jnp.concatenate([ones, jac * valid[:, None]], axis=1)
+        if jac32:
+            # Jacobian via the f32/dd32 re-trace of the same phase
+            # chain (see _use_f32_jac). Inputs split device-side so the
+            # public step signature stays all-f64.
+            batch32 = _tree_to32(batch)
+            cache32 = _tree_to32(cache)
+            s64 = jnp.asarray(scale_np)
+            s32 = s64.astype(jnp.float32)
+            ua, ub = _split32(th / s64, tl / s64)
+            fa, fb = _split32(fh, fl)
+
+            def phase32(ua_):
+                ph, _ = phase_fn(ua_ * s32, ub * s32, fa, fb,
+                                 batch32, cache32)
+                return ph.hi + ph.lo
+
+            f032 = f0.astype(jnp.float32)
+            valid32 = valid.astype(jnp.float32)
+            jac = jax.jacfwd(phase32)(ua) / f032
+            ones = (valid32 / f032)[:, None]
+            M = jnp.concatenate([ones, jac * valid32[:, None]], axis=1)
+        else:
+            jac = jax.jacfwd(phase_f64)(th) / f0
+            ones = (valid / f0)[:, None]
+            M = jnp.concatenate([ones, jac * valid[:, None]], axis=1)
         r = r * valid
         Fv = F * valid[:, None]
-        return _gls_core(M, Fv, phi, r, nvec, valid, eid, jvar, nseg,
-                         f32mm=f32mm)
+        dp, cov, chi2, r_out = _gls_core(
+            M, Fv, phi, r, nvec, valid, eid, jvar, nseg, f32mm=f32mm)
+        if jac32:
+            sfull = jnp.concatenate([jnp.ones(1), s64])
+            dp = dp * sfull
+            cov = cov * jnp.outer(sfull, sfull)
+        return dp, cov, chi2, r_out
 
     args = (jnp.asarray(th), jnp.asarray(tl), jnp.asarray(fh),
             jnp.asarray(fl), batch, sc, jnp.asarray(F_np),
@@ -173,8 +271,10 @@ def _symm_mm(X, Y, f32: bool):
     """X.T @ Y with optional f32 inputs at HIGHEST matmul precision
     (on TPU: 6-pass bf16 through the MXU, ~f32-exact; f64 matmuls
     there are software-emulated and an order of magnitude slower).
-    Result is always f64."""
-    if not f32:
+    Already-f32 inputs (the f32 Jacobian path) always take the HIGHEST
+    route — default f32 dot precision on TPU is bf16, not acceptable
+    for normal equations. Result is always f64."""
+    if not f32 and X.dtype == jnp.float64 and Y.dtype == jnp.float64:
         return X.T @ Y
     out = jax.lax.dot(X.astype(jnp.float32).T, Y.astype(jnp.float32),
                       precision=jax.lax.Precision.HIGHEST)
@@ -197,7 +297,12 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
     normal equations (the reference's layout). Only the Fourier noise
     bases remain in F."""
     p = M.shape[1]
+    mdt = M.dtype  # f32 when the Jacobian came from the f32 path: all
+    # (N, p+q)-wide elementwise work then stays f32 (native VPU speed
+    # on TPU), while (N,)-vectors and the (p+q)^2 solve stay f64
     w = valid / nvec
+    wM = w.astype(mdt)
+    F = F.astype(mdt)
     # Two-stage column normalization. The F1/F2 design columns reach
     # ~1e13 s/unit, so sum(M^2 * w) would hit ~1e38+ — beyond the
     # exponent range of TPU-emulated f64 (f32-range limited). Scaling
@@ -207,7 +312,7 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
     colmax = jnp.max(jnp.abs(M), axis=0)
     colmax = jnp.where(colmax == 0, 1.0, colmax)
     Ms = M / colmax[None, :]
-    norm = jnp.sqrt(jnp.sum(Ms * Ms * w[:, None], axis=0))
+    norm = jnp.sqrt(jnp.sum(Ms * Ms * wM[:, None], axis=0))
     norm = jnp.where(norm == 0, 1.0, norm)
     Mn = Ms / norm[None, :]
     big = jnp.concatenate([Mn, F], axis=1)
@@ -215,10 +320,11 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
     # (big*w spans ~1e12 from the weights; big*sqrt(w) only ~1e6) and
     # makes Sigma exactly symmetric by construction
     sw = jnp.sqrt(w)
-    bigs = big * sw[:, None]
+    swM = sw.astype(mdt)
+    bigs = big * swM[:, None]
     rs = r * sw
     Sigma = _symm_mm(bigs, bigs, f32mm)
-    b = _symm_mm(bigs, rs[:, None], f32mm)[:, 0]
+    b = _symm_mm(bigs, rs.astype(mdt)[:, None], f32mm)[:, 0]
     rCr = jnp.sum(rs * rs)
     if nseg > 1:  # static: no ECORR -> skip the dead downdate entirely
         # epoch contractions (Sherman-Morrison downdate); the O(N p)
@@ -233,12 +339,12 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
 
         s_seg = seg(w)
         g = jvar / (1.0 + jvar * s_seg)
-        E = seg(big * w[:, None])
+        E = seg(big * wM[:, None])
         wr_seg = seg(w * r)
         sg = jnp.sqrt(g)
-        Eg = E * sg[:, None]
+        Eg = E * sg.astype(mdt)[:, None]
         Sigma = Sigma - _symm_mm(Eg, Eg, f32mm)
-        b = b - Eg.T @ (sg * wr_seg)
+        b = b - Eg.astype(jnp.float64).T @ (sg * wr_seg)
         rCr = rCr - jnp.sum(g * wr_seg ** 2)
     q = F.shape[1]
     prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi]) if q else \

@@ -1,0 +1,228 @@
+"""The f32 Jacobian path (parallel/fit_step jac_f32): the design
+matrix is computed by re-tracing the phase chain with f32/dd32 inputs
+(reference algorithm: src/pint/fitter.py builds the same design matrix
+via registered derivative chains in longdouble; here jacfwd over a
+dtype-degraded chain, accurate to ~1e-7 of column max — design columns
+feed equilibrated normal equations and need only ~1e-6).
+
+Also covers the dd32 substrate: dtype-generic dd ops at f32-pair
+precision (~2^-48) and the large-|lo| generalization of
+dd_frac/dd_round that dd32 at 1e10-turn magnitudes requires.
+"""
+
+import io
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.ops.dd import (
+    DD,
+    dd,
+    dd_add,
+    dd_frac,
+    dd_mul,
+    dd_round,
+    dd_to_dd32,
+    f64_to_dd32,
+    two_prod,
+)
+from pint_tpu.parallel import build_fit_step
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+BASE_PAR = """PSR J0000+0000
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+F0 300.123456789 1
+F1 -1.0e-15 1
+DM 20.0 1
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+
+def _problem(extra="", n=400, seed=3):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BASE_PAR + extra))
+        rng = np.random.default_rng(seed)
+        mjds = np.sort(rng.uniform(53001, 56999, n))
+        freqs = np.tile([1400.0, 820.0], n // 2)
+        toas = make_fake_toas_fromMJDs(
+            mjds, model, error_us=1.0, freq_mhz=freqs,
+            add_noise=True, rng=rng)
+    return model, toas
+
+
+def _compare(model, toas, tol_sigma=1e-2, tol_chi2=1e-6):
+    s64, a64, names = build_fit_step(model, toas, jac_f32=False)
+    s32, a32, _ = build_fit_step(model, toas, jac_f32=True)
+    dp64, cov64, chi64, _ = [np.asarray(x) for x in jax.jit(s64)(*a64)]
+    dp32, _, chi32, _ = [np.asarray(x) for x in jax.jit(s32)(*a32)]
+    sig = np.sqrt(np.diag(cov64))
+    assert np.max(np.abs(dp64 - dp32) / sig) < tol_sigma, names
+    assert abs(chi64 - chi32) <= tol_chi2 * abs(chi64)
+    return dp64, dp32, sig
+
+
+class TestDD32Substrate:
+    def test_dd32_add_mul_precision(self):
+        rng = np.random.default_rng(0)
+        x = rng.uniform(-1e8, 1e8, 500)
+        y = rng.uniform(-1e3, 1e3, 500)
+        a, b = f64_to_dd32(x), f64_to_dd32(y)
+        s, p = dd_add(a, b), dd_mul(a, b)
+        assert s.hi.dtype == jnp.float32 and p.hi.dtype == jnp.float32
+        sv = np.asarray(s.hi, np.float64) + np.asarray(s.lo, np.float64)
+        pv = np.asarray(p.hi, np.float64) + np.asarray(p.lo, np.float64)
+        # dd32 eps ~ 2^-48 = 3.6e-15
+        assert np.max(np.abs(sv - (x + y)) / np.abs(x + y)) < 3e-14
+        assert np.max(np.abs(pv - (x * y)) / np.abs(x * y)) < 3e-14
+
+    def test_two_prod_f32_exact(self):
+        rng = np.random.default_rng(1)
+        a = jnp.asarray(rng.uniform(-1, 1, 500), jnp.float32)
+        b = jnp.asarray(rng.uniform(-1, 1, 500), jnp.float32)
+        tp = two_prod(a, b)
+        exact = np.asarray(a, np.float64) * np.asarray(b, np.float64)
+        recon = np.asarray(tp.hi, np.float64) + \
+            np.asarray(tp.lo, np.float64)
+        assert np.max(np.abs(recon - exact)) == 0.0
+
+    def test_frac_round_large_lo(self):
+        """dd32 at 1e10 has ulp(hi) = 1024 >> 1: the integer strip must
+        handle |lo| spanning hundreds of units."""
+        rng = np.random.default_rng(2)
+        ph = rng.uniform(1e9, 1e10, 2000)
+        a32 = f64_to_dd32(ph)
+        fr = dd_frac(a32)
+        frv = np.asarray(fr.hi, np.float64) + np.asarray(fr.lo, np.float64)
+        truth = ph - np.round(ph)
+        # |err| <= magnitude * 2^-48 * small factor
+        assert np.max(np.abs(frv - truth)) < 1e-4
+        rd = dd_round(a32)
+        rdv = np.asarray(rd.hi, np.float64) + np.asarray(rd.lo, np.float64)
+        assert np.max(np.abs(rdv - np.round(ph))) == 0.0
+
+    def test_frac_round_f64_unchanged(self):
+        ph = np.array([2.0, 5e9, -3e9, 55000.75])
+        lo = np.array([1e-20, 0.3e-16, -0.3e-16, 1e-18])
+        f = dd_frac(DD(jnp.asarray(ph), jnp.asarray(lo)))
+        expect = (ph - np.round(ph)) + lo
+        got = np.asarray(f.hi) + np.asarray(f.lo)
+        np.testing.assert_allclose(got, expect, rtol=0, atol=1e-30)
+        assert np.asarray(dd_round(dd(2.5)).hi) in (2.0, 3.0)
+
+    def test_split_roundtrip(self):
+        rng = np.random.default_rng(3)
+        x = rng.uniform(-1e10, 1e10, 100)
+        a = f64_to_dd32(x)
+        back = np.asarray(a.hi, np.float64) + np.asarray(a.lo, np.float64)
+        assert np.max(np.abs(back - x) / np.abs(x)) < 3e-14
+        d64 = dd(x, rng.uniform(-1e-8, 1e-8, 100))
+        a2 = dd_to_dd32(d64)
+        v64 = np.asarray(d64.hi) + np.asarray(d64.lo)
+        back2 = np.asarray(a2.hi, np.float64) + \
+            np.asarray(a2.lo, np.float64)
+        assert np.max(np.abs(back2 - v64) / np.abs(v64)) < 3e-14
+
+
+class TestJac32FitStep:
+    def test_isolated_pulsar_with_high_fterms(self):
+        """F2..F4 columns reach dt^5/120 ~ 1e38; the per-param scale
+        keeps the f32 path in range and exact after unscaling."""
+        extra = "F2 1e-26 1\nF3 1e-33 1\nF4 1e-42 1\nPMRA 2.0 1\nPMDEC -3 1\nPX 1.2 1\n"
+        model, toas = _problem(extra)
+        _compare(model, toas)
+
+    def test_high_order_fterms_f5_f7(self):
+        """F5..F7 ride the power-of-two scale window (column in f32
+        range AND tangent seed normal after the factorial division)."""
+        extra = ("F2 1e-26 1\nF3 1e-33 1\nF4 1e-40 1\nF5 1e-48 1\n"
+                 "F6 1e-56 1\nF7 1e-64 1\n")
+        model, toas = _problem(extra)
+        _compare(model, toas, tol_sigma=3e-2)
+
+    def test_f8_falls_back_to_f64(self):
+        """No feasible f32 scale window for F8 at a decade span: the
+        build must silently fall back to the f64 Jacobian and still be
+        correct."""
+        extra = "".join(f"F{i} 1e-{26 + 7 * (i - 2)} 1\n"
+                        for i in range(2, 9))
+        model, toas = _problem(extra)
+        s32, a32, _ = build_fit_step(model, toas, jac_f32=True)
+        s64, a64, _ = build_fit_step(model, toas, jac_f32=False)
+        dp32 = np.asarray(jax.jit(s32)(*a32)[0])
+        dp64 = np.asarray(jax.jit(s64)(*a64)[0])
+        np.testing.assert_allclose(dp32, dp64, rtol=1e-12)
+
+    def test_noise_model_ecorr(self):
+        extra = ("EFAC -be X 1.1\nEQUAD -be X 0.3\nECORR -be X 1.2\n"
+                 "TNREDAMP -13.7\nTNREDGAM 3.5\nTNREDC 10\n")
+        model, toas = _problem(extra)
+        for f in toas.flags:
+            f["be"] = "X"
+        toas._touch() if hasattr(toas, "_touch") else None
+        _compare(model, toas)
+
+    @pytest.mark.parametrize("binpar", [
+        "BINARY ELL1\nPB 0.38 1\nA1 1.42 1\nTASC 54999.93 1\n"
+        "EPS1 1e-5 1\nEPS2 -2e-5 1\n",
+        "BINARY DD\nPB 67.8 1\nA1 32.3 1\nT0 54999.1 1\nECC 0.27 1\n"
+        "OM 120.0 1\nOMDOT 0.01 1\nSINI 0.9 1\nM2 0.3 1\n",
+        "BINARY ELL1\nFB0 3.05e-5 1\nFB1 -1e-19 1\nA1 1.42 1\n"
+        "TASC 54999.93 1\nEPS1 1e-5 1\nEPS2 -2e-5 1\n",
+    ], ids=["ell1-short-pb", "dd-ecc", "ell1-fb"])
+    def test_binary(self, binpar):
+        model, toas = _problem(binpar)
+        _compare(model, toas)
+
+    def test_jacobian_columns_relative(self):
+        """Column-level check: every f32 column within 1e-5 of its f64
+        twin, relative to the column max (tighter than the step-level
+        check, which is condition-number amplified)."""
+        from pint_tpu.parallel.fit_step import _split32, _tree_to32
+
+        extra = ("BINARY ELL1\nPB 0.38 1\nA1 1.42 1\nTASC 54999.93 1\n"
+                 "EPS1 1e-5 1\nEPS2 -2e-5 1\nPMRA 2.0 1\nPMDEC -3 1\n")
+        model, toas = _problem(extra)
+        phase_fn, _ = model._build_phase_fn()
+        cache = model.get_cache(toas)
+        free, _, th, tl, fh, fl = model._pack()
+        batch = cache["batch"]
+        sc = {k: v for k, v in cache.items() if k != "batch"}
+
+        def p64(thx):
+            ph, _ = phase_fn(thx, tl, fh, fl, batch, sc)
+            return ph.hi + ph.lo
+
+        jac64 = np.asarray(jax.jacfwd(p64)(jnp.asarray(th)))
+        batch32, sc32 = _tree_to32(batch), _tree_to32(sc)
+        ua, ub = _split32(jnp.asarray(th), jnp.asarray(tl))
+        fa, fb = _split32(jnp.asarray(fh), jnp.asarray(fl))
+
+        def p32(ua_):
+            ph, _ = phase_fn(ua_, ub, fa, fb, batch32, sc32)
+            return ph.hi + ph.lo
+
+        jac32 = np.asarray(jax.jacfwd(p32)(ua), np.float64)
+        assert jac32.dtype == np.float64  # cast after, computed f32
+        for j, nm in enumerate(free):
+            cmax = np.max(np.abs(jac64[:, j]))
+            assert np.max(np.abs(jac64[:, j] - jac32[:, j])) < 1e-5 * cmax, nm
+
+    def test_env_override(self, monkeypatch):
+        from pint_tpu.parallel.fit_step import _use_f32_jac
+
+        monkeypatch.setenv("PINT_TPU_JAC", "f32")
+        assert _use_f32_jac(None) is True
+        monkeypatch.setenv("PINT_TPU_JAC", "f64")
+        assert _use_f32_jac(None) is False
+        assert _use_f32_jac(True) is True
