@@ -10,7 +10,14 @@ evaluated on the CPU backend plus the numpy/scipy Woodbury GLS solve
 GLSFitter.fit_toas (BASELINE.md measurement protocol: the reference
 itself is not runnable in this image).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per benchmark config (BASELINE.md configs 1-5),
+with the north-star line LAST (the driver records the last line).
+When the accelerator is reachable the north-star line carries BOTH
+backends' step times (step_ms on the accelerator, cpu_xla_step_ms for
+the same XLA program on the host CPU) so the vs_baseline ratio — which
+is XLA-vs-numpy by protocol — cannot be misread as a TPU-vs-CPU claim.
+After a CPU fallback re-exec, a bounded late probe retries the TPU so
+a transiently-wedged tunnel doesn't cost the round's TPU number.
 """
 
 from __future__ import annotations
@@ -26,9 +33,12 @@ def log(*a):
 
 NTOA = 10_000
 NDMX = 28  # 28 DMX + 12 other free params = 40 columns + offset
+AXON_VARS = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_TPU_GEN",
+             "PALLAS_AXON_REMOTE_COMPILE")
 
 
-def build_problem():
+def _make_model_toas(par_lines, mjds, freqs, seed=1, error_us=1.0,
+                     flag_sets=None):
     import io
     import warnings
 
@@ -36,6 +46,46 @@ def build_problem():
 
     from pint_tpu.models import get_model
     from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO("\n".join(par_lines) + "\n"))
+        rng = np.random.default_rng(seed)
+        toas = make_fake_toas_fromMJDs(
+            mjds, model, error_us=error_us, freq_mhz=freqs,
+            add_noise=True, rng=rng)
+        if flag_sets:
+            for i, f in enumerate(toas.flags):
+                for k, fn in flag_sets.items():
+                    f[k] = fn(i)
+    return model, toas
+
+
+def _add_dmx(par, span0, span1, ndmx):
+    """Append ndmx free DMX windows tiling [span0, span1]."""
+    import numpy as np
+
+    edges = np.linspace(span0, span1, ndmx + 1)
+    for i in range(ndmx):
+        par.append(f"DMX_{i + 1:04d} 0.0 1")
+        par.append(f"DMXR1_{i + 1:04d} {edges[i]:.4f}")
+        par.append(f"DMXR2_{i + 1:04d} {edges[i + 1]:.4f}")
+
+
+def _clustered_mjds(span0, span1, ntoa, per_cluster=4):
+    """Clustered observing epochs so the ECORR quantization basis has
+    real structure: ntoa/4 clusters within ~30 min, inter-cluster gaps
+    far above the 0.5-day bucket threshold."""
+    import numpy as np
+
+    ncluster = ntoa // per_cluster
+    centers = np.linspace(span0 + 1, span1 - 1, ncluster)
+    offsets = np.linspace(0.0, 0.021, per_cluster)
+    return (centers[:, None] + offsets[None, :]).ravel()
+
+
+def build_problem():
+    import numpy as np
 
     span0, span1 = 53000.0, 57000.0
     par = [
@@ -70,34 +120,16 @@ def build_problem():
     ]
     for i in range(4):
         par.append(f"JUMP -grp g{i} 1e-6 1")
-    edges = np.linspace(span0, span1, NDMX + 1)
-    for i in range(NDMX):
-        par.append(f"DMX_{i + 1:04d} 0.0 1")
-        par.append(f"DMXR1_{i + 1:04d} {edges[i]:.4f}")
-        par.append(f"DMXR2_{i + 1:04d} {edges[i + 1]:.4f}")
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        model = get_model(io.StringIO("\n".join(par) + "\n"))
-        rng = np.random.default_rng(1)
-        # Clustered observing epochs so the ECORR quantization basis has
-        # real structure: NTOA/4 clusters of 4 TOAs within ~30 min, with
-        # inter-cluster gaps far above the 0.5-day bucket threshold
-        # (create_quantization_matrix, pint_tpu/models/noise.py).
-        ncluster = NTOA // 4
-        centers = np.linspace(span0 + 1, span1 - 1, ncluster)
-        offsets = np.array([0.0, 0.007, 0.014, 0.021])
-        mjds = (centers[:, None] + offsets[None, :]).ravel()
-        # Two frequency bands within every cluster: single-band data
-        # leaves DM/DM1/DM2 exactly collinear with Offset/F1/F2
-        # (singular normal matrix — the round-2 bench crash).
-        freqs = np.tile([1400.0, 1400.0, 820.0, 820.0], ncluster)
-        toas = make_fake_toas_fromMJDs(
-            mjds, model, error_us=1.0, freq_mhz=freqs,
-            add_noise=True, rng=rng)
-        for i, f in enumerate(toas.flags):
-            f["be"] = "X"
-            f["grp"] = f"g{i % 5}"  # g4 matches no JUMP: 4 free jumps
-    return model, toas
+    _add_dmx(par, span0, span1, NDMX)
+    mjds = _clustered_mjds(span0, span1, NTOA)
+    # Two frequency bands within every cluster: single-band data
+    # leaves DM/DM1/DM2 exactly collinear with Offset/F1/F2
+    # (singular normal matrix — the round-2 bench crash).
+    freqs = np.tile([1400.0, 1400.0, 820.0, 820.0], NTOA // 4)
+    return _make_model_toas(
+        par, mjds, freqs, seed=1,
+        flag_sets={"be": lambda i: "X",
+                   "grp": lambda i: f"g{i % 5}"})  # g4 free: 4 jumps
 
 
 def time_fn(fn, reps=5):
@@ -134,87 +166,53 @@ def cpu_fallback_env() -> dict:
     NOT enough: the container's sitecustomize registers the axon TPU
     plugin whenever PALLAS_AXON_POOL_IPS is set and a wedged tunnel
     then hangs even CPU-pinned processes — drop the axon vars entirely
-    (same recipe as __graft_entry__.dryrun_multichip)."""
+    (same recipe as __graft_entry__.dryrun_multichip). The dropped vars
+    are stashed so the late TPU re-probe can reconstruct them."""
     import os
 
     env = dict(os.environ)
-    for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_TPU_GEN",
-              "PALLAS_AXON_REMOTE_COMPILE"):
-        env.pop(k, None)
+    stash = {}
+    for k in AXON_VARS:
+        if k in env:
+            stash[k] = env.pop(k)
+    env["PINT_TPU_AXON_STASH"] = json.dumps(stash)
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_ENABLE_X64"] = "1"
     env["PINT_TPU_BENCH_FALLBACK"] = "1"
     return env
 
 
-def main():
-    import os
-    import sys
-
-    # only the axon TPU tunnel has the hang-on-init failure mode; on
-    # plain hosts skip the probe subprocess entirely
-    if not os.environ.get("PINT_TPU_BENCH_FALLBACK") and \
-            os.environ.get("PALLAS_AXON_POOL_IPS"):
-        if not accelerator_responsive():
-            log("accelerator backend unresponsive; re-running on CPU")
-            os.execvpe(sys.executable, [sys.executable, __file__],
-                       cpu_fallback_env())
-
+def measure_step(model, toas, reps=5):
+    """Jitted fit-step wall time on the default backend; returns
+    (step_seconds, chi2, jitted, args)."""
     import jax
-
-    jax.config.update("jax_enable_x64", True)
-    import numpy as np
-
-    backend = jax.default_backend()
-    log(f"backend: {backend}, devices: {jax.devices()}")
-
-    model, toas = build_problem()
-    nfree = len(model.free_params)
-    log(f"N={toas.ntoas} free params={nfree}")
 
     from pint_tpu.parallel import build_fit_step
 
-    step_fn, args, names = build_fit_step(model, toas)
+    step_fn, args, _ = build_fit_step(model, toas)
     jitted = jax.jit(step_fn)
     t0 = time.perf_counter()
     out = jitted(*args)
     jax.block_until_ready(out)
-    log(f"compile+first run: {time.perf_counter() - t0:.1f}s "
+    log(f"  compile+first run: {time.perf_counter() - t0:.1f}s "
         f"chi2={float(out[2]):.1f}")
+    t = time_fn(lambda: jax.block_until_ready(jitted(*args)), reps)
+    return t, float(out[2]), jitted, args
 
-    accel_t = time_fn(lambda: jax.block_until_ready(jitted(*args)))
-    log(f"accelerated fit step: {accel_t * 1e3:.1f} ms "
-        f"({toas.ntoas / accel_t:.0f} TOA/s)")
 
-    # optional device-trace capture for step attribution (jacfwd phase
-    # chain vs matmuls vs Cholesky): view with tensorboard/xprof
-    import os
+def measure_numpy_mirror(model, toas, reps=3):
+    """The reference-algorithm CPU path: residuals + design matrix on
+    the CPU backend, numpy/scipy basis-Woodbury solve (dense ECORR
+    quantization columns, as the reference carries them)."""
+    import jax
+    import numpy as np
 
-    profdir = os.environ.get("PINT_TPU_PROFILE_DIR")
-    if profdir:
-        from pint_tpu.profiling import trace
-
-        with trace(profdir):
-            jax.block_until_ready(jitted(*args))
-        log(f"profile trace written to {profdir}")
-
-    # ---- CPU reference-algorithm path -------------------------------
-    cpu = jax.devices("cpu")[0]
     from pint_tpu.gls import gls_solve_np
+    from pint_tpu.residuals import Residuals
 
+    cpu = jax.devices("cpu")[0]
     with jax.default_device(cpu):
-        cpu_args = jax.device_put(args, cpu)
-        cpu_jit = jax.jit(step_fn)
-        jax.block_until_ready(cpu_jit(*cpu_args))  # warm
-
-        # CPU denominator, reference-style: design matrix + residuals on
-        # host, then the numpy/scipy basis-Woodbury solve
-        M_, names_, _ = model.designmatrix(toas)
-        r_ = np.zeros(toas.ntoas)
-
         def cpu_once():
-            from pint_tpu.residuals import Residuals
-
             res = Residuals(toas, model)
             r = res.time_resids
             M, _, _ = model.designmatrix(toas)
@@ -223,21 +221,366 @@ def main():
             phi = model.noise_model_basis_weight(toas)
             model._cache_key = None  # defeat caching: honest rebuild
             model.__dict__.pop("_noise_basis_cache", None)
-            return gls_solve_np(np.asarray(M), F, phi, np.asarray(r),
-                                nvec)
+            if F is None:
+                F, phi = np.zeros((toas.ntoas, 0)), np.ones(0)
+            return gls_solve_np(np.asarray(M), F, phi,
+                                np.asarray(r), nvec)
 
-        cpu_t = time_fn(cpu_once, reps=3)
+        return time_fn(cpu_once, reps=reps)
+
+
+# ---------------------------------------------------------------------
+# BASELINE.md configs 1-5 (extra JSON lines; north star prints last)
+# ---------------------------------------------------------------------
+
+
+def config1_ngc6440e():
+    """Config 1: NGC6440E fixture (62 TOAs, 6 params) — WLS fit."""
+    import os
+    import warnings
+
+    from pint_tpu import get_model_and_toas
+    from pint_tpu.fitter import WLSFitter
+
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tests", "datafile")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model, toas = get_model_and_toas(
+            os.path.join(d, "NGC6440E.par"),
+            os.path.join(d, "NGC6440E.tim"))
+        fit = WLSFitter(toas, model)
+        fit.fit_toas()  # warm compile
+        t = time_fn(lambda: WLSFitter(toas, model).fit_toas(), reps=3)
+    return {"metric": "config1_ngc6440e_wls_fit",
+            "value": round(toas.ntoas / t, 1), "unit": "TOA/s",
+            "fit_wall_ms": round(t * 1e3, 2)}
+
+
+def config2_b1855like():
+    """Config 2: B1855+09-like — 5k TOAs, ELL1 binary, GLS with
+    EFAC/EQUAD/ECORR + red noise + DMX."""
+    import numpy as np
+
+    span0, span1 = 53000.0, 56000.0
+    par = [
+        "PSR B1855+09x", "RAJ 18:57:36.39 1", "DECJ 09:43:17.2 1",
+        "PMRA -2.9 1", "PMDEC -5.5 1", "PX 0.3 1",
+        "F0 186.49408156698235 1", "F1 -6.2049e-16 1",
+        "DM 13.29", "PEPOCH 54500", "POSEPOCH 54500", "DMEPOCH 54500",
+        "TZRMJD 54500.1", "TZRSITE @", "TZRFRQ 1400", "UNITS TDB",
+        "BINARY ELL1", "PB 12.32717 1", "A1 9.2307805 1",
+        "TASC 54500.03 1", "EPS1 -2.15e-5 1", "EPS2 -3.1e-7 1",
+        "SINI 0.999 1", "M2 0.25 1",
+        "EFAC -be X 1.1", "EQUAD -be X 0.2", "ECORR -be X 0.9",
+        "TNREDAMP -14.1", "TNREDGAM 4.1", "TNREDC 20",
+    ]
+    _add_dmx(par, span0, span1, 12)
+    n = 5000
+    mjds = _clustered_mjds(span0, span1, n)
+    freqs = np.tile([1400.0, 1400.0, 430.0, 430.0], n // 4)
+    model, toas = _make_model_toas(par, mjds, freqs, seed=2,
+                                   flag_sets={"be": lambda i: "X"})
+    t, chi2, _, _ = measure_step(model, toas)
+    tnp = measure_numpy_mirror(model, toas)
+    log(f"  config2: step {t * 1e3:.1f} ms, numpy mirror "
+        f"{tnp * 1e3:.1f} ms")
+    return {"metric": "config2_b1855like_gls_ecorr_5k",
+            "value": round(toas.ntoas / t, 1), "unit": "TOA/s",
+            "vs_baseline": round(tnp / t, 2),
+            "step_ms": round(t * 1e3, 2)}
+
+
+def config3_j1713like_wideband():
+    """Config 3: J1713+0747-like wideband TOAs — wideband downhill fit
+    with DMX (stacked time+DM residual blocks)."""
+    import numpy as np
+
+    from pint_tpu.wideband_fitter import WidebandDownhillFitter
+
+    span0, span1 = 53000.0, 56000.0
+    par = [
+        "PSR J1713+0747x", "RAJ 17:13:49.53 1", "DECJ 07:47:37.5 1",
+        "PMRA 4.9 1", "PMDEC -3.9 1", "PX 0.85 1",
+        "F0 218.8118437960826 1", "F1 -4.08e-16 1",
+        "DM 15.99", "PEPOCH 54500", "POSEPOCH 54500", "DMEPOCH 54500",
+        "TZRMJD 54500.1", "TZRSITE @", "TZRFRQ 1400", "UNITS TDB",
+        "BINARY ELL1", "PB 67.8251 1", "A1 32.34242 1",
+        "TASC 54500.2 1", "EPS1 3.9e-5 1", "EPS2 -7.4e-5 1",
+        "DMEFAC -be X 1.1", "DMEQUAD -be X 1e-5",
+    ]
+    _add_dmx(par, span0, span1, 10)
+    n = 2000
+    rng = np.random.default_rng(3)
+    mjds = np.sort(rng.uniform(span0, span1, n))
+    freqs = np.tile([1400.0, 2100.0], n // 2)
+    model, toas = _make_model_toas(par, mjds, freqs, seed=3,
+                                   flag_sets={"be": lambda i: "X"})
+    # attach wideband DM measurements (flags -pp_dm / -pp_dme)
+    dm0 = 15.99
+    for i, f in enumerate(toas.flags):
+        f["pp_dm"] = str(dm0 + rng.normal(0, 1e-4))
+        f["pp_dme"] = "1e-4"
+    model.F0.value += 5e-11
+    WidebandDownhillFitter(toas, model).fit_toas()  # warm compiles
+    model.F0.value += 5e-11
+    fit = WidebandDownhillFitter(toas, model)
+    fit.fit_toas()
+    wall = fit.stats.wall_time_s
+    return {"metric": "config3_j1713like_wideband_downhill_2k",
+            "value": round(fit.stats.toas_per_sec, 1), "unit": "TOA/s",
+            "fit_wall_ms": round(wall * 1e3, 1),
+            "iterations": fit.stats.iterations}
+
+
+def config4_j0613like_fullcov():
+    """Config 4: J0613-0200-like ELL1 + PLRedNoise, dense
+    full-covariance GLS (C = N + F phi F^T, O(N^2)) vs the same
+    algorithm in numpy — the reference's full_cov=True branch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pint_tpu.gls import _gls_kernel_fullcov
+    from pint_tpu.residuals import Residuals
+
+    par = [
+        "PSR J0613-0200x", "RAJ 06:13:43.97 1", "DECJ -02:00:47.2 1",
+        "PMRA 1.84 1", "PMDEC -10.6 1", "PX 0.9 1",
+        "F0 326.6005670074 1", "F1 -1.023e-15 1",
+        "DM 38.77 1", "PEPOCH 54500", "POSEPOCH 54500",
+        "TZRMJD 54500.1", "TZRSITE @", "TZRFRQ 1400", "UNITS TDB",
+        "BINARY ELL1", "PB 1.198512575 1", "A1 1.09144 1",
+        "TASC 54500.11 1", "EPS1 3.5e-6 1", "EPS2 -2.5e-6 1",
+        "TNREDAMP -13.9", "TNREDGAM 3.1", "TNREDC 15",
+    ]
+    n = 2000
+    rng = np.random.default_rng(4)
+    mjds = np.sort(rng.uniform(53000, 56000, n))
+    freqs = np.tile([1400.0, 820.0], n // 2)
+    model, toas = _make_model_toas(par, mjds, freqs, seed=4)
+    r = jnp.asarray(Residuals(toas, model).time_resids)
+    M, _, _ = model.designmatrix(toas)
+    M = jnp.asarray(M)
+    nvec = jnp.asarray(model.scaled_toa_uncertainty(toas) ** 2)
+    F = jnp.asarray(model.noise_model_designmatrix(toas))
+    phi = jnp.asarray(model.noise_model_basis_weight(toas))
+    out = _gls_kernel_fullcov(M, F, phi, r, nvec)
+    jax.block_until_ready(out)
+    t = time_fn(lambda: jax.block_until_ready(
+        _gls_kernel_fullcov(M, F, phi, r, nvec)))
+
+    # numpy mirror of the same dense algebra (scipy cho_factor)
+    from scipy.linalg import cho_factor, cho_solve
+
+    Mn_, F_, phi_, r_, nv_ = (np.asarray(M), np.asarray(F),
+                              np.asarray(phi), np.asarray(r),
+                              np.asarray(nvec))
+
+    def np_once():
+        C = np.diag(nv_) + (F_ * phi_[None, :]) @ F_.T
+        cf = cho_factor(C, lower=True)
+        norm = np.sqrt(np.sum(Mn_ * Mn_, axis=0))
+        Mn = Mn_ / norm[None, :]
+        CiM = cho_solve(cf, Mn)
+        Cir = cho_solve(cf, r_)
+        Sigma = Mn.T @ CiM
+        b = Mn.T @ Cir
+        cf2 = cho_factor(Sigma, lower=True)
+        return cho_solve(cf2, b) / norm
+
+    tnp = time_fn(np_once, reps=3)
+    log(f"  config4: fullcov kernel {t * 1e3:.1f} ms, numpy "
+        f"{tnp * 1e3:.1f} ms")
+    return {"metric": "config4_j0613like_fullcov_gls_2k",
+            "value": round(n / t, 1), "unit": "TOA/s",
+            "vs_baseline": round(tnp / t, 2),
+            "solve_ms": round(t * 1e3, 2)}
+
+
+def config5_pta():
+    """Config 5: 67-pulsar PTA batch — one vmapped GLS solve per
+    iteration across the whole array (bench_pta.py folded into the
+    artifact per the round-3 brief)."""
+    from bench_pta import build_pulsar
+
+    from pint_tpu.parallel import fit_pta
+
+    t0 = time.perf_counter()
+    pulsars = [build_pulsar(k, 100) for k in range(67)]
+    log(f"  config5: built 67 pulsars in {time.perf_counter() - t0:.0f}s")
+    res = fit_pta([(t, m) for m, t, _ in pulsars], maxiter=2)
+    stats = fit_pta.last_stats
+    n_ok = sum(1 for (m, t, truth), r in zip(pulsars, res)
+               if abs(m.F0.value - truth["F0"]) < 5 * r["errors"]["F0"])
+    return {"metric": "config5_pta_batch_67psr",
+            "value": round(stats["toas_per_sec"], 1), "unit": "TOA/s",
+            "npulsars": 67, "ntoa_total": stats["ntoa_total"],
+            "device_solve_ms": round(stats["device_solve_s"] * 1e3, 1),
+            "recovered_5sigma": n_ok}
+
+
+def late_tpu_probe(extra_timeout: float = 900.0):
+    """After a CPU fallback, retry the TPU once the heavy work is done:
+    a transiently-wedged tunnel shouldn't cost the round's TPU number.
+    Runs bench.py --north-star-only in a bounded subprocess with the
+    stashed axon env restored; returns its parsed JSON dict or None."""
+    import os
+    import subprocess
+
+    stash = json.loads(os.environ.get("PINT_TPU_AXON_STASH", "{}"))
+    if not stash:
+        return None
+    env = dict(os.environ)
+    env.update(stash)
+    env.pop("PINT_TPU_BENCH_FALLBACK", None)
+    env.pop("JAX_PLATFORMS", None)
+    # cheap bounded probe first — don't spend the subprocess timeout
+    # discovering the tunnel is still dead
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=180, capture_output=True, env=env)
+        if r.returncode != 0:
+            return None
+    except subprocess.TimeoutExpired:
+        return None
+    log("late probe: accelerator responsive again — measuring on TPU")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--north-star-only"],
+            timeout=extra_timeout, capture_output=True, text=True,
+            env=env)
+    except subprocess.TimeoutExpired:
+        log("late probe: TPU run timed out")
+        return None
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if d.get("metric", "").startswith("gls_fit_iteration"):
+            return d
+    log(f"late probe: no parseable result (rc={r.returncode})")
+    return None
+
+
+def main():
+    import os
+    import sys
+
+    north_star_only = "--north-star-only" in sys.argv
+
+    # only the axon TPU tunnel has the hang-on-init failure mode; on
+    # plain hosts skip the probe subprocess entirely
+    if not os.environ.get("PINT_TPU_BENCH_FALLBACK") and \
+            os.environ.get("PALLAS_AXON_POOL_IPS"):
+        if not accelerator_responsive():
+            log("accelerator backend unresponsive; re-running on CPU")
+            os.execvpe(sys.executable,
+                       [sys.executable, __file__] + sys.argv[1:],
+                       cpu_fallback_env())
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    # persistent XLA compile cache: dedups the per-pulsar compiles of
+    # config 5 within a run and warms repeat runs
+    from pint_tpu.config import enable_compile_cache
+
+    enable_compile_cache(
+        "PINT_TPU_BENCH_JIT_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_compile_cache"))
+
+    backend = jax.default_backend()
+    log(f"backend: {backend}, devices: {jax.devices()}")
+
+    model, toas = build_problem()
+    nfree = len(model.free_params)
+    log(f"N={toas.ntoas} free params={nfree}")
+
+    accel_t, chi2, jitted, args = measure_step(model, toas)
+    log(f"accelerated fit step [{backend}]: {accel_t * 1e3:.1f} ms "
+        f"({toas.ntoas / accel_t:.0f} TOA/s)")
+
+    # same XLA program on the host CPU backend, full-f64 flags (the
+    # honest backend-vs-backend comparison, reported alongside)
+    cpu_xla_ms = None
+    if backend != "cpu":
+        from pint_tpu.parallel import build_fit_step
+
+        cpu = jax.devices("cpu")[0]
+        step_c, args_c, _ = build_fit_step(model, toas,
+                                           matmul_f32=False,
+                                           jac_f32=False)
+        with jax.default_device(cpu):
+            cpu_args = jax.device_put(args_c, cpu)
+            cpu_jit = jax.jit(step_c)
+            jax.block_until_ready(cpu_jit(*cpu_args))
+            cpu_xla_t = time_fn(
+                lambda: jax.block_until_ready(cpu_jit(*cpu_args)))
+        cpu_xla_ms = round(cpu_xla_t * 1e3, 2)
+        log(f"same step on CPU-XLA (f64): {cpu_xla_ms} ms")
+
+    # optional device-trace capture for step attribution
+    profdir = os.environ.get("PINT_TPU_PROFILE_DIR")
+    if profdir:
+        from pint_tpu.profiling import trace
+
+        with trace(profdir):
+            jax.block_until_ready(jitted(*args))
+        log(f"profile trace written to {profdir}")
+
+    cpu_t = measure_numpy_mirror(model, toas)
     log(f"cpu reference path: {cpu_t * 1e3:.1f} ms "
         f"({toas.ntoas / cpu_t:.0f} TOA/s)")
 
-    value = toas.ntoas / accel_t
-    print(json.dumps({
+    north = {
         "metric": "gls_fit_iteration_throughput_10k_toas_40p",
-        "value": round(value, 1),
+        "value": round(toas.ntoas / accel_t, 1),
         "unit": "TOA/s",
         "vs_baseline": round(cpu_t / accel_t, 2),
         "backend": backend,
-    }))
+        "step_ms": round(accel_t * 1e3, 2),
+        "numpy_mirror_ms": round(cpu_t * 1e3, 1),
+    }
+    if cpu_xla_ms is not None:
+        north["cpu_xla_step_ms"] = cpu_xla_ms
+
+    if north_star_only:
+        print(json.dumps(north))
+        return
+
+    # free the big problem before the extra configs
+    del jitted, args, model, toas
+
+    for fn in (config1_ngc6440e, config2_b1855like,
+               config3_j1713like_wideband, config4_j0613like_fullcov,
+               config5_pta):
+        try:
+            t0 = time.perf_counter()
+            rec = fn()
+            rec["backend"] = backend
+            log(f"{rec['metric']}: {rec['value']} {rec['unit']} "
+                f"({time.perf_counter() - t0:.0f}s total)")
+            print(json.dumps(rec))
+        except Exception as e:  # a config failure must not cost the
+            log(f"{fn.__name__} failed: {e!r}")  # north-star artifact
+    sys.stdout.flush()
+
+    # retry the TPU late if this process is the CPU fallback: the
+    # tunnel may have recovered while the heavy work ran
+    if os.environ.get("PINT_TPU_BENCH_FALLBACK"):
+        late = late_tpu_probe()
+        if late is not None and late.get("backend") == "tpu":
+            log("late TPU probe succeeded; recording TPU north star")
+            print(json.dumps(north))  # keep the CPU record visible
+            north = late
+
+    print(json.dumps(north))
 
 
 if __name__ == "__main__":

@@ -18,7 +18,23 @@ from pathlib import Path
 from typing import Optional
 
 __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
-           "obs_override"]
+           "obs_override", "enable_compile_cache"]
+
+
+def enable_compile_cache(env_var: str, default_dir: str) -> Optional[str]:
+    """Point jax's persistent XLA compilation cache at ``default_dir``
+    (override with the named env var; value "0" disables). Shared by
+    tests/conftest.py and bench.py — the suite and the benchmark are
+    both compile-dominated on a cold start. Returns the dir used."""
+    import jax
+
+    cache_dir = os.environ.get(env_var, default_dir)
+    if cache_dir == "0":
+        return None
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    return cache_dir
 
 
 def datadir() -> Path:

@@ -175,7 +175,8 @@ class PulsarBinary(DelayComponent):
             orbits = dd_taylor_horner(dt_dd, coeffs)
             M = TWOPI * dd_to_f64(dd_frac(orbits))
             dt = dd_to_f64(dt_dd)
-            plain = [jnp.zeros(())] + [_v(pv, n) for n in self.fb_terms]
+            plain = [jnp.zeros((), dt.dtype)] + \
+                [_v(pv, n) for n in self.fb_terms]
             nhat = TWOPI * taylor_horner_deriv(dt, plain, 1)
             return M, nhat
         pb_s = _v(pv, "PB") * SECS_PER_DAY

@@ -159,8 +159,9 @@ class TroposphereDelay(DelayComponent):
     def _interp_coeff(self, table, abslat_deg):
         """Piecewise-linear lat interpolation of an NMF coefficient
         row (host grid, device latitude)."""
-        return jnp.interp(abslat_deg, jnp.asarray(self._LAT_GRID),
-                          jnp.asarray(table))
+        dt = jnp.asarray(abslat_deg).dtype
+        return jnp.interp(abslat_deg, jnp.asarray(self._LAT_GRID, dt),
+                          jnp.asarray(table, dt))
 
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         if not self.CORRECT_TROPOSPHERE.value:

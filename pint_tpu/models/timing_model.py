@@ -412,9 +412,31 @@ class TimingModel:
         return phase_fn, (free_names, frozen_names)
 
     def _get_compiled(self):
+        # The key must cover everything baked into the trace: the
+        # component/parameter structure, the free set, ref_day, every
+        # str/bool/int param (ECL, SIFUNC, K96, ... are read as trace
+        # statics), and FROZEN device-param values (epoch params like
+        # CMEPOCH are read via .value in device code). Free-param
+        # VALUES are runtime arguments and deliberately absent — the
+        # hot fitter loop re-uses one compile across iterations.
+        statics = tuple(
+            (p.name, p.value)
+            for c in self._ordered_components()
+            if not isinstance(c, MiscParams)  # header-only (PSR name,
+            # EPHEM, ...) — never read inside a trace, and keying on
+            # them would force one compile per pulsar in PTA batches
+            for p in c.params.values()
+            if isinstance(p, (strParameter, boolParameter,
+                              intParameter)))
+        # the one MiscParams entry that IS a trace static (solar-
+        # system Shapiro branches on it)
+        statics += (("PLANET_SHAPIRO", bool(self.PLANET_SHAPIRO.value)),)
+        frozen_vals = tuple(
+            p.value for p in self._device_params() if p.frozen)
         key = (tuple(sorted(self.components)),
                tuple(p.name for p in self._device_params()),
-               tuple(self.free_params), self.ref_day)
+               tuple(self.free_params), self.ref_day, statics,
+               frozen_vals)
         if self._jit_phase is None or self._cache_key_params != key:
             fn, names = self._build_phase_fn()
             self._jit_phase = jax.jit(fn)
@@ -423,9 +445,17 @@ class TimingModel:
         return self._jit_phase
 
     def invalidate_cache(self, params_only=False):
-        self._jit_phase = None
-        self._cache_key_params = None
+        """Drop cached compiled state. params_only=True (a parameter
+        VALUE changed) keeps the jitted phase function: values enter as
+        runtime arguments, so the trace is still valid — _get_compiled
+        re-keys on (components, device params, free set, ref_day) and
+        rebuilds exactly when the STRUCTURE changes. Clearing the jit
+        here cost a full retrace per fitter iteration (the config-1
+        bench regression that exposed it). ref_day is re-derived since
+        epoch-valued params feed the key."""
         if not params_only:
+            self._jit_phase = None
+            self._cache_key_params = None
             self._cache_key = None
             self._cache = None
             self.__dict__.pop("_noise_basis_cache", None)

@@ -251,7 +251,10 @@ def dd_mul_f(a: DD, b: FloatLike) -> DD:
 
 
 def dd_div_f(a: DD, b: FloatLike) -> DD:
-    return dd_div(a, _as_dd(b))
+    # cast b to a's dtype (like add_f/mul_f): _as_dd would type a bare
+    # Python float as f64 and silently promote a dd32 chain
+    b = jnp.asarray(b, a.hi.dtype)
+    return dd_div(a, DD(b, jnp.zeros_like(b)))
 
 
 # ----------------------------------------------------------------------

@@ -85,18 +85,33 @@ def utc_mjd_to_tt_mjd(day, frac):
 
 def tt_mjd_to_utc_mjd(day, frac):
     """TT (f64 day, f64 frac) -> pulsar-MJD UTC (day, frac), both f64
-    pairs normalized to frac in [0, 1). Inverse of utc_mjd_to_tt_mjd;
-    the leap table is evaluated at the UTC day, via a two-pass so
-    epochs within ~69 s after TT midnight on a leap-adoption day get
-    the pre-step offset."""
+    pairs normalized to frac in [0, 1). Inverse of utc_mjd_to_tt_mjd.
+
+    The leap table must be evaluated at the UTC day the answer lands
+    on, which is itself the answer — a fixed point of the staircase
+    map d -> day + floor(frac - off(d)). Two iterations reach it
+    everywhere except inside an inserted leap second (23:59:60.x has
+    no pulsar-MJD preimage; the iteration 2-cycles across the step):
+    those instants alias to the start of the following day, matching
+    the convention's elapsed/86400 aliasing, as does an exact
+    post-step midnight that lands one ulp short (the bug the
+    precision-fuzz leap sweep caught: the old two-pass returned a UTC
+    a full second late there)."""
     day = np.asarray(day, np.float64)
     frac = np.asarray(frac, np.float64)
-    off = (tai_minus_utc(day) + TT_MINUS_TAI) / SECS_PER_DAY
-    day_utc = day + np.floor(frac - off)
-    off = (tai_minus_utc(day_utc) + TT_MINUS_TAI) / SECS_PER_DAY
-    f = frac - off
-    carry = np.floor(f)
-    return day + carry, f - carry
+
+    def off_of(d):
+        return (tai_minus_utc(d) + TT_MINUS_TAI) / SECS_PER_DAY
+
+    d1 = day + np.floor(frac - off_of(day))
+    d2 = day + np.floor(frac - off_of(d1))
+    d3 = day + np.floor(frac - off_of(d2))
+    # converged lanes have d3 == d2; 2-cycling lanes (inside a leap
+    # second) take the later day — both are just the max
+    day_utc = np.maximum(d2, d3)
+    f = frac - off_of(day_utc) - (day_utc - day)
+    f = np.clip(f, 0.0, np.nextafter(1.0, 0.0))
+    return day_utc, f
 
 
 def tdb_minus_tt_seconds(tt_mjd_f64):

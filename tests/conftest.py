@@ -30,14 +30,12 @@ jax.config.update("jax_enable_x64", True)
 # Persistent compilation cache: the suite is jit-compile dominated
 # (hundreds of distinct model structures); caching compiled executables
 # across runs cuts wall-clock by more than half on a warm cache.
-_cache_dir = os.environ.get(
+from pint_tpu.config import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(
     "PINT_TPU_TEST_JIT_CACHE",
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  ".jax_compile_cache"))
-if _cache_dir != "0":
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

