@@ -467,6 +467,28 @@ def late_tpu_probe(extra_timeout: float = 900.0):
     return None
 
 
+def scan_nscaling():
+    """--scan: step time vs N (10k/30k/100k TOAs) on the default
+    backend — the MXU-crossover measurement (the TPU's advantage grows
+    with N as the matmuls fatten while fixed overheads amortize)."""
+    import jax
+
+    global NTOA
+    out = []
+    for n in (10_000, 30_000, 100_000):
+        NTOA = n
+        model, toas = build_problem()
+        t, chi2, jitted, args = measure_step(model, toas, reps=3)
+        log(f"N={n}: {t * 1e3:.1f} ms ({n / t:.0f} TOA/s)")
+        out.append({"metric": "gls_step_nscaling", "ntoa": n,
+                    "step_ms": round(t * 1e3, 2),
+                    "value": round(n / t, 1), "unit": "TOA/s",
+                    "backend": jax.default_backend()})
+        del jitted, args, model, toas
+    for rec in out:
+        print(json.dumps(rec))
+
+
 def main():
     import os
     import sys
@@ -495,6 +517,10 @@ def main():
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_compile_cache"))
 
+    if "--scan" in sys.argv:
+        scan_nscaling()
+        return
+
     backend = jax.default_backend()
     log(f"backend: {backend}, devices: {jax.devices()}")
 
@@ -505,6 +531,23 @@ def main():
     accel_t, chi2, jitted, args = measure_step(model, toas)
     log(f"accelerated fit step [{backend}]: {accel_t * 1e3:.1f} ms "
         f"({toas.ntoas / accel_t:.0f} TOA/s)")
+
+    # transparency: the f32-Jacobian variant is auto-on only on TPU;
+    # when we're on the CPU backend measure it too (it halves the CPU
+    # step at <1e-2 sigma agreement — tests/test_jac32.py)
+    jac32_ms = None
+    if backend == "cpu":
+        import jax as _jax
+
+        from pint_tpu.parallel import build_fit_step
+
+        fn2, args2, _ = build_fit_step(model, toas, jac_f32=True)
+        j2 = _jax.jit(fn2)
+        _jax.block_until_ready(j2(*args2))
+        jac32_ms = round(time_fn(
+            lambda: _jax.block_until_ready(j2(*args2))) * 1e3, 2)
+        log(f"f32-jacobian variant [cpu]: {jac32_ms} ms")
+        del fn2, j2, args2  # keep the pre-configs memory release real
 
     # same XLA program on the host CPU backend, full-f64 flags (the
     # honest backend-vs-backend comparison, reported alongside)
@@ -549,6 +592,8 @@ def main():
     }
     if cpu_xla_ms is not None:
         north["cpu_xla_step_ms"] = cpu_xla_ms
+    if jac32_ms is not None:
+        north["step_ms_jac32"] = jac32_ms
 
     if north_star_only:
         print(json.dumps(north))

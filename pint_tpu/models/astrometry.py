@@ -55,6 +55,15 @@ class Astrometry(DelayComponent):
         """Unit vector SSB→pulsar, ICRS, per TOA (N,3)."""
         raise NotImplementedError
 
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        ang = parse_unit("rad")
+        pm = parse_unit("mas/yr")
+        return {"POSEPOCH": parse_unit("d"), "PX": parse_unit("mas"),
+                "RAJ": ang, "DECJ": ang, "ELONG": ang, "ELAT": ang,
+                "PMRA": pm, "PMDEC": pm, "PMELONG": pm, "PMELAT": pm}
+
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         n = self.psr_dir(pv, batch)
         ctx["psr_dir"] = n

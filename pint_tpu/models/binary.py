@@ -52,6 +52,7 @@ from pint_tpu.ops.dd import (
     dd_sub,
     dd_sub_f,
     dd_to_f64,
+    dd_where,
 )
 
 SECS_PER_DAY = 86400.0
@@ -131,6 +132,32 @@ class PulsarBinary(DelayComponent):
         if self.PB.value is None and not self.fb_terms:
             raise ValueError(
                 f"{type(self).__name__} requires PB or FB0")
+
+    def param_dimensions(self):
+        from pint_tpu.units import DIMENSIONLESS, parse_unit
+
+        t = parse_unit("s")
+        d = parse_unit("d")
+
+        def fb_dim(name):
+            return parse_unit("s") ** -(int(name[2:]) + 1)
+
+        return {"PB": d, "PBDOT": DIMENSIONLESS, "A1": parse_unit("ls"),
+                "A1DOT": parse_unit("ls/s"), "M2": parse_unit("Msun"),
+                "SINI": DIMENSIONLESS, "T0": d, "TASC": d,
+                "ECC": DIMENSIONLESS, "EDOT": t ** -1,
+                "OM": parse_unit("deg"), "OMDOT": parse_unit("deg/yr"),
+                "GAMMA": t, "EPS1": DIMENSIONLESS,
+                "EPS2": DIMENSIONLESS, "EPS1DOT": t ** -1,
+                "EPS2DOT": t ** -1, "FB*": fb_dim,
+                "T0X_*": d, "A1X_*": parse_unit("ls"),
+                "XR1_*": d, "XR2_*": d,
+                "KIN": parse_unit("deg"), "KOM": parse_unit("deg"),
+                "H3": t, "H4": t, "STIG": DIMENSIONLESS,
+                "MTOT": parse_unit("Msun"), "XPBDOT": DIMENSIONLESS,
+                "XOMDOT": parse_unit("deg/yr"),
+                "DR": DIMENSIONLESS, "DTH": DIMENSIONLESS,
+                "A0": t, "B0": t, "LNEDOT": t ** -1}
 
     # -- orbit machinery ----------------------------------------------
 
@@ -315,8 +342,14 @@ class BinaryBT(_KeplerBinary):
 
     register = True
 
+    def _x_adjust(self, x, ctx):
+        """Hook for per-TOA projected-semi-major-axis adjustments
+        (BinaryBTPiecewise overrides)."""
+        return x
+
     def binary_delay(self, pv, dt, M, nhat, ctx):
         x, ecc, om = self._elements(pv, dt)
+        x = self._x_adjust(x, ctx)
         E = kepler_E(M, ecc)
         sE, cE = jnp.sin(E), jnp.cos(E)
         alpha = x * jnp.sin(om)
@@ -644,3 +677,117 @@ class BinaryELL1k(BinaryELL1):
         Drep = x * (cP + eps2 * c2P + eps1 * s2P)
         Drepp = x * (-sP - 2.0 * eps2 * s2P + 2.0 * eps1 * c2P)
         return self._inverse_timing(Dre, Drep, Drepp, nhat, 0.0)
+
+
+class BinaryBTPiecewise(BinaryBT):
+    """BT with piecewise-constant T0 and/or A1 over MJD ranges
+    (reference: binary_bt.BinaryBTPiecewise / BT_piecewise.py, par
+    name ``BT_piecewise``): within piece i's window [XR1_i, XR2_i],
+    T0X_i and A1X_i replace the global T0/A1; outside every window the
+    globals hold. TPU-first layout: each piece becomes a host-built
+    0/1 mask over the TOA axis, the per-TOA orbital epoch is a
+    dd_where chain (so the epoch stays a dd pair per TOA — required
+    for the f32 Jacobian path too), and the A1 swap rides the
+    ``_x_adjust`` hook as a plain where chain. No per-piece Python
+    loop survives under jit: masks are static-shape (N,) arrays."""
+
+    register = True
+
+    _KINDS = ("T0X_", "A1X_", "XR1_", "XR2_")
+
+    def __init__(self):
+        super().__init__()
+        self.piece_ids: List[int] = []
+
+    def add_piece_param(self, kind: str, index: int, index_str=None):
+        units = {"T0X_": "MJD", "A1X_": "ls",
+                 "XR1_": "MJD", "XR2_": "MJD"}[kind]
+        p = prefixParameter(prefix=kind, index=index,
+                            index_str=index_str or f"{index:04d}",
+                            units=units)
+        self.add_param(p)
+        self.setup()
+        return p
+
+    def setup(self):
+        super().setup()
+        ids = set()
+        names: dict = {}
+        for n in self.params:
+            for kind in self._KINDS:
+                if n.startswith(kind) and n[len(kind):].isdigit():
+                    i = int(n[len(kind):])
+                    ids.add(i)
+                    names.setdefault(i, {})[kind] = n
+        self.piece_ids = sorted(ids)
+        self._piece_names = names
+
+    def validate(self):
+        super().validate()
+        for i in self.piece_ids:
+            nm = self._piece_names[i]
+            if "XR1_" not in nm or "XR2_" not in nm or \
+                    self.params[nm["XR1_"]].value is None or \
+                    self.params[nm["XR2_"]].value is None:
+                raise ValueError(
+                    f"BT_piecewise piece {i} needs XR1_/XR2_ bounds")
+            if "T0X_" not in nm and "A1X_" not in nm:
+                raise ValueError(
+                    f"BT_piecewise piece {i} sets neither T0X nor A1X")
+            if self.params[nm["XR1_"]].value >= \
+                    self.params[nm["XR2_"]].value:
+                raise ValueError(
+                    f"BT_piecewise piece {i}: XR1 must be < XR2 "
+                    f"(an inverted window would be silently inert)")
+        # overlapping windows would double-apply in the where chains
+        spans = sorted(
+            (self.params[self._piece_names[i]["XR1_"]].value,
+             self.params[self._piece_names[i]["XR2_"]].value)
+            for i in self.piece_ids)
+        for (a1, b1), (a2, _) in zip(spans, spans[1:]):
+            if a2 < b1:
+                raise ValueError("BT_piecewise windows overlap")
+
+    def prepare(self, toas, batch, cache, prefix=""):
+        import numpy as np
+
+        mjd = np.asarray(batch.tdb_day) + np.asarray(batch.tdb_frac.hi)
+        for i in self.piece_ids:
+            nm = self._piece_names[i]
+            r1 = self.params[nm["XR1_"]].value
+            r2 = self.params[nm["XR2_"]].value
+            cache[f"btx_mask_{i}"] = (
+                (mjd >= r1) & (mjd < r2)).astype(np.float64)
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        ref = self._parent.ref_day
+        tb = dd_mul_f(dd_add_f(batch.tdb_frac, batch.tdb_day - ref),
+                      SECS_PER_DAY)
+        shape = batch.tdb_day.shape
+        t0 = pv["T0"]
+        epoch = DD(jnp.broadcast_to(t0.hi, shape),
+                   jnp.broadcast_to(t0.lo, shape))
+        a1_shift = jnp.zeros_like(batch.freq_mhz)
+        for i in self.piece_ids:
+            nm = self._piece_names[i]
+            mask = jnp.asarray(cache[f"btx_mask_{i}"])
+            inside = mask > 0
+            t0n = nm.get("T0X_")
+            if t0n is not None and t0n in pv:
+                px = pv[t0n]
+                epoch = dd_where(
+                    inside,
+                    DD(jnp.broadcast_to(px.hi, shape),
+                       jnp.broadcast_to(px.lo, shape)), epoch)
+            a1n = nm.get("A1X_")
+            if a1n is not None and a1n in pv:
+                a1_shift = jnp.where(
+                    inside, _v(pv, a1n) - _v(pv, "A1"), a1_shift)
+        ctx["btx_a1_shift"] = a1_shift
+        eref = dd_mul_f(dd_sub_f(epoch, ref), SECS_PER_DAY)
+        dt_dd = dd_sub_f(dd_sub(tb, eref), delay_so_far)
+        M, nhat = self._orbit(pv, dt_dd)
+        return self.binary_delay(pv, dd_to_f64(dt_dd), M, nhat, ctx)
+
+    def _x_adjust(self, x, ctx):
+        return x + ctx.pop("btx_a1_shift", 0.0)

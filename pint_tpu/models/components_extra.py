@@ -397,10 +397,33 @@ class SolarWindDispersion(DelayComponent):
         self.add_param(floatParameter("NE_SW", units="cm^-3", value=0.0,
                                       aliases=["NE1AU", "SOLARN0"]))
         self.add_param(floatParameter("SWM", units="", value=0.0))
+        self.add_param(floatParameter("SWP", units="", value=2.0,
+                                      description="radial density "
+                                      "power-law index (SWM 1)"))
 
     def validate(self):
-        if self.SWM.value not in (None, 0.0, 0):
-            raise NotImplementedError("only SWM 0 is implemented")
+        if self.SWM.value not in (None, 0.0, 0, 1.0, 1):
+            raise NotImplementedError("SWM must be 0 or 1")
+        if int(self.SWM.value or 0) == 1 and \
+                (self.SWP.value is None or self.SWP.value <= 1.0):
+            raise ValueError("SWM 1 needs SWP > 1 (the line-of-sight "
+                             "integral diverges otherwise)")
+
+    # 64-node Gauss-Legendre rule for the SWM-1 line-of-sight integral:
+    # differentiable in BOTH the elongation and the power-law index
+    # (jacfwd-able — a betainc/gamma closed form would not give d/dSWP)
+    _GL = np.polynomial.legendre.leggauss(64)
+
+    def _cosq_integral(self, phi0, q):
+        """∫_{phi0}^{pi/2} cos^q(phi) dphi by fixed quadrature; phi0
+        per TOA, q traced scalar (> -1)."""
+        nodes = jnp.asarray(self._GL[0], phi0.dtype)
+        wts = jnp.asarray(self._GL[1], phi0.dtype)
+        half = (jnp.pi / 2 - phi0) / 2.0
+        mid = (jnp.pi / 2 + phi0) / 2.0
+        phi = mid[:, None] + half[:, None] * nodes[None, :]
+        c = jnp.clip(jnp.cos(phi), 1e-12, 1.0)
+        return half * jnp.sum(wts[None, :] * c ** q, axis=-1)
 
     def dm_value_device(self, pv, batch, cache, ctx):
         ne = _val(pv, "NE_SW")
@@ -411,6 +434,17 @@ class SolarWindDispersion(DelayComponent):
         rho = jnp.arccos(jnp.clip(cosr, -1.0, 1.0))
         r_m = r_lts * C_M_S
         sinr = jnp.maximum(jnp.sin(rho), 1e-9)
+        if int(self.SWM.value or 0) == 1:
+            # n_e = NE_SW (AU/r)^SWP: DM = NE_SW AU^p b^{1-p}
+            #   ∫_{rho-pi/2}^{pi/2} cos^{p-2} dphi, b = r sin(rho)
+            # (You et al. 2007 geometry; reference: SWM 1 branch of
+            # solar_wind_dispersion.py). p = 2 reduces exactly to the
+            # SWM-0 closed form below.
+            p = _val(pv, "SWP")
+            b_m = r_m * sinr
+            F = self._cosq_integral(rho - jnp.pi / 2.0, p - 2.0)
+            return ne * AU_M ** p * b_m ** (1.0 - p) / PC_M * F
+        # SWM 0: n_e = NE_SW (AU/r)^2 closed form
         # DM in pc/cm^3: NE_SW [cm^-3] * AU^2[m^2]/pc[m] * geom [1/m]
         return ne * (AU_M * AU_M / PC_M) * (jnp.pi - rho) / (r_m * sinr)
 

@@ -41,6 +41,22 @@ class Dispersion(DelayComponent):
         TimingModel.total_dm summing Dispersion dm_value)."""
         return jnp.zeros_like(batch.freq_mhz)
 
+    def param_dimensions(self):
+        from pint_tpu.models.parameter import split_prefixed_name
+        from pint_tpu.units import parse_unit
+
+        ne = parse_unit("pc cm^-3")
+
+        def dm_dim(name):
+            # only reached for DM<digits> (exact keys and the longer
+            # DMX_* stems win in _spec_lookup before 'DM*')
+            _, _, i = split_prefixed_name(name)
+            return ne / parse_unit("yr") ** i
+
+        return {"DM": ne, "DM*": dm_dim, "DMEPOCH": parse_unit("d"),
+                "DMX": ne, "DMX_*": ne, "DMXR1_*": parse_unit("d"),
+                "DMXR2_*": parse_unit("d"), "DMJUMP": ne}
+
 
 class DispersionDM(Dispersion):
     """DM + DM1·dt + DM2·dt²/2... around DMEPOCH (reference:

@@ -33,6 +33,7 @@ DEFAULT_COMPONENTS = ["Spindown"]
 _F_RE = re.compile(r"^F(\d+)$")
 _DM_RE = re.compile(r"^DM(\d+)$")
 _DMX_RE = re.compile(r"^(DMX_|DMXR1_|DMXR2_)(\d+)$")
+_BTX_RE = re.compile(r"^(T0X_|A1X_|XR1_|XR2_)(\d+)$")
 _FB_RE = re.compile(r"^FB(\d+)$")
 
 # mask-parameter families → owning component class (extended as the
@@ -139,8 +140,11 @@ class ModelBuilder:
                 # case-insensitive: the conventional par name for e.g.
                 # BinaryELL1k is "ELL1k"
                 by_upper = {c.upper(): c for c in component_types}
-                cls_name = by_upper.get(
-                    (BINARY_COMPONENT_PREFIX + binary_name).upper())
+                # underscore-insensitive: par "BT_piecewise" names
+                # class BinaryBTPiecewise
+                want = (BINARY_COMPONENT_PREFIX + binary_name).upper()
+                cls_name = by_upper.get(want) or by_upper.get(
+                    want.replace("_", ""))
                 if cls_name is None:
                     raise NotImplementedError(
                         f"binary model {binary_name!r} is not implemented "
@@ -186,6 +190,18 @@ class ModelBuilder:
                           if type(c).__name__.startswith("Binary")]
                 if binary:
                     p = binary[0].add_fb_term(int(m.group(1)))
+                    p.from_tokens(toks)
+                    continue
+
+            # 1d. BT_piecewise pieces → the active binary
+            m = _BTX_RE.match(key)
+            if m:
+                binary = [c for c in comps.values()
+                          if hasattr(c, "add_piece_param")]
+                if binary:
+                    p = binary[0].add_piece_param(
+                        m.group(1), int(m.group(2)),
+                        index_str=m.group(2))
                     p.from_tokens(toks)
                     continue
 

@@ -261,7 +261,8 @@ class MJDParameter(Parameter):
     """Epoch parameter (PEPOCH, T0, TASC, TZRMJD...): value is MJD;
     internally an exact (day, frac) split via dd."""
 
-    units = "MJD"
+    def __init__(self, name, units: str = "MJD", **kw):
+        super().__init__(name, units=units, **kw)
 
     def _parse_value(self, tok):
         from pint_tpu.time.mjd import parse_mjd_string

@@ -48,6 +48,20 @@ class Spindown(PhaseComponent):
         if self.F0.value is None:
             raise ValueError("Spindown requires F0")
 
+    def param_dimensions(self):
+        from pint_tpu.models.parameter import split_prefixed_name
+        from pint_tpu.units import parse_unit
+
+        def f_dim(name):
+            if name in ("F0", "F1"):
+                i = int(name[1])
+            else:
+                _, _, i = split_prefixed_name(name)
+            return parse_unit("Hz") / parse_unit("s") ** i
+
+        return {"F*": f_dim, "F0": f_dim, "F1": f_dim,
+                "PEPOCH": parse_unit("d")}
+
     def f_terms(self):
         """Ordered [F0, F1, F2, ...] parameter names present."""
         out = ["F0"]

@@ -119,6 +119,15 @@ class Component:
     def validate(self):
         """Raise on missing/contradictory parameters."""
 
+    def param_dimensions(self) -> dict:
+        """{param name or 'PREFIX*': units.Unit or callable(name) ->
+        Unit} — the expected DIMENSION of each parameter slot, checked
+        against the declared ``units`` strings at model build time
+        (pint_tpu.units.check_model_units). Empty dict = unchecked
+        (incremental adoption). Keys ending in '*' match the numeric
+        prefix family."""
+        return {}
+
     def prepare(self, toas, batch, cache: dict, prefix: str = ""):
         """Host precompute into `cache` (masks etc.) for this batch.
         Keys must be namespaced `f"{prefix}{self.__class__.__name__}_*"`
@@ -789,6 +798,11 @@ class TimingModel:
     def validate(self):
         for c in self.components.values():
             c.validate()
+        # build-time unit discipline: every declared parameter unit
+        # must carry the dimension its component slot requires
+        from pint_tpu.units import check_model_units
+
+        check_model_units(self)
 
     def get_or_create_component(self, name: str):
         """components[name], constructing and attaching it from the

@@ -59,6 +59,16 @@ class ToaBatch(NamedTuple):
     obs_planet_pos: jnp.ndarray  # (P,N,3) observatory→planet, lt-s
     pulse_number: jnp.ndarray   # (N,) f64, NaN where untracked
 
+    # unit metadata per leaf (pint_tpu.units strings) — the batch half
+    # of the build-time unit discipline; component authors consult this
+    # the way parameter slots consult Component.param_dimensions
+    UNITS = {
+        "tdb_day": "d", "tdb_frac": "d", "freq_mhz": "MHz",
+        "error_us": "us", "ssb_obs_pos": "ls", "ssb_obs_vel": "ls/s",
+        "obs_sun_pos": "ls", "obs_planet_pos": "ls",
+        "pulse_number": "turn",
+    }
+
     @property
     def ntoas(self):
         return self.freq_mhz.shape[0]

@@ -1,0 +1,182 @@
+"""BT_piecewise binary and SWM=1 solar wind (the round-3 verdict's
+"tail of the tail"; reference: src/pint/models/binary_bt.py
+BinaryBTPiecewise / BT_piecewise.py, solar_wind_dispersion.py SWM 1).
+Strategy per SURVEY.md §4.2: limit/equivalence cross-checks plus
+jacfwd-vs-finite-difference for the new fittable parameters."""
+
+import copy
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+
+def _mk(par: str):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return get_model(io.StringIO(par))
+
+
+def _toas(model, n=120, seed=0, start=54100, end=55900):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return make_fake_toas_uniform(
+            start, end, n, model, error_us=1.0,
+            rng=np.random.default_rng(seed))
+
+
+BASE = """PSR J1012+5307
+RAJ 10:12:33.43
+DECJ 53:07:02.5
+F0 310.0 1
+F1 -5e-16
+PEPOCH 55000
+POSEPOCH 55000
+DM 9.0
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+BT_ORBIT = """PB 1.2
+A1 3.5
+T0 55000.2
+ECC 0.01
+OM 40.0
+"""
+
+
+class TestBTPiecewise:
+    def test_parses_and_windows_apply(self):
+        par = (BASE + "BINARY BT_piecewise\n" + BT_ORBIT
+               + "T0X_0001 55000.2002 1\nA1X_0001 3.5004 1\n"
+               + "XR1_0001 54800\nXR2_0001 55200\n")
+        m = _mk(par)
+        assert "BinaryBTPiecewise" in m.components
+        toas = _toas(m)
+        d_pw = np.asarray(m.delay(toas))
+        # plain-BT twins for each side of the window
+        m_out = _mk(BASE + "BINARY BT\n" + BT_ORBIT)
+        m_in = _mk(BASE + "BINARY BT\n" + BT_ORBIT.replace(
+            "T0 55000.2", "T0 55000.2002").replace("A1 3.5", "A1 3.5004"))
+        d_out = np.asarray(m_out.delay(toas))
+        d_in = np.asarray(m_in.delay(toas))
+        batch = m.get_cache(toas)["batch"]
+        mjd = np.asarray(batch.tdb_day) + np.asarray(batch.tdb_frac.hi)
+        inside = (mjd >= 54800) & (mjd < 55200)
+        assert inside.any() and (~inside).any()
+        np.testing.assert_allclose(d_pw[inside], d_in[inside],
+                                   rtol=0, atol=1e-10)
+        np.testing.assert_allclose(d_pw[~inside], d_out[~inside],
+                                   rtol=0, atol=1e-10)
+
+    def test_jacfwd_vs_finite_difference(self):
+        par = (BASE + "BINARY BT_piecewise\n" + BT_ORBIT
+               + "T0X_0001 55000.2002 1\nA1X_0001 3.5004 1\n"
+               + "XR1_0001 54800\nXR2_0001 55200\n")
+        m = _mk(par)
+        toas = _toas(m)
+        M, names, _ = m.designmatrix(toas, incoffset=False)
+        M = np.asarray(M)
+        for pname, step in (("T0X_0001", 2e-6), ("A1X_0001", 1e-5)):
+            j = names.index(pname)
+            mp = copy.deepcopy(m)
+            mp.get_param(pname).add_delta(step)
+            mp.invalidate_cache(params_only=True)
+            mm = copy.deepcopy(m)
+            mm.get_param(pname).add_delta(-step)
+            mm.invalidate_cache(params_only=True)
+            rp = np.asarray(Residuals(toas, mp,
+                                      subtract_mean=False).time_resids)
+            rm = np.asarray(Residuals(toas, mm,
+                                      subtract_mean=False).time_resids)
+            fd = (rp - rm) / (2 * step)
+            scale = np.max(np.abs(fd)) + 1e-30
+            np.testing.assert_allclose(M[:, j] / scale, fd / scale,
+                                       atol=5e-3, err_msg=pname)
+            # outside the window the piece parameter only enters via
+            # the (in-window) TZR phase anchor: the column is a
+            # constant there, with real time dependence only inside
+            batch = m.get_cache(toas)["batch"]
+            mjd = np.asarray(batch.tdb_day) + \
+                np.asarray(batch.tdb_frac.hi)
+            outside = ~((mjd >= 54800) & (mjd < 55200))
+            assert np.ptp(M[outside, j]) / scale < 1e-9
+            assert np.ptp(M[~outside, j]) / scale > 1e-3
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="XR1_/XR2_"):
+            _mk(BASE + "BINARY BT_piecewise\n" + BT_ORBIT
+                + "T0X_0001 55000.2002 1\n")
+        with pytest.raises(ValueError, match="overlap"):
+            _mk(BASE + "BINARY BT_piecewise\n" + BT_ORBIT
+                + "T0X_0001 55000.2002\nXR1_0001 54800\nXR2_0001 55200\n"
+                + "T0X_0002 55000.2001\nXR1_0002 55100\nXR2_0002 55400\n")
+
+
+SW_BASE = BASE.replace("DM 9.0", "DM 9.0\nNE_SW 8.0 1")
+
+
+class TestSolarWindSWM1:
+    def test_swp2_matches_swm0(self):
+        """n_e ~ r^-2 is the SWM-0 closed form: the SWM-1 quadrature
+        must reproduce it to quadrature accuracy."""
+        m0 = _mk(SW_BASE + "SWM 0\n")
+        m1 = _mk(SW_BASE + "SWM 1\nSWP 2.0\n")
+        toas = _toas(m0, n=200)
+        d0 = np.asarray(m0.delay(toas))
+        d1 = np.asarray(m1.delay(toas))
+        np.testing.assert_allclose(d1, d0, rtol=1e-9, atol=1e-13)
+
+    def test_steeper_profile_falls_faster(self):
+        """Away from conjunction, a steeper density profile (larger
+        SWP) gives less DM at 1 AU-scale impact parameters... with the
+        1 AU normalization the p-dependence is monotone in the
+        geometry; just check order and positivity."""
+        m1 = _mk(SW_BASE + "SWM 1\nSWP 2.0\n")
+        m2 = _mk(SW_BASE + "SWM 1\nSWP 2.6\n")
+        m_off = _mk(SW_BASE.replace("NE_SW 8.0 1", "NE_SW 0.0")
+                    + "SWM 0\n")
+        toas = _toas(m1, n=100)
+        base = np.asarray(m_off.delay(toas))
+        d1 = np.asarray(m1.delay(toas)) - base
+        d2 = np.asarray(m2.delay(toas)) - base
+        assert np.all(d1 > 0) and np.all(d2 > 0)
+        # both carry the conjunction spike at the same epoch
+        assert abs(int(np.argmax(d1)) - int(np.argmax(d2))) <= 1
+
+    def test_jacfwd_vs_finite_difference_ne_sw_swp(self):
+        par = SW_BASE.replace("NE_SW 8.0 1", "NE_SW 8.0 1") \
+            + "SWM 1\nSWP 2.3 1\n"
+        m = _mk(par)
+        toas = _toas(m, n=100)
+        M, names, _ = m.designmatrix(toas, incoffset=False)
+        M = np.asarray(M)
+        for pname, step in (("NE_SW", 1e-3), ("SWP", 1e-4)):
+            j = names.index(pname)
+            mp = copy.deepcopy(m)
+            mp.get_param(pname).add_delta(step)
+            mp.invalidate_cache(params_only=True)
+            mm = copy.deepcopy(m)
+            mm.get_param(pname).add_delta(-step)
+            mm.invalidate_cache(params_only=True)
+            rp = np.asarray(Residuals(toas, mp,
+                                      subtract_mean=False).time_resids)
+            rm = np.asarray(Residuals(toas, mm,
+                                      subtract_mean=False).time_resids)
+            fd = (rp - rm) / (2 * step)
+            scale = np.max(np.abs(fd)) + 1e-30
+            np.testing.assert_allclose(M[:, j] / scale, fd / scale,
+                                       atol=5e-3, err_msg=pname)
+
+    def test_swm1_validation(self):
+        with pytest.raises(ValueError, match="SWP"):
+            _mk(SW_BASE + "SWM 1\nSWP 0.5\n")
+        with pytest.raises(NotImplementedError):
+            _mk(SW_BASE + "SWM 2\n")

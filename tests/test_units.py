@@ -1,0 +1,119 @@
+"""Build-time unit discipline (SURVEY §5 last open row): parameter
+unit strings are checked against per-component dimension specs at
+model-build time — a component wired with wrong units fails before
+anything is traced, with a clear error."""
+
+import io
+import warnings
+
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.models.spindown import Spindown
+from pint_tpu.models.timing_model import TimingModel
+from pint_tpu.units import (
+    DIMENSIONLESS,
+    UnitError,
+    check_model_units,
+    parse_unit,
+)
+
+
+class TestUnitAlgebra:
+    @pytest.mark.parametrize("a,b", [
+        ("s", "sec"), ("d", "MJD"), ("Hz", "1/s"),
+        ("pc cm^-3 / yr^2", "pc cm-3 yr^-2"),
+        ("mas/yr", "rad / s"),      # same dimension, different scale
+        ("ls/s", ""),               # lt-s is time-valued: T/T = 1
+        ("Hz/s^2", "s^-3"),
+    ])
+    def test_equivalent_dimensions(self, a, b):
+        assert parse_unit(a) == parse_unit(b)
+
+    @pytest.mark.parametrize("a,b", [
+        ("s", "Hz"), ("d", "deg"), ("pc cm^-3", "pc"),
+        ("Hz/s", "Hz/s^2"), ("Msun", "s"),
+    ])
+    def test_distinct_dimensions(self, a, b):
+        assert parse_unit(a) != parse_unit(b)
+
+    def test_dimensionless_forms(self):
+        for t in (None, "", "1", "s/s"):
+            assert parse_unit(t) == DIMENSIONLESS
+
+    def test_unknown_atom_raises(self):
+        with pytest.raises(UnitError, match="unknown unit atom"):
+            parse_unit("furlong/fortnight")
+
+
+class TestModelUnitCheck:
+    def test_wrong_units_component_fails_at_build(self):
+        """The 'Done' criterion: a deliberately-wrong-units component
+        fails at build time with a clear error."""
+
+        class BadSpindown(Spindown):
+            register = False
+
+            def __init__(self):
+                super().__init__()
+                # F1 in Hz (should be Hz/s): the classic ladder slip
+                self.params["F1"].units = "Hz"
+
+        comp = BadSpindown()
+        comp.F0.value = 100.0
+        comp.params["F1"].value = -1e-15
+        m = TimingModel([comp])
+        comp.params["PEPOCH"].value = 55000.0
+        with pytest.raises(UnitError, match="F1.*requires"):
+            m.validate()
+
+    def test_epoch_in_wrong_units_fails(self):
+        class BadEpoch(Spindown):
+            register = False
+
+            def __init__(self):
+                super().__init__()
+                self.params["PEPOCH"].units = "yr^2"
+
+        comp = BadEpoch()
+        comp.F0.value = 100.0
+        comp.params["PEPOCH"].value = 55000.0
+        m = TimingModel([comp])
+        with pytest.raises(UnitError, match="PEPOCH"):
+            m.validate()
+
+    def test_real_models_pass(self):
+        """Every registered family used together validates — the spec
+        and the actual parameter declarations agree."""
+        par = """PSR J0
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+PMRA 2.0 1
+PMDEC -3.0 1
+PX 1.2 1
+F0 300.1 1
+F1 -1e-15 1
+F2 1e-26 1
+DM 20.0 1
+DM1 1e-4 1
+DMX_0001 0.0 1
+DMXR1_0001 53000
+DMXR2_0001 57000
+PEPOCH 55000
+POSEPOCH 55000
+DMEPOCH 55000
+UNITS TDB
+BINARY BT_piecewise
+PB 1.2
+A1 3.5
+T0 55000.2
+ECC 0.01
+OM 40.0
+T0X_0001 55000.2002 1
+XR1_0001 54800
+XR2_0001 55200
+"""
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(io.StringIO(par))
+        check_model_units(m)  # idempotent re-check
