@@ -161,7 +161,12 @@ class PulsarBinary(DelayComponent):
 
     # -- orbit machinery ----------------------------------------------
 
-    def _dt(self, pv, batch, delay_so_far):
+    def _epoch(self, pv, batch, cache):
+        """Orbital epoch as DD [MJD] — scalar, or per-TOA for
+        piecewise variants (BinaryBTPiecewise overrides)."""
+        return pv[self.epoch_param]
+
+    def _dt(self, pv, batch, cache, delay_so_far):
         """Barycentric seconds since the orbital epoch, as DD. Kept in
         dd through the mean-anomaly computation: collapsing to a single
         float first loses the orbit count's low bits (fatal in the f32
@@ -170,7 +175,7 @@ class PulsarBinary(DelayComponent):
         ref = self._parent.ref_day
         tb = dd_mul_f(dd_add_f(batch.tdb_frac, batch.tdb_day - ref),
                       SECS_PER_DAY)
-        epoch = pv[self.epoch_param]
+        epoch = self._epoch(pv, batch, cache)
         eref = dd_mul_f(dd_add_f(dd_sub_f(epoch, ref), 0.0), SECS_PER_DAY)
         return dd_sub_f(dd_sub(tb, eref), delay_so_far)
 
@@ -210,7 +215,7 @@ class PulsarBinary(DelayComponent):
         return self._mean_anomaly(dt_dd, pb_s, _v(pv, "PBDOT"))
 
     def delay(self, pv, batch, cache, ctx, delay_so_far):
-        dt_dd = self._dt(pv, batch, delay_so_far)
+        dt_dd = self._dt(pv, batch, cache, delay_so_far)
         M, nhat = self._orbit(pv, dt_dd)
         return self.binary_delay(pv, dd_to_f64(dt_dd), M, nhat, ctx)
 
@@ -700,11 +705,17 @@ class BinaryBTPiecewise(BinaryBT):
         self.piece_ids: List[int] = []
 
     def add_piece_param(self, kind: str, index: int, index_str=None):
-        units = {"T0X_": "MJD", "A1X_": "ls",
-                 "XR1_": "MJD", "XR2_": "MJD"}[kind]
-        p = prefixParameter(prefix=kind, index=index,
-                            index_str=index_str or f"{index:04d}",
-                            units=units)
+        name = f"{kind}{index_str or f'{index:04d}'}"
+        if kind == "T0X_":
+            # epochs keep the exact day/frac dd split a plain float
+            # parse would round away (~0.3 us at MJD magnitudes)
+            p = MJDParameter(name)
+        else:
+            units = {"A1X_": "ls", "XR1_": "MJD", "XR2_": "MJD"}[kind]
+            p = prefixParameter(prefix=kind, index=index,
+                                index_str=index_str or f"{index:04d}",
+                                units=units)
+        p.prefix, p.index = kind, index
         self.add_param(p)
         self.setup()
         return p
@@ -759,35 +770,37 @@ class BinaryBTPiecewise(BinaryBT):
             cache[f"btx_mask_{i}"] = (
                 (mjd >= r1) & (mjd < r2)).astype(np.float64)
 
-    def delay(self, pv, batch, cache, ctx, delay_so_far):
-        ref = self._parent.ref_day
-        tb = dd_mul_f(dd_add_f(batch.tdb_frac, batch.tdb_day - ref),
-                      SECS_PER_DAY)
+    def _epoch(self, pv, batch, cache):
+        """Per-TOA orbital epoch: global T0 with T0X_i applied inside
+        each window via a dd_where chain (epochs stay dd pairs per
+        TOA — required for the f32 Jacobian path too)."""
         shape = batch.tdb_day.shape
         t0 = pv["T0"]
         epoch = DD(jnp.broadcast_to(t0.hi, shape),
                    jnp.broadcast_to(t0.lo, shape))
-        a1_shift = jnp.zeros_like(batch.freq_mhz)
         for i in self.piece_ids:
-            nm = self._piece_names[i]
-            mask = jnp.asarray(cache[f"btx_mask_{i}"])
-            inside = mask > 0
-            t0n = nm.get("T0X_")
+            t0n = self._piece_names[i].get("T0X_")
             if t0n is not None and t0n in pv:
+                inside = jnp.asarray(cache[f"btx_mask_{i}"]) > 0
                 px = pv[t0n]
                 epoch = dd_where(
                     inside,
                     DD(jnp.broadcast_to(px.hi, shape),
                        jnp.broadcast_to(px.lo, shape)), epoch)
-            a1n = nm.get("A1X_")
+        return epoch
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        # the A1 swap rides ctx into the _x_adjust hook; the epoch
+        # swap rides the _epoch hook inside the shared _dt
+        a1_shift = jnp.zeros_like(batch.freq_mhz)
+        for i in self.piece_ids:
+            a1n = self._piece_names[i].get("A1X_")
             if a1n is not None and a1n in pv:
+                inside = jnp.asarray(cache[f"btx_mask_{i}"]) > 0
                 a1_shift = jnp.where(
                     inside, _v(pv, a1n) - _v(pv, "A1"), a1_shift)
         ctx["btx_a1_shift"] = a1_shift
-        eref = dd_mul_f(dd_sub_f(epoch, ref), SECS_PER_DAY)
-        dt_dd = dd_sub_f(dd_sub(tb, eref), delay_so_far)
-        M, nhat = self._orbit(pv, dt_dd)
-        return self.binary_delay(pv, dd_to_f64(dt_dd), M, nhat, ctx)
+        return super().delay(pv, batch, cache, ctx, delay_so_far)
 
     def _x_adjust(self, x, ctx):
         return x + ctx.pop("btx_a1_shift", 0.0)

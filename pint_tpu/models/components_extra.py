@@ -443,7 +443,10 @@ class SolarWindDispersion(DelayComponent):
             p = _val(pv, "SWP")
             b_m = r_m * sinr
             F = self._cosq_integral(rho - jnp.pi / 2.0, p - 2.0)
-            return ne * AU_M ** p * b_m ** (1.0 - p) / PC_M * F
+            # (AU/b)^p * b / pc keeps every intermediate O(1): the
+            # naive AU^p overflows f32 range for SWP >= ~3.45 in the
+            # f32 Jacobian re-trace
+            return ne * (AU_M / b_m) ** p * (b_m / PC_M) * F
         # SWM 0: n_e = NE_SW (AU/r)^2 closed form
         # DM in pc/cm^3: NE_SW [cm^-3] * AU^2[m^2]/pc[m] * geom [1/m]
         return ne * (AU_M * AU_M / PC_M) * (jnp.pi - rho) / (r_m * sinr)

@@ -45,7 +45,14 @@ from pint_tpu.models.parameter import (
     maskParameter,
     strParameter,
 )
-from pint_tpu.ops.dd import DD, dd_add, dd_mul_f, dd_sub_f, dd_to_f64
+from pint_tpu.ops.dd import (
+    DD,
+    dd_add,
+    dd_frac,
+    dd_mul_f,
+    dd_sub_f,
+    dd_to_f64,
+)
 from pint_tpu.phase import Phase
 
 SECS_PER_DAY = 86400.0
@@ -386,8 +393,10 @@ class TimingModel:
         self._ref_day = day if day is not None else 55000.0
         return self._ref_day
 
-    def _raw_phase_fn(self, pv, batch, cache, sub: str):
-        """The shared delay→phase chain (device, pure)."""
+    def _delay_tb(self, pv, batch, cache, sub: str):
+        """The shared delay chain + delay-subtracted barycentric time
+        (device, pure): the single implementation both the direct dd
+        phase and the anchored delta-phase build on."""
         ctx: dict = {}
         delay = jnp.zeros_like(batch.freq_mhz)
         for comp in self.delay_components:
@@ -395,6 +404,11 @@ class TimingModel:
         tb = dd_mul_f(dd_addf_day(batch, self.ref_day), SECS_PER_DAY)
         tb = dd_sub_f(tb, delay)
         ctx["tb"] = tb
+        return delay, tb, ctx
+
+    def _raw_phase_fn(self, pv, batch, cache, sub: str):
+        """The full delay→phase chain (device, pure), absolute dd."""
+        delay, tb, ctx = self._delay_tb(pv, batch, cache, sub)
         phase = DD(jnp.zeros_like(delay), jnp.zeros_like(delay))
         for comp in self.phase_components:
             phase = dd_add_dd(phase, comp.phase(pv, batch, cache[sub],
@@ -419,6 +433,185 @@ class TimingModel:
             return phase, delay
 
         return phase_fn, (free_names, frozen_names)
+
+    # -------- anchored delta-phase (the TPU-safe fit-step engine) -----
+    #
+    # The direct chain above tracks the ABSOLUTE pulse phase (~1e10
+    # turns) in dd — exact on CPU (IEEE f64 EFTs), but on TPU the
+    # emulated f64 is not correctly rounded (~2^-48 effective), leaving
+    # a ~3e-5-turn (~100 ns) error floor through the final
+    # large-cancellation. The anchored form removes every large
+    # intermediate: the host computes the exact reference phase/delays
+    # ONCE (CPU backend), and the device evaluates only the difference
+    #   Delta = taylor(x, F - F_ref)                      [<= turns]
+    #         + sum_i F_ref,i (x^{i+1} - y^{i+1})/(i+1)!  [powdiff,
+    #           applied via the factored small difference d_ref - d]
+    #         + (phi_other(theta) - phi_other(theta_ref)) [small]
+    # so 2^-48 working precision yields <=1e-9-turn residual accuracy
+    # on any backend. See ops/taylor.taylor_powdiff and
+    # ARCHITECTURE.md "Anchored delta-phase".
+
+    def _phase_pieces(self, pv, batch, cache, sub: str, skip=()):
+        """(delay, tb_dd, other_phase_f64): the delay chain, the
+        delay-subtracted barycentric time, and the summed phase of all
+        PhaseComponents except those in ``skip`` (class names)."""
+        delay, tb, ctx = self._delay_tb(pv, batch, cache, sub)
+        other = jnp.zeros_like(delay)
+        for comp in self.phase_components:
+            if type(comp).__name__ in skip:
+                continue
+            p = comp.phase(pv, batch, cache[sub], ctx, tb)
+            other = other + (p.hi + p.lo)
+        return delay, tb, other
+
+    def supports_anchored(self) -> bool:
+        spin = self.components.get("Spindown")
+        return spin is not None and "PEPOCH" not in self.free_params \
+            and spin.PEPOCH.value is not None
+
+    def build_anchor(self, toas) -> dict:
+        """Host-side anchor constants (exact dd on the CPU backend):
+        reference frac-phase, reference delays (main + TZR rows),
+        reference non-spindown phase sums, reference F coefficients,
+        and scaling. Arrays are numpy; rebuilt by build_fit_step
+        whenever the step is rebuilt."""
+        if not self.supports_anchored():
+            raise ValueError("anchored step needs Spindown with a "
+                             "frozen PEPOCH")
+        free, frozen, th0, tl0, fh0, fl0 = self._pack()
+        cache = self.get_cache(toas)
+        spin = self.components["Spindown"]
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            batch = jax.device_put(cache["batch"], cpu)
+            sc = jax.device_put(_strip(cache), cpu)
+            phase_fn, _ = self._build_phase_fn()
+            ph, _ = jax.jit(phase_fn)(
+                jnp.asarray(th0), jnp.asarray(tl0), jnp.asarray(fh0),
+                jnp.asarray(fl0), batch, sc)
+            fr = dd_frac(ph)
+            r_ref = np.asarray(fr.hi, np.float64) + \
+                np.asarray(fr.lo, np.float64)
+            pv0 = {nm: DD(jnp.asarray(th0[i]), jnp.asarray(tl0[i]))
+                   for i, nm in enumerate(free)}
+            pv0.update({nm: DD(jnp.asarray(fh0[j]), jnp.asarray(fl0[j]))
+                        for j, nm in enumerate(frozen)})
+            d_ref, _, oth_ref = jax.jit(
+                lambda b, c: self._phase_pieces(
+                    pv0, b, c, "main", skip=("Spindown",)))(batch, sc)
+            anc = {"r_ref": r_ref,
+                   "d_ref": np.asarray(d_ref, np.float64),
+                   "oth_ref": np.asarray(oth_ref, np.float64)}
+            if "tzr_batch" in sc:
+                d_t, _, o_t = jax.jit(
+                    lambda b, c: self._phase_pieces(
+                        pv0, b, c, "tzr", skip=("Spindown",)))(
+                    sc["tzr_batch"], sc)
+                anc["d_ref_tzr"] = np.asarray(d_t, np.float64)
+                anc["oth_ref_tzr"] = np.asarray(o_t, np.float64)
+        # spindown reference coefficients and time scaling (host)
+        fnames = spin.f_terms()
+        name_to_val = {}
+        for i, nm in enumerate(free):
+            name_to_val[nm] = th0[i] + tl0[i]
+        for j, nm in enumerate(frozen):
+            name_to_val[nm] = fh0[j] + fl0[j]
+        anc_static = {
+            "fnames": fnames,
+            "fref": [float(name_to_val[nm]) for nm in fnames],
+            "fidx": [free.index(nm) if nm in free else None
+                     for nm in fnames],
+            "pepoch_shift": (float(spin.PEPOCH.value) - self.ref_day)
+            * SECS_PER_DAY,
+        }
+        mjd = np.asarray(cache["batch"].tdb_day) + \
+            np.asarray(cache["batch"].tdb_frac.hi)
+        anc_static["t_scale"] = max(
+            float(np.max(np.abs((mjd - self.ref_day) * SECS_PER_DAY
+                                - anc_static["pepoch_shift"]))), 1.0) \
+            * 1.05
+        return anc, anc_static
+
+    def _build_anchored_fn(self, anc_static):
+        """fn(dth, dtl, fh, fl, batch, cache) -> (frac_resid, delay).
+
+        (dth, dtl) is the HOST-COMPUTED exact delta theta - theta_ref
+        for the FREE params (on-device subtraction of near-equal
+        values is exactly what TPU's non-IEEE f64 cannot be trusted
+        with); (fh, fl) are the FULL frozen-param pairs, normally the
+        build-time values but honored if a caller substitutes others
+        (grid_chisq varies frozen params through these slots — their
+        deltas are formed on device, acceptable because grid steps
+        dwarf the subtraction error). batch/cache may be f64 or the
+        f32/dd32 conversions (dtype follows dth); cache["anchor"]
+        holds build_anchor's array constants."""
+        from pint_tpu.ops.dd import dd_add, dd_sub, dd_to_dd32
+        from pint_tpu.ops.taylor import taylor_horner, taylor_powdiff
+
+        free, frozen, th0, tl0, fh0, fl0 = self._pack()
+        ref64 = (th0, tl0, fh0, fl0)
+        r32 = dd_to_dd32(DD(np.asarray(th0), np.asarray(tl0)))
+        f32r = dd_to_dd32(DD(np.asarray(fh0), np.asarray(fl0)))
+        ref32 = (np.asarray(r32.hi), np.asarray(r32.lo),
+                 np.asarray(f32r.hi), np.asarray(f32r.lo))
+        fnames = anc_static["fnames"]
+        fref = anc_static["fref"]
+        fidx = anc_static["fidx"]
+        # frozen-slot index of each F term (for grid-varied frozen Fs)
+        fjdx = [frozen.index(nm) if nm in frozen else None
+                for nm in fnames]
+        pep = anc_static["pepoch_shift"]
+        t_scale = anc_static["t_scale"]
+        ref_day = self.ref_day
+
+        def fn(dth, dtl, fh, fl, batch, cache):
+            f32 = dth.dtype == jnp.float32
+            rh, rl, qh, ql = [jnp.asarray(a) for a in
+                              (ref32 if f32 else ref64)]
+            delta = dth + dtl
+            pv = {}
+            for i, nm in enumerate(free):
+                pv[nm] = dd_add(DD(rh[i], rl[i]), DD(dth[i], dtl[i]))
+            for j, nm in enumerate(frozen):
+                pv[nm] = DD(fh[j], fl[j])
+            # frozen deltas vs the anchor (zero unless a caller
+            # substituted grid values through fh/fl)
+            fdelta = dd_to_f64(dd_sub(DD(fh, fl), DD(qh, ql)))
+            anc = cache["anchor"]
+
+            def delta_phase(batch_x, sub, d_ref, oth_ref):
+                d, tb, oth = self._phase_pieces(
+                    pv, batch_x, cache, sub, skip=("Spindown",))
+                # x = seconds since PEPOCH at the CURRENT delay
+                t_rel = (batch_x.tdb_day - ref_day) * SECS_PER_DAY \
+                    + (batch_x.tdb_frac.hi + batch_x.tdb_frac.lo) \
+                    * SECS_PER_DAY
+                x = t_rel - d - pep
+                dxy = d_ref - d      # small: cancellation of ~500 s
+                a_coeffs = [jnp.zeros((), x.dtype)]
+                for k, nm in enumerate(fnames):
+                    if fidx[k] is not None:
+                        a_coeffs.append(delta[fidx[k]])
+                    elif fjdx[k] is not None:
+                        a_coeffs.append(fdelta[fjdx[k]])
+                    else:
+                        a_coeffs.append(jnp.zeros((), x.dtype))
+                A = taylor_horner(x, a_coeffs)
+                B = taylor_powdiff(x, dxy, [0.0] + fref,
+                                   t_scale=t_scale)
+                return A + B + (oth - oth_ref), d
+
+            dphi, d_main = delta_phase(batch, "main", anc["d_ref"],
+                                       anc["oth_ref"])
+            if "tzr_batch" in cache:
+                dphi_t, _ = delta_phase(cache["tzr_batch"], "tzr",
+                                        anc["d_ref_tzr"],
+                                        anc["oth_ref_tzr"])
+                dphi = dphi - dphi_t[0]
+            v = anc["r_ref"] + dphi
+            return v - jnp.round(v), d_main
+
+        return fn
 
     def _get_compiled(self):
         # The key must cover everything baked into the trace: the

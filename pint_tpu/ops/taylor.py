@@ -49,6 +49,47 @@ def taylor_horner_deriv(dt, coeffs: Sequence, deriv_order: int = 1):
     return acc
 
 
+def taylor_powdiff(x, dxy, coeffs: Sequence, t_scale: float = 1.0):
+    """Σ_i coeffs[i] · (x^i − y^i)/i!  with  y = x − dxy, computed via
+    the exact factorization  x^i − y^i = dxy · Σ_k x^k y^{i−1−k}  so
+    the small difference dxy is APPLIED, never recovered by
+    subtracting two large powers. This is the anchored delta-phase
+    engine: x ~ 1e8 s and the result ~ F·dxy ≤ O(1) turns, yet no
+    intermediate carries the ~1e10-turn absolute phase — every term is
+    accurate at plain working precision (TPU's emulated f64 included).
+
+    ``t_scale`` normalizes the power sums (Σ (x/T)^k (y/T)^{i-1-k},
+    with T^{i-1} folded into the coefficient) so the f32 Jacobian
+    path can trace this without overflowing f32 range at high i.
+    """
+    coeffs = [float(c) for c in coeffs]  # host constants by design:
+    # the anchored reference coefficients are fixed at build time, so
+    # each c·T^{i-1}/i! is folded in exact host f64 (T^{i-1} would
+    # overflow f32 if traced)
+    x = jnp.asarray(x)
+    if x.dtype not in (jnp.float32, jnp.float64):
+        x = x.astype(jnp.float64)
+    T = float(t_scale) if t_scale else 1.0
+    xs = x / T
+    ys = xs - dxy / T
+    n = len(coeffs)
+    xpow = [jnp.ones_like(xs)]      # xs^0 .. xs^{n-2}
+    for _ in range(max(0, n - 2)):
+        xpow.append(xpow[-1] * xs)
+    total = jnp.zeros_like(x)
+    for i in range(1, n):
+        if coeffs[i] == 0.0:
+            continue
+        acc = jnp.zeros_like(x)
+        for k in range(i):  # ascending: xs^k added at step k is then
+            # multiplied by ys for the remaining i-1-k steps
+            acc = acc * ys + xpow[k]
+        # acc = Σ_{k=0..i-1} xs^k ys^{i-1-k}
+        total = total + (coeffs[i] * T ** (i - 1)
+                         / math.factorial(i)) * acc
+    return dxy * total
+
+
 def dd_taylor_horner(dt: DD, coeffs: Sequence) -> DD:
     """Sum_i coeffs[i] * dt^i / i! with a double-double accumulator.
 

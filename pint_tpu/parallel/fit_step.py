@@ -44,9 +44,9 @@ def _resolve_f32(flag: Optional[bool], env_name: str) -> bool:
     if flag is not None:
         return bool(flag)
     env = os.environ.get(env_name, "").lower()
-    if env in ("f32", "float32"):
+    if env in ("f32", "float32", "on", "true", "1"):
         return True
-    if env in ("f64", "float64"):
+    if env in ("f64", "float64", "off", "false", "0"):
         return False
     return jax.default_backend() == "tpu"
 
@@ -56,6 +56,16 @@ def _use_f32_matmul(flag: Optional[bool]) -> bool:
     equilibrated normal equations only need ~1e-7 relative accuracy,
     which HIGHEST-precision f32 MXU passes deliver."""
     return _resolve_f32(flag, "PINT_TPU_GLS_MATMUL")
+
+
+def _use_anchored(flag: Optional[bool]) -> bool:
+    """Anchored delta-phase evaluation ($PINT_TPU_ANCHORED): the host
+    computes the exact reference phase once and the device evaluates
+    only the small difference — no ~1e10-turn intermediate survives,
+    so TPU's non-IEEE emulated f64 (~2^-48, which breaks the dd EFTs
+    and leaves a ~100 ns error floor through the absolute-phase
+    cancellation) delivers full residual accuracy. Auto-on on TPU."""
+    return _resolve_f32(flag, "PINT_TPU_ANCHORED")
 
 
 def _use_f32_jac(flag: Optional[bool]) -> bool:
@@ -92,7 +102,8 @@ def _split32(hi, lo=None):
 
 def build_fit_step(model, toas, pad_to: Optional[int] = None,
                    matmul_f32: Optional[bool] = None,
-                   jac_f32: Optional[bool] = None):
+                   jac_f32: Optional[bool] = None,
+                   anchored: Optional[bool] = None):
     """(step_fn, args, names): step_fn is pure and jittable,
 
         step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid)
@@ -152,6 +163,19 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
                             math.floor(e_hi), 126))
                 scale_np[i] = 2.0 ** (-e)
 
+    # anchored delta-phase: host computes the exact reference once;
+    # the step's (th, tl) arguments then carry the HOST-COMPUTED exact
+    # delta theta - theta_ref (zeros in the returned args)
+    anchored_on = _use_anchored(anchored) and model.supports_anchored()
+    afn = None
+    f0_ref = 0.0
+    if anchored_on:
+        anc_arrays, anc_static = model.build_anchor(toas)
+        afn = model._build_anchored_fn(anc_static)
+        sc = {**sc, "anchor": {k: jnp.asarray(v)
+                               for k, v in anc_arrays.items()}}
+        f0_ref = anc_static["fref"][0]
+
     nvec_np = model.scaled_toa_uncertainty(toas) ** 2
     # ECORR rides the Sherman-Morrison segment path (one rank-1
     # downdate per observing epoch) instead of dense basis columns —
@@ -198,16 +222,26 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
 
     def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
                 eid, jvar):
-        def phase_f64(thx):
-            ph, _ = phase_fn(thx, tl, fh, fl, batch, cache)
-            # absolute-phase dd collapses to f64 AFTER the fractional
-            # part is extracted — sub-ns residual precision survives
-            f = dd_frac(ph)
-            return f.hi + f.lo
+        if anchored_on:
+            def phase_f64(thx):
+                fr, _ = afn(thx, tl, fh, fl, batch, cache)
+                return fr
+        else:
+            def phase_f64(thx):
+                ph, _ = phase_fn(thx, tl, fh, fl, batch, cache)
+                # absolute-phase dd collapses to f64 AFTER the
+                # fractional part is extracted — sub-ns residual
+                # precision survives
+                f = dd_frac(ph)
+                return f.hi + f.lo
 
         frac = phase_f64(th)
         i = f0_src[1]
-        f0 = (th[i] + tl[i]) if f0_src[0] == "free" else (fh[i] + fl[i])
+        if anchored_on and f0_src[0] == "free":
+            f0 = f0_ref + (th[i] + tl[i])  # th carries delta-theta
+        else:
+            f0 = (th[i] + tl[i]) if f0_src[0] == "free" \
+                else (fh[i] + fl[i])
         w = valid / nvec
         wmean = jnp.sum(frac * w) / jnp.sum(w)
         r = (frac - wmean) / f0
@@ -222,10 +256,16 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             ua, ub = _split32(th / s64, tl / s64)
             fa, fb = _split32(fh, fl)
 
-            def phase32(ua_):
-                ph, _ = phase_fn(ua_ * s32, ub * s32, fa, fb,
-                                 batch32, cache32)
-                return ph.hi + ph.lo
+            if anchored_on:
+                def phase32(ua_):
+                    fr, _ = afn(ua_ * s32, ub * s32, fa, fb,
+                                batch32, cache32)
+                    return fr
+            else:
+                def phase32(ua_):
+                    ph, _ = phase_fn(ua_ * s32, ub * s32, fa, fb,
+                                     batch32, cache32)
+                    return ph.hi + ph.lo
 
             f032 = f0.astype(jnp.float32)
             valid32 = valid.astype(jnp.float32)
@@ -246,6 +286,10 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             cov = cov * jnp.outer(sfull, sfull)
         return dp, cov, chi2, r_out
 
+    if anchored_on:
+        # the (th, tl) slots carry delta theta vs the anchor: zero at
+        # the reference point build_anchor just captured
+        th, tl = np.zeros_like(th), np.zeros_like(tl)
     args = (jnp.asarray(th), jnp.asarray(tl), jnp.asarray(fh),
             jnp.asarray(fl), batch, sc, jnp.asarray(F_np),
             jnp.asarray(phi_np), jnp.asarray(nvec_np),
@@ -396,15 +440,19 @@ def toa_sharding(mesh, axis: str = "toa"):
     return shard_leaf
 
 
-def build_sharded_fit_step(model, toas, mesh, axis: str = "toa"):
+def build_sharded_fit_step(model, toas, mesh, axis: str = "toa",
+                           **flags):
     """The same fit step, with all TOA-axis inputs block-sharded over
     ``mesh``'s ``axis``. Pads N to a mesh-divisible length with masked
-    rows. Returns (jitted_fn, device_args, names)."""
+    rows. Extra keyword flags (matmul_f32/jac_f32/anchored) pass
+    through to build_fit_step. Returns (jitted_fn, device_args,
+    names)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     nshard = mesh.shape[axis]
     pad_to = _pad_to(toas.ntoas, nshard)
-    step_fn, args, names = build_fit_step(model, toas, pad_to=pad_to)
+    step_fn, args, names = build_fit_step(model, toas, pad_to=pad_to,
+                                          **flags)
     th, tl, fh, fl, batch, sc, F, phi, nvec, valid, eid, jvar = args
 
     shard = toa_sharding(mesh, axis)

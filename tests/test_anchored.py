@@ -1,0 +1,163 @@
+"""Anchored delta-phase fit step (the TPU-safe phase engine): the host
+computes the exact reference once; the device evaluates only small
+differences via ops/taylor.taylor_powdiff, so no ~1e10-turn
+intermediate exists and 2^-48 working precision (TPU emulated f64)
+yields full residual accuracy. On CPU (IEEE f64) the anchored and
+direct-dd paths must agree to sub-ps residual level — that equality is
+the oracle here; the TPU benefit is by construction (magnitudes), not
+re-measurable on CPU."""
+
+import io
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.parallel import build_fit_step, build_sharded_fit_step
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+BASE = """PSR J0000+0000
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+F0 300.123456789 1
+F1 -1.0e-15 1
+DM 20.0 1
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+
+def _problem(extra="", n=400, seed=11):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BASE + extra))
+        rng = np.random.default_rng(seed)
+        mjds = np.sort(rng.uniform(53001, 56999, n))
+        toas = make_fake_toas_fromMJDs(
+            mjds, model, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 820.0], n // 2),
+            add_noise=True, rng=rng)
+        for i, f in enumerate(toas.flags):
+            f["be"] = "X" if i % 2 else "Y"  # JUMP -be Y hits only
+            # half the TOAs (a full-coverage jump is collinear with
+            # the Offset column — singular by construction)
+    return model, toas
+
+
+CASES = {
+    "isolated-f2": "F2 1e-26 1\nPMRA 2.0 1\nPMDEC -3 1\nPX 1.2 1\n",
+    "ecorr-red": ("EFAC -be X 1.1\nEQUAD -be X 0.3\nECORR -be X 1.2\n"
+                  "TNREDAMP -13.7\nTNREDGAM 3.5\nTNREDC 10\n"),
+    "ell1-short-pb": ("BINARY ELL1\nPB 0.38 1\nA1 1.42 1\n"
+                      "TASC 54999.93 1\nEPS1 1e-5 1\nEPS2 -2e-5 1\n"),
+    "glitch-wave-jump": ("GLEP_1 55200\nGLPH_1 0.2 1\nGLF0_1 1e-7 1\n"
+                         "WAVE_OM 0.005\nWAVE1 0.01 -0.02\n"
+                         "JUMP -be Y 1e-6 1\n"),
+}
+
+
+@pytest.mark.parametrize("extra", list(CASES.values()),
+                         ids=list(CASES.keys()))
+class TestAnchoredEqualsDirect:
+    def test_at_anchor(self, extra):
+        model, toas = _problem(extra)
+        sD, aD, _ = build_fit_step(model, toas, anchored=False,
+                                   jac_f32=False)
+        sA, aA, _ = build_fit_step(model, toas, anchored=True,
+                                   jac_f32=False)
+        oD = jax.jit(sD)(*aD)
+        oA = jax.jit(sA)(*aA)
+        rD, rA = np.asarray(oD[3]), np.asarray(oA[3])
+        assert np.max(np.abs(rD - rA)) < 1e-11  # 10 ps
+        assert abs(float(oD[2]) - float(oA[2])) < 1e-6 * abs(
+            float(oD[2])) + 1e-9
+        sig = np.sqrt(np.diag(np.asarray(oD[1])))
+        assert np.max(np.abs(np.asarray(oD[0]) - np.asarray(oA[0]))
+                      / sig) < 1e-4
+
+    def test_perturbed_compensated(self, extra):
+        """Nonzero delta: the anchored path receives the exact delta;
+        the direct path gets the same delta folded into its dd pair
+        with compensation. Sub-ps agreement required."""
+        model, toas = _problem(extra)
+        free = model.free_params
+        sD, aD, _ = build_fit_step(model, toas, anchored=False,
+                                   jac_f32=False)
+        sA, aA, _ = build_fit_step(model, toas, anchored=True,
+                                   jac_f32=False)
+        rng = np.random.default_rng(5)
+        # perturb every free param by ~1e-7 of a natural scale
+        dth = np.zeros(len(free))
+        dth[free.index("F0")] = 3e-10
+        dth[free.index("F1")] = -2e-18
+        dth[free.index("DM")] = 1e-5
+        th = np.asarray(aD[0])
+        tl = np.asarray(aD[1])
+        th2 = th + dth
+        tl2 = tl + (dth - (th2 - th))
+        oD = jax.jit(sD)(*((jnp.asarray(th2), jnp.asarray(tl2))
+                           + aD[2:]))
+        oA = jax.jit(sA)(*((jnp.asarray(dth),) + aA[1:]))
+        rD, rA = np.asarray(oD[3]), np.asarray(oA[3])
+        assert np.max(np.abs(rD - rA)) < 1e-11
+        assert abs(float(oD[2]) - float(oA[2])) < 1e-6 * abs(
+            float(oD[2])) + 1e-9
+
+
+def test_anchored_with_f32_jacobian():
+    """The production TPU configuration: anchored phase + f32 Jacobian
+    + f32 MXU matmuls vs the plain f64 direct step."""
+    model, toas = _problem(CASES["ell1-short-pb"] + "F2 1e-26 1\n")
+    sD, aD, _ = build_fit_step(model, toas, anchored=False,
+                               jac_f32=False, matmul_f32=False)
+    sA, aA, _ = build_fit_step(model, toas, anchored=True,
+                               jac_f32=True, matmul_f32=True)
+    oD = jax.jit(sD)(*aD)
+    oA = jax.jit(sA)(*aA)
+    sig = np.sqrt(np.diag(np.asarray(oD[1])))
+    assert np.max(np.abs(np.asarray(oD[0]) - np.asarray(oA[0]))
+                  / sig) < 1e-2
+    assert np.max(np.abs(np.asarray(oD[3]) - np.asarray(oA[3]))) < 1e-11
+
+
+def test_anchored_sharded_equals_unsharded():
+    from jax.sharding import Mesh
+
+    model, toas = _problem(CASES["ecorr-red"], n=200)
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-virtual-device conftest mesh")
+    mesh = Mesh(np.array(devs[:8]).reshape(8), ("toa",))
+    jitted, dev_args, _ = build_sharded_fit_step(
+        model, toas, mesh, anchored=True, jac_f32=True)
+    sA, aA, _ = build_fit_step(model, toas, anchored=True,
+                               jac_f32=True)
+    oS = jitted(*dev_args)
+    oU = jax.jit(sA)(*aA)
+    # f32 reductions reorder across shards: compare parameter steps
+    # against their uncertainties, not bitwise
+    sig = np.sqrt(np.diag(np.asarray(oU[1])))
+    assert np.max(np.abs(np.asarray(oS[0]) - np.asarray(oU[0]))
+                  / sig) < 1e-3
+    assert abs(float(oS[2]) - float(oU[2])) < 1e-5 * abs(float(oU[2]))
+
+
+def test_supports_anchored_gating():
+    model, toas = _problem()
+    assert model.supports_anchored()
+    model.get_param("PEPOCH").frozen = False
+    assert not model.supports_anchored()
+    model.get_param("PEPOCH").frozen = True
+    # anchored=True on an unsupported model silently falls back
+    model2, toas2 = _problem()
+    model2.get_param("PEPOCH").frozen = False
+    s, a, _ = build_fit_step(model2, toas2, anchored=True)
+    out = jax.jit(s)(*a)
+    assert np.isfinite(float(out[2]))
