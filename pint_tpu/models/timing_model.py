@@ -729,6 +729,22 @@ class TimingModel:
                 planets=bool(self.PLANET_SHAPIRO.value))
 
     # ---------------- public evaluation API ---------------------------
+    #
+    # These exact-dd entry points (the host-fitter surface: Residuals,
+    # designmatrix, phase) are pinned to the CPU backend whenever the
+    # process default is TPU: double-double error-free transforms are
+    # silently broken by TPU's non-correctly-rounded emulated f64
+    # (ARCHITECTURE.md), so running them there would degrade residuals
+    # to ~100 ns. The TPU-native hot path is the anchored fit step
+    # (parallel/fit_step), which needs no dd on device.
+
+    @staticmethod
+    def _exact_backend():
+        import contextlib
+
+        if jax.default_backend() == "tpu":
+            return jax.default_device(jax.devices("cpu")[0])
+        return contextlib.nullcontext()
 
     def phase(self, toas, abs_phase=True) -> Phase:
         """Total pulse phase at each TOA (reference: TimingModel.phase).
@@ -738,7 +754,8 @@ class TimingModel:
             cache = {k: v for k, v in cache.items() if k != "tzr_batch"}
         _, _, th, tl, fh, fl = self._pack()
         fn = self._get_compiled()
-        phase, _ = fn(th, tl, fh, fl, cache["batch"], _strip(cache))
+        with self._exact_backend():
+            phase, _ = fn(th, tl, fh, fl, cache["batch"], _strip(cache))
         return Phase(phase)
 
     def delay(self, toas) -> jnp.ndarray:
@@ -747,7 +764,8 @@ class TimingModel:
         cache = self.get_cache(toas)
         _, _, th, tl, fh, fl = self._pack()
         fn = self._get_compiled()
-        _, delay = fn(th, tl, fh, fl, cache["batch"], _strip(cache))
+        with self._exact_backend():
+            _, delay = fn(th, tl, fh, fl, cache["batch"], _strip(cache))
         return delay
 
     def designmatrix(self, toas, incoffset=True):
@@ -764,7 +782,8 @@ class TimingModel:
             ph, _ = fn(thx, tl, fh, fl, batch, sc)
             return ph.hi + ph.lo
 
-        jac = jax.jacfwd(phase_of)(th)  # (N, p) turns/unit
+        with self._exact_backend():
+            jac = jax.jacfwd(phase_of)(th)  # (N, p) turns/unit
         f0 = self.F0.value
         M = np.asarray(jac) / f0
         names = list(free)
@@ -792,7 +811,8 @@ class TimingModel:
                        cache["batch"], sc)
             return ph.hi + ph.lo
 
-        return jax.jacfwd(phase_of)(jnp.asarray(th[i]))
+        with self._exact_backend():
+            return jax.jacfwd(phase_of)(jnp.asarray(th[i]))
 
     # ---------------- wideband DM channel ------------------------------
 

@@ -30,7 +30,7 @@ import numpy as np
 
 __all__ = ["LCPrimitive", "LCGaussian", "LCGaussian2", "LCVonMises",
            "LCLorentzian", "LCLorentzian2", "LCTopHat",
-           "LCTemplate", "LCFitter", "GaussianPrior",
+           "LCSkewGaussian", "LCTemplate", "LCFitter", "GaussianPrior",
            "read_template", "write_template", "make_template"]
 
 
@@ -191,9 +191,40 @@ class LCTopHat(LCPrimitive):
         return float(shape[0])
 
 
+class LCSkewGaussian(LCPrimitive):
+    """Wrapped skew-normal peak (reference: the lcprimitives skew
+    family): pdf = 2/sigma phi(z) Phi(alpha z), z = d/sigma. Shape
+    params ride the template's log transform (positive), so the SIGNED
+    skewness alpha is stored as shape[1] = exp(alpha): shape[1] = 1 is
+    symmetric, >1 skews the tail to later phase, <1 to earlier."""
+
+    name = "skewgaussian"
+    n_shape = 2
+
+    @staticmethod
+    def pdf(phi, loc, shape):
+        sigma = shape[0]
+        alpha = jnp.log(shape[1])
+        d = phi - loc
+        ns = jnp.arange(-3.0, 4.0)
+        z = (d[..., None] + ns) / sigma
+        g = jnp.exp(-0.5 * z * z) / (sigma * jnp.sqrt(2 * jnp.pi))
+        cdf = 0.5 * (1.0 + jax.scipy.special.erf(
+            alpha * z / jnp.sqrt(2.0)))
+        return jnp.sum(2.0 * g * cdf, axis=-1)
+
+    @classmethod
+    def fwhm(cls, shape) -> float:
+        # Gaussian-equivalent width of the skew-normal
+        a = math.log(float(shape[1]))
+        dlt = a / math.sqrt(1 + a * a)
+        sd = float(shape[0]) * math.sqrt(1 - 2 * dlt * dlt / math.pi)
+        return 2.0 * math.sqrt(2.0 * math.log(2.0)) * sd
+
+
 _PRIM_TYPES = {c.name: c for c in
                (LCGaussian, LCGaussian2, LCVonMises, LCLorentzian,
-                LCLorentzian2, LCTopHat)}
+                LCLorentzian2, LCTopHat, LCSkewGaussian)}
 
 
 class LCTemplate:
@@ -303,6 +334,34 @@ class LCTemplate:
         d = abs(a - b)
         return float(min(d, 1.0 - d))
 
+    def param_mask(self, free_norms=True, free_locs=True,
+                   free_widths=True, prims=None) -> np.ndarray:
+        """Boolean mask over theta selecting FREE entries, for
+        LCFitter's free= argument (reference: the LCNorm/LCPrimitive
+        free arrays). ``prims`` restricts to a subset of primitive
+        indices; note norms live on a softmax simplex, so freeing any
+        norm also frees the background logit (the simplex has one
+        redundant direction — holding the rest fixed keeps their
+        RATIOS fixed, the natural analog of the reference's fixed
+        norms)."""
+        m = len(self.primitives)
+        sel = list(range(m)) if prims is None else list(prims)
+        mask = np.zeros(len(np.asarray(self.theta)), bool)
+        if free_norms:
+            mask[0] = True
+            for k in sel:
+                mask[1 + k] = True
+        if free_locs:
+            for k in sel:
+                mask[m + 1 + k] = True
+        if free_widths:
+            off = 2 * m + 1
+            for k, nsh in enumerate(self._shape_sizes):
+                if k in sel:
+                    mask[off:off + nsh] = True
+                off += nsh
+        return mask
+
     def rotate(self, dphi: float):
         """Shift every peak location by dphi (mod 1), in place
         (reference: LCTemplate.rotate)."""
@@ -335,7 +394,16 @@ class LCTemplate:
             if nk == 0:
                 continue
             s = shapes[k]
-            if isinstance(prim, LCGaussian):
+            if isinstance(prim, LCSkewGaussian):
+                # skew-normal draw: z = d*|z0| + sqrt(1-d^2)*z1 with
+                # d = alpha/sqrt(1+alpha^2) (Azzalini representation)
+                alpha = np.log(s[1])
+                dlt = alpha / np.sqrt(1 + alpha * alpha)
+                z0 = np.abs(rng.normal(size=nk))
+                z1 = rng.normal(size=nk)
+                draw = locs[k] + s[0] * (dlt * z0
+                                         + np.sqrt(1 - dlt ** 2) * z1)
+            elif isinstance(prim, LCGaussian):
                 draw = rng.normal(locs[k], s[0], size=nk)
             elif isinstance(prim, LCGaussian2):
                 side = rng.uniform(size=nk) < s[0] / (s[0] + s[1])
@@ -465,24 +533,35 @@ class LCFitter:
         theta = self.template.theta if theta is None else theta
         return -float(self._nll(jnp.asarray(theta)))
 
-    def fit(self, maxiter: int = 500, compute_errors: bool = True
-            ) -> dict:
+    def fit(self, maxiter: int = 500, compute_errors: bool = True,
+            free=None) -> dict:
         """ML fit; updates the template's theta in place. With
         compute_errors, invert the exact autodiff Hessian at the
         optimum for the theta covariance (reference: LCFitter's
-        hess_errors)."""
+        hess_errors). ``free`` is a boolean theta mask (see
+        LCTemplate.param_mask) — fixed entries are held at their
+        current values (reference: the free/fixed machinery on LCNorm
+        and each LCPrimitive)."""
         from scipy.optimize import minimize
 
+        theta0 = np.asarray(self.template.theta, np.float64)
+        free = np.ones(len(theta0), bool) if free is None \
+            else np.asarray(free, bool)
+        base = jnp.asarray(theta0)
+        fidx = jnp.asarray(np.nonzero(free)[0])
+
         def f(x):
-            v, g = self._valgrad(jnp.asarray(x))
-            return float(v), np.asarray(g, dtype=np.float64)
+            full = base.at[fidx].set(jnp.asarray(x))
+            v, g = self._valgrad(full)
+            return float(v), np.asarray(g, dtype=np.float64)[free]
 
         # dense BFGS: theta is tiny (3m+1) and scipy 1.17's L-BFGS-B
         # line search stalls on the phase-periodic landscape
-        res = minimize(f, np.asarray(self.template.theta), jac=True,
-                       method="BFGS",
+        res = minimize(f, theta0[free], jac=True, method="BFGS",
                        options={"maxiter": maxiter, "gtol": 1e-6})
-        self.template.theta = np.asarray(res.x)
+        theta = theta0.copy()
+        theta[free] = np.asarray(res.x)
+        self.template.theta = theta
         gnorm = float(np.linalg.norm(res.jac))
         # BFGS often ends with "precision loss" right at the optimum;
         # a small gradient relative to |logL| is convergence
@@ -492,15 +571,17 @@ class LCFitter:
                "success": bool(res.success)
                or gnorm < 1e-4 * max(1.0, abs(float(res.fun)))}
         if compute_errors:
-            H = np.asarray(self._hess(jnp.asarray(res.x)))
+            H = np.asarray(self._hess(jnp.asarray(theta)))
+            Hf = H[np.ix_(free, free)]
+            err = np.zeros(len(theta))
             try:
-                cov = np.linalg.inv(H)
-                err = np.sqrt(np.maximum(np.diag(cov), 0.0))
+                cov = np.linalg.inv(Hf)
+                err[free] = np.sqrt(np.maximum(np.diag(cov), 0.0))
             except np.linalg.LinAlgError:
                 cov = None
-                err = np.full(len(res.x), np.nan)
-            out["theta_cov"] = cov
-            out["theta_err"] = err
+                err[free] = np.nan
+            out["theta_cov"] = cov  # free-subset covariance
+            out["theta_err"] = err  # full-length, 0 at fixed entries
         return out
 
     # ---- binned fit (reference: LCFitter chi-squared path) ---------

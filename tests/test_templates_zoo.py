@@ -136,3 +136,63 @@ def test_gaussian2_ml_recovery():
     sl, sr = fit_t.widths[0]
     assert sl == pytest.approx(0.02, abs=0.01)
     assert sr == pytest.approx(0.06, abs=0.015)
+
+
+def test_skewgaussian_normalized_and_skews():
+    """LCSkewGaussian: unit integral; exp(alpha)>1 pushes probability
+    to later phase; exp(alpha)=1 reduces to the plain Gaussian."""
+    import numpy as np
+
+    from pint_tpu.templates import LCGaussian, LCSkewGaussian, LCTemplate
+
+    xs = np.linspace(0, 1, 20001)
+    for a in (0.3, 1.0, 3.5):
+        t = LCTemplate([LCSkewGaussian()], [0.9], [0.5], [[0.03, a]])
+        y = t(xs)
+        assert abs(np.trapezoid(y, xs) - 1.0) < 1e-3, a
+    sym = LCTemplate([LCSkewGaussian()], [0.9], [0.5], [[0.03, 1.0]])
+    plain = LCTemplate([LCGaussian()], [0.9], [0.5], [[0.03]])
+    np.testing.assert_allclose(sym(xs), plain(xs), rtol=1e-10)
+    skew = LCTemplate([LCSkewGaussian()], [0.9], [0.5], [[0.03, 3.5]])
+    y = skew(xs)
+    mean = np.trapezoid(xs * (y - y.min()), xs) / np.trapezoid(
+        y - y.min(), xs)
+    assert mean > 0.5 + 0.005  # tail to later phase
+    # random() must draw the skew-normal, not a symmetric fallback
+    # (window out the uniform background — its symmetric mass about a
+    # shifted mixture mean would pollute the third moment)
+    draws = skew.random(50000, rng=np.random.default_rng(3))
+    d = draws[(draws > 0.35) & (draws < 0.75)]
+    m = d.mean()
+    skewness = np.mean((d - m) ** 3) / np.std(d) ** 3
+    assert skewness > 0.5  # alpha = log(3.5) > 0: right-skewed
+
+
+def test_free_fixed_machinery():
+    """param_mask + LCFitter(free=): fixed entries must not move, and
+    the partial fit still recovers the free ones."""
+    import numpy as np
+
+    from pint_tpu.templates import LCFitter, LCGaussian, LCTemplate
+
+    rng = np.random.default_rng(8)
+    truth = LCTemplate([LCGaussian(), LCGaussian()], [0.35, 0.25],
+                       [0.2, 0.65], [[0.02], [0.05]])
+    # draw photons from the truth by rejection
+    xs = rng.uniform(0, 1, 40000)
+    keep = rng.uniform(0, truth(xs).max() * 1.05, 40000) < truth(xs)
+    phases = xs[keep][:8000]
+    start = LCTemplate([LCGaussian(), LCGaussian()], [0.35, 0.25],
+                       [0.23, 0.65], [[0.02], [0.05]])
+    mask = start.param_mask(free_norms=False, free_widths=False,
+                            prims=[0])   # only peak-0 location free
+    theta_before = np.asarray(start.theta).copy()
+    fit = LCFitter(start, phases)
+    out = fit.fit(free=mask)
+    theta_after = np.asarray(start.theta)
+    # fixed entries bitwise unchanged
+    np.testing.assert_array_equal(theta_before[~mask],
+                                  theta_after[~mask])
+    # the free location moved toward the truth
+    assert abs(start.locs[0] - 0.2) < 0.01
+    assert out["theta_err"][~mask].max() == 0.0
