@@ -816,34 +816,39 @@ class TimingModel:
 
     # ---------------- wideband DM channel ------------------------------
 
+    def dm_total_device(self, pv, batch, cache_sub):
+        """Total model DM [pc/cm^3] per TOA as a pure device function,
+        aggregating every component exposing ``dm_value_device`` (DM
+        polynomial, DMX, DMJUMP, solar wind, DMWaveX). Astrometry's
+        delay runs first to populate the ctx geometry (pulsar
+        direction) the solar-wind term needs (reference: total DM
+        summed over Dispersion components). Shared by build_dm_fn and
+        the wideband fit step, so the two channels cannot
+        desynchronize."""
+        ctx: dict = {}
+        zero = jnp.zeros_like(batch.freq_mhz)
+        for c in self.delay_components:
+            if c.category == "astrometry":
+                c.delay(pv, batch, cache_sub, ctx, zero)
+        dm = zero
+        for c in self._ordered_components():
+            if hasattr(c, "dm_value_device"):
+                dm = dm + c.dm_value_device(pv, batch, cache_sub, ctx)
+        return dm
+
     def build_dm_fn(self, toas):
-        """(dm_fn, free_names): dm_fn(th) -> model DM per TOA [pc/cm^3],
-        pure and jacfwd-able, aggregating every component exposing
-        ``dm_value_device`` (DM polynomial, DMX, DMJUMP, solar wind,
-        DMWaveX). Astrometry's delay runs first to populate the ctx
-        geometry (pulsar direction) the solar-wind term needs
-        (reference: total DM summed over Dispersion components)."""
+        """(dm_fn, free_names): dm_fn(th) -> model DM per TOA
+        [pc/cm^3], pure and jacfwd-able (see dm_total_device)."""
         cache = self.get_cache(toas)
         batch = cache["batch"]
         main = cache["main"]
         free, frozen, th, tl, fh, fl = self._pack()
-        astro = [c for c in self.delay_components
-                 if c.category == "astrometry"]
-        dm_comps = [c for c in self._ordered_components()
-                    if hasattr(c, "dm_value_device")]
 
         def dm_fn(thx):
             pv = {nm: DD(thx[i], tl[i]) for i, nm in enumerate(free)}
             for j, nm in enumerate(frozen):
                 pv[nm] = DD(fh[j], fl[j])
-            ctx: dict = {}
-            zero = jnp.zeros_like(batch.freq_mhz)
-            for c in astro:
-                c.delay(pv, batch, main, ctx, zero)
-            dm = zero
-            for c in dm_comps:
-                dm = dm + c.dm_value_device(pv, batch, main, ctx)
-            return dm
+            return self.dm_total_device(pv, batch, main)
 
         return dm_fn, (free, np.asarray(th))
 

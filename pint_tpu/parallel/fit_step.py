@@ -103,7 +103,8 @@ def _split32(hi, lo=None):
 def build_fit_step(model, toas, pad_to: Optional[int] = None,
                    matmul_f32: Optional[bool] = None,
                    jac_f32: Optional[bool] = None,
-                   anchored: Optional[bool] = None):
+                   anchored: Optional[bool] = None,
+                   wideband: bool = False):
     """(step_fn, args, names): step_fn is pure and jittable,
 
         step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid)
@@ -115,6 +116,13 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
 
     ``valid`` is a 0/1 mask supporting padding of the TOA axis to a
     mesh-divisible length: padded rows carry weight 0 everywhere.
+
+    With ``wideband`` the iteration solves the stacked [time; DM]
+    system in the same single XLA program (reference:
+    WidebandTOAFitter's joint solve): the DM channel's residuals
+    (-pp_dm/-pp_dme flags) and jacobian ride extra rows whose noise
+    is white (correlated bases and ECORR act on TOA rows only), and
+    ``resids`` stays the N time residuals.
     """
     phase_fn, (free_names, frozen_names) = model._build_phase_fn()
     cache = model.get_cache(toas)
@@ -129,6 +137,17 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     n = toas.ntoas
     f32mm = _use_f32_matmul(matmul_f32)
     jac32 = _use_f32_jac(jac_f32)
+
+    if wideband:
+        from pint_tpu.wideband import get_wideband_dm
+
+        dm_meas_np, _ = get_wideband_dm(toas)
+        # DMEFAC/DMEQUAD-scaled DM sigmas, matching DMResiduals
+        dm_err_np = model.scaled_dm_uncertainty(toas)
+        sc = {**sc, "wb_dm": jnp.asarray(dm_meas_np),
+              "wb_dme": jnp.asarray(np.asarray(dm_err_np))}
+        def dm_device(pv, batch_x, cache_x):
+            return model.dm_total_device(pv, batch_x, cache_x["main"])
 
     # Per-free-param scale for the f32 Jacobian: F_i (i>=2) columns are
     # dt^{i+1}/(i+1)! and overflow f32 range from i=4; differentiating
@@ -278,13 +297,68 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             M = jnp.concatenate([ones, jac * valid[:, None]], axis=1)
         r = r * valid
         Fv = F * valid[:, None]
-        dp, cov, chi2, r_out = _gls_core(
+        r_time = r
+        if wideband:
+            # stacked [time; DM] rows: DM residuals in f64 (the
+            # measurement scale needs it), DM jacobian in the same
+            # dtype/scaling as the time jacobian
+            def dm_of64(thx):
+                return dm_device(make_pv(thx, tl, fh, fl),
+                                 batch, cache)
+
+            r_dm = (cache["wb_dm"] - dm_of64(th)) * valid
+            if jac32:
+                def dm_of32(ua_):
+                    return dm_device(
+                        make_pv(ua_ * s32, ub * s32, fa, fb),
+                        batch32, cache32)
+
+                jac_dm = jax.jacfwd(dm_of32)(ua)
+                zcol = jnp.zeros((jac_dm.shape[0], 1), jac_dm.dtype)
+                M_dm = jnp.concatenate(
+                    [zcol, -jac_dm * valid32[:, None]], axis=1)
+            else:
+                jac_dm = jax.jacfwd(dm_of64)(th)
+                zcol = jnp.zeros((jac_dm.shape[0], 1), jac_dm.dtype)
+                M_dm = jnp.concatenate(
+                    [zcol, -jac_dm * valid[:, None]], axis=1)
+            M = jnp.concatenate([M, M_dm], axis=0)
+            r = jnp.concatenate([r, r_dm])
+            nvec = jnp.concatenate([nvec, cache["wb_dme"] ** 2])
+            valid = jnp.concatenate([valid, valid])
+            Fv = jnp.concatenate([Fv, jnp.zeros_like(Fv)], axis=0)
+            # DM rows ride the zero-variance 'no epoch' ECORR slot
+            eid = jnp.concatenate(
+                [eid, jnp.full_like(eid, nseg - 1)])
+        dp, cov, chi2, _ = _gls_core(
             M, Fv, phi, r, nvec, valid, eid, jvar, nseg, f32mm=f32mm)
         if jac32:
             sfull = jnp.concatenate([jnp.ones(1), s64])
             dp = dp * sfull
             cov = cov * jnp.outer(sfull, sfull)
-        return dp, cov, chi2, r_out
+        return dp, cov, chi2, r_time
+
+    # captured before the anchored zeroing below: the wideband DM
+    # channel rebuilds pv as ref + delta in anchored mode
+    th0_c, tl0_c = np.asarray(th).copy(), np.asarray(tl).copy()
+    ref32_c = dd_to_dd32(DD(th0_c, tl0_c))
+
+    def make_pv(thx, tlx, fhx, flx):
+        """pv dict for auxiliary device channels (DM), honoring the
+        anchored delta-theta convention and the caller's dtype."""
+        from pint_tpu.ops.dd import dd_add
+
+        if anchored_on:
+            f32m = thx.dtype == jnp.float32
+            rh = jnp.asarray(ref32_c.hi if f32m else th0_c)
+            rl = jnp.asarray(ref32_c.lo if f32m else tl0_c)
+            pv = {nm: dd_add(DD(rh[i], rl[i]), DD(thx[i], tlx[i]))
+                  for i, nm in enumerate(free)}
+        else:
+            pv = {nm: DD(thx[i], tlx[i]) for i, nm in enumerate(free)}
+        pv.update({nm: DD(fhx[j], flx[j])
+                   for j, nm in enumerate(frozen)})
+        return pv
 
     if anchored_on:
         # the (th, tl) slots carry delta theta vs the anchor: zero at

@@ -1,0 +1,121 @@
+"""One-kernel wideband fit step (build_fit_step(wideband=True)): the
+stacked [time; DM] GLS iteration as a single XLA program (reference:
+WidebandTOAFitter's joint solve, which runs residuals/designmatrix/
+solve as separate host phases). Oracle: the host fitter's
+_solve_once on the same problem."""
+
+import io
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.parallel import build_fit_step
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+from pint_tpu.wideband_fitter import WidebandTOAFitter
+
+PAR = """PSR J1713x
+RAJ 17:13:49.53 1
+DECJ 07:47:37.5 1
+F0 218.81 1
+F1 -4.08e-16 1
+DM 15.99
+PEPOCH 54500
+TZRMJD 54500.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+DMX_0001 0.0 1
+DMXR1_0001 53000
+DMXR2_0001 54500
+DMX_0002 0.0 1
+DMXR1_0002 54500
+DMXR2_0002 56000
+DMEFAC -be X 1.1
+DMEQUAD -be X 2e-5
+"""
+
+
+def _problem(n=300, seed=3, extra=""):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(PAR + extra))
+        rng = np.random.default_rng(seed)
+        mjds = np.sort(rng.uniform(53000, 56000, n))
+        toas = make_fake_toas_fromMJDs(
+            mjds, m, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 2100.0], n // 2),
+            add_noise=True, rng=rng)
+        for f in toas.flags:
+            f["be"] = "X"
+            f["pp_dm"] = str(15.99 + rng.normal(0, 1e-4))
+            f["pp_dme"] = "1e-4"
+    return m, toas
+
+
+class TestWidebandStep:
+    def test_matches_host_fitter_f64(self):
+        m, toas = _problem()
+        fit = WidebandTOAFitter(toas, m)
+        x, cov, chi2, noise, names = fit._solve_once()
+        sig = np.sqrt(np.diag(cov))
+        s, a, names2 = build_fit_step(m, toas, wideband=True,
+                                      anchored=False, jac_f32=False)
+        out = jax.jit(s)(*a)
+        assert names2 == names
+        assert np.max(np.abs(x - np.asarray(out[0])) / sig) < 1e-9
+        # the step returns the N TIME residuals, not the stacked 2N
+        assert np.asarray(out[3]).shape == (toas.ntoas,)
+
+    def test_production_config_agrees(self):
+        """anchored + f32 Jacobian + f32 MXU vs the host fitter."""
+        m, toas = _problem()
+        fit = WidebandTOAFitter(toas, m)
+        x, cov, _, _, _ = fit._solve_once()
+        sig = np.sqrt(np.diag(cov))
+        s, a, _ = build_fit_step(m, toas, wideband=True,
+                                 anchored=True, jac_f32=True,
+                                 matmul_f32=True)
+        out = jax.jit(s)(*a)
+        assert np.max(np.abs(x - np.asarray(out[0])) / sig) < 1e-2
+
+    def test_dm_errors_scaled(self):
+        """DMEFAC/DMEQUAD must reach the step's DM rows: inflating
+        DMEFAC widens DM-sensitive parameter uncertainties."""
+        m1, toas1 = _problem()
+        m2, toas2 = _problem(
+            extra="")  # same par; modify DMEFAC below
+        m2.get_param("DMEFAC1").value = 3.0
+        m2.invalidate_cache(params_only=True)
+        _, a1, names = build_fit_step(m1, toas1, wideband=True,
+                                      anchored=False, jac_f32=False)
+        s1, _, _ = build_fit_step(m1, toas1, wideband=True,
+                                  anchored=False, jac_f32=False)
+        s2, a2, _ = build_fit_step(m2, toas2, wideband=True,
+                                   anchored=False, jac_f32=False)
+        c1 = np.diag(np.asarray(jax.jit(s1)(*a1)[1]))
+        c2 = np.diag(np.asarray(jax.jit(s2)(*a2)[1]))
+        j = names.index("DMX_0001")
+        assert c2[j] > 2.0 * c1[j]
+
+    def test_sharded_wideband(self):
+        from jax.sharding import Mesh
+
+        from pint_tpu.parallel import build_sharded_fit_step
+
+        m, toas = _problem(n=200)
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs the 8-virtual-device conftest mesh")
+        mesh = Mesh(np.array(devs[:8]).reshape(8), ("toa",))
+        jitted, dev_args, _ = build_sharded_fit_step(
+            m, toas, mesh, wideband=True, anchored=True, jac_f32=True)
+        sU, aU, _ = build_fit_step(m, toas, wideband=True,
+                                   anchored=True, jac_f32=True)
+        oS = jitted(*dev_args)
+        oU = jax.jit(sU)(*aU)
+        sig = np.sqrt(np.diag(np.asarray(oU[1])))
+        assert np.max(np.abs(np.asarray(oS[0]) - np.asarray(oU[0]))
+                      / sig) < 1e-3
