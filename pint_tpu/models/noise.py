@@ -401,6 +401,22 @@ class PLDMNoise(NoiseComponent):
         phi = powerlaw(freqs, A, gamma) * df
         return F, phi
 
+    def noise_dm_basis(self, toas, F_time):
+        """The same basis expressed in the wideband DM channel
+        [pc/cm^3 per coefficient]: a coefficient is a delay at
+        REF_FREQ, so its DM is coeff * REF_FREQ^2 / DMconst
+        (reference: the wideband GLS couples pl_dm bases into the DM
+        residual block). Derived from the CACHED time-channel block
+        ``F_time`` (= fourier * (REF/nu)^2), guaranteeing the two
+        channels can never desynchronize in mode count or time grid:
+        un-scaling by (nu/REF)^2 recovers the raw Fourier basis."""
+        from pint_tpu import DMconst
+
+        scale = (np.asarray(toas.get_freqs())
+                 / self.REF_FREQ_MHZ) ** 2
+        fourier = np.asarray(F_time) * scale[:, None]
+        return fourier * (self.REF_FREQ_MHZ ** 2 / DMconst)
+
 
 class PLChromNoise(NoiseComponent):
     """Power-law chromatic noise with a general spectral index: the

@@ -938,6 +938,28 @@ class TimingModel:
             return None
         return np.concatenate([phi for _, _, phi in pairs])
 
+    def noise_model_dm_designmatrix(self, toas, exclude=()):
+        """(N, q) DM-channel block of the noise basis, column-aligned
+        with noise_model_designmatrix: components whose process IS a
+        DM perturbation (PLDMNoise) expose ``noise_dm_basis`` and
+        couple into the wideband DM rows; all others contribute zeros
+        (reference: the wideband GLS DM-block coupling). None when no
+        basis is active."""
+        pairs = self.noise_model_basis_weight_pairs(toas,
+                                                    exclude=exclude)
+        if not pairs:
+            return None
+        comps = {type(c).__name__: c for c in self.noise_components}
+        blocks = []
+        for name, F, _ in pairs:
+            comp = comps.get(name)
+            if comp is not None and hasattr(comp, "noise_dm_basis"):
+                blocks.append(np.asarray(
+                    comp.noise_dm_basis(toas, F_time=F)))
+            else:
+                blocks.append(np.zeros_like(np.asarray(F)))
+        return np.concatenate(blocks, axis=1)
+
     def noise_model_ecorr_segments(self, toas):
         """ECORR epoch-segment structure for the Sherman-Morrison GLS
         path: (epoch_ids (N,) int32 — value K means 'in no epoch' —,

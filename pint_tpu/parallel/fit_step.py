@@ -146,6 +146,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         dm_err_np = model.scaled_dm_uncertainty(toas)
         sc = {**sc, "wb_dm": jnp.asarray(dm_meas_np),
               "wb_dme": jnp.asarray(np.asarray(dm_err_np))}
+
         def dm_device(pv, batch_x, cache_x):
             return model.dm_total_device(pv, batch_x, cache_x["main"])
 
@@ -211,6 +212,11 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     if F_np is None:
         F_np, phi_np = np.zeros((n, 0)), np.ones(0)
     nseg = len(jvar_np)
+    if wideband:
+        Fdm_np = model.noise_model_dm_designmatrix(toas,
+                                                   exclude=exclude)
+        sc = {**sc, "wb_Fdm": jnp.asarray(
+            np.zeros((n, 0)) if Fdm_np is None else Fdm_np)}
 
     valid_np = np.ones(n)
     if pad_to is not None and pad_to > n:
@@ -325,8 +331,11 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             M = jnp.concatenate([M, M_dm], axis=0)
             r = jnp.concatenate([r, r_dm])
             nvec = jnp.concatenate([nvec, cache["wb_dme"] ** 2])
+            # DM-process bases (PLDMNoise) couple into the DM rows;
+            # all other bases are zero there
+            Fv = jnp.concatenate(
+                [Fv, cache["wb_Fdm"] * valid[:, None]], axis=0)
             valid = jnp.concatenate([valid, valid])
-            Fv = jnp.concatenate([Fv, jnp.zeros_like(Fv)], axis=0)
             # DM rows ride the zero-variance 'no epoch' ECORR slot
             eid = jnp.concatenate(
                 [eid, jnp.full_like(eid, nseg - 1)])

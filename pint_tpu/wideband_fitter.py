@@ -11,10 +11,12 @@ residual
 
 where M_time is the usual phase design matrix (d resid/d theta) and
 M_dm = -d DM_model/d theta (r_dm = measured - model). Correlated-noise
-bases act on the TOA rows (zero on DM rows; the reference couples
-PLDMNoise into the DM block — refinement tracked for a later round).
-Both blocks and the solve reuse the GLS kernel unchanged: the stack is
-just a taller whitened least-squares problem.
+bases act on the TOA rows, and bases whose process IS a DM
+perturbation (PLDMNoise) additionally couple into the DM rows via
+TimingModel.noise_model_dm_designmatrix — the joint GP sees the same
+coefficient through both channels, matching the reference's wideband
+coupling. Both blocks and the solve reuse the GLS kernel unchanged:
+the stack is just a taller whitened least-squares problem.
 """
 
 from __future__ import annotations
@@ -79,7 +81,9 @@ class WidebandTOAFitter(Fitter):
             F = np.zeros((2 * n, 0))
             phi = np.ones(0)
         else:
-            F = np.concatenate([F_t, np.zeros_like(F_t)], axis=0)
+            # DM-process bases (PLDMNoise) couple into the DM rows
+            F_dm = self.model.noise_model_dm_designmatrix(self.toas)
+            F = np.concatenate([F_t, F_dm], axis=0)
         args = (jnp.asarray(M), jnp.asarray(F), jnp.asarray(phi),
                 jnp.asarray(r), jnp.asarray(nvec))
         if threshold is not None:

@@ -89,10 +89,8 @@ class TestWidebandStep:
             extra="")  # same par; modify DMEFAC below
         m2.get_param("DMEFAC1").value = 3.0
         m2.invalidate_cache(params_only=True)
-        _, a1, names = build_fit_step(m1, toas1, wideband=True,
-                                      anchored=False, jac_f32=False)
-        s1, _, _ = build_fit_step(m1, toas1, wideband=True,
-                                  anchored=False, jac_f32=False)
+        s1, a1, names = build_fit_step(m1, toas1, wideband=True,
+                                       anchored=False, jac_f32=False)
         s2, a2, _ = build_fit_step(m2, toas2, wideband=True,
                                    anchored=False, jac_f32=False)
         c1 = np.diag(np.asarray(jax.jit(s1)(*a1)[1]))
@@ -119,3 +117,76 @@ class TestWidebandStep:
         sig = np.sqrt(np.diag(np.asarray(oU[1])))
         assert np.max(np.abs(np.asarray(oS[0]) - np.asarray(oU[0]))
                       / sig) < 1e-3
+
+
+class TestDMNoiseCoupling:
+    def test_pldm_couples_into_dm_rows(self):
+        """PLDMNoise columns are nonzero in the DM-channel block;
+        red-noise columns are zero there; column order matches the
+        time-row stacking."""
+        m, toas = _problem(extra="TNDMAMP -13.0\nTNDMGAM 3.0\n"
+                           "TNDMC 8\nTNREDAMP -14.0\nTNREDGAM 4.0\n"
+                           "TNREDC 5\n")
+        Ft = m.noise_model_designmatrix(toas)
+        Fd = m.noise_model_dm_designmatrix(toas)
+        assert Fd.shape == Ft.shape
+        pairs = m.noise_model_basis_weight_pairs(toas)
+        off = 0
+        for name, F, _ in pairs:
+            w = F.shape[1]
+            blk = Fd[:, off:off + w]
+            if name == "PLDMNoise":
+                assert np.max(np.abs(blk)) > 0
+            else:
+                assert np.max(np.abs(blk)) == 0, name
+            off += w
+
+    def test_step_matches_fitter_with_pldm(self):
+        m, toas = _problem(extra="TNDMAMP -13.0\nTNDMGAM 3.0\n"
+                           "TNDMC 8\n")
+        fit = WidebandTOAFitter(toas, m)
+        x, cov, _, _, _ = fit._solve_once()
+        sig = np.sqrt(np.diag(cov))
+        s, a, _ = build_fit_step(m, toas, wideband=True,
+                                 anchored=False, jac_f32=False)
+        out = jax.jit(s)(*a)
+        assert np.max(np.abs(x - np.asarray(out[0])) / sig) < 1e-8
+
+    def test_coupling_absorbs_injected_dm_signal(self, monkeypatch):
+        """Inject a slow sinusoidal DM(t) into the DM channel; the
+        marginalized wideband chi2 with the PLDMNoise coupling must
+        beat the same solve with the DM block zeroed (the pre-coupling
+        behavior) by a decisive margin — if noise_model_dm_designmatrix
+        ever regresses to zeros, this fails."""
+        import io as _io
+
+        from pint_tpu.models.timing_model import TimingModel
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m_sim = get_model(_io.StringIO(PAR))
+            rng = np.random.default_rng(9)
+            mjds = np.sort(rng.uniform(53000, 56000, 240))
+            toas = make_fake_toas_fromMJDs(
+                mjds, m_sim, error_us=1.0,
+                freq_mhz=np.tile([1400.0, 2100.0], 120),
+                add_noise=True, rng=rng)
+            amp, period = 3e-3, 700.0
+            dm_sig = amp * np.sin(2 * np.pi * (mjds - 53000) / period)
+            for i, f in enumerate(toas.flags):
+                f["be"] = "X"
+                f["pp_dm"] = str(15.99 + dm_sig[i]
+                                 + rng.normal(0, 1e-4))
+                f["pp_dme"] = "1e-4"
+            m_fit = get_model(_io.StringIO(
+                PAR + "TNDMAMP -12.0\nTNDMGAM 2.0\nTNDMC 12\n"))
+        chi2_coupled = WidebandTOAFitter(toas, m_fit)._solve_once()[2]
+        orig = TimingModel.noise_model_dm_designmatrix
+        monkeypatch.setattr(
+            TimingModel, "noise_model_dm_designmatrix",
+            lambda self, t, exclude=(): np.zeros_like(
+                np.asarray(orig(self, t, exclude=exclude))))
+        chi2_zeroed = WidebandTOAFitter(toas, m_fit)._solve_once()[2]
+        # the sine is ~27 sigma per DM point: without coupling the GP
+        # cannot explain the DM channel and chi2 blows up
+        assert chi2_zeroed - chi2_coupled > 1000.0
