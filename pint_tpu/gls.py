@@ -34,7 +34,8 @@ import numpy as np
 from pint_tpu.fitter import Fitter, MaxiterReached
 from pint_tpu.residuals import Residuals
 
-__all__ = ["GLSFitter", "DownhillGLSFitter", "gls_solve_np"]
+__all__ = ["GLSFitter", "DownhillGLSFitter",
+           "DeviceDownhillGLSFitter", "gls_solve_np"]
 
 
 @partial(jax.jit, static_argnames=("f32mm",))
@@ -333,3 +334,124 @@ class DownhillGLSFitter(GLSFitter):
         self.noise_resids = noise
         self._record_stats(best_chi2, iterations, t0)
         return best_chi2
+
+
+class DeviceDownhillGLSFitter(GLSFitter):
+    """Downhill GLS where EVERY trial iteration is the one-kernel
+    jitted fit step (pint_tpu.parallel.build_fit_step): phase, design
+    matrix, whitening, ECORR downdates, normal equations, Cholesky and
+    the accept/reject chi2 all stay device-resident — one device
+    round-trip per trial instead of the host fitter's
+    residuals/designmatrix/solve phases. Parameter state advances on
+    the HOST in exact arithmetic: in anchored mode as the cumulative
+    dd delta against the build anchor (the step's (th, tl) slots), in
+    direct mode as compensated updates of the packed dd pairs.
+
+    Composes with every step flag (anchored / jac_f32 / matmul_f32 /
+    wideband) — on TPU the production configuration is auto-on, making
+    this the fastest full-fit path on the hardware the framework is
+    named for. Singular systems are the caller's concern (the step is
+    Cholesky-only): a non-finite first step raises instead of silently
+    falling back."""
+
+    def __init__(self, toas, model, residuals=None, track_mode=None,
+                 wideband=False, **step_flags):
+        super().__init__(toas, model, residuals=residuals,
+                         track_mode=track_mode)
+        self.wideband = wideband
+        self.step_flags = dict(step_flags, wideband=wideband)
+
+    def fit_toas(self, maxiter=20, min_lambda=1e-3,
+                 required_chi2_decrease=1e-2):
+        from pint_tpu.ops import dd_np
+        from pint_tpu.parallel import build_fit_step
+        from pint_tpu.parallel.fit_step import _use_anchored
+
+        t0 = time.perf_counter()
+        step_fn, args, names = build_fit_step(self.model, self.toas,
+                                              **self.step_flags)
+        jitted = jax.jit(step_fn)
+        anchored = _use_anchored(
+            self.step_flags.get("anchored")) and \
+            self.model.supports_anchored()
+        # host-side exact parameter state in the step's (th, tl) slots
+        th = np.asarray(args[0], np.float64).copy()
+        tl = np.asarray(args[1], np.float64).copy()
+        rest = args[2:]
+
+        def run(th_, tl_):
+            return jitted(jnp.asarray(th_), jnp.asarray(tl_), *rest)
+
+        def bump(th_, tl_, d):
+            """(th, tl) + d with the low part carrying the rounding
+            remainder — the delta survives exactly (dd discipline)."""
+            s = dd_np.add(dd_np.dd(th_, tl_), dd_np.dd(d))
+            return np.asarray(s[0]), np.asarray(s[1])
+
+        out = run(th, tl)
+        dp = np.asarray(out[0], np.float64)
+        cov = np.asarray(out[1])
+        best = float(out[2])
+        if not np.isfinite(best) or not np.all(np.isfinite(dp)):
+            raise ValueError(
+                "device fit step produced non-finite values "
+                "(singular system? use GLSFitter's SVD fallback)")
+        iterations = 0
+        converged = False
+        for _ in range(maxiter):
+            iterations += 1
+            lam, accepted = 1.0, False
+            while lam >= min_lambda:
+                thc, tlc = bump(th, tl, lam * dp[1:])
+                outc = run(thc, tlc)
+                newchi2 = float(outc[2])
+                if np.isfinite(newchi2) and newchi2 <= best + 1e-12:
+                    accepted = True
+                    break
+                lam /= 2.0
+            if not accepted:
+                converged = True
+                break
+            improved = best - newchi2
+            th, tl = thc, tlc
+            dp = np.asarray(outc[0], np.float64)
+            cov = np.asarray(outc[1])
+            best = newchi2
+            if improved < required_chi2_decrease:
+                converged = True
+                break
+        else:
+            raise MaxiterReached(
+                f"no convergence in {maxiter} device downhill "
+                f"iterations")
+        # sync the model to the accepted device state: total delta vs
+        # the build point, applied through the exact dd param updates
+        th0 = np.asarray(args[0], np.float64)
+        tl0 = np.asarray(args[1], np.float64)
+        total = dd_np.sub(dd_np.dd(th, tl), dd_np.dd(th0, tl0))
+        if anchored:
+            # the slots ARE deltas vs the anchor == the build params
+            total = dd_np.dd(th, tl)
+        delta_f64 = dd_np.to_f64(total)
+        self.update_model(np.concatenate([[0.0], delta_f64]), names)
+        self.set_uncertainties(cov, names)
+        # final host refresh at the accepted optimum: residuals and
+        # the ML noise realization (the device step returns neither
+        # the basis amplitudes nor DM residuals)
+        if self.wideband:
+            from pint_tpu.wideband_fitter import WidebandTOAFitter
+
+            helper = WidebandTOAFitter(self.toas, self.model)
+            _, _, _, noise, _ = helper._solve_once()
+            self.noise_resids = noise
+            self.resids = helper.resids
+            self.dm_resids = helper.dm_resids
+        else:
+            _, _, _, noise, _ = self._solve_once()
+            self.noise_resids = noise
+        self.converged = converged
+        self._record_stats(
+            best, iterations, t0,
+            dof=(2 * self.toas.ntoas - len(self.model.free_params) - 1)
+            if self.wideband else None)
+        return best

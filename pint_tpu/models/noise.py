@@ -365,6 +365,23 @@ class PLRedNoise(NoiseComponent):
         return F, phi
 
 
+def _dm_rows_from_time_basis(toas, F_time):
+    """Wideband DM-channel block [pc/cm^3 per coefficient] of a pure
+    nu^-2 (DM-perturbation) noise process, derived from its CACHED
+    time-channel block: delay rows are DMconst * DM / nu^2, so
+    DM rows = F_time * nu^2 / DMconst — using the cached F_time
+    guarantees the two channels can never desynchronize in mode count
+    or time grid. Infinite-frequency rows (barycentered TOAs) carry
+    F_time = 0 and the product would be 0*inf: those rows are set to
+    zero — the GP simply does not inform the DM channel there
+    (reference: the wideband GLS DM-block coupling)."""
+    from pint_tpu import DMconst
+
+    nu = np.asarray(toas.get_freqs())
+    scale = np.where(np.isfinite(nu), nu * nu / DMconst, 0.0)
+    return np.asarray(F_time) * scale[:, None]
+
+
 class PLDMNoise(NoiseComponent):
     """Power-law DM (chromatic nu^-2) noise: the red-noise Fourier basis
     with each row scaled by (1400 MHz / nu)^2
@@ -402,20 +419,8 @@ class PLDMNoise(NoiseComponent):
         return F, phi
 
     def noise_dm_basis(self, toas, F_time):
-        """The same basis expressed in the wideband DM channel
-        [pc/cm^3 per coefficient]: a coefficient is a delay at
-        REF_FREQ, so its DM is coeff * REF_FREQ^2 / DMconst
-        (reference: the wideband GLS couples pl_dm bases into the DM
-        residual block). Derived from the CACHED time-channel block
-        ``F_time`` (= fourier * (REF/nu)^2), guaranteeing the two
-        channels can never desynchronize in mode count or time grid:
-        un-scaling by (nu/REF)^2 recovers the raw Fourier basis."""
-        from pint_tpu import DMconst
-
-        scale = (np.asarray(toas.get_freqs())
-                 / self.REF_FREQ_MHZ) ** 2
-        fourier = np.asarray(F_time) * scale[:, None]
-        return fourier * (self.REF_FREQ_MHZ ** 2 / DMconst)
+        """Wideband DM-channel block (see _dm_rows_from_time_basis)."""
+        return _dm_rows_from_time_basis(toas, F_time)
 
 
 class PLChromNoise(NoiseComponent):
@@ -522,3 +527,9 @@ class PLSWNoise(NoiseComponent):
         df = freqs[0]
         phi = powerlaw(freqs, A, gamma) * df
         return F, phi
+
+    def noise_dm_basis(self, toas, F_time):
+        """Solar-wind noise is also a pure nu^-2 DM perturbation (the
+        geometry factor rides along in F_time): couple it into the
+        wideband DM rows like PLDMNoise."""
+        return _dm_rows_from_time_basis(toas, F_time)

@@ -1,0 +1,110 @@
+"""DeviceDownhillGLSFitter: whole downhill fits driven by the
+one-kernel jitted fit step, parameter state advanced on host in exact
+dd. Oracle: the host DownhillGLSFitter on identical problems."""
+
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.gls import DeviceDownhillGLSFitter, DownhillGLSFitter
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+PAR = """PSR J0000+0000
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+F0 300.123456789 1
+F1 -1.0e-15 1
+DM 20.0 1
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EFAC -be X 1.1
+ECORR -be X 1.2
+TNREDAMP -13.7
+TNREDGAM 3.5
+TNREDC 10
+"""
+
+
+def _two_models(extra="", n=600, seed=2):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m1 = get_model(io.StringIO(PAR + extra))
+        m2 = get_model(io.StringIO(PAR + extra))
+        rng = np.random.default_rng(seed)
+        mjds = np.sort(rng.uniform(53001, 56999, n))
+        toas = make_fake_toas_fromMJDs(
+            mjds, m1, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 820.0], n // 2),
+            add_noise=True, rng=rng)
+        for f in toas.flags:
+            f["be"] = "X"
+    for m in (m1, m2):
+        m.F0.value += 2e-9
+        m.get_param("DM").value += 1e-4
+        m.invalidate_cache(params_only=True)
+    return m1, m2, toas
+
+
+class TestDeviceDownhill:
+    def test_matches_host_downhill(self):
+        m1, m2, toas = _two_models()
+        chi2_h = DownhillGLSFitter(toas, m1).fit_toas()
+        fit_d = DeviceDownhillGLSFitter(toas, m2, anchored=False,
+                                        jac_f32=False)
+        chi2_d = fit_d.fit_toas()
+        assert abs(chi2_h - chi2_d) < 1e-6 * abs(chi2_h)
+        for n in ("F0", "DM", "RAJ"):
+            a, b = m1.get_param(n), m2.get_param(n)
+            assert abs(a.value - b.value) <= 1e-6 * a.uncertainty, n
+            assert b.uncertainty == pytest.approx(a.uncertainty,
+                                                  rel=1e-6)
+        assert fit_d.converged
+
+    def test_production_config(self):
+        """anchored + f32 Jacobian: converges to the same optimum
+        within a small fraction of sigma."""
+        m1, m2, toas = _two_models()
+        DownhillGLSFitter(toas, m1).fit_toas()
+        fit_d = DeviceDownhillGLSFitter(toas, m2, anchored=True,
+                                        jac_f32=True, matmul_f32=True)
+        fit_d.fit_toas()
+        for n in ("F0", "DM"):
+            a, b = m1.get_param(n), m2.get_param(n)
+            assert abs(a.value - b.value) < 2e-2 * a.uncertainty, n
+
+    def test_wideband_device_fit(self):
+        m1, m2, toas = _two_models()
+        rng = np.random.default_rng(7)
+        for f in toas.flags:
+            f["pp_dm"] = str(20.0 + rng.normal(0, 1e-4))
+            f["pp_dme"] = "1e-4"
+        from pint_tpu.wideband_fitter import WidebandDownhillFitter
+
+        chi2_h = WidebandDownhillFitter(toas, m1).fit_toas()
+        fit_d = DeviceDownhillGLSFitter(toas, m2, wideband=True,
+                                        anchored=False, jac_f32=False)
+        chi2_d = fit_d.fit_toas()
+        assert abs(chi2_h - chi2_d) < 1e-4 * abs(chi2_h)
+        for n in ("F0", "DM"):
+            a, b = m1.get_param(n), m2.get_param(n)
+            assert abs(a.value - b.value) < 0.05 * a.uncertainty, n
+        # wideband dof: chi2 sums over 2N stacked rows
+        assert fit_d.stats.dof == 2 * toas.ntoas - \
+            len(m2.free_params) - 1
+        assert fit_d.get_noise_resids() is not None
+
+    def test_stats_populated(self):
+        _, m2, toas = _two_models(n=200)
+        fit = DeviceDownhillGLSFitter(toas, m2, anchored=False,
+                                      jac_f32=False)
+        fit.fit_toas()
+        assert fit.stats.iterations >= 1
+        assert fit.stats.toas_per_sec > 0
+        assert fit.stats.fitter == "DeviceDownhillGLSFitter"

@@ -190,3 +190,19 @@ class TestDMNoiseCoupling:
         # the sine is ~27 sigma per DM point: without coupling the GP
         # cannot explain the DM channel and chi2 blows up
         assert chi2_zeroed - chi2_coupled > 1000.0
+
+
+def test_plsw_couples_and_inf_freq_safe():
+    """PLSWNoise also couples into the DM rows, and an
+    infinite-frequency TOA row yields zeros (not NaN) in the DM
+    block."""
+    m, toas = _problem(extra="NE_SW 6.0\nTNSWAMP -6.0\nTNSWGAM 2.0\n"
+                       "TNSWC 6\n")
+    # make one TOA barycentric/infinite-frequency
+    toas.freq_mhz[0] = np.inf
+    toas._touch() if hasattr(toas, "_touch") else None
+    Fd = m.noise_model_dm_designmatrix(toas)
+    assert Fd is not None
+    assert np.all(np.isfinite(Fd))
+    assert np.max(np.abs(Fd[1:])) > 0       # coupling present
+    assert np.max(np.abs(Fd[0])) == 0.0     # inf row zeroed
