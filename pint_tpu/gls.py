@@ -365,15 +365,11 @@ class DeviceDownhillGLSFitter(GLSFitter):
                  required_chi2_decrease=1e-2):
         from pint_tpu.ops import dd_np
         from pint_tpu.parallel import build_fit_step
-        from pint_tpu.parallel.fit_step import _use_anchored
 
         t0 = time.perf_counter()
         step_fn, args, names = build_fit_step(self.model, self.toas,
                                               **self.step_flags)
         jitted = jax.jit(step_fn)
-        anchored = _use_anchored(
-            self.step_flags.get("anchored")) and \
-            self.model.supports_anchored()
         # host-side exact parameter state in the step's (th, tl) slots
         th = np.asarray(args[0], np.float64).copy()
         tl = np.asarray(args[1], np.float64).copy()
@@ -398,6 +394,7 @@ class DeviceDownhillGLSFitter(GLSFitter):
                 "(singular system? use GLSFitter's SVD fallback)")
         iterations = 0
         converged = False
+        maxed_out = False
         for _ in range(maxiter):
             iterations += 1
             lam, accepted = 1.0, False
@@ -421,17 +418,15 @@ class DeviceDownhillGLSFitter(GLSFitter):
                 converged = True
                 break
         else:
-            raise MaxiterReached(
-                f"no convergence in {maxiter} device downhill "
-                f"iterations")
-        # sync the model to the accepted device state: total delta vs
-        # the build point, applied through the exact dd param updates
+            maxed_out = True
+        # sync the model to the accepted device state even when about
+        # to raise: callers catching MaxiterReached expect the best
+        # point found (host DownhillGLSFitter behavior). (th, tl) are
+        # deltas vs the zeroed build slots in anchored mode and full
+        # pairs otherwise — the difference formula covers both.
         th0 = np.asarray(args[0], np.float64)
         tl0 = np.asarray(args[1], np.float64)
         total = dd_np.sub(dd_np.dd(th, tl), dd_np.dd(th0, tl0))
-        if anchored:
-            # the slots ARE deltas vs the anchor == the build params
-            total = dd_np.dd(th, tl)
         delta_f64 = dd_np.to_f64(total)
         self.update_model(np.concatenate([[0.0], delta_f64]), names)
         self.set_uncertainties(cov, names)
@@ -446,12 +441,15 @@ class DeviceDownhillGLSFitter(GLSFitter):
             self.noise_resids = noise
             self.resids = helper.resids
             self.dm_resids = helper.dm_resids
+            dof = helper._wb_dof()
         else:
             _, _, _, noise, _ = self._solve_once()
             self.noise_resids = noise
+            dof = None
         self.converged = converged
-        self._record_stats(
-            best, iterations, t0,
-            dof=(2 * self.toas.ntoas - len(self.model.free_params) - 1)
-            if self.wideband else None)
+        self._record_stats(best, iterations, t0, dof=dof)
+        if maxed_out:
+            raise MaxiterReached(
+                f"no convergence in {maxiter} device downhill "
+                f"iterations (model left at the best point found)")
         return best

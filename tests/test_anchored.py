@@ -161,3 +161,24 @@ def test_supports_anchored_gating():
     s, a, _ = build_fit_step(model2, toas2, anchored=True)
     out = jax.jit(s)(*a)
     assert np.isfinite(float(out[2]))
+
+def test_grid_chisq_anchored_matches(monkeypatch):
+    """grid_chisq varies FROZEN params through the step's fh/fl slots:
+    with anchored on (the TPU default) the surface must match the
+    direct path — the bug class this guards against is the anchored fn
+    baking build-time frozen values and returning a flat surface."""
+    from pint_tpu.gridutils import grid_chisq
+
+    model, toas = _problem(n=150)
+    f0 = model.F0.value
+    grid = np.linspace(f0 - 2e-9, f0 + 2e-9, 5)
+    # force BOTH modes explicitly: on a TPU backend (or with the env
+    # preset) the 'direct' pass would otherwise silently be anchored
+    # too and the comparison vacuous
+    monkeypatch.setenv("PINT_TPU_ANCHORED", "off")
+    c_direct = grid_chisq(model, toas, ["F0"], [grid])
+    monkeypatch.setenv("PINT_TPU_ANCHORED", "on")
+    c_anch = grid_chisq(model, toas, ["F0"], [grid])
+    assert np.ptp(c_direct) > 1.0           # a real surface
+    np.testing.assert_allclose(c_anch, c_direct,
+                               rtol=1e-6, atol=1e-6)
