@@ -794,6 +794,44 @@ class TimingModel:
             if incoffset else [self.get_param(n).units for n in free]
         return M, names, units
 
+    def d_phase_d_toa(self, toas, sample_step_s: float = 1.0):
+        """Instantaneous topocentric pulse frequency [Hz] at each TOA
+        (reference: TimingModel.d_phase_d_toa): central finite
+        difference of the FULL pipeline at ±sample_step_s — the
+        shifted TOA sets re-run clock/ephemeris/barycentering, so the
+        Doppler from Earth motion is captured (a jvp through the
+        device chain alone would miss it: batch positions are
+        precomputed constants there). The phase difference is taken in
+        dd, so the ~1e10-turn absolute phases cancel exactly."""
+        from pint_tpu.ops import dd_np
+        from pint_tpu.toa import get_TOAs_array
+
+        # the TOA cache is single-slot: preserve the caller's entry so
+        # the two shifted evaluations don't force a full pipeline
+        # recompute on the model's next call with the original toas
+        saved = (self._cache, self._cache_key)
+        step_d = sample_step_s / SECS_PER_DAY
+        phases = []
+        for sign in (+1.0, -1.0):
+            frac = dd_np.add_f(
+                (np.asarray(toas.mjd_frac[0]),
+                 np.asarray(toas.mjd_frac[1])), sign * step_d)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                t2 = get_TOAs_array(
+                    (np.asarray(toas.mjd_day), frac),
+                    obs=list(toas.obs), freqs=toas.freq_mhz,
+                    errors=toas.error_us, ephem=self.EPHEM.value,
+                    planets=bool(self.PLANET_SHAPIRO.value),
+                    flags=[dict(f) for f in toas.flags])
+            phases.append(self.phase(t2, abs_phase=False).turns)
+        self._cache, self._cache_key = saved
+        diff = dd_np.sub((np.asarray(phases[0].hi),
+                          np.asarray(phases[0].lo)),
+                         (np.asarray(phases[1].hi),
+                          np.asarray(phases[1].lo)))
+        return dd_np.to_f64(diff) / (2.0 * sample_step_s)
+
     def d_phase_d_param(self, toas, param: str):
         """Single-parameter phase derivative [turns/unit] via the same
         jacfwd path (reference: TimingModel.d_phase_d_param)."""

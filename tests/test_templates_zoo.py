@@ -196,3 +196,39 @@ def test_free_fixed_machinery():
     # the free location moved toward the truth
     assert abs(start.locs[0] - 0.2) < 0.01
     assert out["theta_err"][~mask].max() == 0.0
+
+
+def test_empirical_fourier_and_kde_recover_profile():
+    """Both empirical templates (measured, not ML-fit) approximate the
+    true two-peak pdf from its own photon draws."""
+    import numpy as np
+
+    from pint_tpu.templates import (
+        LCEmpiricalFourier,
+        LCGaussian,
+        LCKernelDensity,
+        LCTemplate,
+    )
+
+    rng = np.random.default_rng(5)
+    truth = LCTemplate([LCGaussian(), LCGaussian()], [0.4, 0.3],
+                       [0.25, 0.7], [[0.03], [0.06]])
+    phases = truth.random(60000, rng=rng)
+    xs = np.linspace(0, 1, 512, endpoint=False)
+    ytrue = truth(xs)
+    for maker in (lambda: LCEmpiricalFourier.from_phases(phases,
+                                                         nharm=24),
+                  lambda: LCKernelDensity(phases)):
+        t = maker()
+        y = t(xs)
+        # unit normalization and pointwise agreement at few-percent
+        assert abs(np.mean(y) - 1.0) < 0.02
+        err = np.max(np.abs(y - ytrue)) / np.max(ytrue)
+        assert err < 0.08, type(t).__name__
+    # weighted measurement: weighting out half the photons of peak 2
+    # suppresses it
+    w = np.where(np.abs(phases - 0.7) < 0.15, 0.2, 1.0)
+    tw = LCEmpiricalFourier.from_phases(phases, weights=w, nharm=24)
+    y = tw(xs)
+    assert y[np.argmin(np.abs(xs - 0.25))] > \
+        y[np.argmin(np.abs(xs - 0.7))]
