@@ -107,14 +107,38 @@ class Fitter:
         return self.stats
 
     @staticmethod
-    def auto(toas, model, downhill=True, **kw):
+    def auto(toas, model, downhill=True, device=None, **kw):
         """Pick a fitter from model contents and data (reference:
         Fitter.auto): wideband when TOAs carry -pp_dm DM channels, GLS
         when correlated-noise components are present, WLS otherwise;
-        downhill wrappers by default."""
+        downhill wrappers by default.
+
+        ``device`` selects the DeviceDownhillGLSFitter — whole
+        downhill fits as one jitted kernel per trial. Default: auto-on
+        when the process backend is TPU and the model supports the
+        anchored step (there the host fitters' exact-dd surfaces pin
+        to the CPU backend, so the device fitter is both the fastest
+        AND the most TPU-native path); explicit True/False overrides."""
+        import jax
+
         from pint_tpu.wideband import has_wideband_dm
 
-        if has_wideband_dm(toas):
+        wideband = has_wideband_dm(toas)
+        if device and not downhill:
+            raise ValueError(
+                "device=True requires downhill=True: the device fit "
+                "path IS a downhill loop (use build_fit_step directly "
+                "for single linearized solves)")
+        if device is None:
+            device = (downhill
+                      and jax.default_backend() == "tpu"
+                      and model.supports_anchored())
+        if device and downhill:
+            from pint_tpu.gls import DeviceDownhillGLSFitter
+
+            return DeviceDownhillGLSFitter(toas, model,
+                                           wideband=wideband, **kw)
+        if wideband:
             from pint_tpu.wideband_fitter import (
                 WidebandDownhillFitter,
                 WidebandTOAFitter,

@@ -811,11 +811,20 @@ class TimingModel:
         # recompute on the model's next call with the original toas
         saved = (self._cache, self._cache_key)
         step_d = sample_step_s / SECS_PER_DAY
+        # the caller's mjd_frac is ALREADY clock-corrected (TOAs apply
+        # corrections in place); get_TOAs_array would correct again,
+        # shifting both evaluations by the full clock chain — so undo
+        # the correction first and let the fresh pipeline re-apply it
+        clk = np.zeros(toas.ntoas)
+        if getattr(toas, "clock_applied", False):
+            clk = np.array([float(f.get("clkcorr", 0.0))
+                            for f in toas.flags])
         phases = []
         for sign in (+1.0, -1.0):
             frac = dd_np.add_f(
                 (np.asarray(toas.mjd_frac[0]),
-                 np.asarray(toas.mjd_frac[1])), sign * step_d)
+                 np.asarray(toas.mjd_frac[1])),
+                sign * step_d - clk / SECS_PER_DAY)
             with warnings.catch_warnings():
                 warnings.simplefilter("ignore")
                 t2 = get_TOAs_array(
@@ -823,7 +832,8 @@ class TimingModel:
                     obs=list(toas.obs), freqs=toas.freq_mhz,
                     errors=toas.error_us, ephem=self.EPHEM.value,
                     planets=bool(self.PLANET_SHAPIRO.value),
-                    flags=[dict(f) for f in toas.flags])
+                    flags=[{k: v for k, v in f.items()
+                            if k != "clkcorr"} for f in toas.flags])
             phases.append(self.phase(t2, abs_phase=False).turns)
         self._cache, self._cache_key = saved
         diff = dd_np.sub((np.asarray(phases[0].hi),

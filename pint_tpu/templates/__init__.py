@@ -299,14 +299,19 @@ class LCKernelDensity:
             neff = w.sum() ** 2 / (w ** 2).sum()
             bw = 1.06 * sigma_c * neff ** (-0.2) / 3.0
         self.bw = float(max(bw, 2.0 / ngrid))
-        grid = np.arange(ngrid) / ngrid
+        # bin CENTERS: anchoring at left edges would rotate the whole
+        # density by -0.5/ngrid (a systematic phase bias)
+        grid = (np.arange(ngrid) + 0.5) / ngrid
         # O(N + ngrid log ngrid): histogram the weighted phases onto
         # the grid (bin width 1/ngrid << bw, negligible smearing) and
         # circular-convolve with the wrapped-Gaussian kernel by FFT —
-        # construction stays cheap at millions of photons
+        # construction stays cheap at millions of photons. The kernel
+        # is indexed by center-to-center offsets, so it is the same
+        # circular-distance array either way.
         hist, _ = np.histogram(ph, bins=ngrid, range=(0.0, 1.0),
                                weights=w)
-        dcirc = np.minimum(grid, 1.0 - grid)
+        off = np.arange(ngrid) / ngrid
+        dcirc = np.minimum(off, 1.0 - off)
         kern = np.exp(-0.5 * (dcirc / self.bw) ** 2)
         dens = np.real(np.fft.ifft(np.fft.fft(hist)
                                    * np.fft.fft(kern)))
@@ -316,8 +321,13 @@ class LCKernelDensity:
 
     def __call__(self, phases) -> np.ndarray:
         ph = np.mod(np.asarray(phases, np.float64), 1.0)
-        return np.interp(ph, np.concatenate([self._grid, [1.0]]),
-                         np.concatenate([self._dens, [self._dens[0]]]))
+        # circular interpolation: pad both ends with the wrapped
+        # neighbors (grid runs 0.5/G .. 1-0.5/G)
+        xp = np.concatenate([[self._grid[-1] - 1.0], self._grid,
+                             [self._grid[0] + 1.0]])
+        fp = np.concatenate([[self._dens[-1]], self._dens,
+                             [self._dens[0]]])
+        return np.interp(ph, xp, fp)
 
 
 class LCTemplate:

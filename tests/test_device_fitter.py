@@ -108,3 +108,27 @@ class TestDeviceDownhill:
         assert fit.stats.iterations >= 1
         assert fit.stats.toas_per_sec > 0
         assert fit.stats.fitter == "DeviceDownhillGLSFitter"
+
+
+def test_fitter_auto_device_selection():
+    """Fitter.auto(device=True) returns the device fitter (narrowband
+    and wideband); default on the CPU backend stays with the host
+    fitters."""
+    from pint_tpu.fitter import Fitter
+    from pint_tpu.gls import DownhillGLSFitter
+
+    _, m, toas = _two_models(n=100)
+    f = Fitter.auto(toas, m)
+    assert isinstance(f, DownhillGLSFitter)
+    assert not isinstance(f, DeviceDownhillGLSFitter)
+    fd = Fitter.auto(toas, m, device=True)
+    assert isinstance(fd, DeviceDownhillGLSFitter)
+    assert not fd.wideband
+    rng = np.random.default_rng(1)
+    for fl in toas.flags:
+        fl["pp_dm"] = str(20.0 + rng.normal(0, 1e-4))
+        fl["pp_dme"] = "1e-4"
+    fw = Fitter.auto(toas, m, device=True)
+    assert isinstance(fw, DeviceDownhillGLSFitter) and fw.wideband
+    chi2 = fd.fit_toas()
+    assert np.isfinite(chi2)
