@@ -140,13 +140,21 @@ class BayesianTiming:
 
         lnnorm = self._lnnorm
 
+        # with an explicit PhaseOffset the sampled PHOFF replaces the
+        # implicit mean removal — subtracting the mean here would make
+        # PHOFF exactly inert in the likelihood (the same bug class
+        # the fitters fix; see residuals.Residuals)
+        demean = "PhaseOffset" not in self.model.components
+
         def lnlike_core(tl_eff):
             # tl_eff is a jit INPUT, not a captured constant, so XLA
             # cannot constant-fold the tiny low word away against th0
             # (see build_batched_phase_eval)
             frac = frac_fn(tl_eff)
-            wmean = jnp.sum(frac * w) / jnp.sum(w)
-            r = (frac - wmean) / f0
+            if demean:
+                wmean = jnp.sum(frac * w) / jnp.sum(w)
+                frac = frac - wmean
+            r = frac / f0
             rCr = jnp.sum(r * r * w)
             if eid is not None:
                 wr_seg = jax.ops.segment_sum(w * r, eid,

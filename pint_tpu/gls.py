@@ -370,6 +370,7 @@ class DeviceDownhillGLSFitter(GLSFitter):
         step_fn, args, names = build_fit_step(self.model, self.toas,
                                               **self.step_flags)
         jitted = jax.jit(step_fn)
+        noff = 1 if names and names[0] == "Offset" else 0
         # host-side exact parameter state in the step's (th, tl) slots
         th = np.asarray(args[0], np.float64).copy()
         tl = np.asarray(args[1], np.float64).copy()
@@ -399,7 +400,7 @@ class DeviceDownhillGLSFitter(GLSFitter):
             iterations += 1
             lam, accepted = 1.0, False
             while lam >= min_lambda:
-                thc, tlc = bump(th, tl, lam * dp[1:])
+                thc, tlc = bump(th, tl, lam * dp[noff:])
                 outc = run(thc, tlc)
                 newchi2 = float(outc[2])
                 if np.isfinite(newchi2) and newchi2 <= best + 1e-12:
@@ -428,7 +429,8 @@ class DeviceDownhillGLSFitter(GLSFitter):
         tl0 = np.asarray(args[1], np.float64)
         total = dd_np.sub(dd_np.dd(th, tl), dd_np.dd(th0, tl0))
         delta_f64 = dd_np.to_f64(total)
-        self.update_model(np.concatenate([[0.0], delta_f64]), names)
+        self.update_model(
+            np.concatenate([np.zeros(noff), delta_f64]), names)
         self.set_uncertainties(cov, names)
         # final host refresh at the accepted optimum: residuals and
         # the ML noise realization (the device step returns neither

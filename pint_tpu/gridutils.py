@@ -37,6 +37,7 @@ def _build_grid_eval(model, toas, parnames: Sequence[str],
     # an empty remaining-free set is fine: the implicit Offset column is
     # always profiled, so the step still returns a meaningful chi2
     step_fn, args, names = build_fit_step(m, toas)
+    noff = 1 if names and names[0] == "Offset" else 0
     th0 = args[0]
     _, frozen_names, _, _, fh0, fl0 = m._pack()
     gidx = jnp.asarray([frozen_names.index(nm) for nm in parnames])
@@ -52,8 +53,9 @@ def _build_grid_eval(model, toas, parnames: Sequence[str],
         def one_iter(th):
             dparams, cov, chi2, r = step_fn(
                 th, args[1], fh, fl_z, *args[4:])
-            # names[0] is the Offset column; the rest align with th
-            return th + dparams[1:], chi2
+            # drop the Offset column when present; the rest align
+            # with th (PHOFF models have no implicit offset column)
+            return th + dparams[noff:], chi2
 
         for _ in range(maxiter):
             th, _ = one_iter(th)

@@ -17,6 +17,14 @@ from pint_tpu.ops.dd import DD
 class PhaseOffset(PhaseComponent):
     category = "phase_offset"
 
+    # the TZR phase must NOT include PHOFF (reference: PhaseOffset —
+    # the offset shifts observed phases relative to the TZR anchor):
+    # a constant applied to BOTH the main rows and the TZR row cancels
+    # identically in the anchored difference, making PHOFF inert and
+    # its design column zero (a singular normal matrix when free) —
+    # the bug the production-config component sweep caught.
+    apply_to_tzr = False
+
     def __init__(self):
         super().__init__()
         self.add_param(floatParameter("PHOFF", units="turn", value=0.0))

@@ -407,10 +407,15 @@ class TimingModel:
         return delay, tb, ctx
 
     def _raw_phase_fn(self, pv, batch, cache, sub: str):
-        """The full delay→phase chain (device, pure), absolute dd."""
+        """The full delay→phase chain (device, pure), absolute dd.
+        Components with ``apply_to_tzr = False`` (PhaseOffset) are
+        excluded from the TZR row: a constant present in both would
+        cancel out of the anchored difference entirely."""
         delay, tb, ctx = self._delay_tb(pv, batch, cache, sub)
         phase = DD(jnp.zeros_like(delay), jnp.zeros_like(delay))
         for comp in self.phase_components:
+            if sub == "tzr" and not getattr(comp, "apply_to_tzr", True):
+                continue
             phase = dd_add_dd(phase, comp.phase(pv, batch, cache[sub],
                                                 ctx, tb))
         return phase, delay
@@ -459,6 +464,8 @@ class TimingModel:
         other = jnp.zeros_like(delay)
         for comp in self.phase_components:
             if type(comp).__name__ in skip:
+                continue
+            if sub == "tzr" and not getattr(comp, "apply_to_tzr", True):
                 continue
             p = comp.phase(pv, batch, cache[sub], ctx, tb)
             other = other + (p.hi + p.lo)
@@ -771,7 +778,12 @@ class TimingModel:
     def designmatrix(self, toas, incoffset=True):
         """(M, names, units): M[i,j] = d(time-resid_i)/d(free-param_j)
         [s / param-unit], with a leading all-ones offset column when
-        incoffset (reference: TimingModel.designmatrix)."""
+        incoffset (reference: TimingModel.designmatrix). When a
+        PhaseOffset component is present, PHOFF REPLACES the implicit
+        offset column (reference semantics — both at once would be an
+        exactly collinear pair)."""
+        if "PhaseOffset" in self.components:
+            incoffset = False
         cache = self.get_cache(toas)
         free, _, th, tl, fh, fl = self._pack()
         fn = self._get_compiled()

@@ -110,9 +110,13 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid)
             -> (dparams, cov, chi2, resids)
 
-    dparams is the GLS parameter correction (Offset column first), cov
-    its covariance, chi2 the basis-marginalized chi2 at the current
-    point, resids the mean-subtracted time residuals [s].
+    dparams is the GLS parameter correction aligned with the returned
+    ``names`` (an implicit Offset column leads UNLESS the model has a
+    PhaseOffset — PHOFF replaces it, and then ``resids`` are NOT
+    mean-subtracted either: the fitted offset plays that role; check
+    names[0] == "Offset" rather than assuming it). cov is the
+    correction covariance, chi2 the basis-marginalized chi2 at the
+    current point, resids the time residuals [s].
 
     ``valid`` is a 0/1 mask supporting padding of the TOA axis to a
     mesh-divisible length: padded rows carry weight 0 everywhere.
@@ -131,6 +135,10 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         f0_src = ("free", free.index("F0"))
     else:
         f0_src = ("frozen", frozen.index("F0"))
+    # PHOFF replaces the implicit Offset column (reference semantics:
+    # both at once are exactly collinear -> singular normal matrix)
+    incoffset = "PhaseOffset" not in model.components
+    noff = 1 if incoffset else 0
 
     batch = cache["batch"]
     sc = {k: v for k, v in cache.items() if k != "batch"}
@@ -268,8 +276,12 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             f0 = (th[i] + tl[i]) if f0_src[0] == "free" \
                 else (fh[i] + fl[i])
         w = valid / nvec
-        wmean = jnp.sum(frac * w) / jnp.sum(w)
-        r = (frac - wmean) / f0
+        if incoffset:
+            wmean = jnp.sum(frac * w) / jnp.sum(w)
+            r = (frac - wmean) / f0
+        else:
+            # PHOFF models: the fitted offset replaces mean removal
+            r = frac / f0
         if jac32:
             # Jacobian via the f32/dd32 re-trace of the same phase
             # chain (see _use_f32_jac). Inputs split device-side so the
@@ -295,12 +307,16 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             f032 = f0.astype(jnp.float32)
             valid32 = valid.astype(jnp.float32)
             jac = jax.jacfwd(phase32)(ua) / f032
-            ones = (valid32 / f032)[:, None]
-            M = jnp.concatenate([ones, jac * valid32[:, None]], axis=1)
+            cols = [jac * valid32[:, None]]
+            if incoffset:
+                cols.insert(0, (valid32 / f032)[:, None])
+            M = jnp.concatenate(cols, axis=1)
         else:
             jac = jax.jacfwd(phase_f64)(th) / f0
-            ones = (valid / f0)[:, None]
-            M = jnp.concatenate([ones, jac * valid[:, None]], axis=1)
+            cols = [jac * valid[:, None]]
+            if incoffset:
+                cols.insert(0, (valid / f0)[:, None])
+            M = jnp.concatenate(cols, axis=1)
         r = r * valid
         Fv = F * valid[:, None]
         r_time = r
@@ -320,14 +336,14 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
                         batch32, cache32)
 
                 jac_dm = jax.jacfwd(dm_of32)(ua)
-                zcol = jnp.zeros((jac_dm.shape[0], 1), jac_dm.dtype)
-                M_dm = jnp.concatenate(
-                    [zcol, -jac_dm * valid32[:, None]], axis=1)
+                dm_cols = [-jac_dm * valid32[:, None]]
             else:
                 jac_dm = jax.jacfwd(dm_of64)(th)
-                zcol = jnp.zeros((jac_dm.shape[0], 1), jac_dm.dtype)
-                M_dm = jnp.concatenate(
-                    [zcol, -jac_dm * valid[:, None]], axis=1)
+                dm_cols = [-jac_dm * valid[:, None]]
+            if incoffset:  # zero DM response of the offset column
+                dm_cols.insert(0, jnp.zeros(
+                    (jac_dm.shape[0], 1), jac_dm.dtype))
+            M_dm = jnp.concatenate(dm_cols, axis=1)
             M = jnp.concatenate([M, M_dm], axis=0)
             r = jnp.concatenate([r, r_dm])
             nvec = jnp.concatenate([nvec, cache["wb_dme"] ** 2])
@@ -342,7 +358,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         dp, cov, chi2, _ = _gls_core(
             M, Fv, phi, r, nvec, valid, eid, jvar, nseg, f32mm=f32mm)
         if jac32:
-            sfull = jnp.concatenate([jnp.ones(1), s64])
+            sfull = jnp.concatenate([jnp.ones(noff), s64])
             dp = dp * sfull
             cov = cov * jnp.outer(sfull, sfull)
         return dp, cov, chi2, r_time
@@ -378,7 +394,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             jnp.asarray(phi_np), jnp.asarray(nvec_np),
             jnp.asarray(valid_np), jnp.asarray(eid_np),
             jnp.asarray(jvar_np))
-    return step_fn, args, ["Offset"] + free
+    return step_fn, args, (["Offset"] if incoffset else []) + free
 
 
 def _pad_leaf(a: np.ndarray, pad: int) -> np.ndarray:

@@ -24,7 +24,8 @@ class Residuals:
     """
 
     def __init__(self, toas, model, track_mode: Optional[str] = None,
-                 subtract_mean: bool = True, use_weighted_mean: bool = True):
+                 subtract_mean: Optional[bool] = None,
+                 use_weighted_mean: bool = True):
         self.toas = toas
         self.model = model
         if track_mode is None:
@@ -32,6 +33,13 @@ class Residuals:
                           if toas.get_pulse_numbers() is not None
                           else "nearest")
         self.track_mode = track_mode
+        if subtract_mean is None:
+            # with an explicit PhaseOffset the fitted PHOFF replaces
+            # the implicit mean removal (reference: Residuals defaults
+            # subtract_mean off when PHOFF is in the model — otherwise
+            # the mean subtraction deletes exactly the signal PHOFF
+            # measures and it always fits to zero)
+            subtract_mean = "PhaseOffset" not in model.components
         self.subtract_mean = subtract_mean
         self.use_weighted_mean = use_weighted_mean
         self._phase_resids = None

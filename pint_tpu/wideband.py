@@ -112,7 +112,7 @@ class WidebandTOAResiduals(CombinedResiduals):
     residuals.WidebandTOAResiduals): .toa is the phase/time channel,
     .dm the DM-measurement channel."""
 
-    def __init__(self, toas, model, subtract_mean: bool = True,
+    def __init__(self, toas, model, subtract_mean=None,
                  track_mode=None):
         from pint_tpu.residuals import Residuals
 

@@ -181,3 +181,80 @@ def test_sink_model_deepcopy_independent(sink):
         warnings.simplefilter("ignore")
         r2 = Residuals(toas, m2).rms_weighted()
     assert r2 > r1 * 10  # the copy's perturbation is visible only there
+
+def test_production_fit_step_across_component_zoo():
+    """The TPU production configuration (anchored + f32 Jacobian +
+    f32-MXU) must survive the kitchen-sink model — every component
+    family at once — and agree with the plain f64 direct step. This is
+    the guard that a component added/changed without dtype discipline
+    (a bare f64 constant, an unreduced large angle, an unscaled
+    column) cannot silently break the path the real chip runs."""
+    import jax
+
+    from pint_tpu.parallel import build_fit_step
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(SINK_PAR))
+        rng = np.random.default_rng(21)
+        # four frequency bands: FD1/FD2/DM/DMX are only separable
+        # with >= 3 distinct frequencies (each is a few-valued
+        # function of nu — fewer bands make the model itself singular)
+        toas = make_fake_toas_uniform(
+            54100, 55900, 300, model, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 820.0, 2100.0, 430.0], 75),
+            rng=rng)
+        for i, f in enumerate(toas.flags):
+            f["grp"] = "a" if i % 3 else "b"
+    sD, aD, names = build_fit_step(model, toas, anchored=False,
+                                   jac_f32=False, matmul_f32=False)
+    sP, aP, _ = build_fit_step(model, toas, anchored=True,
+                               jac_f32=True, matmul_f32=True)
+    oD = jax.jit(sD)(*aD)
+    oP = jax.jit(sP)(*aP)
+    sig = np.sqrt(np.diag(np.asarray(oD[1])))
+    assert np.all(np.isfinite(np.asarray(oP[0])))
+    assert np.all(np.isfinite(sig))
+    # residuals identical to sub-ns; steps within the f32 discipline
+    assert np.max(np.abs(np.asarray(oD[3]) - np.asarray(oP[3]))) < 1e-10
+    assert np.max(np.abs(np.asarray(oD[0]) - np.asarray(oP[0]))
+                  / sig) < 3e-2, names
+    assert abs(float(oD[2]) - float(oP[2])) < 1e-5 * abs(float(oD[2]))
+
+
+def test_phoff_is_actually_fittable():
+    """PHOFF replaces the implicit offset column AND the implicit mean
+    subtraction (reference: PhaseOffset semantics). Regression for the
+    production-sweep finding: PHOFF applied to the TZR row too (or
+    mean-subtracted away) is silently inert — simulate with a nonzero
+    PHOFF and recover it."""
+    from pint_tpu.fitter import DownhillWLSFitter
+
+    par = """PSR J1
+RAJ 10:12:33.43 1
+DECJ 53:07:02.5 1
+F0 310.0 1
+F1 -5e-16 1
+PEPOCH 55000
+DM 9.0
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+PHOFF 0.0 1
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m_t = get_model(io.StringIO(par.replace("PHOFF 0.0 1",
+                                                "PHOFF 0.013 1")))
+        toas = make_fake_toas_uniform(
+            54000, 56000, 300, m_t, error_us=1.0,
+            rng=np.random.default_rng(5), add_noise=True)
+        m = get_model(io.StringIO(par))
+    # the design matrix must NOT carry the implicit offset column
+    _, names, _ = m.designmatrix(toas)
+    assert "Offset" not in names and "PHOFF" in names
+    fit = DownhillWLSFitter(toas, m)
+    fit.fit_toas()
+    p = m.get_param("PHOFF")
+    assert abs(p.value - 0.013) < 5 * max(p.uncertainty, 1e-6)
