@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["z2m", "hm", "hmw", "h_sig", "sig2sigma", "sf_z2m", "sf_hm"]
+__all__ = ["z2m", "hm", "hmw", "h_sig", "sig2sigma", "sf_z2m", "sf_hm", "h2sig"]
 
 
 @partial(jax.jit, static_argnames=("m",))
@@ -119,3 +119,10 @@ def _sigma_from_logsf(logsf: float) -> float:
     for _ in range(10):
         x = np.sqrt(-2.0 * (logsf + np.log(x * np.sqrt(2 * np.pi))))
     return float(x)
+
+
+def h2sig(h: float) -> float:
+    """Significance in Gaussian sigma of an H-statistic (reference:
+    eventstats.h2sig). Delegates to h_sig, which works in log space
+    so huge H never underflows to inf."""
+    return h_sig(h)

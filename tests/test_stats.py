@@ -108,3 +108,10 @@ def test_annotate_feeds_global_scoreboard():
         x = sum(range(100))
     assert x == 4950
     assert scoreboard.counts["unit-test-phase"] == 1
+
+
+def test_h2sig_alias():
+    from pint_tpu.eventstats import h2sig, sf_hm, sig2sigma
+
+    assert h2sig(30.0) == sig2sigma(sf_hm(30.0))
+    assert 3.0 < h2sig(30.0) < 6.0
