@@ -34,6 +34,11 @@ def _val(pv, name, default=0.0):
     return (p.hi + p.lo) if p is not None else default
 
 
+_GL_UNITS = {"GLEP_": "MJD", "GLPH_": "turn", "GLTD_": "d",
+             "GLF0_": "Hz", "GLF1_": "Hz/s", "GLF2_": "Hz/s^2",
+             "GLF0D_": "Hz"}
+
+
 class Glitch(PhaseComponent):
     """Sudden spin-up events with exponential recovery (reference:
     glitch.Glitch). Per glitch index n: GLEP_n (epoch), GLPH_n (phase
@@ -56,8 +61,7 @@ class Glitch(PhaseComponent):
         for pre in self.PREFIXES:
             self.add_param(prefixParameter(
                 prefix=pre, index=1, index_str="1",
-                units={"GLEP_": "MJD", "GLPH_": "turn",
-                       "GLTD_": "d"}.get(pre, "Hz")))
+                units=_GL_UNITS[pre]))
         self.glitch_ids: list = []
 
     def add_glitch(self, index, epoch, ph=0.0, f0=0.0, f1=0.0, f2=0.0,
@@ -68,8 +72,7 @@ class Glitch(PhaseComponent):
             self.add_param(prefixParameter(
                 prefix=pre, index=index, index_str=str(index), value=val,
                 frozen=frozen if pre != "GLEP_" else True,
-                units={"GLEP_": "MJD", "GLPH_": "turn", "GLTD_": "d"
-                       }.get(pre, "Hz")))
+                units=_GL_UNITS[pre]))
         self.setup()
 
     def setup(self):
@@ -86,7 +89,7 @@ class Glitch(PhaseComponent):
                 if nm not in self.params:
                     self.add_param(prefixParameter(
                         prefix=pre, index=i, index_str=str(i),
-                        value=0.0, units=""))
+                        value=0.0, units=_GL_UNITS[pre]))
                 elif self.params[nm].value is None and pre != "GLEP_":
                     self.params[nm].value = 0.0
 
@@ -94,6 +97,13 @@ class Glitch(PhaseComponent):
         for i in self.glitch_ids:
             if self.params[f"GLEP_{i}"].value in (None, 0.0):
                 raise ValueError(f"glitch {i} needs GLEP_{i}")
+
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {pre + "*": parse_unit(_GL_UNITS[pre])
+                for pre in self.PREFIXES}
+
 
     def phase(self, pv, batch, cache, ctx, tb):
         ref = self._parent.ref_day
@@ -153,6 +163,15 @@ class Wave(PhaseComponent):
     def validate(self):
         if self.wave_ids and self.WAVE_OM.value is None:
             raise ValueError("WAVE terms require WAVE_OM")
+
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        out = {"WAVE_OM": parse_unit("rad/d"),
+               "WAVEEPOCH": parse_unit("d"),
+               "WAVE*": parse_unit("s")}
+        return out
+
 
     def prepare(self, toas, batch, cache, prefix=""):
         if not self.wave_ids or self.WAVE_OM.value is None:

@@ -57,6 +57,13 @@ _FDJUMP_RE = re.compile(r"^FD(\d+)JUMP$")
 # canonical mask param name per alias
 MASK_CANONICAL = {"T2EFAC": "EFAC", "T2EQUAD": "EQUAD", "TNECORR": "ECORR"}
 
+# canonical units per mask family (the par-file convention; kept in
+# sync with the components' own add_noise_param declarations and
+# checked by the build-time unit discipline)
+MASK_UNITS = {"EFAC": "", "EQUAD": "us", "TNEQ": "log10(s)",
+              "ECORR": "us", "DMEFAC": "", "DMEQUAD": "pc cm^-3",
+              "JUMP": "s", "DMJUMP": "pc cm^-3", "FDJUMP": "s"}
+
 BINARY_COMPONENT_PREFIX = "Binary"
 
 
@@ -236,7 +243,11 @@ class ModelBuilder:
                 comp = get_comp(cls_name)
                 canonical = MASK_CANONICAL.get(key, key)
                 mask_counters[canonical] = mask_counters.get(canonical, 0) + 1
-                p = maskParameter(canonical, index=mask_counters[canonical])
+                p = maskParameter(
+                    canonical, index=mask_counters[canonical],
+                    units=MASK_UNITS.get(
+                        canonical,
+                        "s" if _FDJUMP_RE.match(key) else ""))
                 comp.add_param(p)
                 p.from_tokens(toks)
                 continue

@@ -119,6 +119,16 @@ def create_fourier_design_matrix(t_sec: np.ndarray, nmodes: int,
     return F, np.repeat(f, 2)
 
 
+
+
+def _spec(d):
+    """{name-or-'PREFIX*': parse_unit(text)} from a plain dict (see
+    pint_tpu.units._spec_lookup for the key rules)."""
+    from pint_tpu.units import parse_unit
+
+    return {k: parse_unit(v) for k, v in d.items()}
+
+
 class NoiseComponent(Component):
     """Base: category 'noise'; contributes no delay/phase. Subclasses
     override exactly one of the three noise hooks."""
@@ -145,6 +155,11 @@ class ScaleToaError(NoiseComponent):
     (reference: ScaleToaError.scale_toa_sigma)."""
 
     register = True
+
+
+    def param_dimensions(self):
+        return _spec({"EFAC*": "", "EQUAD*": "us",
+                      "TNEQ*": "log10(s)"})
 
     def __init__(self):
         super().__init__()
@@ -204,6 +219,10 @@ class ScaleDmError(NoiseComponent):
 
     register = True
 
+
+    def param_dimensions(self):
+        return _spec({"DMEFAC*": "", "DMEQUAD*": "pc cm^-3"})
+
     def __init__(self):
         super().__init__()
         self.dmefacs: list = []
@@ -241,6 +260,11 @@ class EcorrNoise(NoiseComponent):
     (reference: EcorrNoise.ecorr_basis_weight_pair)."""
 
     register = True
+
+
+    def param_dimensions(self):
+        return _spec({"ECORR*": "us"})
+
     is_basis_noise = True
 
     def __init__(self):
@@ -323,6 +347,12 @@ class PLRedNoise(NoiseComponent):
     """
 
     register = True
+
+
+    def param_dimensions(self):
+        return _spec({"TNREDAMP": "", "TNREDGAM": "",
+                      "RNAMP": "us/sqrt(yr)", "RNIDX": ""})
+
     is_basis_noise = True
 
     def __init__(self):
@@ -388,6 +418,11 @@ class PLDMNoise(NoiseComponent):
     (reference: PLDMNoise.pl_dm_basis_weight_pair)."""
 
     register = True
+
+
+    def param_dimensions(self):
+        return _spec({"TNDMAMP": "", "TNDMGAM": ""})
+
     is_basis_noise = True
 
     REF_FREQ_MHZ = 1400.0
@@ -430,6 +465,11 @@ class PLChromNoise(NoiseComponent):
     (reference: PLChromNoise.pl_chrom_basis_weight_pair)."""
 
     register = True
+
+
+    def param_dimensions(self):
+        return _spec({"TNCHROMAMP": "", "TNCHROMGAM": ""})
+
     is_basis_noise = True
 
     REF_FREQ_MHZ = 1400.0
@@ -478,6 +518,11 @@ class PLSWNoise(NoiseComponent):
     SolarWindDispersion component for the geometry."""
 
     register = True
+
+
+    def param_dimensions(self):
+        return _spec({"TNSWAMP": "", "TNSWGAM": ""})
+
     is_basis_noise = True
 
     REF_FREQ_MHZ = 1400.0

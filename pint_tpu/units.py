@@ -77,6 +77,7 @@ _ATOMS: Dict[str, Dict[str, Fraction]] = {
     "cycle": {"A": Fraction(1)},
     "msun": {"M": Fraction(1)},
     "kg": {"M": Fraction(1)},
+    "strain": {},          # dimensionless (GW convention)
     "1": {},
     "": {},
 }
@@ -122,10 +123,17 @@ DIMENSIONLESS = Unit()
 
 def _parse_atom(tok: str) -> Unit:
     """One factor: ``atom`` or ``atom^exp`` (exp may be negative or
-    fractional like 2/3)."""
+    fractional like 2/3). ``sqrt(X)`` is X^(1/2); ``log10`` /
+    ``log10(X)`` is dimensionless (a logarithm)."""
     tok = tok.strip()
     if not tok:
         return DIMENSIONLESS
+    low = tok.lower()
+    if low == "log10" or (low.startswith("log10(")
+                          and low.endswith(")")):
+        return DIMENSIONLESS
+    if low.startswith("sqrt(") and low.endswith(")"):
+        return _parse_atom(tok[5:-1]) ** Fraction(1, 2)
     if "^" in tok:
         base, exp = tok.split("^", 1)
     elif tok[-1].isdigit() and tok[:-2] and tok[-2] in "-+" \

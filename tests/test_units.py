@@ -117,3 +117,33 @@ XR2_0001 55200
             warnings.simplefilter("ignore")
             m = get_model(io.StringIO(par))
         check_model_units(m)  # idempotent re-check
+
+
+class TestParserExtensions:
+    def test_sqrt_and_log10_forms(self):
+        # (note: '/' always splits division, so fractional exponents
+        # must be decimal: 'yr^-0.5', not 'yr^-1/2')
+        assert parse_unit("us/sqrt(yr)") == parse_unit("us yr^-0.5")
+        assert parse_unit("sqrt(s)") == parse_unit("s^0.5")
+        assert parse_unit("sqrt(s)") != parse_unit("s")
+        for t in ("log10", "log10(s)", "log10(strain)", "strain"):
+            assert parse_unit(t) == DIMENSIONLESS, t
+
+    def test_mask_units_match_component_declarations(self):
+        """MASK_UNITS (the par-file builder's table) must stay in sync
+        with each component's own add_* declaration — the drift hazard
+        of having two declaration sites, made a checked invariant."""
+        from pint_tpu.models.jump import PhaseJump
+        from pint_tpu.models.model_builder import MASK_UNITS
+        from pint_tpu.models.noise import EcorrNoise, ScaleToaError
+
+        ste = ScaleToaError()
+        for pre in ("EFAC", "EQUAD", "TNEQ"):
+            p = ste.add_noise_param(pre, "-be", "X", 1.0)
+            assert parse_unit(p.units) == parse_unit(
+                MASK_UNITS[pre]), pre
+        p = EcorrNoise().add_ecorr("-be", "X", 1.0)
+        assert parse_unit(p.units) == parse_unit(MASK_UNITS["ECORR"])
+        jp = PhaseJump().add_jump(key="-be", key_value=("X",),
+                                  value=0.0)
+        assert parse_unit(jp.units) == parse_unit(MASK_UNITS["JUMP"])
