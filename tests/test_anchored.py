@@ -65,50 +65,45 @@ CASES = {
 
 @pytest.mark.parametrize("extra", list(CASES.values()),
                          ids=list(CASES.keys()))
-class TestAnchoredEqualsDirect:
-    def test_at_anchor(self, extra):
-        model, toas = _problem(extra)
-        sD, aD, _ = build_fit_step(model, toas, anchored=False,
-                                   jac_f32=False)
-        sA, aA, _ = build_fit_step(model, toas, anchored=True,
-                                   jac_f32=False)
-        oD = jax.jit(sD)(*aD)
-        oA = jax.jit(sA)(*aA)
-        rD, rA = np.asarray(oD[3]), np.asarray(oA[3])
-        assert np.max(np.abs(rD - rA)) < 1e-11  # 10 ps
-        assert abs(float(oD[2]) - float(oA[2])) < 1e-6 * abs(
-            float(oD[2])) + 1e-9
-        sig = np.sqrt(np.diag(np.asarray(oD[1])))
-        assert np.max(np.abs(np.asarray(oD[0]) - np.asarray(oA[0]))
-                      / sig) < 1e-4
+def test_anchored_equals_direct(extra):
+    """At the anchor AND under a compensated perturbation, with the
+    same two compiled steps (compile count is what the suite's wall
+    time is made of). The anchored path receives the exact delta; the
+    direct path gets it folded into its dd pair with compensation."""
+    model, toas = _problem(extra)
+    free = model.free_params
+    sD, aD, _ = build_fit_step(model, toas, anchored=False,
+                               jac_f32=False)
+    sA, aA, _ = build_fit_step(model, toas, anchored=True,
+                               jac_f32=False)
+    jD, jA = jax.jit(sD), jax.jit(sA)
 
-    def test_perturbed_compensated(self, extra):
-        """Nonzero delta: the anchored path receives the exact delta;
-        the direct path gets the same delta folded into its dd pair
-        with compensation. Sub-ps agreement required."""
-        model, toas = _problem(extra)
-        free = model.free_params
-        sD, aD, _ = build_fit_step(model, toas, anchored=False,
-                                   jac_f32=False)
-        sA, aA, _ = build_fit_step(model, toas, anchored=True,
-                                   jac_f32=False)
-        rng = np.random.default_rng(5)
-        # perturb every free param by ~1e-7 of a natural scale
-        dth = np.zeros(len(free))
-        dth[free.index("F0")] = 3e-10
-        dth[free.index("F1")] = -2e-18
-        dth[free.index("DM")] = 1e-5
-        th = np.asarray(aD[0])
-        tl = np.asarray(aD[1])
-        th2 = th + dth
-        tl2 = tl + (dth - (th2 - th))
-        oD = jax.jit(sD)(*((jnp.asarray(th2), jnp.asarray(tl2))
-                           + aD[2:]))
-        oA = jax.jit(sA)(*((jnp.asarray(dth),) + aA[1:]))
-        rD, rA = np.asarray(oD[3]), np.asarray(oA[3])
-        assert np.max(np.abs(rD - rA)) < 1e-11
-        assert abs(float(oD[2]) - float(oA[2])) < 1e-6 * abs(
-            float(oD[2])) + 1e-9
+    # --- at the anchor ---
+    oD = jD(*aD)
+    oA = jA(*aA)
+    rD, rA = np.asarray(oD[3]), np.asarray(oA[3])
+    assert np.max(np.abs(rD - rA)) < 1e-11  # 10 ps
+    assert abs(float(oD[2]) - float(oA[2])) < 1e-6 * abs(
+        float(oD[2])) + 1e-9
+    sig = np.sqrt(np.diag(np.asarray(oD[1])))
+    assert np.max(np.abs(np.asarray(oD[0]) - np.asarray(oA[0]))
+                  / sig) < 1e-4
+
+    # --- perturbed (same compiled steps, new arguments) ---
+    dth = np.zeros(len(free))
+    dth[free.index("F0")] = 3e-10
+    dth[free.index("F1")] = -2e-18
+    dth[free.index("DM")] = 1e-5
+    th = np.asarray(aD[0])
+    tl = np.asarray(aD[1])
+    th2 = th + dth
+    tl2 = tl + (dth - (th2 - th))
+    oD = jD(*((jnp.asarray(th2), jnp.asarray(tl2)) + aD[2:]))
+    oA = jA(*((jnp.asarray(dth),) + aA[1:]))
+    rD, rA = np.asarray(oD[3]), np.asarray(oA[3])
+    assert np.max(np.abs(rD - rA)) < 1e-11
+    assert abs(float(oD[2]) - float(oA[2])) < 1e-6 * abs(
+        float(oD[2])) + 1e-9
 
 
 def test_anchored_with_f32_jacobian():
