@@ -13,10 +13,13 @@ from typing import Optional
 import numpy as np
 
 from pint_tpu.models.parameter import split_prefixed_name  # noqa: F401
-from pint_tpu.ops.taylor import taylor_horner  # noqa: F401
+from pint_tpu.ops.taylor import (  # noqa: F401
+    taylor_horner,
+    taylor_horner_deriv,
+)
 
 __all__ = ["FTest", "weighted_mean", "dmxparse",
-           "split_prefixed_name", "taylor_horner",
+           "split_prefixed_name", "taylor_horner", "taylor_horner_deriv",
            "format_uncertainty", "dmx_ranges", "add_dmx_ranges",
            "wavex_setup", "dmwavex_setup",
            "akaike_information_criterion",
