@@ -26,7 +26,7 @@ from pint_tpu.toa import TOAs, get_TOAs_array
 __all__ = ["load_fits_TOAs", "load_event_TOAs", "load_Fermi_TOAs",
            "load_NICER_TOAs", "load_RXTE_TOAs", "load_NuSTAR_TOAs",
            "load_Swift_TOAs", "load_XMM_TOAs", "get_event_weights",
-           "get_event_TOAs", "get_Fermi_TOAs", "get_NICER_TOAs", "get_RXTE_TOAs", "get_NuSTAR_TOAs", "get_Swift_TOAs", "get_XMM_TOAs"]
+           "get_fits_TOAs", "get_event_TOAs", "get_Fermi_TOAs", "get_NICER_TOAs", "get_RXTE_TOAs", "get_NuSTAR_TOAs", "get_Swift_TOAs", "get_XMM_TOAs"]
 
 # (MJDREFI, MJDREFF) fallbacks when the header omits them
 MISSION_MJDREF = {
@@ -176,6 +176,7 @@ def get_event_weights(toas: TOAs) -> Optional[np.ndarray]:
 # the reference's modern entry-point names (get_* returning a fully
 # computed TOAs object — which is what the load_* functions here
 # already produce; reference: event_toas.get_NICER_TOAs etc.)
+get_fits_TOAs = load_fits_TOAs
 get_event_TOAs = load_event_TOAs
 get_Fermi_TOAs = load_Fermi_TOAs
 get_NICER_TOAs = load_NICER_TOAs

@@ -1,7 +1,8 @@
 """Analysis utilities: F-test, DMX parsing/statistics, weighted stats.
 
 Reference: src/pint/utils.py (FTest, dmxparse, weighted_mean,
-split_prefixed_name, taylor_horner — the latter two live in
+split_prefixed_name, taylor_horner, taylor_horner_deriv — the
+latter three live in
 pint_tpu.models.parameter / pint_tpu.ops.taylor here and are
 re-exported for API familiarity).
 """
