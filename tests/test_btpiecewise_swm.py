@@ -180,3 +180,21 @@ class TestSolarWindSWM1:
             _mk(SW_BASE + "SWM 1\nSWP 0.5\n")
         with pytest.raises(NotImplementedError):
             _mk(SW_BASE + "SWM 2\n")
+
+
+def test_btpiecewise_parfile_roundtrip():
+    """as_parfile keeps the piece windows/values and the rebuilt model
+    matches (incl. the MJDParameter dd split of T0X epochs)."""
+    par = (BASE + "BINARY BT_piecewise\n" + BT_ORBIT
+           + "T0X_0001 55000.20021234567 1\nA1X_0001 3.5004 1\n"
+           + "XR1_0001 54800\nXR2_0001 55200\n")
+    m = _mk(par)
+    m2 = _mk(m.as_parfile())
+    assert "BinaryBTPiecewise" in m2.components
+    for nm in ("T0X_0001", "A1X_0001", "XR1_0001", "XR2_0001"):
+        v1, v2 = m.get_param(nm).value, m2.get_param(nm).value
+        assert v2 == pytest.approx(v1, rel=0, abs=1e-12), nm
+    # the T0X dd pair survives the round trip to sub-ns
+    d1 = m.get_param("T0X_0001").dd
+    d2 = m2.get_param("T0X_0001").dd
+    assert abs((d1[0] - d2[0]) + (d1[1] - d2[1])) < 1e-13  # days
