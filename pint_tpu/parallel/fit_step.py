@@ -198,11 +198,32 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     afn = None
     f0_ref = 0.0
     if anchored_on:
-        anc_arrays, anc_static = model.build_anchor(toas)
-        afn = model._build_anchored_fn(anc_static)
-        sc = {**sc, "anchor": {k: jnp.asarray(v)
-                               for k, v in anc_arrays.items()}}
-        f0_ref = anc_static["fref"][0]
+        try:
+            anc_arrays, anc_static = model.build_anchor(toas)
+            afn = model._build_anchored_fn(anc_static)
+            new_f0_ref = anc_static["fref"][0]
+        except Exception as e:  # pragma: no cover — defensive: on a
+            # CPU backend the direct chain is equally exact, so an
+            # unforeseen host-reference failure degrades gracefully;
+            # on TPU the direct absolute-phase chain is NOT
+            # trustworthy (non-IEEE emulated f64 — CLAUDE.md), so a
+            # silent fallback would be a correctness downgrade:
+            # re-raise there
+            if jax.default_backend() == "tpu":
+                raise
+            from pint_tpu.logging import log
+
+            log.warning(
+                "anchored fit-step build failed (%r); falling back "
+                "to the direct phase chain (exact on this backend)", e)
+            anchored_on = False
+        else:
+            # commit only after every build step succeeded: a partial
+            # failure must not leave stale anchor arrays riding the
+            # cache through padding/sharding/f32 conversion
+            sc = {**sc, "anchor": {k: jnp.asarray(v)
+                                   for k, v in anc_arrays.items()}}
+            f0_ref = new_f0_ref
 
     nvec_np = model.scaled_toa_uncertainty(toas) ** 2
     # ECORR rides the Sherman-Morrison segment path (one rank-1
