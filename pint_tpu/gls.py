@@ -362,22 +362,22 @@ class DeviceDownhillGLSFitter(GLSFitter):
         self.step_flags = dict(step_flags, wideband=wideband)
 
     def fit_toas(self, maxiter=20, min_lambda=1e-3,
-                 required_chi2_decrease=1e-2):
+                 required_chi2_decrease=1e-2,
+                 steps_per_dispatch=None):
+        """``steps_per_dispatch`` > 1 runs that many downhill
+        iterations inside ONE device program (build_fit_loop) and
+        replays the returned ledger on host in exact dd — measured on
+        the axon tunnel every dispatch carries a large fixed cost, so
+        this is the difference between a usable and an unusable
+        full-fit path on TPU. Default: 8 on TPU, 1 elsewhere (on CPU
+        dispatch is ~us and the plain step keeps compile time down)."""
         from pint_tpu.ops import dd_np
-        from pint_tpu.parallel import build_fit_step
+        from pint_tpu.parallel import build_fit_loop, build_fit_step
 
+        if steps_per_dispatch is None:
+            steps_per_dispatch = \
+                8 if jax.default_backend() == "tpu" else 1
         t0 = time.perf_counter()
-        step_fn, args, names = build_fit_step(self.model, self.toas,
-                                              **self.step_flags)
-        jitted = jax.jit(step_fn)
-        noff = 1 if names and names[0] == "Offset" else 0
-        # host-side exact parameter state in the step's (th, tl) slots
-        th = np.asarray(args[0], np.float64).copy()
-        tl = np.asarray(args[1], np.float64).copy()
-        rest = args[2:]
-
-        def run(th_, tl_):
-            return jitted(jnp.asarray(th_), jnp.asarray(tl_), *rest)
 
         def bump(th_, tl_, d):
             """(th, tl) + d with the low part carrying the rounding
@@ -385,41 +385,97 @@ class DeviceDownhillGLSFitter(GLSFitter):
             s = dd_np.add(dd_np.dd(th_, tl_), dd_np.dd(d))
             return np.asarray(s[0]), np.asarray(s[1])
 
-        out = run(th, tl)
-        dp = np.asarray(out[0], np.float64)
-        cov = np.asarray(out[1])
-        best = float(out[2])
-        if not np.isfinite(best) or not np.all(np.isfinite(dp)):
+        def nonfinite_error():
             raise ValueError(
                 "device fit step produced non-finite values "
                 "(singular system? use GLSFitter's SVD fallback)")
+
+        if steps_per_dispatch > 1:
+            # maxiter is honored at steps_per_dispatch granularity:
+            # the loop program is compiled for one fixed K (clamped
+            # so a single dispatch never exceeds maxiter), and a
+            # final partial dispatch would need its own compile, so a
+            # multi-dispatch fit may run up to K-1 iterations past
+            # maxiter before reporting MaxiterReached
+            loop_fn, args, names = build_fit_loop(
+                self.model, self.toas,
+                max_iter=int(min(steps_per_dispatch, maxiter)),
+                min_lambda=min_lambda,
+                required_chi2_decrease=required_chi2_decrease,
+                **self.step_flags)
+        else:
+            loop_fn, args, names = build_fit_step(
+                self.model, self.toas, **self.step_flags)
+        jitted = jax.jit(loop_fn)
+        noff = 1 if names and names[0] == "Offset" else 0
+        # host-side exact parameter state in the step's (th, tl) slots
+        th = np.asarray(args[0], np.float64).copy()
+        tl = np.asarray(args[1], np.float64).copy()
+        rest = args[2:]
         iterations = 0
         converged = False
         maxed_out = False
-        for _ in range(maxiter):
-            iterations += 1
-            lam, accepted = 1.0, False
-            while lam >= min_lambda:
-                thc, tlc = bump(th, tl, lam * dp[noff:])
-                outc = run(thc, tlc)
-                newchi2 = float(outc[2])
-                if np.isfinite(newchi2) and newchi2 <= best + 1e-12:
-                    accepted = True
+
+        if steps_per_dispatch > 1:
+            while True:
+                out = jitted(jnp.asarray(th), jnp.asarray(tl), *rest)
+                dp = np.asarray(out[2], np.float64)
+                cov = np.asarray(out[3])
+                best = float(out[4])
+                if iterations == 0 and (
+                        not np.isfinite(float(out[5]))
+                        or not np.all(np.isfinite(dp))):
+                    nonfinite_error()
+                niter = int(out[6])
+                deltas = np.asarray(out[8], np.float64)
+                lams = np.asarray(out[9], np.float64)
+                # exact host replay of the device's accepted updates
+                for k in range(niter):
+                    if lams[k] > 0.0:
+                        th, tl = bump(th, tl, deltas[k])
+                iterations += niter
+                if bool(out[7]):          # loop converged on device
+                    converged = True
                     break
-                lam /= 2.0
-            if not accepted:
-                converged = True
-                break
-            improved = best - newchi2
-            th, tl = thc, tlc
-            dp = np.asarray(outc[0], np.float64)
-            cov = np.asarray(outc[1])
-            best = newchi2
-            if improved < required_chi2_decrease:
-                converged = True
-                break
+                if iterations >= maxiter:
+                    maxed_out = True
+                    break
         else:
-            maxed_out = True
+            def run(th_, tl_):
+                return jitted(jnp.asarray(th_), jnp.asarray(tl_),
+                              *rest)
+
+            out = run(th, tl)
+            dp = np.asarray(out[0], np.float64)
+            cov = np.asarray(out[1])
+            best = float(out[2])
+            if not np.isfinite(best) or not np.all(np.isfinite(dp)):
+                nonfinite_error()
+            for _ in range(maxiter):
+                iterations += 1
+                lam, accepted = 1.0, False
+                while lam >= min_lambda:
+                    thc, tlc = bump(th, tl, lam * dp[noff:])
+                    outc = run(thc, tlc)
+                    newchi2 = float(outc[2])
+                    if np.isfinite(newchi2) and \
+                            newchi2 <= best + 1e-12:
+                        accepted = True
+                        break
+                    lam /= 2.0
+                if not accepted:
+                    converged = True
+                    break
+                improved = best - newchi2
+                th, tl = thc, tlc
+                dp = np.asarray(outc[0], np.float64)
+                cov = np.asarray(outc[1])
+                best = newchi2
+                if improved < required_chi2_decrease:
+                    converged = True
+                    break
+            else:
+                maxed_out = True
         # sync the model to the accepted device state even when about
         # to raise: callers catching MaxiterReached expect the best
         # point found (host DownhillGLSFitter behavior). (th, tl) are

@@ -13,6 +13,7 @@ process pool for chi2 grids. Here the parallel axes are TPU-native:
 """
 
 from pint_tpu.parallel.fit_step import (  # noqa: F401
+    build_fit_loop,
     build_fit_step,
     build_sharded_fit_step,
 )

@@ -26,9 +26,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu.ops.dd import DD, dd_frac, dd_to_dd32
+from pint_tpu.ops.dd import DD, dd_add, dd_frac, dd_to_dd32
+from pint_tpu.ops.dd import dd as dd_new
 
-__all__ = ["build_fit_step", "build_sharded_fit_step", "toa_sharding"]
+__all__ = ["build_fit_loop", "build_fit_step",
+           "build_sharded_fit_step", "toa_sharding"]
 
 
 def _pad_to(n: int, multiple: int) -> int:
@@ -392,8 +394,6 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     def make_pv(thx, tlx, fhx, flx):
         """pv dict for auxiliary device channels (DM), honoring the
         anchored delta-theta convention and the caller's dtype."""
-        from pint_tpu.ops.dd import dd_add
-
         if anchored_on:
             f32m = thx.dtype == jnp.float32
             rh = jnp.asarray(ref32_c.hi if f32m else th0_c)
@@ -416,6 +416,127 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             jnp.asarray(valid_np), jnp.asarray(eid_np),
             jnp.asarray(jvar_np))
     return step_fn, args, (["Offset"] if incoffset else []) + free
+
+
+def build_fit_loop(model, toas, max_iter: int = 8,
+                   min_lambda: float = 1e-3,
+                   required_chi2_decrease: float = 1e-2,
+                   **step_flags):
+    """Up to ``max_iter`` downhill GLS iterations — step-halving line
+    search included — as ONE jittable device program, plus an exact
+    replay ledger for the host.
+
+    Motivation (measured, axon TPU v5e over the tunnel): every device
+    dispatch pays a large fixed cost, so the one-round-trip-per-trial
+    DeviceDownhillGLSFitter spends its wall time on dispatches, not
+    math (62-TOA full WLS fit: 3.2 s on TPU vs 6 ms on CPU-XLA).
+    Running K iterations per dispatch amortizes that fixed cost K-fold.
+    Reference behavior mirrored: src/pint/fitter.py DownhillFitter
+    (accept iff chi2 improves, else halve the step, stop at min_lambda
+    or when the improvement is below ``required_chi2_decrease``).
+
+    Precision contract: inside the loop the parameter state advances
+    by two-sum on the (th, tl) pair — approximate on TPU's non-IEEE
+    f64, exact on CPU — but every APPLIED update is recorded in a
+    ledger of plain-f64 deltas, so the host replays the identical
+    decision sequence in exact dd arithmetic afterward
+    (DeviceDownhillGLSFitter.fit_toas(steps_per_dispatch=K)). In
+    anchored mode (th, tl) carry small anchor-relative deltas, so the
+    intra-loop two-sum error is bounded by 2^-48 of the DELTA, far
+    inside the anchored error budget.
+
+    Returns ``(loop_fn, args, names)`` where
+
+        loop_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
+                eid, jvar) -> (th', tl', dp, cov, best_chi2, chi2_0,
+                               niter, converged, deltas, lams)
+
+    with ``deltas`` (max_iter, p) the applied parameter updates
+    (zero rows beyond ``niter`` or on the rejected final iteration),
+    ``lams`` (max_iter,) the accepted step-halving factors (0 =
+    rejected/unused), ``chi2_0`` the chi2 of the entry point, and
+    ``converged`` True when the loop stopped for a reason other than
+    exhausting ``max_iter``.
+    """
+    from jax import lax
+
+    step_fn, args, names = build_fit_step(model, toas, **step_flags)
+    noff = 1 if names and names[0] == "Offset" else 0
+    K = int(max_iter)
+
+    def _two_sum_add(ah, al, d):
+        # the host replay bump — dd_np.add(dd_np.dd(th, tl),
+        # dd_np.dd(d)) — composed from the 1:1-mirrored jax dd
+        # helpers, so on IEEE hardware the device trajectory and the
+        # host ledger replay produce identical pairs by construction;
+        # on TPU's non-IEEE f64 both degrade together to ~2^-48 of
+        # the (small, anchored) delta
+        s = dd_add(dd_new(ah, al), dd_new(d))
+        return s.hi, s.lo
+
+    def loop_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
+                eid, jvar):
+        def step(a, b):
+            dp, cov, chi2, _ = step_fn(a, b, fh, fl, batch, cache, F,
+                                       phi, nvec, valid, eid, jvar)
+            return dp, cov, chi2
+
+        dp0, cov0, chi2_0 = step(th, tl)
+        p = th.shape[0]
+        deltas0 = jnp.zeros((K, p), th.dtype)
+        lams0 = jnp.zeros(K, th.dtype)
+
+        def cond(c):
+            k, done = c[0], c[1]
+            return jnp.logical_and(jnp.logical_not(done), k < K)
+
+        def body(c):
+            k, done, thk, tlk, dpk, covk, best, deltas, lams = c
+            d = dpk[noff:]
+
+            def hcond(h):
+                lam, acc = h[0], h[1]
+                return jnp.logical_and(jnp.logical_not(acc),
+                                       lam >= min_lambda)
+
+            def hbody(h):
+                lam, _, thc, tlc, dpc, covc, chic = h
+                tht, tlt = _two_sum_add(thk, tlk, lam * d)
+                dpt, covt, chit = step(tht, tlt)
+                ok = jnp.logical_and(jnp.isfinite(chit),
+                                     chit <= best + 1e-12)
+                keep = lambda new, old: jnp.where(ok, new, old)
+                return (jnp.where(ok, lam, lam / 2.0), ok,
+                        keep(tht, thc), keep(tlt, tlc),
+                        keep(dpt, dpc), keep(covt, covc),
+                        keep(chit, chic))
+
+            lam, acc, thc, tlc, dpc, covc, chic = lax.while_loop(
+                hcond, hbody,
+                (jnp.asarray(1.0, th.dtype), jnp.asarray(False),
+                 thk, tlk, dpk, covk, jnp.asarray(jnp.inf, th.dtype)))
+
+            improved = best - chic
+            applied = jnp.where(acc, lam * d, jnp.zeros_like(d))
+            deltas = deltas.at[k].set(applied)
+            lams = lams.at[k].set(jnp.where(acc, lam, 0.0))
+            keep = lambda new, old: jnp.where(acc, new, old)
+            done = jnp.logical_or(
+                jnp.logical_not(acc),
+                improved < required_chi2_decrease)
+            return (k + 1, done, keep(thc, thk), keep(tlc, tlk),
+                    keep(dpc, dpk), keep(covc, covk),
+                    keep(chic, best), deltas, lams)
+
+        k, done, thf, tlf, dpf, covf, best, deltas, lams = \
+            lax.while_loop(cond, body,
+                           (jnp.asarray(0, jnp.int32),
+                            jnp.asarray(False), th, tl, dp0, cov0,
+                            chi2_0, deltas0, lams0))
+        return (thf, tlf, dpf, covf, best, chi2_0, k, done, deltas,
+                lams)
+
+    return loop_fn, args, names
 
 
 def _pad_leaf(a: np.ndarray, pad: int) -> np.ndarray:
