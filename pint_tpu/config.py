@@ -18,7 +18,50 @@ from pathlib import Path
 from typing import Optional
 
 __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
-           "obs_override", "enable_compile_cache"]
+           "obs_override", "enable_compile_cache", "solve_device",
+           "solve_scope"]
+
+
+def solve_device(ntoa: int):
+    """Device for the host fitters' linear-solve kernels, or None for
+    the default backend. Small problems stay on the host CPU when the
+    default backend is an accelerator: every accelerator dispatch has
+    a fixed latency (∼0.1–0.25 s round-trip over the axon TPU tunnel,
+    ∼0.1–1 ms on a local chip) that dwarfs a tiny solve — a 62-TOA WLS
+    fit measured 3.4 s over the tunnel vs 6 ms on host. Threshold:
+    $PINT_TPU_HOST_SOLVE_MAX_TOA (default 8192 when the axon tunnel
+    env is present, else 1024; 0 disables routing). Fitter.auto uses
+    the same policy to pick host fitters over the device-resident
+    downhill fitter for small problems."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    try:
+        thresh = int(os.environ.get("PINT_TPU_HOST_SOLVE_MAX_TOA", -1))
+    except ValueError:
+        thresh = -1
+    if thresh < 0:
+        thresh = 8192 if os.environ.get("PALLAS_AXON_POOL_IPS") \
+            else 1024
+    if thresh == 0 or ntoa >= thresh:
+        return None
+    return jax.devices("cpu")[0]
+
+
+def solve_scope(ntoa: int):
+    """Context manager form of solve_device: jax.default_device(cpu)
+    for small problems on an accelerator backend, else a no-op. All
+    jnp.asarray placements of the solve inputs must happen INSIDE the
+    scope — converting first would ship them to the accelerator (over
+    the tunnel) only to pull them back for the pinned solve."""
+    import contextlib
+
+    import jax
+
+    dev = solve_device(ntoa)
+    return jax.default_device(dev) if dev is not None \
+        else contextlib.nullcontext()
 
 
 def enable_compile_cache(env_var: str, default_dir: str) -> Optional[str]:

@@ -84,6 +84,25 @@ class Fitter:
         self.converged = False
         self.stats = None  # FitStats, set by fit_toas
 
+    def _solve_scope(self):
+        """Context manager scoping the jitted solve kernels: pins
+        small problems to the host CPU backend when the default
+        backend is an accelerator (config.solve_device — dispatch
+        latency dwarfs a tiny solve; a 62-TOA WLS fit measured 3.4 s
+        over the axon tunnel vs 6 ms on host). jnp.asarray of the
+        solve inputs must happen inside the scope."""
+        from pint_tpu.config import solve_scope
+
+        return solve_scope(self.toas.ntoas)
+
+    def _solve_pinned(self) -> bool:
+        """True when _solve_scope pins this problem's solves to the
+        host CPU (jax.default_backend() cannot tell: it reports the
+        process default platform regardless of the device context)."""
+        from pint_tpu.config import solve_device
+
+        return solve_device(self.toas.ntoas) is not None
+
     def _record_stats(self, chi2: float, iterations: int, t0: float,
                       dof=None):
         """Populate self.stats (SURVEY §5 metrics requirement).
@@ -130,9 +149,15 @@ class Fitter:
                 "path IS a downhill loop (use build_fit_step directly "
                 "for single linearized solves)")
         if device is None:
+            from pint_tpu.config import solve_device
+
             device = (downhill
                       and jax.default_backend() == "tpu"
-                      and model.supports_anchored())
+                      and model.supports_anchored()
+                      # tiny problems route to host fitters whose
+                      # solves pin to the CPU backend (_solve_scope):
+                      # dispatch latency dwarfs the compute
+                      and solve_device(toas.ntoas) is None)
         if device and downhill:
             from pint_tpu.gls import DeviceDownhillGLSFitter
 
@@ -201,9 +226,10 @@ class WLSFitter(Fitter):
             r = self.resids.time_resids
             err_s = self.toas.get_errors() * 1e-6
             M, names, units = self.get_designmatrix()
-            x, cov, _ = _wls_solve(jnp.asarray(M), jnp.asarray(r),
-                                   jnp.asarray(err_s),
-                                   threshold_arg=threshold)
+            with self._solve_scope():
+                x, cov, _ = _wls_solve(jnp.asarray(M), jnp.asarray(r),
+                                       jnp.asarray(err_s),
+                                       threshold_arg=threshold)
             # residual here is model-phase excess: r ≈ M·(θ−θ_true), so
             # the parameter correction is −x
             x = -np.asarray(x)
@@ -236,9 +262,10 @@ class DownhillWLSFitter(WLSFitter):
             r = self.resids.time_resids
             err_s = self.toas.get_errors() * 1e-6
             M, names, units = self.get_designmatrix()
-            x, cov, _ = _wls_solve(jnp.asarray(M), jnp.asarray(r),
-                                   jnp.asarray(err_s),
-                                   threshold_arg=threshold)
+            with self._solve_scope():
+                x, cov, _ = _wls_solve(jnp.asarray(M), jnp.asarray(r),
+                                       jnp.asarray(err_s),
+                                       threshold_arg=threshold)
             x = -np.asarray(x)  # see WLSFitter: correction is −solution
             lam = 1.0
             accepted = False

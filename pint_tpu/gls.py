@@ -159,8 +159,12 @@ def gls_chi2(model, toas, resids=None) -> float:
     if F is None:
         return float(np.sum(np.asarray(r) ** 2 / nvec))
     phi = model.noise_model_basis_weight(toas)
-    return float(_gls_chi2_kernel(jnp.asarray(F), jnp.asarray(phi),
-                                  jnp.asarray(r), jnp.asarray(nvec)))
+    from pint_tpu.config import solve_scope
+
+    with solve_scope(toas.ntoas):
+        return float(_gls_chi2_kernel(jnp.asarray(F), jnp.asarray(phi),
+                                      jnp.asarray(r),
+                                      jnp.asarray(nvec)))
 
 
 @jax.jit
@@ -237,30 +241,40 @@ class GLSFitter(Fitter):
     def _solve_once(self, threshold=None):
         self.resids = Residuals(self.toas, self.model,
                                 track_mode=self.track_mode)
-        r = jnp.asarray(self.resids.time_resids)
+        r = self.resids.time_resids
         M, names, units = self.get_designmatrix()
-        M = jnp.asarray(M)
-        nvec = jnp.asarray(
-            self.model.scaled_toa_uncertainty(self.toas) ** 2)
+        nvec = self.model.scaled_toa_uncertainty(self.toas) ** 2
         Fb = self.model.noise_model_designmatrix(self.toas)
         phi = self.model.noise_model_basis_weight(self.toas)
         if Fb is None:
             Fb = np.zeros((self.toas.ntoas, 0))
             phi = np.ones(0)
-        Fb, phi = jnp.asarray(Fb), jnp.asarray(phi)
-        if self.full_cov:
-            x, cov, chi2, noise = _gls_kernel_fullcov(M, Fb, phi, r, nvec)
-        elif threshold is not None:
-            x, cov, chi2, noise, _ = _gls_kernel_svd(
-                M, Fb, phi, r, nvec, threshold=float(threshold))
-        else:
-            from pint_tpu.parallel.fit_step import _use_f32_matmul
-
-            x, cov, chi2, noise, _, ok = _gls_kernel(
-                M, Fb, phi, r, nvec, f32mm=_use_f32_matmul(None))
-            if not bool(ok):
-                x, cov, chi2, noise, _ = _gls_kernel_svd(
+        with self._solve_scope():
+            # asarray INSIDE the scope: placement follows the pinned
+            # device (converting first would ship tiny solves to the
+            # accelerator just to pull them back)
+            r, M, nvec = (jnp.asarray(r), jnp.asarray(M),
+                          jnp.asarray(nvec))
+            Fb, phi = jnp.asarray(Fb), jnp.asarray(phi)
+            if self.full_cov:
+                x, cov, chi2, noise = _gls_kernel_fullcov(
                     M, Fb, phi, r, nvec)
+            elif threshold is not None:
+                x, cov, chi2, noise, _ = _gls_kernel_svd(
+                    M, Fb, phi, r, nvec, threshold=float(threshold))
+            else:
+                from pint_tpu.parallel.fit_step import _use_f32_matmul
+
+                # when the solve is pinned to the host CPU the f32-MXU
+                # auto-on (keyed on the process backend) is moot: CPU
+                # f64 is native, so keep full precision there
+                f32mm = False if self._solve_pinned() else \
+                    _use_f32_matmul(None)
+                x, cov, chi2, noise, _, ok = _gls_kernel(
+                    M, Fb, phi, r, nvec, f32mm=f32mm)
+                if not bool(ok):
+                    x, cov, chi2, noise, _ = _gls_kernel_svd(
+                        M, Fb, phi, r, nvec)
         # r ≈ M (θ − θ_true): the correction is −x (see WLSFitter)
         return (-np.asarray(x), np.asarray(cov), float(chi2),
                 np.asarray(noise), names)

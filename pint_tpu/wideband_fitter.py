@@ -84,18 +84,23 @@ class WidebandTOAFitter(Fitter):
             # DM-process bases (PLDMNoise) couple into the DM rows
             F_dm = self.model.noise_model_dm_designmatrix(self.toas)
             F = np.concatenate([F_t, F_dm], axis=0)
-        args = (jnp.asarray(M), jnp.asarray(F), jnp.asarray(phi),
-                jnp.asarray(r), jnp.asarray(nvec))
-        if threshold is not None:
-            x, cov, chi2, noise, _ = _gls_kernel_svd(
-                *args, threshold=float(threshold))
-        else:
-            from pint_tpu.parallel.fit_step import _use_f32_matmul
+        with self._solve_scope():
+            # asarray inside the scope: placement follows the pinned
+            # device (see GLSFitter._solve_once)
+            args = (jnp.asarray(M), jnp.asarray(F), jnp.asarray(phi),
+                    jnp.asarray(r), jnp.asarray(nvec))
+            if threshold is not None:
+                x, cov, chi2, noise, _ = _gls_kernel_svd(
+                    *args, threshold=float(threshold))
+            else:
+                from pint_tpu.parallel.fit_step import _use_f32_matmul
 
-            x, cov, chi2, noise, _, ok = _gls_kernel(
-                *args, f32mm=_use_f32_matmul(None))
-            if not bool(ok):
-                x, cov, chi2, noise, _ = _gls_kernel_svd(*args)
+                f32mm = False if self._solve_pinned() else \
+                    _use_f32_matmul(None)
+                x, cov, chi2, noise, _, ok = _gls_kernel(
+                    *args, f32mm=f32mm)
+                if not bool(ok):
+                    x, cov, chi2, noise, _ = _gls_kernel_svd(*args)
         return (-np.asarray(x), np.asarray(cov), float(chi2),
                 np.asarray(noise)[:n], names)
 

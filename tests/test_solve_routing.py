@@ -1,0 +1,97 @@
+"""Small-problem host routing (pint_tpu.config.solve_device): on an
+accelerator backend, tiny solves pin to the host CPU — dispatch
+latency (~0.1-0.25 s round-trip over the axon tunnel) dwarfs the
+compute. Measured motivation: a 62-TOA WLS fit took 3.4 s over the
+tunnel vs 6 ms on host (bench.py config 1, round 4)."""
+import io
+import warnings
+
+import jax
+import pytest
+
+from pint_tpu.config import solve_device
+
+
+def test_inert_on_cpu_backend():
+    # the test env's default backend IS cpu: no routing ever
+    assert jax.default_backend() == "cpu"
+    assert solve_device(1) is None
+    assert solve_device(10 ** 7) is None
+
+
+@pytest.fixture
+def fake_tpu(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    yield
+
+
+def test_small_routes_to_host(fake_tpu, monkeypatch):
+    monkeypatch.delenv("PINT_TPU_HOST_SOLVE_MAX_TOA", raising=False)
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    dev = solve_device(62)
+    assert dev is not None and dev.platform == "cpu"
+    assert solve_device(1024) is None  # at/above threshold
+
+
+def test_tunnel_raises_threshold(fake_tpu, monkeypatch):
+    monkeypatch.delenv("PINT_TPU_HOST_SOLVE_MAX_TOA", raising=False)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert solve_device(5000) is not None  # < 8192 tunnel default
+    assert solve_device(8192) is None
+
+
+def test_env_override(fake_tpu, monkeypatch):
+    monkeypatch.setenv("PINT_TPU_HOST_SOLVE_MAX_TOA", "100")
+    assert solve_device(99) is not None
+    assert solve_device(100) is None
+    monkeypatch.setenv("PINT_TPU_HOST_SOLVE_MAX_TOA", "0")
+    assert solve_device(1) is None  # 0 disables routing
+
+
+def test_auto_prefers_host_fitters_for_tiny_problems(monkeypatch):
+    """Fitter.auto on a (faked) TPU backend: a tiny problem gets a
+    host downhill fitter, a big one the device-resident fitter."""
+    import numpy as np
+
+    import pint_tpu.fitter as fitter_mod
+    from pint_tpu.fitter import DownhillWLSFitter, Fitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = """
+PSR J0000+0042
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+F0 61.0 1
+F1 -1e-15 1
+DM 20.0
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.01
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(par))
+        toas = make_fake_toas_uniform(
+            54000, 56000, 40, model, error_us=1.0,
+            rng=np.random.default_rng(7))
+    # auto reads jax.default_backend inside fitter.py's module scope
+    monkeypatch.setattr(fitter_mod.jax, "default_backend",
+                        lambda: "tpu")
+    monkeypatch.delenv("PINT_TPU_HOST_SOLVE_MAX_TOA", raising=False)
+    fit = Fitter.auto(toas, model)
+    assert isinstance(fit, DownhillWLSFitter)
+    # the WLS fit still runs end-to-end with the CPU-pinned solve
+    fit.fit_toas()
+    assert fit.converged
+    # ... and a big problem keeps the device-resident fitter: auto
+    # must not lose the accelerator path to an over-eager threshold
+    monkeypatch.setenv("PINT_TPU_HOST_SOLVE_MAX_TOA", "10")
+    from pint_tpu.gls import DeviceDownhillGLSFitter
+
+    assert model.supports_anchored()
+    fit_big = Fitter.auto(toas, model)  # 40 TOAs >= threshold 10
+    assert isinstance(fit_big, DeviceDownhillGLSFitter)
