@@ -23,6 +23,23 @@ __all__ = ["Fitter", "WLSFitter", "DownhillWLSFitter", "fit_summary",
            "ConvergenceFailure", "MaxiterReached", "StepProblem"]
 
 
+class DegeneracyWarning(UserWarning):
+    """The normal matrix was singular or ill-conditioned enough that
+    the Cholesky solve failed and the SVD fallback (which drops
+    near-degenerate directions) was used (reference: fitter.py
+    DegeneracyWarning)."""
+
+
+def warn_degenerate(what: str = "normal matrix") -> None:
+    """Emit the shared Cholesky-failed/SVD-fallback DegeneracyWarning
+    (one message, one stacklevel, used by GLS and wideband solvers)."""
+    import warnings
+
+    warnings.warn(
+        f"{what} Cholesky failed (degenerate design columns?); "
+        f"using the SVD fallback", DegeneracyWarning, stacklevel=4)
+
+
 class ConvergenceFailure(RuntimeError):
     pass
 

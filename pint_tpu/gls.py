@@ -273,6 +273,9 @@ class GLSFitter(Fitter):
                 x, cov, chi2, noise, _, ok = _gls_kernel(
                     M, Fb, phi, r, nvec, f32mm=f32mm)
                 if not bool(ok):
+                    from pint_tpu.fitter import warn_degenerate
+
+                    warn_degenerate()
                     x, cov, chi2, noise, _ = _gls_kernel_svd(
                         M, Fb, phi, r, nvec)
         # r ≈ M (θ − θ_true): the correction is −x (see WLSFitter)

@@ -1,5 +1,7 @@
 """``.tim`` TOA-file parser/writer (TEMPO2 "FORMAT 1" plus the TEMPO
-Princeton column format).
+Princeton and Parkes column formats; ITOA lines are detected and
+rejected with a clear error, matching the reference, whose
+parse_TOA_line raises "not implemented" for ITOA).
 
 Reference behavior: src/pint/toa.py (.tim parsing in get_TOAs / TOA
 class). Key property preserved here: **the MJD never passes through a
@@ -96,18 +98,60 @@ def _parse_princeton_line(line: str) -> Optional[TimTOA]:
                   obs=obs, name=name)
 
 
+def _parse_parkes_line(line: str) -> Optional[TimTOA]:
+    """TEMPO Parkes column format (detected by a blank first column
+    and a decimal point at column 41): name(1:18), freq-MHz(25:34),
+    MJD(34:55), phase offset(55:63), error-us(63:71), 1-char
+    observatory(79). The MJD field is already one decimal string."""
+    if len(line) < 80 or not line.startswith(" ") \
+            or line[41:42] != ".":
+        return None
+    name = line[1:18].strip()
+    freq = line[25:34].strip()
+    mjd = line[34:55].strip().replace(" ", "")
+    err = line[63:71].strip()
+    obs = line[79:80].strip()
+    if not (freq and mjd and err and obs):
+        return None
+    if not (_is_number(freq) and _is_number(mjd) and _is_number(err)):
+        return None
+    phoff = line[55:63].strip()
+    if phoff and _is_number(phoff) and float(phoff) != 0.0:
+        # a phase offset shifts the TOA by phoff*P0, which a parser
+        # cannot apply (it needs the model's period). The reference
+        # raises for exactly this reason — silent mis-timing otherwise
+        raise ValueError(
+            f"nonzero phase offset {phoff} in Parkes-format TOA line "
+            f"is not supported (matches the reference): {line!r}")
+    return TimTOA(mjd_str=mjd, freq_mhz=float(freq),
+                  error_us=float(err), obs=obs, name=name)
+
+
 def parse_tim(source, _depth: int = 0,
               _jump_base: int = 0) -> List[TimTOA]:
     """Parse a .tim file (path, file object, or literal multi-line string).
 
     INCLUDE is followed relative to the including file's directory.
     """
+    toas, _fmt, _jc = _parse_tim_stream(source, _depth=_depth,
+                                        _jump_base=_jump_base)
+    return toas
+
+
+def _parse_tim_stream(source, _depth: int = 0, _jump_base: int = 0,
+                      _fmt: str = "Unknown"):
+    """parse_tim worker returning (toas, fmt, jump_count): FORMAT and
+    jump numbering are properties of the expanded line STREAM, exactly
+    as in the reference's single linear loop — an INCLUDEd file
+    inherits the current format mode, and a FORMAT command inside it
+    stays in force after the include returns."""
     from pint_tpu.io.par import resolve_source
 
     lines, base_dir = resolve_source(source, kind="tim")
 
     toas: List[TimTOA] = []
     skipping = False
+    fmt = _fmt  # FORMAT 1 switches every later line to TEMPO2
     time_offset_s = 0.0
     efac = 1.0
     equad_us = 0.0
@@ -144,12 +188,10 @@ def parse_tim(source, _depth: int = 0,
                 inc = parts[1]
                 if not os.path.isabs(inc):
                     inc = os.path.join(base_dir, inc)
-                sub = parse_tim(inc, _depth=_depth + 1,
-                                _jump_base=jump_count)
-                for t in sub:
-                    jid = t.flags.get("tim_jump")
-                    if jid is not None:
-                        jump_count = max(jump_count, int(jid))
+                sub, fmt, sub_jc = _parse_tim_stream(
+                    inc, _depth=_depth + 1, _jump_base=jump_count,
+                    _fmt=fmt)
+                jump_count = max(jump_count, sub_jc)
                 toas.extend(sub)
             elif head == "TIME" and len(parts) > 1:
                 time_offset_s += float(parts[1])
@@ -161,12 +203,32 @@ def parse_tim(source, _depth: int = 0,
                 jump_active = not jump_active
                 if jump_active:
                     jump_count += 1
-            # FORMAT/MODE/PHASE/TRACK/INFO: recorded implicitly or ignored
+            elif head == "FORMAT" and len(parts) > 1:
+                fmt = "Tempo2" if parts[1] == "1" else "Unknown"
+            # MODE/PHASE/TRACK/INFO: recorded implicitly or ignored
             continue
 
-        toa = _parse_format1_line(parts)
-        if toa is None:
-            toa = _parse_princeton_line(line)
+        # per-line format detection (the reference's _toa_format):
+        # after a FORMAT 1 command every line is TEMPO2-tokenized;
+        # otherwise the Parkes column signature is checked FIRST (a
+        # Parkes line tokenizes numerically and would be swallowed by
+        # the free-form parser), then free-form/Princeton, and a line
+        # none of them accept with the ITOA signature — the TOA
+        # decimal point in column 15 (index 14) — gets the reference's
+        # explicit rejection instead of a generic parse error
+        if fmt == "Tempo2":
+            toa = _parse_format1_line(parts)
+        elif line.startswith(" ") and line[41:42] == ".":
+            toa = _parse_parkes_line(line)
+        else:
+            toa = _parse_format1_line(parts)
+            if toa is None:
+                toa = _parse_princeton_line(line)
+            if toa is None and line[14:15] == ".":
+                raise NotImplementedError(
+                    f"ITOA-format TOA lines are not supported (the "
+                    f"reference's parse_TOA_line raises here too): "
+                    f"{line!r}")
         if toa is None:
             raise ValueError(f"unparseable TOA line: {line!r}")
         if time_offset_s != 0.0:
@@ -178,7 +240,7 @@ def parse_tim(source, _depth: int = 0,
         if jump_active:
             toa.flags.setdefault("tim_jump", str(jump_count))
         toas.append(toa)
-    return toas
+    return toas, fmt, jump_count
 
 
 def write_tim(path_or_file, toas: List[TimTOA], comment: str = "") -> None:

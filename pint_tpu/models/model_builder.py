@@ -93,6 +93,34 @@ def _build_param_index():
     return idx
 
 
+def guess_binary_model(keys) -> str:
+    """Pick the native binary model implied by a parameter-name set
+    (reference: model_builder guess_binary_model; used for TEMPO2
+    "BINARY T2" par files, where the T2 superset model dispatches on
+    which parameters appear). ``keys``: iterable of UPPERCASE par
+    keys. Order matters — the most specific signature wins."""
+    keys = set(keys)
+    if "KIN" in keys or "KOM" in keys:
+        return "DDK"
+    if "EPS1" in keys or "EPS2" in keys or "TASC" in keys:
+        if "LNEDOT" in keys:
+            return "ELL1k"
+        return "ELL1H" if "H3" in keys else "ELL1"
+    if "MTOT" in keys:
+        return "DDGR"
+    if "SHAPMAX" in keys:
+        return "DDS"
+    if "H3" in keys and "STIG" in keys:
+        return "DDH"
+    if keys & {"SINI", "M2", "OMDOT", "GAMMA"}:
+        return "DD"
+    return "BT"
+
+
+class T2BinaryWarning(UserWarning):
+    """BINARY T2 par file loaded via guess_binary_model."""
+
+
 class UnknownParameterWarning(UserWarning):
     pass
 
@@ -144,6 +172,30 @@ class ModelBuilder:
         for ln in lines:
             if ln.key == "BINARY" and ln.tokens:
                 binary_name = ln.tokens[0]
+                if binary_name.upper() == "T2":
+                    # TEMPO2's generic dispatcher model: choose the
+                    # native family from the parameter signature
+                    # (reference: guess_binary_model)
+                    binary_name = guess_binary_model(
+                        {x.key.upper() for x in lines})
+                    if binary_name == "DDK":
+                        # T2 KIN/KOM are IAU-convention; the DDK
+                        # kernel uses DT92 (KIN -> 180-KIN,
+                        # KOM -> 90-KOM; same mapping as
+                        # t2binary2pint) — loading the raw values
+                        # would silently corrupt the Kopeikin terms
+                        for x in lines:
+                            k = x.key.upper()
+                            if k in ("KIN", "KOM") and x.tokens:
+                                ref = 180.0 if k == "KIN" else 90.0
+                                x.tokens[0] = repr(
+                                    ref - float(x.tokens[0]))
+                    warnings.warn(
+                        f"BINARY T2 interpreted as {binary_name!r} via "
+                        f"guess_binary_model"
+                        + (" (KIN/KOM converted IAU->DT92)"
+                           if binary_name == "DDK" else ""),
+                        T2BinaryWarning, stacklevel=2)
                 # case-insensitive: the conventional par name for e.g.
                 # BinaryELL1k is "ELL1k"
                 by_upper = {c.upper(): c for c in component_types}
@@ -185,6 +237,20 @@ class ModelBuilder:
             # 1b. exact/alias match against the registry index
             cls_name = self.param_index.get(key)
             if cls_name is not None:
+                if cls_name.startswith(BINARY_COMPONENT_PREFIX) \
+                        and any(type(c).__name__.startswith(
+                            BINARY_COMPONENT_PREFIX)
+                            for c in comps.values()):
+                    # a binary param the SELECTED model doesn't carry
+                    # (e.g. SINI in a DDK par — DDK derives the
+                    # inclination from KIN; reference warns the same
+                    # way) must never instantiate a second binary
+                    warnings.warn(
+                        f"{key} is not used by the selected binary "
+                        f"model; ignoring it",
+                        UnknownParameterWarning, stacklevel=2)
+                    unknown.append(key)
+                    continue
                 comp = get_comp(cls_name)
                 p = _param_by_name_or_alias(comp, key)
                 p.from_tokens(toks)

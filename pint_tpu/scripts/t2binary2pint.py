@@ -36,14 +36,9 @@ def t2_to_native_parfile(text: str) -> str:
     if binary != "T2":
         return text
 
-    if "KIN" in keys or "KOM" in keys:
-        target = "DDK"
-    elif "EPS1" in keys or "EPS2" in keys:
-        target = "ELL1H" if "H3" in keys else "ELL1"
-    elif "SINI" in keys or "M2" in keys or "OMDOT" in keys:
-        target = "DD"
-    else:
-        target = "BT"
+    from pint_tpu.models.model_builder import guess_binary_model
+
+    target = guess_binary_model(keys)
 
     out = []
     for raw in text.splitlines():

@@ -146,6 +146,27 @@ class TOAs:
             out.append(v)
         return out
 
+    @property
+    def index(self):
+        """Original-position index of each TOA, surviving select()
+        subsets (reference: the TOAs table "index" column). Lazily
+        arange for containers built before the first access."""
+        ix = getattr(self, "_index", None)
+        if ix is None or len(ix) != self.ntoas:
+            self._index = np.arange(self.ntoas)
+        return self._index
+
+    def renumber(self, index_order=True):
+        """Reset the index column (reference: TOAs.renumber):
+        index_order=True numbers 0..N-1 in current storage order;
+        False preserves the relative order of the existing indices
+        (rank-renumber after deletions)."""
+        if index_order:
+            self._index = np.arange(self.ntoas)
+        else:
+            self._index = np.argsort(np.argsort(self.index))
+        self._touch()
+
     def get_pulse_numbers(self):
         pn = self.get_flag_value("pn", fill_value="nan", as_type=float)
         arr = np.array(pn)
@@ -183,6 +204,7 @@ class TOAs:
             (self.tdb_frac[0][idx], self.tdb_frac[1][idx])
         out.obs_planet_pos = None if self.obs_planet_pos is None else \
             {k: v[idx] for k, v in self.obs_planet_pos.items()}
+        out._index = self.index[idx]
         out._serial = next(_TOAS_SERIAL)
         return out
 
@@ -466,6 +488,32 @@ class TOAs:
                 obs=self.obs[i], name=self.names[i] or f"toa{i}",
                 flags=flags))
         write_tim(path, out)
+
+
+def save_pickle(toas: TOAs, picklefilename: str) -> None:
+    """Pickle a TOAs object (reference: toa.save_pickle). The npz
+    columnar cache (TOAs.to_npz) is the preferred persistent format —
+    no code execution on load — but the reference's pickle entry
+    points are provided for API parity."""
+    import pickle
+
+    with open(picklefilename, "wb") as fh:
+        pickle.dump(toas, fh, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_pickle(picklefilename: str) -> TOAs:
+    """Unpickle a TOAs object (reference: toa.load_pickle). Only load
+    files you wrote yourself — pickle executes code on load; prefer
+    TOAs.from_npz for shared caches."""
+    import pickle
+
+    with open(picklefilename, "rb") as fh:
+        out = pickle.load(fh)
+    if not isinstance(out, TOAs):
+        raise TypeError(f"{picklefilename!r} did not contain a TOAs "
+                        f"object (got {type(out).__name__})")
+    out._serial = next(_TOAS_SERIAL)
+    return out
 
 
 def merge_TOAs(toas_list: List[TOAs]) -> TOAs:

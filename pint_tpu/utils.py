@@ -20,11 +20,26 @@ from pint_tpu.ops.taylor import (  # noqa: F401
 )
 
 __all__ = ["FTest", "weighted_mean", "dmxparse",
+           "get_highest_density_range",
            "split_prefixed_name", "taylor_horner", "taylor_horner_deriv",
            "format_uncertainty", "dmx_ranges", "add_dmx_ranges",
            "wavex_setup", "dmwavex_setup",
            "akaike_information_criterion",
            "bayesian_information_criterion", "PosVel"]
+
+
+def get_highest_density_range(mjds, ndays: float = 7.0):
+    """(start, end) MJD of the ``ndays``-wide window holding the most
+    TOAs (reference: utils.get_highest_density_range — used to pick a
+    TZR region). Sliding-window count over sorted epochs; ties go to
+    the earliest window."""
+    m = np.sort(np.asarray(mjds, dtype=np.float64))
+    if m.size == 0:
+        raise ValueError("no MJDs given")
+    counts = np.searchsorted(m, m + float(ndays), side="right") \
+        - np.arange(m.size)
+    k = int(np.argmax(counts))
+    return float(m[k]), float(m[k] + float(ndays))
 
 
 def FTest(chi2_1: float, dof_1: int, chi2_2: float, dof_2: int) -> float:

@@ -100,6 +100,9 @@ class WidebandTOAFitter(Fitter):
                 x, cov, chi2, noise, _, ok = _gls_kernel(
                     *args, f32mm=f32mm)
                 if not bool(ok):
+                    from pint_tpu.fitter import warn_degenerate
+
+                    warn_degenerate("wideband normal matrix")
                     x, cov, chi2, noise, _ = _gls_kernel_svd(*args)
         return (-np.asarray(x), np.asarray(cov), float(chi2),
                 np.asarray(noise)[:n], names)

@@ -1,0 +1,153 @@
+"""guess_binary_model / BINARY T2 builder path + DegeneracyWarning.
+Reference anchors: src/pint/models/model_builder.py
+(guess_binary_model), src/pint/fitter.py (DegeneracyWarning)."""
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models.model_builder import (
+    T2BinaryWarning,
+    get_model,
+    guess_binary_model,
+)
+
+
+class TestGuessBinaryModel:
+    @pytest.mark.parametrize("keys,expect", [
+        ({"PB", "A1", "T0", "ECC", "OM"}, "BT"),
+        ({"PB", "A1", "T0", "ECC", "OM", "M2", "SINI"}, "DD"),
+        ({"PB", "A1", "T0", "ECC", "OM", "GAMMA"}, "DD"),
+        ({"PB", "A1", "T0", "ECC", "OM", "SHAPMAX"}, "DDS"),
+        ({"PB", "A1", "T0", "ECC", "OM", "MTOT"}, "DDGR"),
+        ({"PB", "A1", "T0", "ECC", "OM", "H3", "STIG"}, "DDH"),
+        ({"PB", "A1", "T0", "ECC", "OM", "KIN", "KOM"}, "DDK"),
+        ({"PB", "A1", "TASC", "EPS1", "EPS2"}, "ELL1"),
+        ({"PB", "A1", "TASC", "EPS1", "EPS2", "H3"}, "ELL1H"),
+        ({"PB", "A1", "TASC", "EPS1", "EPS2", "LNEDOT"}, "ELL1k"),
+        # KIN wins over ELL1 indicators (most specific first)
+        ({"PB", "A1", "TASC", "EPS1", "KIN"}, "DDK"),
+    ])
+    def test_signatures(self, keys, expect):
+        assert guess_binary_model(keys) == expect
+
+    def test_builder_loads_t2_par(self):
+        par = """
+PSR J1012+5307
+RAJ 10:12:33.43 1
+DECJ 53:07:02.5 1
+F0 190.2678376 1
+F1 -6.2e-16
+DM 9.02
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.01
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+BINARY T2
+PB 0.60467271355 1
+A1 0.5818172 1
+TASC 55000.1 1
+EPS1 1.2e-6 1
+EPS2 -3.0e-7 1
+"""
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            m = get_model(io.StringIO(par))
+        assert any(isinstance(x.message, T2BinaryWarning) for x in w)
+        assert "BinaryELL1" in m.components
+        assert m.PB.value == pytest.approx(0.60467271355)
+        # round-trips with the resolved model name, not T2
+        bline = [ln for ln in m.as_parfile().splitlines()
+                 if ln.split() and ln.split()[0] == "BINARY"]
+        assert bline and bline[0].split()[1] == "ELL1"
+
+    def test_builder_converts_t2_ddk_angles(self):
+        """T2 KIN/KOM (IAU convention) must load as DT92 values —
+        identical to what t2binary2pint writes (KIN->180-KIN,
+        KOM->90-KOM)."""
+        par = """
+PSR J0437-4715
+RAJ 04:37:15.9 1
+DECJ -47:15:09.1 1
+F0 173.6879458 1
+DM 2.64
+PEPOCH 55000
+POSEPOCH 55000
+PX 6.4 1
+PMRA 121.4 1
+PMDEC -71.5 1
+TZRMJD 55000.01
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+BINARY T2
+PB 5.741 1
+A1 3.3667 1
+T0 55000.2 1
+ECC 1.9e-5 1
+OM 1.2 1
+KIN 137.56 1
+KOM 207.0 1
+M2 0.224 1
+SINI 0.674 1
+"""
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(io.StringIO(par))
+        assert "BinaryDDK" in m.components
+        # exactly one binary: the stray SINI (DDK derives inclination
+        # from KIN) must be dropped with a warning, not spawn ELL1
+        assert sum(1 for c in m.components if c.startswith("Binary")) \
+            == 1
+        assert m.KIN.value == pytest.approx(180.0 - 137.56)
+        assert m.KOM.value == pytest.approx(90.0 - 207.0)
+
+
+class TestDegeneracyWarning:
+    def test_collinear_columns_warn_and_solve(self):
+        """Two exactly-collinear DMX windows make the normal matrix
+        singular: the Cholesky ok-flag must trip, warn, and the SVD
+        fallback must still return finite results."""
+        from pint_tpu.fitter import DegeneracyWarning
+        from pint_tpu.gls import GLSFitter
+        from pint_tpu.simulation import make_fake_toas_uniform
+
+        par = """
+PSR J0000+0001
+RAJ 12:00:00.0
+DECJ 30:00:00.0
+F0 61.0 1
+F1 -1e-15 1
+DM 20.0 1
+DM1 0.0 1
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.01
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+TNREDAMP -13.0
+TNREDGAM 3.0
+TNREDC 5
+DMX_0001 0.0 1
+DMXR1_0001 54000
+DMXR2_0001 56000
+DMX_0002 0.0 1
+DMXR1_0002 54000
+DMXR2_0002 56000
+"""
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(io.StringIO(par))
+            toas = make_fake_toas_uniform(
+                54100, 55900, 120, m, error_us=1.0, add_noise=True,
+                rng=np.random.default_rng(9))
+        fit = GLSFitter(toas, m)
+        with pytest.warns(DegeneracyWarning):
+            chi2 = fit.fit_toas()
+        assert np.isfinite(chi2)
+        assert np.all(np.isfinite(np.diag(
+            fit.parameter_covariance_matrix)))

@@ -1,0 +1,166 @@
+"""Reference-API surface tail: Parkes/ITOA tim formats, TOAs.index /
+renumber, save_pickle/load_pickle, get_highest_density_range.
+Reference anchors: src/pint/toa.py (_toa_format, parse_TOA_line,
+TOAs.renumber, save_pickle/load_pickle), src/pint/utils.py
+(get_highest_density_range)."""
+import io
+import os
+
+import numpy as np
+import pytest
+
+from pint_tpu.io.tim import parse_tim
+from pint_tpu.time.mjd import parse_mjd_string
+from pint_tpu.utils import get_highest_density_range
+
+
+def _parkes_line(name, freq, mjd_str, phoff, err, obs):
+    """Build a TEMPO Parkes-format line with the exact column layout:
+    name(0:17) freq(25:34) MJD(34:55, '.' at col 41) phase-off(55:63)
+    error(63:71) obs(79)."""
+    # MJD field: pad the integer part to put '.' at absolute col 41
+    day, frac = mjd_str.split(".")
+    mjd_field = day.rjust(41 - 34) + "." + frac
+    line = (" " + name).ljust(25)[:25]
+    line += f"{freq:>9.3f}"[:9]
+    line += mjd_field.ljust(21)[:21]
+    line += f"{phoff:>8.4f}"[:8]
+    line += f"{err:>8.3f}"[:8]
+    line = line.ljust(79) + obs
+    assert line[41] == "." and len(line) == 80
+    return line
+
+
+class TestParkesFormat:
+    def test_parse_basic(self):
+        line = _parkes_line("J0437-4715", 1420.405, "50123.4567890123456",
+                            0.0, 1.25, "7")
+        toas = parse_tim(line + "\n")
+        assert len(toas) == 1
+        t = toas[0]
+        assert t.obs == "7"
+        assert t.freq_mhz == pytest.approx(1420.405)
+        assert t.error_us == pytest.approx(1.25)
+        # MJD survives as an exact decimal string
+        d, f = parse_mjd_string(t.mjd_str)
+        assert d == 50123
+        assert f[0] == pytest.approx(0.4567890123456, abs=1e-15)
+        assert "padd" not in t.flags
+
+    def test_phase_offset_raises(self):
+        # a nonzero phase offset shifts the TOA by phoff*P0, which a
+        # parser cannot apply — the reference raises, so do we
+        line = _parkes_line("J1022+1001", 430.0, "48000.25", 0.3125,
+                            3.0, "f")
+        with pytest.raises(ValueError, match="phase offset"):
+            parse_tim(line + "\n")
+
+    def test_not_swallowed_by_format1(self):
+        # without a FORMAT 1 header the column signature must win even
+        # though the tokens happen to look numeric
+        line = _parkes_line("1821", 1400.0, "51000.5", 0.0, 2.0, "3")
+        t = parse_tim(line + "\n")[0]
+        assert t.obs == "3" and t.name == "1821"
+
+    def test_format1_mode_overrides(self):
+        # after FORMAT 1 every line is TEMPO2-tokenized
+        src = ("FORMAT 1\n"
+               "unk 1400.000 51000.500000 2.000 gbt -be X\n")
+        t = parse_tim(src)[0]
+        assert t.obs.lower() in ("gbt", "1")  # registry name
+        assert t.flags["be"] == "X"
+
+
+class TestITOARejected:
+    def test_itoa_line_raises_clear_error(self):
+        # real ITOA signature: the TOA decimal point sits in column 15
+        # (index 14) of a fixed-width line that no other parser accepts
+        line = "XX  name 50123.8864714985  5.00  1420.0000  0.00 AO"
+        assert line[14] == "."
+        with pytest.raises(NotImplementedError, match="ITOA"):
+            parse_tim(line + "\n")
+
+
+class TestFormatThreadsThroughInclude:
+    def test_included_file_inherits_format1(self, tmp_path):
+        # FORMAT applies to the expanded line stream (reference: one
+        # linear loop): an included file without its own header must
+        # still be TEMPO2-tokenized
+        sub = tmp_path / "sub.tim"
+        sub.write_text("unk 1400.000 51000.500000 2.000 @ -be Y\n")
+        master = tmp_path / "master.tim"
+        master.write_text("FORMAT 1\nINCLUDE sub.tim\n")
+        toas = parse_tim(os.fspath(master))
+        assert len(toas) == 1
+        assert toas[0].flags["be"] == "Y"
+
+
+class TestIndexRenumber:
+    def _toas(self):
+        from pint_tpu.toa import get_TOAs_array
+
+        return get_TOAs_array(
+            50000.0 + np.linspace(0, 10, 8), obs="barycenter",
+            errors=1.0)
+
+    def test_index_survives_select(self):
+        t = self._toas()
+        assert list(t.index) == list(range(8))
+        sub = t.select(np.array([0, 2, 5]))
+        assert list(sub.index) == [0, 2, 5]
+
+    def test_renumber_index_order(self):
+        t = self._toas()
+        sub = t.select(np.array([1, 4, 6]))
+        sub.renumber(index_order=True)
+        assert list(sub.index) == [0, 1, 2]
+
+    def test_renumber_rank_order(self):
+        t = self._toas()
+        sub = t.select(np.array([6, 1, 4]))  # out of order
+        sub.renumber(index_order=False)
+        # ranks of [6, 1, 4] -> [2, 0, 1]
+        assert list(sub.index) == [2, 0, 1]
+
+
+class TestPickleRoundTrip:
+    def test_save_load(self, tmp_path):
+        from pint_tpu.toa import get_TOAs_array, load_pickle, save_pickle
+
+        t = get_TOAs_array(50000.0 + np.arange(5.0), obs="barycenter",
+                           errors=2.0)
+        p = os.fspath(tmp_path / "toas.pickle")
+        save_pickle(t, p)
+        t2 = load_pickle(p)
+        assert t2.ntoas == 5
+        np.testing.assert_array_equal(t2.get_errors(), t.get_errors())
+        np.testing.assert_array_equal(t2.mjd_day, t.mjd_day)
+        np.testing.assert_array_equal(t2.mjd_frac[0], t.mjd_frac[0])
+        # tdb precompute survives
+        assert t2.tdb_day is not None
+
+    def test_load_rejects_non_toas(self, tmp_path):
+        import pickle
+
+        from pint_tpu.toa import load_pickle
+
+        p = os.fspath(tmp_path / "junk.pickle")
+        with open(p, "wb") as fh:
+            pickle.dump({"not": "toas"}, fh)
+        with pytest.raises(TypeError, match="TOAs"):
+            load_pickle(p)
+
+
+class TestHighestDensityRange:
+    def test_dense_cluster_found(self):
+        rng = np.random.default_rng(1)
+        sparse = rng.uniform(50000, 51000, 50)
+        dense = 50500.0 + rng.uniform(0, 2.0, 60)
+        lo, hi = get_highest_density_range(
+            np.concatenate([sparse, dense]), ndays=7)
+        assert lo <= dense.min() and dense.max() <= hi
+        assert hi - lo == pytest.approx(7.0)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            get_highest_density_range([])
