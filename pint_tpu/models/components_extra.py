@@ -131,6 +131,49 @@ class Glitch(PhaseComponent):
             total = total + jnp.where(on, ph, 0.0)
         return DD(total, jnp.zeros_like(total))
 
+    _LD_PREFIXES = ("GLPH_", "GLF0_", "GLF1_", "GLF2_", "GLF0D_")
+
+    def linear_design_names(self):
+        # GLEP/GLTD enter nonlinearly and stay on AD when free; the
+        # amplitude-like pieces are linear given the CURRENT epoch/tau
+        return [f"{pre}{i}" for i in self.glitch_ids
+                for pre in self._LD_PREFIXES
+                if not self.params[f"{pre}{i}"].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """Exact partials of the glitch phase wrt its amplitude
+        pieces: mask, mask*dt, mask*dt^2/2, mask*dt^3/6,
+        mask*tau*(1-exp(-dt/tau)) — mirrors phase() above."""
+        names = set(self.linear_design_names())
+        if not names:
+            return {}
+        ref = self._parent.ref_day
+        tb_dd = ctx["tb"]
+        tb_f = tb_dd.hi + tb_dd.lo
+        out = {}
+        for i in self.glitch_ids:
+            ep = _val(pv, f"GLEP_{i}")
+            dt = tb_f - (ep - ref) * SECS_PER_DAY
+            on = (dt >= 0.0).astype(tb_f.dtype)
+            dtc = jnp.where(dt >= 0.0, dt, 0.0)
+            if f"GLPH_{i}" in names:
+                out[f"GLPH_{i}"] = ("phase", on)
+            if f"GLF0_{i}" in names:
+                out[f"GLF0_{i}"] = ("phase", on * dtc)
+            if f"GLF1_{i}" in names:
+                out[f"GLF1_{i}"] = ("phase", on * dtc * dtc / 2.0)
+            if f"GLF2_{i}" in names:
+                out[f"GLF2_{i}"] = ("phase", on * dtc ** 3 / 6.0)
+            if f"GLF0D_{i}" in names:
+                tau = _val(pv, f"GLTD_{i}") * SECS_PER_DAY
+                has_tau = tau > 0
+                tau_safe = jnp.where(has_tau, tau, 1.0)
+                g = jnp.where(has_tau,
+                              tau_safe * (1.0 - jnp.exp(-dtc / tau_safe)),
+                              0.0)
+                out[f"GLF0D_{i}"] = ("phase", on * g)
+        return out
+
 
 class Wave(PhaseComponent):
     """Legacy TEMPO sinusoid whitening (reference: wave.Wave):
@@ -277,6 +320,29 @@ class WaveX(DelayComponent):
                 + _val(pv, f"WXCOS_{istr}") * jnp.cos(arg)
         return total
 
+    def linear_design_names(self):
+        return [f"{pre}{istr}" for _, istr in self.wavex_ids
+                for pre in ("WXSIN_", "WXCOS_")
+                if not self.params[f"{pre}{istr}"].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(WXSIN/WXCOS) = sin/cos(2 pi f t) (exact partial
+        at the current WXFREQ values)."""
+        if not self.wavex_ids:
+            return {}
+        ref = self._parent.ref_day
+        tb = (batch.tdb_day - ref) + batch.tdb_frac.hi \
+            + batch.tdb_frac.lo
+        t = tb - (self._epoch() - ref)
+        out = {}
+        for idx, istr in self.wavex_ids:
+            arg = 2.0 * jnp.pi * _val(pv, f"WXFREQ_{istr}") * t
+            if not self.params[f"WXSIN_{istr}"].frozen:
+                out[f"WXSIN_{istr}"] = ("pre_delay", jnp.sin(arg))
+            if not self.params[f"WXCOS_{istr}"].frozen:
+                out[f"WXCOS_{istr}"] = ("pre_delay", jnp.cos(arg))
+        return out
+
 
 class DMWaveX(DelayComponent):
     """Fourier DM variations (reference: wavex.DMWaveX): DMWXFREQ_000n
@@ -355,6 +421,34 @@ class DMWaveX(DelayComponent):
         bf = ctx.get("bfreq", batch.freq_mhz)
         return DMconst * self.dm_value_device(pv, batch, cache, ctx) \
             / (bf * bf)
+
+    def linear_design_names(self):
+        return [f"{pre}{istr}" for _, istr in self.dmwavex_ids
+                for pre in ("DMWXSIN_", "DMWXCOS_")
+                if not self.params[f"{pre}{istr}"].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(DMWXSIN/COS) = DMconst sin/cos(arg) / nu^2."""
+        if not self.dmwavex_ids:
+            return {}
+        ref = self._parent.ref_day
+        epoch = self.DMWXEPOCH.value
+        if epoch is None:
+            epoch = self._parent.PEPOCH.value
+        t = (batch.tdb_day - ref) + batch.tdb_frac.hi \
+            + batch.tdb_frac.lo - (epoch - ref)
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        inv2 = DMconst / (bf * bf)
+        out = {}
+        for idx, istr in self.dmwavex_ids:
+            arg = 2.0 * jnp.pi * _val(pv, f"DMWXFREQ_{istr}") * t
+            if not self.params[f"DMWXSIN_{istr}"].frozen:
+                out[f"DMWXSIN_{istr}"] = ("pre_delay",
+                                          inv2 * jnp.sin(arg))
+            if not self.params[f"DMWXCOS_{istr}"].frozen:
+                out[f"DMWXCOS_{istr}"] = ("pre_delay",
+                                          inv2 * jnp.cos(arg))
+        return out
 
 
 class FD(DelayComponent):

@@ -12,6 +12,8 @@ friendly, replacing the reference's per-window TOASelect loop.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -115,6 +117,37 @@ class DispersionDM(Dispersion):
         ctx["dm"] = dm
         return DMconst * dm / (bf * bf)
 
+    def linear_design_names(self):
+        free = [nm for nm in self.dm_terms()
+                if not self.params[nm].frozen]
+        if free and not self.DMEPOCH.frozen:
+            return []  # dt_yr pivots on a fitted DMEPOCH: stay on AD
+        return free
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(DMk) = DMconst * dt_yr^k/k! / nu^2 (the Taylor
+        factor mirrors dm_value's taylor_horner)."""
+        names = self.linear_design_names()
+        if not names:
+            return {}
+        bf = self._bfreq(batch, ctx)
+        inv2 = DMconst / (bf * bf)
+        terms = self.dm_terms()
+        if len(terms) > 1:
+            dmep = pv["DMEPOCH"].hi + pv["DMEPOCH"].lo \
+                if "DMEPOCH" in pv else self._parent.ref_day
+            tdb = batch.tdb_day + dd_to_f64(batch.tdb_frac)
+            dt_yr = (tdb - dmep) / 365.25
+        out = {}
+        for nm in names:
+            k = terms.index(nm)
+            if k == 0:
+                out[nm] = ("pre_delay", inv2 * jnp.ones_like(bf))
+            else:
+                out[nm] = ("pre_delay",
+                           inv2 * dt_yr ** k / math.factorial(k))
+        return out
+
 
 class DispersionDMX(Dispersion):
     """Piecewise-constant ΔDM over MJD windows: DMX_0001/DMXR1_/DMXR2_
@@ -175,6 +208,25 @@ class DispersionDMX(Dispersion):
             [pv[f"DMX_{istr}"].hi + pv[f"DMX_{istr}"].lo
              for _, istr in self.dmx_ids])
         return cache["dmx_masks"] @ vals  # (N,k)@(k,) one fused matmul
+
+    def linear_design_names(self):
+        return [f"DMX_{istr}" for _, istr in self.dmx_ids
+                if not self.params[f"DMX_{istr}"].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(DMX_i) = DMconst * window_mask_i / nu^2."""
+        if not self.dmx_ids:
+            return {}
+        bf = self._bfreq(batch, ctx)
+        inv2 = DMconst / (bf * bf)
+        masks = cache["dmx_masks"]
+        out = {}
+        for col, (_, istr) in enumerate(self.dmx_ids):
+            nm = f"DMX_{istr}"
+            if not self.params[nm].frozen:
+                out[nm] = ("pre_delay",
+                           inv2 * masks[:, col].astype(bf.dtype))
+        return out
 
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         if not self.dmx_ids:

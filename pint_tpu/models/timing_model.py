@@ -140,6 +140,24 @@ class Component:
         Keys must be namespaced `f"{prefix}{self.__class__.__name__}_*"`
         or param-specific; values must be arrays (pytree leaves)."""
 
+    # -- hybrid-Jacobian hooks (see TimingModel.linear_design_columns) -
+
+    def linear_design_names(self) -> List[str]:
+        """FREE params of this component whose design-matrix columns
+        have a closed form (no AD tangent needed). Host-side/static;
+        must agree with linear_design_local's claims."""
+        return []
+
+    def linear_design_local(self, pv, batch, cache, ctx) -> dict:
+        """{claimed name: (kind, g)} with kind "pre_delay" (g =
+        d(own delay)/d(param) [s/unit]; the model multiplies by the
+        shared pre-binary stage sensitivity d(phase)/d(delay)) or
+        "phase" (g = d(phase)/d(param) [turns/unit], used directly).
+        Pure and jittable; evaluated at the current pv, so g may
+        depend on other parameters' values (e.g. a JUMP column uses
+        the current F0)."""
+        return {}
+
     # -- conveniences --------------------------------------------------
 
     @property
@@ -393,25 +411,44 @@ class TimingModel:
         self._ref_day = day if day is not None else 55000.0
         return self._ref_day
 
-    def _delay_tb(self, pv, batch, cache, sub: str):
+    def _delay_tb(self, pv, batch, cache, sub: str,
+                  pre_binary_shift=None):
         """The shared delay chain + delay-subtracted barycentric time
         (device, pure): the single implementation both the direct dd
-        phase and the anchored delta-phase build on."""
+        phase and the anchored delta-phase build on.
+
+        ``pre_binary_shift``: optional scalar added to the accumulated
+        delay just BEFORE the pulsar_system (binary) components run —
+        the probe point for the hybrid Jacobian's stage sensitivity
+        (every non-binary delay component is additive there; only the
+        binary consumes delay_so_far, so d(phase)/d(shift) is the
+        exact sensitivity of the phase to ANY pre-binary delay
+        perturbation)."""
         ctx: dict = {}
         delay = jnp.zeros_like(batch.freq_mhz)
+        shifted = pre_binary_shift is None
         for comp in self.delay_components:
+            if not shifted and comp.category == "pulsar_system":
+                delay = delay + pre_binary_shift
+                shifted = True
             delay = delay + comp.delay(pv, batch, cache[sub], ctx, delay)
+        if not shifted:
+            delay = delay + pre_binary_shift
         tb = dd_mul_f(dd_addf_day(batch, self.ref_day), SECS_PER_DAY)
         tb = dd_sub_f(tb, delay)
         ctx["tb"] = tb
         return delay, tb, ctx
 
-    def _raw_phase_fn(self, pv, batch, cache, sub: str):
+    def _raw_phase_fn(self, pv, batch, cache, sub: str,
+                      pre_binary_shift=None):
         """The full delay→phase chain (device, pure), absolute dd.
         Components with ``apply_to_tzr = False`` (PhaseOffset) are
         excluded from the TZR row: a constant present in both would
-        cancel out of the anchored difference entirely."""
-        delay, tb, ctx = self._delay_tb(pv, batch, cache, sub)
+        cancel out of the anchored difference entirely.
+        ``pre_binary_shift`` threads through to _delay_tb (the hybrid
+        Jacobian's stage-sensitivity probe)."""
+        delay, tb, ctx = self._delay_tb(pv, batch, cache, sub,
+                                        pre_binary_shift)
         phase = DD(jnp.zeros_like(delay), jnp.zeros_like(delay))
         for comp in self.phase_components:
             if sub == "tzr" and not getattr(comp, "apply_to_tzr", True):
@@ -470,6 +507,84 @@ class TimingModel:
             p = comp.phase(pv, batch, cache[sub], ctx, tb)
             other = other + (p.hi + p.lo)
         return delay, tb, other
+
+    # -------- hybrid Jacobian: closed-form design columns -------------
+    #
+    # The jacfwd design matrix pushes one tangent per free parameter
+    # through the whole delay/phase chain. But many parameters are
+    # LINEAR in that chain: every non-binary delay component is purely
+    # additive before the binary stage (DELAY_CATEGORY_ORDER — only
+    # pulsar_system consumes delay_so_far), so
+    #   d(phase)/d(p) = S_pre(t) * d(delay_comp)/d(p)
+    # with ONE shared stage sensitivity S_pre = d(phase)/d(shift)
+    # (one JVP), and phase-linear params (JUMP, PHOFF, glitch pieces)
+    # have direct columns. parallel.fit_step drops all such params
+    # from the jacfwd tangent set — 40 -> 13 tangents at the
+    # north-star shape. Columns are exact partials at the current
+    # point (not approximations); equality with jacfwd is pinned by
+    # tests/test_hybrid_jac.py.
+
+    def _abs_phase_shift(self, pv, batch, cache, sub: str, s):
+        """f64 total phase with a pre-binary delay shift ``s`` — the
+        JVP probe for the hybrid Jacobian's stage sensitivity. One
+        chain, not a copy: delegates to _raw_phase_fn so the probe
+        always differentiates exactly what the residuals evaluate."""
+        ph, _ = self._raw_phase_fn(pv, batch, cache, sub,
+                                   pre_binary_shift=s)
+        return ph.hi + ph.lo
+
+    def linear_design_names(self) -> set:
+        """Free-param names with closed-form design columns (the
+        hybrid Jacobian's analytic set)."""
+        free = set(self.free_params)
+        out: set = set()
+        for comp in self.components.values():
+            out |= set(comp.linear_design_names()) & free
+        return out
+
+    def _ld_rows(self, pv, batch, cache, sub: str, names):
+        dt = batch.freq_mhz.dtype
+        delay, tb, ctx = self._delay_tb(pv, batch, cache, sub)
+        local = {}
+        for comp in self._ordered_components():
+            if sub == "tzr" and not getattr(comp, "apply_to_tzr", True):
+                continue
+            for nm, (kind, g) in comp.linear_design_local(
+                    pv, batch, cache[sub], ctx).items():
+                if nm in names:
+                    local[nm] = (kind, g)
+        # the stage-sensitivity JVP costs one full-chain tangent pass:
+        # pay it only when some claim actually is delay-kind (a
+        # JUMP/PHOFF/glitch-only model needs none of it) — the kind
+        # tags are static at trace time
+        if any(kind == "pre_delay" for kind, _ in local.values()):
+            zero = jnp.zeros((), dt)
+
+            def f(s):
+                return self._abs_phase_shift(pv, batch, cache, sub, s)
+
+            _, s_pre = jax.jvp(f, (zero,), (jnp.ones((), dt),))
+        else:
+            s_pre = None
+        return {nm: s_pre * g if kind == "pre_delay" else g
+                for nm, (kind, g) in local.items()}
+
+    def linear_design_columns(self, pv, batch, cache, names) -> dict:
+        """{name: exact d(phase)/d(param) column [turns/unit]} for the
+        claimed ``names``: closed-form local factors x one
+        stage-sensitivity JVP, including the TZR-row subtraction
+        (matches what jacfwd of the TZR-referenced phase would give).
+        Dtype follows ``batch`` (the f32 Jacobian path passes the f32
+        batch/cache)."""
+        main = self._ld_rows(pv, batch, cache, "main", names)
+        if "tzr_batch" in cache:
+            tzr = self._ld_rows(pv, cache["tzr_batch"], cache, "tzr",
+                                names)
+            # a claim can be absent from the tzr row (apply_to_tzr =
+            # False components, e.g. PhaseOffset): no subtraction then
+            return {nm: main[nm] - tzr[nm][0] if nm in tzr
+                    else main[nm] for nm in names}
+        return main
 
     def supports_anchored(self) -> bool:
         spin = self.components.get("Spindown")

@@ -22,6 +22,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -70,6 +72,24 @@ def _use_anchored(flag: Optional[bool]) -> bool:
     return _resolve_f32(flag, "PINT_TPU_ANCHORED")
 
 
+def _use_hybrid_jac(flag: Optional[bool]) -> bool:
+    """Hybrid analytic/AD Jacobian ($PINT_TPU_HYBRID_JAC, default ON
+    on every backend): params with closed-form design columns (DMX
+    windows, JUMPs, Fourier amplitudes, glitch pieces, PHOFF — see
+    TimingModel.linear_design_columns) are dropped from the jacfwd
+    tangent set and their columns computed from local factors times
+    one shared stage-sensitivity JVP. Exact partials, not
+    approximations (equality oracle: tests/test_hybrid_jac.py)."""
+    import os
+
+    if flag is not None:
+        return bool(flag)
+    env = os.environ.get("PINT_TPU_HYBRID_JAC", "").lower()
+    if env in ("off", "false", "0"):
+        return False
+    return True
+
+
 def _use_f32_jac(flag: Optional[bool]) -> bool:
     """Design-matrix (jacfwd) precision ($PINT_TPU_JAC).
 
@@ -106,6 +126,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
                    matmul_f32: Optional[bool] = None,
                    jac_f32: Optional[bool] = None,
                    anchored: Optional[bool] = None,
+                   hybrid_jac: Optional[bool] = None,
                    wideband: bool = False):
     """(step_fn, args, names): step_fn is pure and jittable,
 
@@ -147,6 +168,17 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     n = toas.ntoas
     f32mm = _use_f32_matmul(matmul_f32)
     jac32 = _use_f32_jac(jac_f32)
+
+    # hybrid Jacobian: closed-form columns for the linear params, AD
+    # tangents only for the rest (40 -> 13 tangents at the north-star
+    # shape). Static split at build time; column values are computed
+    # per step at the current parameter point.
+    lin_set = model.linear_design_names() \
+        if _use_hybrid_jac(hybrid_jac) else set()
+    lin_names = [nm for nm in free if nm in lin_set]
+    nl_idx_list = [i for i, nm in enumerate(free) if nm not in lin_set]
+    nl_idx = np.asarray(nl_idx_list, dtype=np.int32)
+    lin_set = frozenset(lin_names)
 
     if wideband:
         from pint_tpu.wideband import get_wideband_dm
@@ -192,6 +224,26 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
                 e = int(min(max(round(idx * L), math.ceil(e_lo), 0),
                             math.floor(e_hi), 126))
                 scale_np[i] = 2.0 ** (-e)
+    if matmul_f32 is None and \
+            not os.environ.get("PINT_TPU_GLS_MATMUL", ""):
+        # auto-resolution couples the matmul route to the FINAL
+        # Jacobian dtype (after the F8+ scale-window fallback above
+        # may have cleared jac32): f32 columns lose nothing to an
+        # f32-HIGHEST Gram, and f64 accumulation of f32 columns costs
+        # ~30% of the step on CPU. Safe under degeneracy — _gls_core
+        # retries in f64 when the f32 Cholesky trips. Explicit
+        # flag/env still wins.
+        f32mm = f32mm or jac32
+
+    # the hybrid columns are d(phase)/d(theta) while AD columns are
+    # d(phase)/d(u) with u = theta*scale; the shared dp/cov unscaling
+    # assumes every CLAIMED param has scale exactly 1 (today only
+    # F-prefix index>=2 are scaled, and those are never claimable).
+    # Guard the invariant so a future scaled-and-claimed prefix fails
+    # loudly instead of silently multiplying its step by 2^e
+    assert all(scale_np[i] == 1.0 for i, nm in enumerate(free)
+               if nm in lin_set), \
+        "f32-Jacobian scaling applied to a closed-form (hybrid) param"
 
     # anchored delta-phase: host computes the exact reference once;
     # the step's (th, tl) arguments then carry the HOST-COMPUTED exact
@@ -276,6 +328,27 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         eid_np = np.concatenate(
             [eid_np, np.full(pad, nseg - 1, np.int32)])
 
+    def _assemble_jac(phase_of_u, u_full, lin_cols):
+        """(N, nfree) Jacobian in free order: AD tangents only for the
+        nonlinear subset (scattered into u_full so the closed-form
+        params stay at their current values), closed-form columns for
+        the rest."""
+        if nl_idx_list:
+            idx = jnp.asarray(nl_idx)
+
+            def sub(u_nl):
+                return phase_of_u(u_full.at[idx].set(u_nl))
+
+            jac_nl = jax.jacfwd(sub)(u_full[idx])
+        out, k = [], 0
+        for nm in free:
+            if nm in lin_set:
+                out.append(lin_cols[nm])
+            else:
+                out.append(jac_nl[:, k])
+                k += 1
+        return jnp.stack(out, axis=1)
+
     def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
                 eid, jvar):
         if anchored_on:
@@ -329,13 +402,25 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
 
             f032 = f0.astype(jnp.float32)
             valid32 = valid.astype(jnp.float32)
-            jac = jax.jacfwd(phase32)(ua) / f032
+            if lin_names:
+                lin_cols = model.linear_design_columns(
+                    make_pv(ua * s32, ub * s32, fa, fb),
+                    batch32, cache32, lin_set)
+                jac = _assemble_jac(
+                    phase32, ua, lin_cols) / f032
+            else:
+                jac = jax.jacfwd(phase32)(ua) / f032
             cols = [jac * valid32[:, None]]
             if incoffset:
                 cols.insert(0, (valid32 / f032)[:, None])
             M = jnp.concatenate(cols, axis=1)
         else:
-            jac = jax.jacfwd(phase_f64)(th) / f0
+            if lin_names:
+                lin_cols = model.linear_design_columns(
+                    make_pv(th, tl, fh, fl), batch, cache, lin_set)
+                jac = _assemble_jac(phase_f64, th, lin_cols) / f0
+            else:
+                jac = jax.jacfwd(phase_f64)(th) / f0
             cols = [jac * valid[:, None]]
             if incoffset:
                 cols.insert(0, (valid / f0)[:, None])
@@ -556,11 +641,12 @@ def _symm_mm(X, Y, f32: bool):
     """X.T @ Y with optional f32 inputs at HIGHEST matmul precision
     (on TPU: 6-pass bf16 through the MXU, ~f32-exact; f64 matmuls
     there are software-emulated and an order of magnitude slower).
-    Already-f32 inputs (the f32 Jacobian path) always take the HIGHEST
-    route — default f32 dot precision on TPU is bf16, not acceptable
-    for normal equations. Result is always f64."""
-    if not f32 and X.dtype == jnp.float64 and Y.dtype == jnp.float64:
-        return X.T @ Y
+    With f32=False inputs are UPCAST to f64 and accumulated there —
+    an exactly-accumulated Gram matrix is PSD whatever the column
+    quantization, which is what the degenerate-model retry in
+    _gls_core relies on. Result is always f64."""
+    if not f32:
+        return X.astype(jnp.float64).T @ Y.astype(jnp.float64)
     out = jax.lax.dot(X.astype(jnp.float32).T, Y.astype(jnp.float32),
                       precision=jax.lax.Precision.HIGHEST)
     return out.astype(jnp.float64)
@@ -608,54 +694,76 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
     swM = sw.astype(mdt)
     bigs = big * swM[:, None]
     rs = r * sw
-    Sigma = _symm_mm(bigs, bigs, f32mm)
-    b = _symm_mm(bigs, rs.astype(mdt)[:, None], f32mm)[:, 0]
-    rCr = jnp.sum(rs * rs)
-    if nseg > 1:  # static: no ECORR -> skip the dead downdate entirely
-        # epoch contractions (Sherman-Morrison downdate); the O(N p)
-        # segment sums stay f64 (elementwise, cheap) — only the
-        # (nseg x p)^T (nseg x p) contraction rides the matmul path
-        # NOTE: no indices_are_sorted hint — eid is a runtime argument
-        # of the advertised-pure step_fn, and a baked-in sortedness
-        # promise would silently corrupt the downdate for any caller
-        # substituting a re-ordered eid
-        def seg(x):
-            return jax.ops.segment_sum(x, eid, num_segments=nseg)
-
-        s_seg = seg(w)
-        g = jvar / (1.0 + jvar * s_seg)
-        E = seg(big * wM[:, None])
-        wr_seg = seg(w * r)
-        sg = jnp.sqrt(g)
-        Eg = E * sg.astype(mdt)[:, None]
-        Sigma = Sigma - _symm_mm(Eg, Eg, f32mm)
-        b = b - Eg.astype(jnp.float64).T @ (sg * wr_seg)
-        rCr = rCr - jnp.sum(g * wr_seg ** 2)
     q = F.shape[1]
     prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi]) if q else \
         jnp.zeros(p)
-    Sigma = Sigma + jnp.diag(prior)
-    # Jacobi-precondition to unit diagonal: Sigma mixes O(1) data terms
-    # with 1/phi priors up to ~1e25, and TPU f64 (emulated, not
-    # IEEE-correctly-rounded) loses the Cholesky on that raw scaling
-    d = jnp.sqrt(jnp.diagonal(Sigma))
-    d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
-    cf = jax.scipy.linalg.cho_factor(Sigma / jnp.outer(d, d), lower=True)
-    xhat = jax.scipy.linalg.cho_solve(cf, b / d) / d
-    inv = jax.scipy.linalg.cho_solve(
-        cf, jnp.eye(Sigma.shape[0])) / jnp.outer(d, d)
-    # chi2 at the point: marginalize the noise (F-basis + ECORR) only,
-    # not the parameter block (see gls.py _gls_chi2_kernel)
-    if q:
-        bF = b[p:]
-        SF = Sigma[p:, p:]
-        dF = d[p:]
-        cfF = jax.scipy.linalg.cho_factor(SF / jnp.outer(dF, dF),
-                                          lower=True)
-        chi2 = rCr - bF @ (jax.scipy.linalg.cho_solve(
-            cfF, bF / dF) / dF)
-    else:
-        chi2 = rCr
+
+    def assemble(use32: bool):
+        Sigma = _symm_mm(bigs, bigs, use32)
+        b = _symm_mm(bigs, rs.astype(mdt)[:, None], use32)[:, 0]
+        rCr = jnp.sum(rs * rs)
+        if nseg > 1:  # static: no ECORR -> skip the dead downdate
+            # epoch contractions (Sherman-Morrison downdate); the
+            # O(N p) segment sums stay f64 (elementwise, cheap) — only
+            # the (nseg x p)^T (nseg x p) contraction rides the matmul
+            # path. NOTE: no indices_are_sorted hint — eid is a
+            # runtime argument of the advertised-pure step_fn, and a
+            # baked-in sortedness promise would silently corrupt the
+            # downdate for any caller substituting a re-ordered eid
+            def seg(x):
+                return jax.ops.segment_sum(x, eid, num_segments=nseg)
+
+            s_seg = seg(w)
+            g = jvar / (1.0 + jvar * s_seg)
+            E = seg(big * wM[:, None])
+            wr_seg = seg(w * r)
+            sg = jnp.sqrt(g)
+            Eg = E * sg.astype(mdt)[:, None]
+            Sigma = Sigma - _symm_mm(Eg, Eg, use32)
+            b = b - Eg.astype(jnp.float64).T @ (sg * wr_seg)
+            rCr = rCr - jnp.sum(g * wr_seg ** 2)
+        return Sigma + jnp.diag(prior), b, rCr
+
+    def solve(Sigma, b, rCr):
+        # Jacobi-precondition to unit diagonal: Sigma mixes O(1) data
+        # terms with 1/phi priors up to ~1e25, and TPU f64 (emulated,
+        # not IEEE-correctly-rounded) loses the Cholesky on that raw
+        # scaling
+        d = jnp.sqrt(jnp.diagonal(Sigma))
+        d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+        cf = jax.scipy.linalg.cho_factor(Sigma / jnp.outer(d, d),
+                                         lower=True)
+        xhat = jax.scipy.linalg.cho_solve(cf, b / d) / d
+        inv = jax.scipy.linalg.cho_solve(
+            cf, jnp.eye(Sigma.shape[0])) / jnp.outer(d, d)
+        # chi2 at the point: marginalize the noise (F-basis + ECORR)
+        # only, not the parameter block (see gls.py _gls_chi2_kernel)
+        if q:
+            bF = b[p:]
+            SF = Sigma[p:, p:]
+            dF = d[p:]
+            cfF = jax.scipy.linalg.cho_factor(SF / jnp.outer(dF, dF),
+                                              lower=True)
+            chi2 = rCr - bF @ (jax.scipy.linalg.cho_solve(
+                cfF, bF / dF) / dF)
+        else:
+            chi2 = rCr
+        return xhat, inv, chi2
+
+    xhat, inv, chi2 = solve(*assemble(f32mm))
+    if f32mm:
+        # in-kernel degeneracy rescue: on a near-rank-deficient model
+        # the f32-accumulated normal matrix can lose positive
+        # definiteness (f32 rounding of a large cancellation) and the
+        # Cholesky NaNs out. Retry ONCE with f64-accumulated matmuls —
+        # an exactly-accumulated Gram matrix is PSD whatever the
+        # column quantization — executing the slow branch only when
+        # the fast one actually failed (lax.cond, not jnp.where).
+        ok = jnp.all(jnp.isfinite(xhat)) & jnp.isfinite(chi2)
+        xhat, inv, chi2 = jax.lax.cond(
+            ok,
+            lambda: (xhat, inv, chi2),
+            lambda: solve(*assemble(False)))
     dparams = -xhat[:p] / colmax / norm  # r ≈ M(θ−θ_true): corr is −x
     cov = inv[:p, :p] / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
     return dparams, cov, chi2, r

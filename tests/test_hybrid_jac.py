@@ -1,0 +1,232 @@
+"""Hybrid analytic/AD Jacobian oracle: closed-form design columns
+(TimingModel.linear_design_columns) must equal jax.jacfwd of the
+direct phase chain to rounding, and the hybrid fit step must
+reproduce the full-AD step. Reference anchor: src/pint/models/
+timing_model.py designmatrix (the reference's analytic d_phase_d_*
+chains are exactly what these closed forms re-derive)."""
+import io
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.ops.dd import DD, dd_frac
+from pint_tpu.parallel import build_fit_step
+from pint_tpu.simulation import make_fake_toas_uniform
+
+SINK_PAR = """
+PSR J1744-9999
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+PMRA 2.0 1
+PMDEC -3.0 1
+PX 1.0 1
+F0 61.0 1
+F1 -1e-15 1
+DM 20.0 1
+DM1 1e-4 1
+PEPOCH 55000
+POSEPOCH 55000
+DMEPOCH 55000
+TZRMJD 55000.01
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+JUMP -be X 1e-5 1
+DMX_0001 1e-4 1
+DMXR1_0001 54000
+DMXR2_0001 55000
+DMX_0002 -2e-4 1
+DMXR1_0002 55000.001
+DMXR2_0002 56000
+WXEPOCH 55000
+WXFREQ_0001 0.002
+WXSIN_0001 1e-5 1
+WXCOS_0001 -2e-5 1
+DMWXEPOCH 55000
+DMWXFREQ_0001 0.003
+DMWXSIN_0001 1e-4 1
+DMWXCOS_0001 2e-4 1
+GLEP_1 54800
+GLPH_1 0.1 1
+GLF0_1 1e-8 1
+GLF1_1 -1e-16 1
+GLF0D_1 1e-8 1
+GLTD_1 50
+BINARY ELL1
+PB 10.0 1
+A1 5.0 1
+TASC 55000.1 1
+EPS1 1e-5 1
+EPS2 -2e-5 1
+"""
+
+EXPECT_LINEAR = {
+    "DM", "DM1", "DMX_0001", "DMX_0002", "JUMP1",
+    "WXSIN_0001", "WXCOS_0001", "DMWXSIN_0001", "DMWXCOS_0001",
+    "GLPH_1", "GLF0_1", "GLF1_1", "GLF0D_1",
+}
+
+
+@pytest.fixture(scope="module")
+def sink():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(SINK_PAR))
+        toas = make_fake_toas_uniform(
+            54100, 55900, 150, m, error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(11))
+        for i, f in enumerate(toas.flags):
+            f["be"] = "X" if i % 3 else "Y"
+        m.get_cache(toas)
+    return m, toas
+
+
+def test_linear_claims(sink):
+    m, _ = sink
+    assert m.linear_design_names() == EXPECT_LINEAR
+
+
+def test_columns_match_jacfwd(sink):
+    """Every closed-form column equals the jacfwd column to rounding
+    — including the TZR-row subtraction and the binary's response to
+    pre-binary delay shifts (the stage-sensitivity JVP)."""
+    m, toas = sink
+    phase_fn, (free, frozen) = m._build_phase_fn()
+    cache = m.get_cache(toas)
+    fr, fz, th, tl, fh, fl = m._pack()
+    batch = cache["batch"]
+    sc = {k: v for k, v in cache.items() if k != "batch"}
+    th, tl, fh, fl = map(jnp.asarray, (th, tl, fh, fl))
+
+    def phase_f64(thx):
+        ph, _ = phase_fn(thx, tl, fh, fl, batch, sc)
+        f = dd_frac(ph)
+        return f.hi + f.lo
+
+    jacfull = np.asarray(jax.jacfwd(phase_f64)(th))
+    pv = {nm: DD(th[i], tl[i]) for i, nm in enumerate(fr)}
+    pv.update({nm: DD(fh[j], fl[j]) for j, nm in enumerate(fz)})
+    lin = m.linear_design_names()
+    cols = m.linear_design_columns(pv, batch, sc, lin)
+    assert set(cols) == lin
+    for nm in sorted(lin):
+        a = np.asarray(cols[nm])
+        b = jacfull[:, fr.index(nm)]
+        scale = max(np.max(np.abs(b)), 1e-300)
+        # the DM column is a cancellation remnant (TZR at the same
+        # frequency subtracts a near-equal constant), so also accept
+        # machine-eps-level ABSOLUTE agreement vs the pre-cancellation
+        # magnitude (~K/nu^2 * S ~ 0.3 here)
+        ok = (np.max(np.abs(a - b)) / scale < 1e-12
+              or np.max(np.abs(a - b)) < 1e-13)
+        assert ok, (nm, np.max(np.abs(a - b)), scale)
+
+
+@pytest.mark.parametrize("flags", [
+    dict(),                                    # plain f64
+    dict(anchored=True),                       # anchored f64
+    dict(anchored=True, jac_f32=True,
+         matmul_f32=True),                     # full production config
+])
+def test_step_matches_full_ad(sink, flags):
+    """The hybrid step's (dparams, cov, chi2, resids) match the
+    full-AD step built with identical flags. DM/DM1 are frozen here:
+    free full-span DMX windows make a free DM exactly collinear
+    (singular normal matrix in BOTH builds — the bench.py modeling
+    note)."""
+    par = SINK_PAR.replace("DM 20.0 1", "DM 20.0") \
+                  .replace("DM1 1e-4 1", "DM1 1e-4")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        toas = make_fake_toas_uniform(
+            54100, 55900, 150, m, error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(11))
+        for i, f in enumerate(toas.flags):
+            f["be"] = "X" if i % 3 else "Y"
+    fn_h, args_h, names = build_fit_step(m, toas, hybrid_jac=True,
+                                         **flags)
+    fn_f, args_f, _ = build_fit_step(m, toas, hybrid_jac=False,
+                                     **flags)
+    out_h = jax.jit(fn_h)(*args_h)
+    out_f = jax.jit(fn_f)(*args_f)
+    dp_h, dp_f = np.asarray(out_h[0]), np.asarray(out_f[0])
+    sig = np.sqrt(np.abs(np.diag(np.asarray(out_f[1]))))
+    # columns agree to rounding (test_columns_match_jacfwd), but the
+    # solve amplifies eps-level differences by the condition number —
+    # this sink's columns span ~20 decades and carry several
+    # near-collinear pairs (glitch vs F1, WaveX vs binary). In f64
+    # that amplification stays below 1e-4 sigma. At f32 column
+    # precision the same amplification acts on ~1e-7 quantization:
+    # the hybrid-vs-AD delta is bounded by the f32 config's own error
+    # scale on a model this degenerate (its documented contract is
+    # <1e-2 sigma at benchmark conditioning), so 5e-2 sigma here.
+    tol_sig = 5e-2 if flags.get("jac_f32") else 1e-4
+    assert np.max(np.abs(dp_h - dp_f) / np.where(sig > 0, sig, 1.0)) \
+        < tol_sig
+    assert float(out_h[2]) == pytest.approx(float(out_f[2]),
+                                            rel=1e-6)
+    np.testing.assert_allclose(np.asarray(out_h[3]),
+                               np.asarray(out_f[3]),
+                               rtol=0, atol=1e-12)
+
+
+def test_f32mm_degeneracy_rescue(sink):
+    """On a near-rank-deficient model the f32-accumulated normal
+    matrix can lose positive definiteness and NaN the Cholesky; the
+    in-kernel lax.cond retry with f64-accumulated matmuls must
+    produce a finite step (this exact sink reproduced the NaN before
+    the retry existed)."""
+    par = SINK_PAR.replace("DM 20.0 1", "DM 20.0") \
+                  .replace("DM1 1e-4 1", "DM1 1e-4")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        toas = make_fake_toas_uniform(
+            54100, 55900, 150, m, error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(11))
+        for i, f in enumerate(toas.flags):
+            f["be"] = "X" if i % 3 else "Y"
+    fn, args, _ = build_fit_step(m, toas, matmul_f32=True,
+                                 hybrid_jac=False)
+    out = jax.jit(fn)(*args)
+    assert np.all(np.isfinite(np.asarray(out[0])))
+    assert np.all(np.isfinite(np.asarray(out[1])))
+    assert np.isfinite(float(out[2]))
+
+
+def test_env_off_disables(sink, monkeypatch):
+    m, toas = sink
+    monkeypatch.setenv("PINT_TPU_HYBRID_JAC", "off")
+    from pint_tpu.parallel.fit_step import _use_hybrid_jac
+
+    assert _use_hybrid_jac(None) is False
+    monkeypatch.setenv("PINT_TPU_HYBRID_JAC", "on")
+    assert _use_hybrid_jac(None) is True
+
+
+def test_phoff_column(sink):
+    """PHOFF (apply_to_tzr=False) gets a -1 column with no TZR
+    subtraction — the exact form whose absence made PHOFF silently
+    inert once before (CLAUDE.md)."""
+    par = SINK_PAR.replace("JUMP -be X 1e-5 1", "PHOFF 0.01 1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        toas = make_fake_toas_uniform(
+            54100, 55900, 60, m, error_us=1.0,
+            rng=np.random.default_rng(12))
+    assert "PHOFF" in m.linear_design_names()
+    phase_fn, (free, _) = m._build_phase_fn()
+    cache = m.get_cache(toas)
+    fr, fz, th, tl, fh, fl = m._pack()
+    th, tl, fh, fl = map(jnp.asarray, (th, tl, fh, fl))
+    sc = {k: v for k, v in cache.items() if k != "batch"}
+    pv = {nm: DD(th[i], tl[i]) for i, nm in enumerate(fr)}
+    pv.update({nm: DD(fh[j], fl[j]) for j, nm in enumerate(fz)})
+    cols = m.linear_design_columns(pv, cache["batch"], sc, {"PHOFF"})
+    np.testing.assert_allclose(np.asarray(cols["PHOFF"]), -1.0)
