@@ -492,6 +492,20 @@ class FD(DelayComponent):
         # TOAs at infinite frequency (barycentred data) see no FD delay
         return jnp.where(jnp.isfinite(bf), total, 0.0)
 
+    def linear_design_names(self):
+        return [f"FD{i}" for i in self.fd_ids
+                if not self.params[f"FD{i}"].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(FDi) = ln(nu/GHz)^i (0 at infinite freq)."""
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        fin = jnp.isfinite(bf)
+        logf = jnp.log(jnp.where(fin, bf, 1000.0) / 1000.0)
+        return {f"FD{i}": ("pre_delay",
+                           jnp.where(fin, logf ** i, 0.0))
+                for i in self.fd_ids
+                if not self.params[f"FD{i}"].frozen}
+
 
 class SolarWindDispersion(DelayComponent):
     """Solar-wind dispersion (reference:
@@ -538,8 +552,9 @@ class SolarWindDispersion(DelayComponent):
         c = jnp.clip(jnp.cos(phi), 1e-12, 1.0)
         return half * jnp.sum(wts[None, :] * c ** q, axis=-1)
 
-    def dm_value_device(self, pv, batch, cache, ctx):
-        ne = _val(pv, "NE_SW")
+    def _geom(self, pv, batch, ctx):
+        """Line-of-sight geometry factor: dm = NE_SW * _geom (the
+        NE_SW partial, shared by delay and linear_design_local)."""
         n = ctx["psr_dir"]  # (N,3) unit observer->pulsar
         s = batch.obs_sun_pos  # (N,3) observer->Sun, lt-s
         r_lts = jnp.sqrt(jnp.sum(s * s, axis=-1))
@@ -559,12 +574,28 @@ class SolarWindDispersion(DelayComponent):
             # (AU/b)^p * b / pc keeps every intermediate O(1): the
             # naive AU^p overflows f32 range for SWP >= ~3.45 in the
             # f32 Jacobian re-trace
-            return ne * (AU_M / b_m) ** p * (b_m / PC_M) * F
+            return (AU_M / b_m) ** p * (b_m / PC_M) * F
         # SWM 0: n_e = NE_SW (AU/r)^2 closed form
         # DM in pc/cm^3: NE_SW [cm^-3] * AU^2[m^2]/pc[m] * geom [1/m]
-        return ne * (AU_M * AU_M / PC_M) * (jnp.pi - rho) / (r_m * sinr)
+        return (AU_M * AU_M / PC_M) * (jnp.pi - rho) / (r_m * sinr)
+
+    def dm_value_device(self, pv, batch, cache, ctx):
+        return _val(pv, "NE_SW") * self._geom(pv, batch, ctx)
 
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         bf = ctx.get("bfreq", batch.freq_mhz)
         return DMconst * self.dm_value_device(pv, batch, cache, ctx) \
             / (bf * bf)
+
+    def linear_design_names(self):
+        return [] if self.NE_SW.frozen else ["NE_SW"]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(NE_SW) = DMconst * geom / nu^2 (exact at the
+        current SWP/astrometry; a free SWP stays on AD)."""
+        if self.NE_SW.frozen:
+            return {}
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        return {"NE_SW": ("pre_delay",
+                          DMconst * self._geom(pv, batch, ctx)
+                          / (bf * bf))}

@@ -10,6 +10,8 @@ solar_wind_dispersion.py (SolarWindDispersionX), fdjump.py (FDJump).
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -42,6 +44,18 @@ def chromatic_index(parent, default: float = 4.0) -> float:
         if v is not None:
             return float(v)
     return default
+
+
+def chromatic_scale(batch, ctx, alpha):
+    """Per-TOA chromatic factor DMconst nu^-alpha 1000^(alpha-2)
+    (0 at infinite frequency) — the single implementation behind
+    ChromaticCM/CMX/CMWaveX delays AND their closed-form design
+    columns (the 1-GHz referencing convention lives here once)."""
+    bf = ctx.get("bfreq", batch.freq_mhz)
+    fin = jnp.isfinite(bf)
+    out = DMconst * jnp.where(fin, bf, 1000.0) ** -alpha \
+        * (1000.0 ** (alpha - 2.0))
+    return jnp.where(fin, out, 0.0)
 
 
 def solar_wind_geometry_host(toas, psr_dir) -> np.ndarray:
@@ -235,8 +249,6 @@ class ChromaticCM(DelayComponent):
             ctx["tb_days"] = tb
         dt = (tb - (self._epoch() - ref)) * SECS_PER_DAY
         cm = _val(pv, "CM") * jnp.ones_like(dt)
-        import math
-
         for i in self.cm_ids:  # true i! even when the series has gaps
             cm = cm + _val(pv, f"CM{i}") * dt ** i / math.factorial(i)
         return cm
@@ -250,6 +262,40 @@ class ChromaticCM(DelayComponent):
         # 1000^(alpha-2) factor makes alpha=2 coincide with DM in the
         # usual MHz convention)
         return jnp.where(jnp.isfinite(bf), out, 0.0)
+
+    def _chrom_scale(self, pv, batch, ctx):
+        """chromatic_scale at the current (possibly traced)
+        TNCHROMIDX."""
+        return chromatic_scale(batch, ctx, _val(pv, "TNCHROMIDX", 4.0))
+
+    def linear_design_names(self):
+        out = [] if self.CM.frozen else ["CM"]
+        out += [f"CM{i}" for i in self.cm_ids
+                if not self.params[f"CM{i}"].frozen]
+        if out and not self.CMEPOCH.frozen:
+            return []  # dt pivots on a fitted CMEPOCH: stay on AD
+        return out
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(CMk) = chrom_scale * dt^k/k! (mirrors
+        cm_value_device; TNCHROMIDX itself stays on AD when free)."""
+        names = set(self.linear_design_names())
+        if not names:
+            return {}
+        sc = self._chrom_scale(pv, batch, ctx)
+        out = {}
+        if "CM" in names:
+            out["CM"] = ("pre_delay", sc)
+        if any(nm != "CM" for nm in names):
+            ref = self._parent.ref_day
+            tb = (batch.tdb_day - ref) + batch.tdb_frac.hi \
+                + batch.tdb_frac.lo
+            dt = (tb - (self._epoch() - ref)) * SECS_PER_DAY
+            for i in self.cm_ids:
+                if f"CM{i}" in names:
+                    out[f"CM{i}"] = ("pre_delay",
+                                     sc * dt ** i / math.factorial(i))
+        return out
 
 
 class ChromaticCMX(DelayComponent):
@@ -309,6 +355,25 @@ class ChromaticCMX(DelayComponent):
         out = DMconst * cm * bf ** -alpha * (1000.0 ** (alpha - 2.0))
         return jnp.where(jnp.isfinite(bf), out, 0.0)
 
+    def _chrom_scale(self, batch, ctx):
+        return chromatic_scale(batch, ctx,
+                               chromatic_index(self._parent))
+
+    def linear_design_names(self):
+        return [f"CMX_{istr}" for _, istr in self.cmx_ids
+                if not self.params[f"CMX_{istr}"].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(CMX_i) = chrom_scale * window_mask_i."""
+        if not self.cmx_ids:
+            return {}
+        sc = self._chrom_scale(batch, ctx)
+        masks = cache["cmx_masks"]
+        return {f"CMX_{istr}": ("pre_delay",
+                                sc * masks[:, col].astype(sc.dtype))
+                for col, (_, istr) in enumerate(self.cmx_ids)
+                if not self.params[f"CMX_{istr}"].frozen}
+
 
 class CMWaveX(DelayComponent):
     """Fourier chromatic variations (reference: wavex.CMWaveX):
@@ -360,6 +425,31 @@ class CMWaveX(DelayComponent):
         bf = ctx.get("bfreq", batch.freq_mhz)
         out = DMconst * cm * bf ** -alpha * (1000.0 ** (alpha - 2.0))
         return jnp.where(jnp.isfinite(bf), out, 0.0)
+
+    def linear_design_names(self):
+        return [f"{pre}{istr}" for _, istr in self.cmwx_ids
+                for pre in ("CMWXSIN_", "CMWXCOS_")
+                if not self.params[f"{pre}{istr}"].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(CMWXSIN/COS) = chrom_scale * sin/cos(arg)."""
+        if not self.cmwx_ids:
+            return {}
+        sc = chromatic_scale(batch, ctx, chromatic_index(self._parent))
+        ref = self._parent.ref_day
+        tb = (batch.tdb_day - ref) + batch.tdb_frac.hi \
+            + batch.tdb_frac.lo
+        t = tb - (self._epoch() - ref)
+        out = {}
+        for idx, istr in self.cmwx_ids:
+            arg = 2.0 * jnp.pi * _val(pv, f"CMWXFREQ_{istr}") * t
+            if not self.params[f"CMWXSIN_{istr}"].frozen:
+                out[f"CMWXSIN_{istr}"] = ("pre_delay",
+                                          sc * jnp.sin(arg))
+            if not self.params[f"CMWXCOS_{istr}"].frozen:
+                out[f"CMWXCOS_{istr}"] = ("pre_delay",
+                                          sc * jnp.cos(arg))
+        return out
 
 
 # ---------------------------------------------------- tabulated phase
@@ -557,6 +647,23 @@ class SolarWindDispersionX(DelayComponent):
         bf = ctx.get("bfreq", batch.freq_mhz)
         return DMconst * dm / (bf * bf)
 
+    def linear_design_names(self):
+        return [f"SWXDM_{istr}" for _, istr in self.swx_ids
+                if not self.params[f"SWXDM_{istr}"].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(SWXDM_i) = DMconst * geom_col_i / nu^2 (the
+        precomputed normalized-geometry window columns)."""
+        if not self.swx_ids:
+            return {}
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        inv2 = DMconst / (bf * bf)
+        cols = cache["swx_cols"]
+        return {f"SWXDM_{istr}": ("pre_delay",
+                                  inv2 * cols[:, c].astype(bf.dtype))
+                for c, (_, istr) in enumerate(self.swx_ids)
+                if not self.params[f"SWXDM_{istr}"].frozen}
+
 
 # ----------------------------------------------------------- FD jumps
 
@@ -611,3 +718,19 @@ class FDJump(DelayComponent):
                 total = total + _val(pv, name) * logf ** order * \
                     cache[f"mask_{name}"]
         return jnp.where(jnp.isfinite(bf), total, 0.0)
+
+    def linear_design_names(self):
+        return [name for _, name in self.fdjumps
+                if not self.params[name].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """d(delay)/d(FDnJUMPi) = ln(nu/GHz)^n * mask_i."""
+        if not self.fdjumps:
+            return {}
+        bf = ctx.get("bfreq", batch.freq_mhz)
+        fin = jnp.isfinite(bf)
+        logf = jnp.log(jnp.where(fin, bf, 1000.0) / 1000.0)
+        return {name: ("pre_delay", jnp.where(
+                    fin, logf ** order * cache[f"mask_{name}"], 0.0))
+                for order, name in self.fdjumps
+                if not self.params[name].frozen}

@@ -43,8 +43,6 @@ def _resolve_f32(flag: Optional[bool], env_name: str) -> bool:
     """Shared f32/f64 mode resolution: explicit argument > env var
     (f32/f64) > auto (f32 on TPU — f64 there is software-emulated and
     bypasses the MXU/VPU fast paths — f64 elsewhere)."""
-    import os
-
     if flag is not None:
         return bool(flag)
     env = os.environ.get(env_name, "").lower()
@@ -80,8 +78,6 @@ def _use_hybrid_jac(flag: Optional[bool]) -> bool:
     tangent set and their columns computed from local factors times
     one shared stage-sensitivity JVP. Exact partials, not
     approximations (equality oracle: tests/test_hybrid_jac.py)."""
-    import os
-
     if flag is not None:
         return bool(flag)
     env = os.environ.get("PINT_TPU_HYBRID_JAC", "").lower()

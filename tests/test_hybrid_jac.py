@@ -199,6 +199,85 @@ def test_f32mm_degeneracy_rescue(sink):
     assert np.isfinite(float(out[2]))
 
 
+SINK2_PAR = """
+PSR J0002-0002
+RAJ 06:00:00.0 1
+DECJ -5:00:00.0 1
+F0 305.0 1
+F1 -3e-16 1
+DM 11.0
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.01
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+NE_SW 6.0 1
+FD1 1e-5 1
+FD1JUMP -be X 2e-5 1
+CM 0.02 1
+CM1 1e-10 1
+TNCHROMIDX 4.0
+CMX_0001 0.01 1
+CMXR1_0001 54000
+CMXR2_0001 55200
+CMWXEPOCH 55000
+CMWXFREQ_0001 0.0015
+CMWXSIN_0001 0.003 1
+CMWXCOS_0001 -0.002 1
+SWXDM_0001 1e-4 1
+SWXR1_0001 54000
+SWXR2_0001 56000
+"""
+
+EXPECT_LINEAR2 = {
+    "NE_SW", "FD1", "FD1JUMP1", "CM", "CM1", "CMX_0001",
+    "CMWXSIN_0001", "CMWXCOS_0001", "SWXDM_0001",
+}
+
+
+def test_chromatic_solar_fd_columns_match_jacfwd():
+    """The chromatic/solar-wind/FD claim families against jacfwd
+    (two observing frequencies so the nu-scalings are exercised)."""
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(SINK2_PAR))
+        mjds = np.linspace(54100, 55900, 120)
+        freqs = np.tile([1400.0, 820.0], 60)
+        toas = make_fake_toas_fromMJDs(
+            mjds, m, error_us=1.0, freq_mhz=freqs, add_noise=True,
+            rng=np.random.default_rng(21))
+        for i, f in enumerate(toas.flags):
+            f["be"] = "X" if i % 2 else "Y"
+        m.get_cache(toas)
+    assert m.linear_design_names() == EXPECT_LINEAR2
+    phase_fn, (free, frozen) = m._build_phase_fn()
+    cache = m.get_cache(toas)
+    fr, fz, th, tl, fh, fl = m._pack()
+    batch = cache["batch"]
+    sc = {k: v for k, v in cache.items() if k != "batch"}
+    th, tl, fh, fl = map(jnp.asarray, (th, tl, fh, fl))
+
+    def phase_f64(thx):
+        ph, _ = phase_fn(thx, tl, fh, fl, batch, sc)
+        f = dd_frac(ph)
+        return f.hi + f.lo
+
+    jacfull = np.asarray(jax.jacfwd(phase_f64)(th))
+    pv = {nm: DD(th[i], tl[i]) for i, nm in enumerate(fr)}
+    pv.update({nm: DD(fh[j], fl[j]) for j, nm in enumerate(fz)})
+    cols = m.linear_design_columns(pv, batch, sc, EXPECT_LINEAR2)
+    for nm in sorted(EXPECT_LINEAR2):
+        a = np.asarray(cols[nm])
+        b = jacfull[:, fr.index(nm)]
+        scale = max(np.max(np.abs(b)), 1e-300)
+        ok = (np.max(np.abs(a - b)) / scale < 1e-12
+              or np.max(np.abs(a - b)) < 1e-13)
+        assert ok, (nm, np.max(np.abs(a - b)), scale)
+
+
 def test_env_off_disables(sink, monkeypatch):
     m, toas = sink
     monkeypatch.setenv("PINT_TPU_HYBRID_JAC", "off")
