@@ -581,6 +581,43 @@ class PiecewiseSpindown(PhaseComponent):
             total = total + cache["pw_masks"][:, k] * ph
         return DD(total, z)
 
+    _LD_PW = ("PWPH_", "PWF0_", "PWF1_", "PWF2_")
+
+    def linear_design_names(self):
+        # PWEP_ (the piece epoch) pivots its dt: pieces with a fitted
+        # epoch keep ALL their params on AD
+        out = []
+        for idx, istr in self.pw_ids:
+            if not self.params[f"PWEP_{istr}"].frozen:
+                continue
+            out += [f"{pre}{istr}" for pre in self._LD_PW
+                    if f"{pre}{istr}" in self.params
+                    and not self.params[f"{pre}{istr}"].frozen]
+        return out
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        """Exact partials of the piecewise spin phase: mask,
+        mask*dt, mask*dt^2/2, mask*dt^3/6 per piece (mirrors phase)."""
+        names = set(self.linear_design_names())
+        if not names:
+            return {}
+        ref = self._parent.ref_day
+        tb = ctx["tb"]
+        tb_f = tb.hi + tb.lo
+        out = {}
+        for k, (idx, istr) in enumerate(self.pw_ids):
+            if not any(f"{pre}{istr}" in names for pre in self._LD_PW):
+                continue
+            ep = pv[f"PWEP_{istr}"]
+            dt = tb_f - ((ep.hi + ep.lo) - ref) * SECS_PER_DAY
+            m = cache["pw_masks"][:, k].astype(tb_f.dtype)
+            for pre, g in (("PWPH_", m), ("PWF0_", m * dt),
+                           ("PWF1_", m * dt * dt / 2.0),
+                           ("PWF2_", m * dt ** 3 / 6.0)):
+                if f"{pre}{istr}" in names:
+                    out[f"{pre}{istr}"] = ("phase", g)
+        return out
+
 
 # ------------------------------------------------- piecewise solar wind
 

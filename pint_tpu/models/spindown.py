@@ -96,3 +96,30 @@ class Spindown(PhaseComponent):
         coeffs = [DD(jnp.zeros_like(dt.hi), jnp.zeros_like(dt.hi))]
         coeffs += [pv[nm] for nm in self.f_terms()]
         return dd_taylor_horner(dt, coeffs)
+
+    def linear_design_names(self):
+        """F1+ only. The spin phase is exactly linear in every F_i
+        (d(phase)/d(F_i) = dt^{i+1}/(i+1)!), but F0 ALSO appears in
+        other components' phases (PhaseJump/Wave/IFunc scale their
+        second-offsets by F0), so claiming F0 here would require every
+        consumer to contribute its share — one AD tangent is the
+        safer trade. PEPOCH fitted => dt pivots => all on AD."""
+        if not self.PEPOCH.frozen or self.PEPOCH.value is None:
+            return []
+        return [nm for nm in self.f_terms()
+                if nm != "F0" and not self.params[nm].frozen]
+
+    def linear_design_local(self, pv, batch, cache, ctx):
+        import math
+
+        names = self.linear_design_names()
+        if not names:
+            return {}
+        dt_dd = self.dt(pv, ctx["tb"])
+        dts = dt_dd.hi + dt_dd.lo  # f64/f32 suffices: columns need
+        # only ~1e-7 relative accuracy (they feed equilibrated normal
+        # equations), unlike the phase value itself
+        terms = self.f_terms()
+        return {nm: ("phase",
+                     dts ** (i + 1) / math.factorial(i + 1))
+                for i, nm in enumerate(terms) if nm in names}

@@ -517,10 +517,12 @@ class TimingModel:
     # pulsar_system consumes delay_so_far), so
     #   d(phase)/d(p) = S_pre(t) * d(delay_comp)/d(p)
     # with ONE shared stage sensitivity S_pre = d(phase)/d(shift)
-    # (one JVP), and phase-linear params (JUMP, PHOFF, glitch pieces)
-    # have direct columns. parallel.fit_step drops all such params
-    # from the jacfwd tangent set — 40 -> 13 tangents at the
-    # north-star shape. Columns are exact partials at the current
+    # (one JVP), and phase-linear params (JUMP, PHOFF, glitch and
+    # piecewise-spindown pieces, spin F1+) have direct columns.
+    # parallel.fit_step drops all such params from the jacfwd tangent
+    # set — 40 -> 11 tangents at the north-star shape (12 under the
+    # f32 Jacobian, where the scaled F2 stays on AD). Columns are
+    # exact partials at the current
     # point (not approximations); equality with jacfwd is pinned by
     # tests/test_hybrid_jac.py.
 
@@ -545,19 +547,20 @@ class TimingModel:
     def _ld_rows(self, pv, batch, cache, sub: str, names):
         dt = batch.freq_mhz.dtype
         delay, tb, ctx = self._delay_tb(pv, batch, cache, sub)
-        local = {}
+        local = []  # (name, kind, g) — same-name claims ADD: several
+        # components may each own part of one parameter's response
         for comp in self._ordered_components():
             if sub == "tzr" and not getattr(comp, "apply_to_tzr", True):
                 continue
             for nm, (kind, g) in comp.linear_design_local(
                     pv, batch, cache[sub], ctx).items():
                 if nm in names:
-                    local[nm] = (kind, g)
+                    local.append((nm, kind, g))
         # the stage-sensitivity JVP costs one full-chain tangent pass:
         # pay it only when some claim actually is delay-kind (a
         # JUMP/PHOFF/glitch-only model needs none of it) — the kind
         # tags are static at trace time
-        if any(kind == "pre_delay" for kind, _ in local.values()):
+        if any(kind == "pre_delay" for _, kind, _ in local):
             zero = jnp.zeros((), dt)
 
             def f(s):
@@ -566,8 +569,11 @@ class TimingModel:
             _, s_pre = jax.jvp(f, (zero,), (jnp.ones((), dt),))
         else:
             s_pre = None
-        return {nm: s_pre * g if kind == "pre_delay" else g
-                for nm, (kind, g) in local.items()}
+        out: dict = {}
+        for nm, kind, g in local:
+            contrib = s_pre * g if kind == "pre_delay" else g
+            out[nm] = out[nm] + contrib if nm in out else contrib
+        return out
 
     def linear_design_columns(self, pv, batch, cache, names) -> dict:
         """{name: exact d(phase)/d(param) column [turns/unit]} for the

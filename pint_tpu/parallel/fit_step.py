@@ -166,15 +166,12 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     jac32 = _use_f32_jac(jac_f32)
 
     # hybrid Jacobian: closed-form columns for the linear params, AD
-    # tangents only for the rest (40 -> 13 tangents at the north-star
-    # shape). Static split at build time; column values are computed
-    # per step at the current parameter point.
+    # tangents only for the rest (40 -> 11 tangents at the north-star
+    # shape). Static split at build time (finalized after the scale
+    # computation below — scaled params must stay on AD); column
+    # values are computed per step at the current parameter point.
     lin_set = model.linear_design_names() \
         if _use_hybrid_jac(hybrid_jac) else set()
-    lin_names = [nm for nm in free if nm in lin_set]
-    nl_idx_list = [i for i, nm in enumerate(free) if nm not in lin_set]
-    nl_idx = np.asarray(nl_idx_list, dtype=np.int32)
-    lin_set = frozenset(lin_names)
 
     if wideband:
         from pint_tpu.wideband import get_wideband_dm
@@ -231,15 +228,18 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         # flag/env still wins.
         f32mm = f32mm or jac32
 
-    # the hybrid columns are d(phase)/d(theta) while AD columns are
-    # d(phase)/d(u) with u = theta*scale; the shared dp/cov unscaling
-    # assumes every CLAIMED param has scale exactly 1 (today only
-    # F-prefix index>=2 are scaled, and those are never claimable).
-    # Guard the invariant so a future scaled-and-claimed prefix fails
-    # loudly instead of silently multiplying its step by 2^e
-    assert all(scale_np[i] == 1.0 for i, nm in enumerate(free)
-               if nm in lin_set), \
-        "f32-Jacobian scaling applied to a closed-form (hybrid) param"
+    # finalize the hybrid split: the hybrid columns are
+    # d(phase)/d(theta) while AD columns are d(phase)/d(u) with
+    # u = theta*scale, and the shared dp/cov unscaling assumes every
+    # CLAIMED param has scale exactly 1 — so any param the f32
+    # scale-window machinery touched (F-prefix index>=2 under jac32)
+    # drops back to the AD tangent set
+    lin_set = {nm for i, nm in enumerate(free)
+               if nm in lin_set and scale_np[i] == 1.0}
+    lin_names = [nm for nm in free if nm in lin_set]
+    nl_idx_list = [i for i, nm in enumerate(free) if nm not in lin_set]
+    nl_idx = np.asarray(nl_idx_list, dtype=np.int32)
+    lin_set = frozenset(lin_names)
 
     # anchored delta-phase: host computes the exact reference once;
     # the step's (th, tl) arguments then carry the HOST-COMPUTED exact

@@ -56,6 +56,11 @@ GLF0_1 1e-8 1
 GLF1_1 -1e-16 1
 GLF0D_1 1e-8 1
 GLTD_1 50
+PWEP_1 54600
+PWSTART_1 54300
+PWSTOP_1 54700
+PWPH_1 0.02 1
+PWF0_1 2e-8 1
 BINARY ELL1
 PB 10.0 1
 A1 5.0 1
@@ -65,9 +70,13 @@ EPS2 -2e-5 1
 """
 
 EXPECT_LINEAR = {
+    "F1",  # spin phase is linear in F1+; F0 stays on AD (other
+    # components scale their phases by it — Spindown docstring)
     "DM", "DM1", "DMX_0001", "DMX_0002", "JUMP1",
     "WXSIN_0001", "WXCOS_0001", "DMWXSIN_0001", "DMWXCOS_0001",
     "GLPH_1", "GLF0_1", "GLF1_1", "GLF0D_1",
+    "PWPH_1", "PWF0_1",  # production-flag combos in
+    # test_step_matches_full_ad now exercise PW claims too
 }
 
 
@@ -228,11 +237,19 @@ CMWXCOS_0001 -0.002 1
 SWXDM_0001 1e-4 1
 SWXR1_0001 54000
 SWXR2_0001 56000
+PWEP_1 55000
+PWSTART_1 54500
+PWSTOP_1 55500
+PWPH_1 0.01 1
+PWF0_1 1e-8 1
+PWF1_1 -1e-17 1
 """
 
 EXPECT_LINEAR2 = {
+    "F1",
     "NE_SW", "FD1", "FD1JUMP1", "CM", "CM1", "CMX_0001",
     "CMWXSIN_0001", "CMWXCOS_0001", "SWXDM_0001",
+    "PWPH_1", "PWF0_1", "PWF1_1",
 }
 
 
