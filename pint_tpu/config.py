@@ -49,6 +49,17 @@ def solve_device(ntoa: int):
     return jax.devices("cpu")[0]
 
 
+def hybrid_jac_enabled(flag: Optional[bool] = None) -> bool:
+    """The ONE parser for $PINT_TPU_HYBRID_JAC (default ON): shared by
+    parallel.fit_step and TimingModel._get_compiled_jac so the device
+    step and the host-fitter design matrix can never disagree about
+    the Jacobian route under the same environment."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("PINT_TPU_HYBRID_JAC", "").lower() \
+        not in ("off", "false", "0")
+
+
 def solve_scope(ntoa: int):
     """Context manager form of solve_device: jax.default_device(cpu)
     for small problems on an accelerator backend, else a no-op. All

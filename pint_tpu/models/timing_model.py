@@ -243,6 +243,8 @@ class TimingModel:
         self._cache = None
         self._jit_phase = None
         self._cache_key_params = None
+        self._jit_jac = None
+        self._cache_key_jac = None
 
     # ---------------- component / parameter plumbing -----------------
 
@@ -741,7 +743,7 @@ class TimingModel:
 
         return fn
 
-    def _get_compiled(self):
+    def _compile_key(self):
         # The key must cover everything baked into the trace: the
         # component/parameter structure, the free set, ref_day, every
         # str/bool/int param (ECL, SIFUNC, K96, ... are read as trace
@@ -763,16 +765,75 @@ class TimingModel:
         statics += (("PLANET_SHAPIRO", bool(self.PLANET_SHAPIRO.value)),)
         frozen_vals = tuple(
             p.value for p in self._device_params() if p.frozen)
-        key = (tuple(sorted(self.components)),
-               tuple(p.name for p in self._device_params()),
-               tuple(self.free_params), self.ref_day, statics,
-               frozen_vals)
+        return (tuple(sorted(self.components)),
+                tuple(p.name for p in self._device_params()),
+                tuple(self.free_params), self.ref_day, statics,
+                frozen_vals)
+
+    def _get_compiled(self):
+        key = self._compile_key()
         if self._jit_phase is None or self._cache_key_params != key:
             fn, names = self._build_phase_fn()
             self._jit_phase = jax.jit(fn)
             self._names = names
             self._cache_key_params = key
         return self._jit_phase
+
+    def _get_compiled_jac(self):
+        """Jitted hybrid design-Jacobian (th, tl, fh, fl, batch, sc)
+        -> (N, p) d(phase)/d(free_j) [turns/unit]: closed-form columns
+        for the linear_design_names set, AD tangents for the rest —
+        cached like _get_compiled, so host fitters stop paying a full
+        jacfwd RE-TRACE on every iteration (designmatrix previously
+        rebuilt the jacobian trace per call)."""
+        from pint_tpu.config import hybrid_jac_enabled
+
+        lin = frozenset(self.linear_design_names()) \
+            if hybrid_jac_enabled() else frozenset()
+        base_key = self._compile_key()
+        key = (base_key, lin)
+        if self._jit_jac is None or self._cache_key_jac != key:
+            phase_fn, (free_names, frozen_names) = \
+                self._build_phase_fn()
+            nl_idx_list = [i for i, nm in enumerate(free_names)
+                           if nm not in lin]
+
+            def jac_fn(th, tl, fh, fl, batch, sc):
+                def phase_of(thx):
+                    ph, _ = phase_fn(thx, tl, fh, fl, batch, sc)
+                    return ph.hi + ph.lo
+
+                if nl_idx_list:
+                    idx = jnp.asarray(np.asarray(nl_idx_list,
+                                                 np.int32))
+
+                    def sub(th_nl):
+                        return phase_of(th.at[idx].set(th_nl))
+
+                    jac_nl = jax.jacfwd(sub)(th[idx])
+                if lin:
+                    pv = {nm: DD(th[i], tl[i])
+                          for i, nm in enumerate(free_names)}
+                    pv.update({nm: DD(fh[j], fl[j])
+                               for j, nm in enumerate(frozen_names)})
+                    cols = self.linear_design_columns(pv, batch, sc,
+                                                      lin)
+                out, k = [], 0
+                for nm in free_names:
+                    if nm in lin:
+                        out.append(cols[nm])
+                    else:
+                        out.append(jac_nl[:, k])
+                        k += 1
+                if not out:  # all params frozen: only the implicit
+                    # Offset column exists — (N, 0), as jacfwd gave
+                    return jnp.zeros((batch.freq_mhz.shape[0], 0),
+                                     batch.freq_mhz.dtype)
+                return jnp.stack(out, axis=1)
+
+            self._jit_jac = jax.jit(jac_fn)
+            self._cache_key_jac = key
+        return self._jit_jac
 
     def invalidate_cache(self, params_only=False):
         """Drop cached compiled state. params_only=True (a parameter
@@ -786,6 +847,8 @@ class TimingModel:
         if not params_only:
             self._jit_phase = None
             self._cache_key_params = None
+            self._jit_jac = None
+            self._cache_key_jac = None
             self._cache_key = None
             self._cache = None
             self.__dict__.pop("_noise_basis_cache", None)
@@ -907,16 +970,14 @@ class TimingModel:
             incoffset = False
         cache = self.get_cache(toas)
         free, _, th, tl, fh, fl = self._pack()
-        fn = self._get_compiled()
+        jac_fn = self._get_compiled_jac()
         sc = _strip(cache)
         batch = cache["batch"]
 
-        def phase_of(thx):
-            ph, _ = fn(thx, tl, fh, fl, batch, sc)
-            return ph.hi + ph.lo
-
         with self._exact_backend():
-            jac = jax.jacfwd(phase_of)(th)  # (N, p) turns/unit
+            jac = jac_fn(jnp.asarray(th), jnp.asarray(tl),
+                         jnp.asarray(fh), jnp.asarray(fl), batch,
+                         sc)  # (N, p) turns/unit
         f0 = self.F0.value
         M = np.asarray(jac) / f0
         names = list(free)

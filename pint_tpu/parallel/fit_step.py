@@ -78,12 +78,9 @@ def _use_hybrid_jac(flag: Optional[bool]) -> bool:
     tangent set and their columns computed from local factors times
     one shared stage-sensitivity JVP. Exact partials, not
     approximations (equality oracle: tests/test_hybrid_jac.py)."""
-    if flag is not None:
-        return bool(flag)
-    env = os.environ.get("PINT_TPU_HYBRID_JAC", "").lower()
-    if env in ("off", "false", "0"):
-        return False
-    return True
+    from pint_tpu.config import hybrid_jac_enabled
+
+    return hybrid_jac_enabled(flag)
 
 
 def _use_f32_jac(flag: Optional[bool]) -> bool:

@@ -41,6 +41,11 @@ FD2 -5e-6 1
 GLEP_1 54900
 GLPH_1 0.1 1
 GLF0_1 1e-8 1
+PWEP_1 54650
+PWSTART_1 54550
+PWSTOP_1 54750
+PWPH_1 0.02 1
+PWF0_1 2e-8 1
 WXEPOCH 55000
 WXFREQ_0001 0.005
 WXSIN_0001 1e-6 1
@@ -70,6 +75,7 @@ FD_STEPS = {
     "PX": 1e-1, "DM": 1e-6, "DM1": 1e-4, "DMX_0001": 1e-6,
     "NE_SW": 1e-1, "FD1": 1e-7, "FD2": 1e-7,
     "GLPH_1": 1e-7, "GLF0_1": 1e-12,
+    "PWPH_1": 1e-7, "PWF0_1": 1e-12,
     "WXSIN_0001": 1e-6, "WXCOS_0001": 1e-6,
     "JUMP1": 1e-7, "PHOFF": 1e-6,
     "PB": 1e-8, "A1": 1e-7, "TASC": 1e-8,
@@ -103,7 +109,7 @@ def test_every_free_param_derivative_vs_fd(sink):
         warnings.simplefilter("ignore")
         M, names, units = model.designmatrix(toas, incoffset=False)
     M = np.asarray(M)
-    assert len(names) == len(model.free_params) == 25
+    assert len(names) == len(model.free_params) == 27
     failures = []
     for pname in names:
         j = names.index(pname)
