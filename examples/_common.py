@@ -1,0 +1,30 @@
+"""Shared example preamble: backend pinning + repo-root sys.path.
+
+Import this FIRST in every example, before any other pint_tpu or jax
+device use:
+
+    import _common  # noqa: F401  (examples/ on sys.path when run
+                    # as `python examples/foo.py`)
+
+Examples default to the CPU backend, pinned BEFORE first device use —
+the axon sitecustomize pre-imports jax, so env vars alone are too
+late, and an unreachable accelerator tunnel HANGS rather than errors
+(CLAUDE.md). Pass --tpu (or set PINT_TPU_EXAMPLES_ACCEL=1) to run on
+the default accelerator backend instead; the fit step then uses the
+TPU production configuration automatically.
+"""
+import os
+import sys
+
+import jax
+
+if "--tpu" in sys.argv:
+    sys.argv.remove("--tpu")
+elif not os.environ.get("PINT_TPU_EXAMPLES_ACCEL"):
+    jax.config.update("jax_platforms", "cpu")
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+DATADIR = os.path.join(REPO_ROOT, "tests", "datafile")

@@ -1,0 +1,66 @@
+"""Batch-fit a small pulsar-timing array: every pulsar's GLS solve in
+ONE vmapped device call per iteration (the TPU-first replacement for
+per-pulsar process pools; reference workflow: fitting a PTA's pulsars
+independently).
+
+Usage: python examples/pta_batch.py [npulsars]
+"""
+import os
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401,E402  (backend pin + repo path)
+
+import io                                         # noqa: E402
+
+import numpy as np                                # noqa: E402
+
+from pint_tpu.models import get_model             # noqa: E402
+from pint_tpu.parallel import fit_pta             # noqa: E402
+from pint_tpu.simulation import make_fake_toas_uniform  # noqa: E402
+
+
+def build_pulsar(k, rng):
+    f0 = 150.0 + 37.0 * (k % 11)
+    par = f"""
+PSR J{1000 + 7 * k:04d}+{k:02d}42
+RAJ {(k * 37) % 24:02d}:12:33.4 1
+DECJ {(k * 11) % 60:02d}:07:02.5 1
+F0 {f0!r} 1
+F1 {-(1 + k % 5) * 1e-16!r} 1
+DM {5.0 + 0.7 * k:.2f}
+PEPOCH 55000
+TZRMJD 55000.01
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+    model = get_model(io.StringIO(par))
+    toas = make_fake_toas_uniform(
+        54000, 56000, 80, model, error_us=1.0, add_noise=True,
+        rng=rng)
+    truth = {"F0": model.F0.value, "F1": model.F1.value}
+    model.F0.value += 3e-10  # perturb before the batch fit
+    return model, toas, truth
+
+
+def main():
+    n_psr = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    rng = np.random.default_rng(1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pulsars = [build_pulsar(k, rng) for k in range(n_psr)]
+        results = fit_pta([(t, m) for m, t, _ in pulsars], maxiter=2)
+    stats = fit_pta.last_stats
+    n_ok = sum(
+        1 for (m, t, truth), r in zip(pulsars, results)
+        if abs(m.F0.value - truth["F0"]) < 5 * r["errors"]["F0"])
+    print(f"{n_psr} pulsars, {stats['ntoa_total']} TOAs: device solve "
+          f"{stats['device_solve_s'] * 1e3:.0f} ms, "
+          f"{stats['toas_per_sec']:.0f} TOA/s")
+    print(f"F0 recovered within 5 sigma: {n_ok}/{n_psr}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Simulate fake TOAs from a model, perturb it, and recover the truth
+(reference: the PINT "Simulate and fit"/zima workflow — this is also
+the framework's strongest self-oracle, SURVEY.md §4).
+
+Usage: python examples/simulate_and_fit.py
+"""
+import io
+import os
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401,E402  (backend pin + repo path)
+
+import numpy as np                                # noqa: E402
+
+from pint_tpu.fitter import Fitter                # noqa: E402
+from pint_tpu.models import get_model             # noqa: E402
+from pint_tpu.simulation import make_fake_toas_uniform  # noqa: E402
+
+PAR = """
+PSR J1855+0943
+RAJ 18:57:36.39 1
+DECJ 09:43:17.2 1
+F0 186.49408156698235 1
+F1 -6.2049e-16 1
+DM 13.29
+PEPOCH 54500
+POSEPOCH 54500
+TZRMJD 54500.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+BINARY ELL1
+PB 12.32717 1
+A1 9.2307805 1
+TASC 54500.03 1
+EPS1 -2.15e-5 1
+EPS2 -3.1e-7 1
+"""
+
+
+def main():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        truth = get_model(io.StringIO(PAR))
+        toas = make_fake_toas_uniform(
+            53500, 55500, 500, truth, error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(42))
+
+    true_vals = {n: truth.get_param(n).value for n in truth.free_params}
+
+    # perturb the model away from the truth, then fit back
+    model = truth
+    model.F0.value += 2e-9
+    model.F1.value *= 1.02
+    model.EPS1.value += 3e-6
+
+    fit = Fitter.auto(toas, model)
+    fit.fit_toas()
+
+    print(f"{'param':8s} {'fit - truth':>14s} {'sigma':>11s} {'pull':>7s}")
+    ok = True
+    for n in model.free_params:
+        d = model.get_param(n).value - true_vals[n]
+        s = fit.errors.get(n, float("nan"))
+        pull = d / s if s else float("nan")
+        ok &= abs(pull) < 5
+        print(f"{n:8s} {d:14.3e} {s:11.3e} {pull:7.2f}")
+    print(f"\nchi2/dof = {fit.stats.reduced_chi2:.3f}; "
+          f"all within 5 sigma: {ok}")
+
+
+if __name__ == "__main__":
+    main()

@@ -80,30 +80,35 @@ def make_fake_toas_uniform(startMJD: float, endMJD: float, ntoas: int,
                            freq_mhz: float = 1400.0, add_noise: bool = False,
                            add_correlated_noise: bool = False,
                            rng: Optional[np.random.Generator] = None,
-                           name: str = "fake") -> TOAs:
+                           name: str = "fake", flags=None) -> TOAs:
     """Evenly spaced synthetic TOAs landing on integer model phase
     (reference: make_fake_toas_uniform)."""
     return make_fake_toas_fromMJDs(
         np.linspace(float(startMJD), float(endMJD), int(ntoas)), model,
         error_us=error_us, obs=obs, freq_mhz=freq_mhz,
         add_noise=add_noise, add_correlated_noise=add_correlated_noise,
-        rng=rng, name=name)
+        rng=rng, name=name, flags=flags)
 
 
 def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, obs: str = "gbt",
                             freq_mhz=1400.0, add_noise: bool = False,
                             add_correlated_noise: bool = False,
                             rng: Optional[np.random.Generator] = None,
-                            name: str = "fake") -> TOAs:
+                            name: str = "fake", flags=None) -> TOAs:
     """Synthetic TOAs at the given MJDs, landing on integer model phase
     (reference: make_fake_toas_fromMJDs). ``freq_mhz``/``error_us`` may
-    be scalars or per-TOA arrays."""
+    be scalars or per-TOA arrays. ``flags``: per-TOA flag dicts (or one
+    dict applied to all) — set them HERE, not after the fact, so
+    flag-selected noise models (EFAC/EQUAD/ECORR maskParameters) apply
+    to the simulated noise draw too."""
     mjds = np.asarray(mjds, dtype=np.float64)
+    if isinstance(flags, dict):
+        flags = [dict(flags) for _ in range(mjds.shape[0])]
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         t = get_TOAs_array(
             mjds, obs=obs, freqs=freq_mhz, errors=error_us,
-            ephem=model.EPHEM.value,
+            ephem=model.EPHEM.value, flags=flags,
             planets=bool(model.PLANET_SHAPIRO.value))
     t.names = [f"{name}{i}" for i in range(t.ntoas)]
     t = zero_residuals(t, model)
