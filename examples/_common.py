@@ -20,7 +20,9 @@ import jax
 
 if "--tpu" in sys.argv:
     sys.argv.remove("--tpu")
-elif not os.environ.get("PINT_TPU_EXAMPLES_ACCEL"):
+elif os.environ.get("PINT_TPU_EXAMPLES_ACCEL", "").lower() in \
+        ("", "0", "off", "false"):  # 0/off = disabled, matching the
+    # PINT_TPU_JIT_CACHE / PINT_TPU_TEST_JIT_CACHE convention
     jax.config.update("jax_platforms", "cpu")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -49,6 +49,19 @@ def solve_device(ntoa: int):
     return jax.devices("cpu")[0]
 
 
+def enable_user_compile_cache() -> Optional[str]:
+    """Persistent XLA compile cache for the CLI entry points:
+    ~/.cache/pint_tpu/xla ($PINT_TPU_JIT_CACHE overrides; "0"
+    disables). Called from each script's main() — NOT at library
+    import (repointing jax's global cache on import would hijack
+    whatever cache the embedding application configured). Repeat
+    pintempo/photonphase runs then skip their dominant compile cost
+    the way the test suite and bench already do."""
+    d = os.path.join(os.path.expanduser("~"), ".cache", "pint_tpu",
+                     "xla")
+    return enable_compile_cache("PINT_TPU_JIT_CACHE", d)
+
+
 def hybrid_jac_enabled(flag: Optional[bool] = None) -> bool:
     """The ONE parser for $PINT_TPU_HYBRID_JAC (default ON): shared by
     parallel.fit_step and TimingModel._get_compiled_jac so the device

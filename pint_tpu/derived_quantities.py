@@ -14,7 +14,7 @@ import numpy as np
 
 __all__ = ["mass_funct", "mass_funct2", "companion_mass", "pulsar_mass",
            "p_to_f", "f_to_p", "pulsar_age", "pulsar_edot", "pulsar_B",
-           "pulsar_B_lightcyl", "omdot", "gamma", "pbdot",
+           "pulsar_B_lightcyl", "omdot", "gamma", "pbdot", "pmtot",
            "shklovskii_factor"]
 
 C = 299792458.0                  # m/s
@@ -128,6 +128,21 @@ def pbdot(mp: float, mc: float, pb_days: float, e: float) -> float:
         * (1.0 - e ** 2) ** -3.5
     return float(-(192.0 * np.pi / 5.0) * n ** (5.0 / 3.0) * m1 * m2
                  * m ** (-1.0 / 3.0) * fe)
+
+
+def pmtot(model) -> float:
+    """Total proper motion [mas/yr] from the model's astrometry
+    (reference: derived_quantities.pmtot): quadrature sum of the
+    equatorial (PMRA, PMDEC) or ecliptic (PMELONG, PMELAT) pair —
+    both conventions carry the cos(latitude) factor already."""
+    for a, b in (("PMRA", "PMDEC"), ("PMELONG", "PMELAT")):
+        try:
+            va = model.get_param(a).value
+            vb = model.get_param(b).value
+        except KeyError:
+            continue
+        return float(np.hypot(va or 0.0, vb or 0.0))
+    raise ValueError("model has no proper-motion parameters")
 
 
 def shklovskii_factor(pm_mas_yr: float, d_kpc: float) -> float:

@@ -17,6 +17,10 @@ def main(argv=None) -> int:
     p.add_argument("par2")
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     from pint_tpu.models import get_model
 
     m1 = get_model(args.par1)

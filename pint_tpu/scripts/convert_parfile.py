@@ -25,6 +25,10 @@ def main(argv=None) -> int:
                         "without this flag TCB input is refused")
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     from pint_tpu.models import get_model
 
     model = get_model(args.input_par, allow_tcb=args.allow_tcb)

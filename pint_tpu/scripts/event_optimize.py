@@ -36,6 +36,10 @@ def main(argv=None) -> int:
                    help="dump the full walker chain + lnprob here")
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     from pint_tpu.event_toas import get_event_weights, load_fits_TOAs
     from pint_tpu.eventstats import h_sig, hmw
     from pint_tpu.mcmc_fitter import PhotonMCMCFitter

@@ -42,6 +42,10 @@ def main(argv=None) -> int:
                    help="write a phaseogram png here")
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     from pint_tpu.event_toas import get_event_weights, load_fits_TOAs
     from pint_tpu.eventstats import h_sig, hmw
     from pint_tpu.io.fits import read_events_fits, write_events_fits

@@ -23,6 +23,10 @@ def main(argv=None) -> int:
     p.add_argument("--ephem", default=None)
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     import io
     import warnings
 

@@ -25,6 +25,10 @@ def main(argv=None) -> int:
                    help="write a pre/post-fit residual plot (png)")
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     from pint_tpu.fitter import Fitter, WLSFitter
     from pint_tpu.gls import GLSFitter
     from pint_tpu.models import get_model_and_toas

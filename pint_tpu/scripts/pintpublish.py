@@ -97,6 +97,10 @@ def main(argv=None) -> int:
                         "refitting")
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     from pint_tpu.fitter import Fitter
     from pint_tpu.models import get_model_and_toas
 

@@ -69,6 +69,10 @@ def main(argv=None) -> int:
     p.add_argument("output_par")
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     with open(args.input_par) as fh:
         text = fh.read()
     converted = t2_to_native_parfile(text)

@@ -16,6 +16,10 @@ def main(argv=None) -> int:
     p.add_argument("output_par")
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     from pint_tpu.models import get_model
 
     # get_model converts TCB -> TDB on load

@@ -31,6 +31,10 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=None)
     args = p.parse_args(argv)
 
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
     import numpy as np
 
     from pint_tpu.models import get_model

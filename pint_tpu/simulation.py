@@ -101,9 +101,13 @@ def make_fake_toas_fromMJDs(mjds, model, error_us=1.0, obs: str = "gbt",
     dict applied to all) — set them HERE, not after the fact, so
     flag-selected noise models (EFAC/EQUAD/ECORR maskParameters) apply
     to the simulated noise draw too."""
-    mjds = np.asarray(mjds, dtype=np.float64)
+    mjds = np.atleast_1d(np.asarray(mjds, dtype=np.float64))
     if isinstance(flags, dict):
         flags = [dict(flags) for _ in range(mjds.shape[0])]
+    elif flags is not None and len(flags) != mjds.shape[0]:
+        raise ValueError(
+            f"flags has {len(flags)} entries for {mjds.shape[0]} "
+            f"TOAs (pass one dict to apply the same flags to all)")
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         t = get_TOAs_array(

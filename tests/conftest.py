@@ -32,10 +32,17 @@ jax.config.update("jax_enable_x64", True)
 # across runs cuts wall-clock by more than half on a warm cache.
 from pint_tpu.config import enable_compile_cache  # noqa: E402
 
-enable_compile_cache(
+_cache_dir = enable_compile_cache(
     "PINT_TPU_TEST_JIT_CACHE",
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  ".jax_compile_cache"))
+# CLI smoke tests call script main()s, which enable the USER compile
+# cache (config.enable_user_compile_cache) — point it at the test
+# cache so they don't repoint jax's global cache at ~/.cache mid-suite
+if _cache_dir:
+    os.environ.setdefault("PINT_TPU_JIT_CACHE", _cache_dir)
+else:
+    os.environ.setdefault("PINT_TPU_JIT_CACHE", "0")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
