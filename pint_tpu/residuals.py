@@ -218,3 +218,22 @@ class Residuals:
     @property
     def reduced_chi2(self) -> float:
         return self.chi2 / self.dof
+
+
+_WIDEBAND_REEXPORTS = ("WidebandTOAResiduals", "CombinedResiduals",
+                       "DMResiduals")
+
+
+def __getattr__(name):
+    """Reference-path re-exports: the reference exposes the wideband
+    residual classes from pint.residuals; they live in pint_tpu.wideband
+    (lazy here — a top-level import would be circular)."""
+    if name in _WIDEBAND_REEXPORTS:
+        from pint_tpu import wideband
+
+        return getattr(wideband, name)
+    raise AttributeError(name)
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_WIDEBAND_REEXPORTS))
