@@ -239,12 +239,16 @@ class TimingModel:
             self.add_component(MiscParams())
         for c in components or []:
             self.add_component(c)
-        self._cache_key = None
-        self._cache = None
-        self._jit_phase = None
-        self._cache_key_params = None
-        self._jit_jac = None
-        self._cache_key_jac = None
+        for k in self._VOLATILE_CACHE_ATTRS:
+            setattr(self, k, None)
+
+    # every compiled-closure / per-TOAs cache slot, shared by
+    # __init__, invalidate_cache, and __getstate__ — a new _jit_*
+    # added to one site but not the pickle-drop list would make
+    # pickle.dumps(model) raise only on WARM models
+    _VOLATILE_CACHE_ATTRS = (
+        "_cache_key", "_cache", "_jit_phase", "_cache_key_params",
+        "_jit_jac", "_cache_key_jac")
 
     # ---------------- component / parameter plumbing -----------------
 
@@ -835,6 +839,17 @@ class TimingModel:
             self._cache_key_jac = key
         return self._jit_jac
 
+    def __getstate__(self):
+        """Pickle/deepcopy support (reference: models pickle for
+        process-pool grids and notebook checkpoints): the compiled
+        phase/Jacobian closures and per-TOAs caches are volatile
+        derived state — drop them; the copy re-compiles lazily."""
+        d = self.__dict__.copy()
+        for k in self._VOLATILE_CACHE_ATTRS:
+            d[k] = None
+        d.pop("_noise_basis_cache", None)
+        return d
+
     def invalidate_cache(self, params_only=False):
         """Drop cached compiled state. params_only=True (a parameter
         VALUE changed) keeps the jitted phase function: values enter as
@@ -845,12 +860,8 @@ class TimingModel:
         bench regression that exposed it). ref_day is re-derived since
         epoch-valued params feed the key."""
         if not params_only:
-            self._jit_phase = None
-            self._cache_key_params = None
-            self._jit_jac = None
-            self._cache_key_jac = None
-            self._cache_key = None
-            self._cache = None
+            for k in self._VOLATILE_CACHE_ATTRS:
+                setattr(self, k, None)
             self.__dict__.pop("_noise_basis_cache", None)
         # ref epoch may shift when epochs change
         self.__dict__.pop("_ref_day", None)
