@@ -109,6 +109,15 @@ class TOAs:
         """Mark this TOAs state as changed (invalidates model caches)."""
         self._serial = next(_TOAS_SERIAL)
 
+    def __setstate__(self, d):
+        """A pickled serial is only unique in the ORIGIN process: an
+        unpickled TOAs carrying it could collide with a locally
+        created TOAs in the receiving process and make
+        TimingModel.get_cache return the wrong cached masks/TZR batch
+        silently — reassign a fresh process-local serial on load."""
+        self.__dict__.update(d)
+        self._serial = next(_TOAS_SERIAL)
+
     @property
     def cache_key(self):
         return self._serial

@@ -57,3 +57,19 @@ def test_deepcopy_toas(warm):
     np.testing.assert_array_equal(t2.mjd_day, t.mjd_day)
     t2.flags[0]["marker"] = "x"
     assert "marker" not in t.flags[0]
+
+
+def test_pickle_toas_fresh_serial(warm):
+    """Raw pickle round-trip (the process-pool path) — and the copy
+    must get a FRESH cache serial: a pickled serial could collide
+    with a locally created TOAs in the receiving process and poison
+    TimingModel.get_cache."""
+    m, t = warm
+    t2 = pickle.loads(pickle.dumps(t))
+    assert t2.ntoas == t.ntoas
+    np.testing.assert_array_equal(t2.mjd_frac[0], t.mjd_frac[0])
+    assert t2.flags == t.flags
+    assert t2.cache_key != t.cache_key
+    # usable end-to-end
+    chi2 = WLSFitter(t2, pickle.loads(pickle.dumps(m))).fit_toas()
+    assert np.isfinite(chi2)
