@@ -59,6 +59,7 @@ def main():
         for f, dm in zip(toas.flags, dm_truth):
             f["pp_dm"] = repr(float(dm + rng.normal(0.0, 2e-4)))
             f["pp_dme"] = "2e-4"
+        toas._touch()  # flags changed in place: bump the cache serial
 
     model.F0.value += 5e-11
     model.DM.value += 3e-4

@@ -1159,9 +1159,15 @@ class TimingModel:
         ) + (exclude,)
         cached = self.__dict__.get("_noise_basis_cache")
         # identity check via a held reference (not a bare id(), which
-        # CPython reuses after garbage collection)
-        if cached is not None and cached[0] is toas and cached[1] == key:
-            return cached[2]
+        # CPython reuses after garbage collection) PLUS the mutation
+        # serial: an in-place flag edit (TOAs._touch bumps the serial)
+        # changes the mask-selected bases while identity and noise
+        # params stay equal — without the serial this returned a STALE
+        # basis after e.g. editing -be flags on the same TOAs object
+        serial = getattr(toas, "cache_key", None)
+        if cached is not None and cached[0] is toas \
+                and cached[1] == serial and cached[2] == key:
+            return cached[3]
         out = []
         for c in self.noise_components:
             if not getattr(c, "is_basis_noise", False) or \
@@ -1170,7 +1176,7 @@ class TimingModel:
             pair = c.noise_basis_weight(toas)
             if pair is not None:
                 out.append((type(c).__name__, pair[0], pair[1]))
-        self._noise_basis_cache = (toas, key, out)
+        self._noise_basis_cache = (toas, serial, key, out)
         return out
 
     def noise_model_designmatrix(self, toas, exclude=()):

@@ -73,3 +73,43 @@ def test_pickle_toas_fresh_serial(warm):
     # usable end-to-end
     chi2 = WLSFitter(t2, pickle.loads(pickle.dumps(m))).fit_toas()
     assert np.isfinite(chi2)
+
+
+def test_noise_basis_cache_respects_touch():
+    """In-place TOAs mutation + _touch() must invalidate the noise
+    basis cache (it keyed only on identity + noise params before:
+    editing -be flags on the same object returned a STALE basis)."""
+    import io
+
+    from pint_tpu.models import get_model
+
+    par = """
+PSR TSTALE
+RAJ 1:00:00
+DECJ 2:00:00
+F0 100 1
+DM 10
+PEPOCH 55000
+TZRMJD 55000.01
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+ECORR -be X 0.5
+"""
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    # clustered epochs: ECORR's quantization basis needs multi-TOA
+    # observing epochs to produce columns
+    centers = np.arange(54000.0, 54006.0)
+    mjds = (centers[:, None] + np.linspace(0, 0.02, 4)[None, :]).ravel()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        t = make_fake_toas_fromMJDs(mjds, m, flags={"be": "X"})
+    F1 = m.noise_model_designmatrix(t)
+    assert F1 is not None and F1.shape[1] > 0  # ECORR basis active
+    for f in t.flags:
+        f["be"] = "Y"  # ECORR no longer selects anything
+    t._touch()
+    F2 = m.noise_model_designmatrix(t)
+    assert F2 is None or F2.shape[1] == 0
