@@ -1,0 +1,30 @@
+"""The shipped examples must keep running end-to-end — they are the
+switching user's first contact (MIGRATION.md/examples). Subprocess
+runs on the CPU backend; marked slow (compile-dominated)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = ["fit_ngc6440e", "simulate_and_fit", "noise_gls_fit",
+            "wideband_fit", "photon_events", "pta_batch"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name):
+    env = dict(os.environ)
+    # strip the accelerator vars: examples pin CPU themselves, but a
+    # wedged tunnel must not be able to hang the subprocess either
+    for k in list(env):
+        if k.startswith("PALLAS_AXON"):
+            env.pop(k)
+    env.pop("PINT_TPU_EXAMPLES_ACCEL", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", f"{name}.py")],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert r.stdout.strip(), "example produced no output"
