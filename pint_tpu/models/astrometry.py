@@ -150,13 +150,26 @@ class AstrometryEcliptic(Astrometry):
         "IAU1980": 84381.448,
     }
 
+    @classmethod
+    def obliquity_arcsec(cls, ecl) -> float:
+        """Strict per-convention obliquity lookup — the ONE resolver
+        (validate, _ecl_matrix, and modelutils._convert all use it, so
+        a typo'd convention fails identically everywhere instead of
+        silently falling back to IERS2010 on some paths)."""
+        obl = cls._OBLIQUITY.get((ecl or "IERS2010").upper())
+        if obl is None:
+            raise ValueError(
+                f"unknown ecliptic convention {ecl!r} "
+                f"(know {sorted(cls._OBLIQUITY)})")
+        return obl
+
     def validate(self):
         if self.ELONG.value is None or self.ELAT.value is None:
             raise ValueError("AstrometryEcliptic requires ELONG and ELAT")
+        self.obliquity_arcsec(self.ECL.value)  # typo'd ECL fails HERE
 
     def _ecl_matrix(self):
-        obl = self._OBLIQUITY.get(
-            (self.ECL.value or "IERS2010").upper(), 84381.406)
+        obl = self.obliquity_arcsec(self.ECL.value)
         # ecliptic ← ICRS; we need its transpose to go ecliptic → ICRS
         return icrs_to_ecliptic_matrix(obl).T
 

@@ -1110,6 +1110,36 @@ class TimingModel:
         dm_fn, (_, th) = self.build_dm_fn(toas)
         return np.asarray(dm_fn(jnp.asarray(th)))
 
+    def as_ECL(self, ecl: str = "IERS2010") -> "TimingModel":
+        """Model with ecliptic astrometry in the ``ecl`` obliquity
+        convention (reference: TimingModel.as_ECL; delegates to
+        modelutils). Already ecliptic in the SAME convention returns
+        self (not a copy — deepcopy if you need independence); a
+        different convention converts through ICRS."""
+        from pint_tpu.models.astrometry import AstrometryEcliptic
+        from pint_tpu.modelutils import model_equatorial_to_ecliptic
+
+        AstrometryEcliptic.obliquity_arcsec(ecl)  # strict, fail early
+        cur = self.components.get("AstrometryEcliptic")
+        if cur is not None:
+            if (cur.ECL.value or "IERS2010").upper() == ecl.upper():
+                return self
+            # convention change: rotate out and back in (exact —
+            # both matrices are pure obliquity rotations)
+            return model_equatorial_to_ecliptic(self.as_ICRS(),
+                                                ecl=ecl)
+        return model_equatorial_to_ecliptic(self, ecl=ecl)
+
+    def as_ICRS(self) -> "TimingModel":
+        """Model with equatorial astrometry (reference:
+        TimingModel.as_ICRS; delegates to modelutils). Already
+        equatorial returns self (not a copy)."""
+        from pint_tpu.modelutils import model_ecliptic_to_equatorial
+
+        if "AstrometryEquatorial" in self.components:
+            return self
+        return model_ecliptic_to_equatorial(self)
+
     # ---------------- noise-model aggregation -------------------------
     # (reference: TimingModel.scaled_toa_uncertainty,
     #  .noise_model_designmatrix, .noise_model_basis_weight,

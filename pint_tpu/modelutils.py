@@ -40,17 +40,19 @@ def _basis(lon, lat):
     return e, n
 
 
-def _convert(model, to_ecliptic: bool):
+def _convert(model, to_ecliptic: bool, ecl: str = "IERS2010"):
     src_name = "AstrometryEquatorial" if to_ecliptic else \
         "AstrometryEcliptic"
     src = model.components.get(src_name)
     if src is None:
         raise ValueError(f"model has no {src_name}")
     if to_ecliptic:
-        M = icrs_to_ecliptic_matrix(84381.406)  # ecliptic <- ICRS
+        obl = AstrometryEcliptic.obliquity_arcsec(ecl)
+        M = icrs_to_ecliptic_matrix(obl)  # ecliptic <- ICRS
         lon0, lat0 = src.RAJ.value, src.DECJ.value
         pml, pmb = src.PMRA.value or 0.0, src.PMDEC.value or 0.0
         dst = AstrometryEcliptic()
+        dst.ECL.value = ecl
         out_names = ("ELONG", "ELAT", "PMELONG", "PMELAT")
     else:
         M = np.asarray(src._ecl_matrix())  # ICRS <- ecliptic
@@ -114,10 +116,11 @@ def _convert(model, to_ecliptic: bool):
     return new
 
 
-def model_equatorial_to_ecliptic(model):
+def model_equatorial_to_ecliptic(model, ecl: str = "IERS2010"):
     """RAJ/DECJ model -> ELONG/ELAT model (reference:
-    modelutils.model_equatorial_to_ecliptic)."""
-    return _convert(model, to_ecliptic=True)
+    modelutils.model_equatorial_to_ecliptic). ``ecl`` picks the
+    obliquity convention (the new model's ECL parameter)."""
+    return _convert(model, to_ecliptic=True, ecl=ecl)
 
 
 def model_ecliptic_to_equatorial(model):

@@ -282,3 +282,27 @@ def test_model_ecliptic_equatorial_roundtrip():
                                                         rel=1e-9)
     assert back.get_param("PMDEC").value == pytest.approx(-7.0,
                                                           rel=1e-9)
+
+
+def test_as_ecl_as_icrs_methods():
+    """TimingModel.as_ECL/as_ICRS (reference method names) delegate to
+    the modelutils conversions, honor the ECL convention argument, and
+    return self when already in the target frame."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(PAR.replace(
+            "RAJ 03:30:00.0 1", "RAJ 03:30:00.0 1\nPMRA 11.0 1")))
+    me = m.as_ECL("IERS2003")
+    assert "AstrometryEcliptic" in me.components
+    assert me.ECL.value == "IERS2003"
+    assert me.as_ECL("IERS2003") is me  # same convention: self
+    # DIFFERENT convention must convert, not silently return self
+    me10 = me.as_ECL("IERS2010")
+    assert me10 is not me and me10.ECL.value == "IERS2010"
+    assert me10.ELONG.value != me.ELONG.value
+    back = me.as_ICRS()
+    assert back.get_param("RAJ").value == pytest.approx(
+        m.get_param("RAJ").value, abs=1e-12)
+    assert back.as_ICRS() is back
+    with pytest.raises(ValueError, match="convention"):
+        m.as_ECL("NOTACONV")
