@@ -313,3 +313,17 @@ def test_d_phase_d_toa_matches_doppler():
     np.testing.assert_allclose(f, expect, rtol=1e-6)
     # annual Doppler amplitude ~1e-4 relative is present
     assert np.ptp(f) / 310.0 > 5e-5
+
+
+def test_d_phase_d_param_single_column(model_and_toas):
+    """d_phase_d_param (reference API) returns exactly the matching
+    designmatrix column (x F0: designmatrix is in seconds/unit)."""
+    model, toas = model_and_toas
+    M, names, _ = model.designmatrix(toas, incoffset=False)
+    for p in ("F0", model.free_params[-1]):
+        col = model.d_phase_d_param(toas, p)
+        np.testing.assert_allclose(
+            col / model.F0.value, M[:, names.index(p)],
+            rtol=0, atol=1e-13 * max(1.0, np.max(np.abs(col))))
+    with pytest.raises(ValueError):
+        model.d_phase_d_param(toas, "DM999")
