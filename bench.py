@@ -36,6 +36,121 @@ NDMX = 28  # 28 DMX + 12 other free params = 40 columns + offset
 AXON_VARS = ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_TPU_GEN",
              "PALLAS_AXON_REMOTE_COMPILE")
 
+# TPU v5e single-chip public peaks, used for the honest MFU/roofline
+# framing of every config: 197 TFLOP/s bf16 on the MXU (f32 matmul
+# ~1/2 of that), 819 GB/s HBM. The 10k north-star step does ~0.26
+# GFLOP of matmul — VPU/latency-bound, effectively zero MFU; the MXU
+# only becomes the bottleneck on the large-N scan / PTA-batch shapes.
+V5E_PEAK_FLOPS = 197e12
+V5E_PEAK_HBM_BPS = 819e9
+
+# ledger file path override (None = BENCH_TPU.jsonl next to this
+# file, the committed default); assign the module global to redirect
+TPU_RECORD_PATH = None
+
+
+def _bench_dir():
+    import os
+
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def xla_cost(jitted, args):
+    """XLA's own cost analysis of the compiled step: total FLOPs and
+    bytes accessed. Compile is a cache hit (the jit just ran), so this
+    is cheap. Returns {} when the backend doesn't report."""
+    try:
+        ca = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        out = {}
+        if ca.get("flops", 0) > 0:
+            out["flops"] = float(ca["flops"])
+        if ca.get("bytes accessed", 0) > 0:
+            out["bytes"] = float(ca["bytes accessed"])
+        return out
+    except Exception as e:
+        log(f"  cost_analysis unavailable: {e!r}")
+        return {}
+
+
+def roofline_fields(jitted, args, step_t, backend):
+    """MFU/roofline attribution for one config: per-step FLOPs and
+    bytes (XLA cost analysis), achieved GFLOP/s and GB/s, and — on
+    TPU — the fraction of v5e peak each represents. The honest
+    framing the 'TPU-native' claim needs: a config whose mfu_pct and
+    hbm_util_pct are both ~0 is latency/VPU-bound and its win cannot
+    come from the MXU."""
+    c = xla_cost(jitted, args)
+    out = {}
+    if "flops" in c:
+        out["flops_step"] = round(c["flops"] / 1e9, 4)  # GFLOP
+        out["gflops_achieved"] = round(c["flops"] / step_t / 1e9, 1)
+        if backend == "tpu":
+            out["mfu_pct"] = round(
+                100.0 * c["flops"] / step_t / V5E_PEAK_FLOPS, 3)
+    if "bytes" in c:
+        out["gbytes_step"] = round(c["bytes"] / 1e9, 4)
+        out["hbm_gbps_achieved"] = round(c["bytes"] / step_t / 1e9, 1)
+        if backend == "tpu":
+            out["hbm_util_pct"] = round(
+                100.0 * c["bytes"] / step_t / V5E_PEAK_HBM_BPS, 2)
+    return out
+
+
+def tpu_record_append(rec):
+    """Append a benchmark record to the committed on-chip ledger
+    (BENCH_TPU.jsonl) with a UTC stamp. Called for every record
+    measured with backend==tpu — whether by the driver's bench run or
+    by tools/tpu_capture.py during a caught tunnel window — so the
+    on-chip history survives as a raw, auditable artifact even when
+    later driver runs fall back to CPU."""
+    import datetime
+    import os
+
+    path = TPU_RECORD_PATH or os.path.join(_bench_dir(),
+                                           "BENCH_TPU.jsonl")
+    stamped = dict(rec)
+    stamped.setdefault(
+        "utc", datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ"))
+    with open(path, "a") as f:
+        f.write(json.dumps(stamped) + "\n")
+
+
+def record_key(d):
+    """Composite ledger key: some metrics are families (one record
+    per scan N, per attribution variant, per PTA size) — keying by
+    metric alone would collapse a family to its last member."""
+    return (d.get("metric"), d.get("ntoa"), d.get("variant"),
+            d.get("npulsars"))
+
+
+def load_tpu_records():
+    """Latest committed on-chip record per (metric, sub-key), in file
+    (= time) order. Lets a CPU-fallback bench run still carry the TPU
+    record with provenance instead of silently reporting only host
+    numbers."""
+    import os
+
+    path = TPU_RECORD_PATH or os.path.join(_bench_dir(),
+                                           "BENCH_TPU.jsonl")
+    if not os.path.exists(path):
+        return {}
+    latest = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if d.get("backend") == "tpu" and "metric" in d:
+                latest[record_key(d)] = d  # file order == time order
+    return latest
+
 
 def _make_model_toas(par_lines, mjds, freqs, seed=1, error_us=1.0,
                      flag_sets=None):
@@ -179,6 +294,14 @@ def cpu_fallback_env() -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_ENABLE_X64"] = "1"
     env["PINT_TPU_BENCH_FALLBACK"] = "1"
+    # keep the driver artifact's stderr tail clean: XLA's CPU AOT
+    # loader logs a scary ERROR for every persistent-cache load whose
+    # compile-time feature string contains pseudo-features
+    # (+prefer-no-scatter) absent from /proc/cpuinfo — even for
+    # entries this very process compiled on this very host. The REAL
+    # cross-host hazard is closed by the CPU-feature-keyed cache dir
+    # (config._host_cache_tag); real failures raise Python-side.
+    env["TF_CPP_MIN_LOG_LEVEL"] = "3"
     return env
 
 
@@ -401,6 +524,11 @@ def config3_j1713like_wideband():
                         dispatch_ms=round(t_step * 1e3, 2))
     except Exception as e:
         log(f"  config3 chained failed: {e!r}")
+    import jax
+
+    rec3["backend"] = jax.default_backend()
+    if rec3["backend"] == "tpu":
+        tpu_record_append(rec3)
     print(json.dumps(rec3))
     return {"metric": "config3_j1713like_wideband_downhill_2k",
             "value": round(fit.stats.toas_per_sec, 1), "unit": "TOA/s",
@@ -575,8 +703,13 @@ def scan_nscaling():
         except Exception as e:
             log(f"  chained scan point failed: {e!r}")
             label = "single-dispatch (chained meas. FAILED)"
+        rec.update(roofline_fields(jitted, args,
+                                   rec["step_ms"] / 1e3,
+                                   rec["backend"]))
         log(f"N={n}: {rec['step_ms']} ms {label} "
             f"({rec['value']:.0f} TOA/s), dispatch {t * 1e3:.1f} ms")
+        if rec["backend"] == "tpu":
+            tpu_record_append(rec)
         out.append(rec)
         del jitted, args, step_fn, model, toas
     for rec in out:
@@ -728,10 +861,46 @@ def main():
         north["step_ms_jac32"] = jac32_ms
     if chained_ms is not None:
         north["step_ms_chained8"] = chained_ms
+    north.update(roofline_fields(jitted, args, per_iter_t, backend))
+
+    # provenance merge: carry the latest committed on-chip records
+    # (BENCH_TPU.jsonl, written during caught tunnel windows) so a
+    # CPU-fallback artifact still shows the TPU state of the art — and
+    # says plainly when the chip was unreachable this run.
+    onchip = load_tpu_records()
+    if backend == "tpu":
+        tpu_record_append(north)
+    else:
+        ns_chip = onchip.get(record_key(north))
+        if ns_chip is not None:
+            north["tpu_on_chip"] = {
+                k: ns_chip[k] for k in
+                ("step_ms", "dispatch_ms", "value", "utc",
+                 "mfu_pct", "flops_step", "imported", "provenance")
+                if k in ns_chip}
+            cfg_note = (" — PRE-HYBRID configuration, production "
+                        "config not yet measured on chip"
+                        if ns_chip.get("imported") else "")
+            north["tpu_note"] = (
+                "TPU unreachable this run; latest committed on-chip "
+                f"record from {ns_chip.get('utc', '?')} "
+                f"(BENCH_TPU.jsonl){cfg_note}")
+        elif os.environ.get("PINT_TPU_BENCH_FALLBACK"):
+            north["tpu_note"] = ("TPU unreachable this run; no "
+                                 "committed on-chip record found")
 
     if north_star_only:
         print(json.dumps(north))
         return
+    if backend != "tpu":
+        # CPU fallback: replay the committed on-chip records so the
+        # driver artifact carries them (fresh-TPU runs skip this —
+        # stale lines for metrics about to be measured would only
+        # confuse per-metric stdout consumers)
+        for rec in onchip.values():
+            rec = dict(rec)
+            rec.setdefault("provenance", "BENCH_TPU.jsonl")
+            print(json.dumps(rec))
 
     # the driver records the LAST stdout JSON line and may kill this
     # process on its own timeout (measured: configs over the TPU
@@ -767,6 +936,8 @@ def main():
             rec["backend"] = backend
             log(f"{rec['metric']}: {rec['value']} {rec['unit']} "
                 f"({time.perf_counter() - t0:.0f}s total)")
+            if backend == "tpu":
+                tpu_record_append(rec)
             print(json.dumps(rec))
         except Exception as e:  # a config failure must not cost the
             log(f"{fn.__name__} failed: {e!r}")  # north-star artifact

@@ -19,7 +19,70 @@ from typing import Optional
 
 __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "obs_override", "enable_compile_cache", "solve_device",
-           "solve_scope"]
+           "solve_scope", "dispatch_rtt_ms", "auto_steps_per_dispatch"]
+
+_RTT_MS: dict = {}
+
+
+def dispatch_rtt_ms() -> float:
+    """Measured round-trip latency of ONE trivial dispatch on the
+    default backend (ms), cached per backend per process. This is the
+    fixed cost every device program pays regardless of its math:
+    ~0.1-0.25 ms on a local chip or CPU, 100-250 ms over the axon TPU
+    tunnel (measured round 4). The device fitters size their
+    steps-per-dispatch chaining from it instead of a hard-coded 8.
+    Override with $PINT_TPU_DISPATCH_RTT_MS (a float) to skip the
+    measurement."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    if backend in _RTT_MS:
+        return _RTT_MS[backend]
+    env = os.environ.get("PINT_TPU_DISPATCH_RTT_MS")
+    if env:
+        try:
+            _RTT_MS[backend] = float(env)
+            return _RTT_MS[backend]
+        except ValueError:
+            pass
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.asarray(0.0)
+    float(f(x))  # compile + first dispatch
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(f(x))  # scalar D2H read: the only sync that can't lie
+        ts.append(time.perf_counter() - t0)
+    _RTT_MS[backend] = min(ts) * 1e3
+    return _RTT_MS[backend]
+
+
+def auto_steps_per_dispatch() -> int:
+    """Downhill iterations to chain per device program, sized from the
+    measured dispatch RTT: 1 on the CPU backend (dispatch is ~us and
+    the plain step keeps compile time down); on an accelerator, enough
+    iterations that the fixed dispatch cost amortizes to <=8 ms per
+    iteration (smallest power of two >= rtt/8, clamped to [4, 32] —
+    ~4 on a local chip, 16-32 over the 100-250 ms axon tunnel). Quantizing matters:
+    K is part of the chained program's compile key, and the tunnel
+    RTT is noisy session-to-session — a raw round(rtt/8) would give
+    ~28 distinct K values, each a cold (multi-minute, remote) compile;
+    powers of two bound it to 4 cache entries. The chained loop
+    early-exits on in-kernel convergence (build_fit_loop's
+    lax.while_loop), so a generous K costs compile size, not wasted
+    iterations."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return 1
+    raw = dispatch_rtt_ms() / 8.0
+    for k in (4, 8, 16):
+        if raw <= k:
+            return k
+    return 32
 
 
 def solve_device(ntoa: int):
@@ -88,16 +151,48 @@ def solve_scope(ntoa: int):
         else contextlib.nullcontext()
 
 
+def _host_cache_tag() -> str:
+    """Cache-subdir tag keyed by the host CPU's feature set. CPU-backend
+    cache entries embed machine code for the compiling host's ISA
+    extensions; reusing them on a host with different features risks
+    SIGILL (XLA warns exactly this when a cache dir travels between
+    heterogeneous driver machines — observed in the round-4 driver
+    bench run). TPU-backend entries are device code and host-portable,
+    but they are compiled under a distinct jax platform key, so keying
+    the whole dir by host features only costs one recompile per new
+    host, never correctness."""
+    import hashlib
+    import platform
+
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    h = hashlib.sha256(
+        (platform.machine() + ":" + feats).encode()).hexdigest()[:10]
+    return f"{platform.machine()}-{h}"
+
+
 def enable_compile_cache(env_var: str, default_dir: str) -> Optional[str]:
-    """Point jax's persistent XLA compilation cache at ``default_dir``
-    (override with the named env var; value "0" disables). Shared by
-    tests/conftest.py and bench.py — the suite and the benchmark are
-    both compile-dominated on a cold start. Returns the dir used."""
+    """Point jax's persistent XLA compilation cache at a host-keyed
+    subdirectory of ``default_dir`` (override the base with the named
+    env var; value "0" disables). Shared by tests/conftest.py and
+    bench.py — the suite and the benchmark are both compile-dominated
+    on a cold start. The subdirectory is keyed by the host CPU feature
+    set (see _host_cache_tag) so a cache dir reused across
+    heterogeneous driver hosts can never serve foreign-ISA binaries.
+    Returns the dir used."""
     import jax
 
-    cache_dir = os.environ.get(env_var, default_dir)
-    if cache_dir == "0":
+    base = os.environ.get(env_var, default_dir)
+    if base == "0":
         return None
+    cache_dir = os.path.join(base, _host_cache_tag())
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)

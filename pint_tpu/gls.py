@@ -386,14 +386,17 @@ class DeviceDownhillGLSFitter(GLSFitter):
         replays the returned ledger on host in exact dd — measured on
         the axon tunnel every dispatch carries a large fixed cost, so
         this is the difference between a usable and an unusable
-        full-fit path on TPU. Default: 8 on TPU, 1 elsewhere (on CPU
-        dispatch is ~us and the plain step keeps compile time down)."""
+        full-fit path on TPU. Default: adaptive — sized from the
+        measured dispatch RTT (config.auto_steps_per_dispatch: 1 on
+        CPU, ~4-8 on a local chip, 16-32 over the high-latency axon
+        tunnel); the chained loop early-exits on in-kernel convergence
+        so oversizing K wastes no iterations."""
+        from pint_tpu.config import auto_steps_per_dispatch
         from pint_tpu.ops import dd_np
         from pint_tpu.parallel import build_fit_loop, build_fit_step
 
         if steps_per_dispatch is None:
-            steps_per_dispatch = \
-                8 if jax.default_backend() == "tpu" else 1
+            steps_per_dispatch = auto_steps_per_dispatch()
         t0 = time.perf_counter()
 
         def bump(th_, tl_, d):

@@ -675,12 +675,23 @@ class SolarWindDispersionX(DelayComponent):
             cols.append(np.where(m, geom / gmax, 0.0))
         cache["swx_cols"] = np.stack(cols, axis=-1)
 
-    def delay(self, pv, batch, cache, ctx, delay_so_far):
+    def dm_value_device(self, pv, batch, cache, ctx):
+        """SWX DM contribution [pc/cm^3] — also feeds the wideband DM
+        channel via TimingModel.dm_total_device (reference: SWX
+        dm_value summed into total DM). No ctx dependence: the
+        geometry columns are host-precomputed at nominal astrometry
+        (class docstring), so the sparse DM-row Jacobian needs no
+        astrometry coupling for SWX."""
         if not self.swx_ids:
             return jnp.zeros_like(batch.freq_mhz)
         vals = jnp.stack([_val(pv, f"SWXDM_{istr}")
                           for _, istr in self.swx_ids])
-        dm = cache["swx_cols"] @ vals
+        return cache["swx_cols"] @ vals
+
+    def delay(self, pv, batch, cache, ctx, delay_so_far):
+        if not self.swx_ids:
+            return jnp.zeros_like(batch.freq_mhz)
+        dm = self.dm_value_device(pv, batch, cache, ctx)
         bf = ctx.get("bfreq", batch.freq_mhz)
         return DMconst * dm / (bf * bf)
 

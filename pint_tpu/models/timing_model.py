@@ -1089,6 +1089,32 @@ class TimingModel:
                 dm = dm + c.dm_value_device(pv, batch, cache_sub, ctx)
         return dm
 
+    def dm_affecting_free_params(self):
+        """Free-parameter names whose tangents can move
+        dm_total_device: the params of every component exposing
+        ``dm_value_device``, plus astrometry's params when a
+        solar-wind component is present (its DM term reads the
+        pulsar-direction ctx that astrometry populates). The wideband
+        fit step restricts the DM-row Jacobian to these columns —
+        every other column is structurally zero, and the full jacfwd
+        paid ~29 wasted tangents out of 40 at the north-star shape
+        for them (ADVICE r4)."""
+        names: set = set()
+        has_sw = False
+        for c in self.components.values():
+            if hasattr(c, "dm_value_device"):
+                names.update(c.params)
+                # only the NE_SW model's dm_value_device reads the
+                # ctx geometry; SWX precomputes its geometry columns
+                # on host at nominal astrometry (no coupling)
+                if getattr(c, "category", "") == "solar_wind":
+                    has_sw = True
+        if has_sw:
+            for c in self.components.values():
+                if getattr(c, "category", "") == "astrometry":
+                    names.update(c.params)
+        return names
+
     def build_dm_fn(self, toas):
         """(dm_fn, free_names): dm_fn(th) -> model DM per TOA
         [pc/cm^3], pure and jacfwd-able (see dm_total_device)."""

@@ -182,6 +182,15 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         def dm_device(pv, batch_x, cache_x):
             return model.dm_total_device(pv, batch_x, cache_x["main"])
 
+        # static column restriction for the DM-row Jacobian (only
+        # meaningful under the hybrid-Jacobian regime; None = full AD)
+        dm_idx = None
+        if _use_hybrid_jac(hybrid_jac):
+            dm_set = model.dm_affecting_free_params()
+            idx = [i for i, nm in enumerate(free) if nm in dm_set]
+            if len(idx) < len(free):
+                dm_idx = np.asarray(idx, dtype=np.int32)
+
     # Per-free-param scale for the f32 Jacobian: F_i (i>=2) columns are
     # dt^{i+1}/(i+1)! and overflow f32 range from i=4; differentiating
     # w.r.t. u_i = F_i * 2^e instead keeps scaled columns ~O(dt). The
@@ -430,16 +439,31 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
                                  batch, cache)
 
             r_dm = (cache["wb_dm"] - dm_of64(th)) * valid
+
+            def sparse_jac(fn, x):
+                """DM-row Jacobian over only the DM-affecting columns
+                (dm_idx, static): all other columns are structurally
+                zero, so the tangent budget drops from n_free to
+                len(dm_idx) (~40 -> ~13 at the north-star shape).
+                With the hybrid split off, run the full jacfwd so the
+                pure-AD oracle path stays byte-identical."""
+                if dm_idx is None:
+                    return jax.jacfwd(fn)(x)
+                sub = jax.jacfwd(lambda xs: fn(x.at[dm_idx].set(xs)))(
+                    x[dm_idx])
+                return jnp.zeros((sub.shape[0], x.shape[0]),
+                                 sub.dtype).at[:, dm_idx].set(sub)
+
             if jac32:
                 def dm_of32(ua_):
                     return dm_device(
                         make_pv(ua_ * s32, ub * s32, fa, fb),
                         batch32, cache32)
 
-                jac_dm = jax.jacfwd(dm_of32)(ua)
+                jac_dm = sparse_jac(dm_of32, ua)
                 dm_cols = [-jac_dm * valid32[:, None]]
             else:
-                jac_dm = jax.jacfwd(dm_of64)(th)
+                jac_dm = sparse_jac(dm_of64, th)
                 dm_cols = [-jac_dm * valid[:, None]]
             if incoffset:  # zero DM response of the offset column
                 dm_cols.insert(0, jnp.zeros(
@@ -752,7 +776,14 @@ def _gls_core(M, F, phi, r, nvec, valid, eid, jvar, nseg: int,
         # an exactly-accumulated Gram matrix is PSD whatever the
         # column quantization — executing the slow branch only when
         # the fast one actually failed (lax.cond, not jnp.where).
-        ok = jnp.all(jnp.isfinite(xhat)) & jnp.isfinite(chi2)
+        # "Failed" must cover finite-but-garbage outputs too: an
+        # indefinite f32 Gram can pass the Cholesky with a tiny
+        # positive pivot from rounding instead of producing a NaN, so
+        # also require a finite inverse with the non-negative diagonal
+        # any true covariance has (ADVICE r4).
+        ok = (jnp.all(jnp.isfinite(xhat)) & jnp.isfinite(chi2)
+              & jnp.all(jnp.isfinite(inv))
+              & jnp.all(jnp.diagonal(inv) >= 0.0))
         xhat, inv, chi2 = jax.lax.cond(
             ok,
             lambda: (xhat, inv, chi2),

@@ -354,3 +354,22 @@ def test_tail_components_parfile_roundtrip():
             _r(m, toas, subtract_mean=False),
             _r(m2, toas, subtract_mean=False), atol=1e-12,
             err_msg=extra)
+
+
+def test_swx_feeds_wideband_dm_channel():
+    """SWX DM must flow into dm_total_device/build_dm_fn (reference:
+    SWX dm_value summed into total DM for the wideband DM channel) —
+    it was delay-only until round 5."""
+    m = _mk("SWXDM_0001 1e-4 1\nSWXR1_0001 54000\nSWXR2_0001 56000\n",
+            base=BASE_NOTZR)
+    toas = _toas(m, n=40)
+    m0 = _mk("", base=BASE_NOTZR)
+    dm_fn, free = m.build_dm_fn(toas)
+    dm0_fn, _ = m0.build_dm_fn(toas)
+    import jax.numpy as jnp
+
+    _, _, th, *_ = m._pack()
+    _, _, th0, *_ = m0._pack()
+    d = np.asarray(dm_fn(jnp.asarray(th)) - dm0_fn(jnp.asarray(th0)))
+    assert d.max() == pytest.approx(1e-4, rel=1e-6)  # window max = SWXDM
+    assert d.min() >= 0.0

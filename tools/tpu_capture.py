@@ -1,0 +1,247 @@
+"""On-chip benchmark capture — run during a live axon-tunnel window.
+
+The tunnel dies for hours and revives for ~tens of minutes
+(ARCHITECTURE.md, round-4 session notes), so every on-chip number must
+be captured opportunistically and committed immediately. This tool is
+stage-based and ledger-driven:
+
+- each --stage NAME measures one benchmark group on the default
+  backend and appends raw JSON lines (UTC-stamped, backend-tagged) to
+  BENCH_TPU.jsonl via bench.tpu_record_append;
+- --remaining prints the stages whose headline metric is not yet in
+  the ledger with backend==tpu (no jax device touch — safe while the
+  tunnel is wedged);
+- --auto runs all remaining stages in priority order.
+
+tools/tpu_watcher.sh drives this: bounded probe every ~9 min, then
+one stage at a time under its own timeout, git-committing the ledger
+after each stage so a tunnel death mid-capture loses at most the
+in-flight stage. Stage priority mirrors VERDICT.md round-4 item 1:
+the production (hybrid-Jacobian) north star first, then the N-scan,
+variant attribution, configs 2-5, and the PTA scaling sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402  (lazy: imports jax only inside functions)
+
+# stage -> headline metric
+STAGES = {
+    "north_star": "gls_fit_iteration_throughput_10k_toas_40p",
+    "scan": "gls_step_nscaling",
+    "attr": "step_variant_attribution",
+    "config2": "config2_b1855like_gls_ecorr_5k",
+    "config3": "config3_j1713like_wideband_step_2k",
+    "config4": "config4_j0613like_fullcov_gls_2k",
+    "config5": "config5_pta_batch_67psr",
+    "pta_scale": "pta_batch_scaling",
+}
+SCAN_NS = (10_000, 30_000, 100_000)
+ATTR_VARIANTS = ("production", "no_hybrid_jac", "jac_f64",
+                 "matmul_f64", "unanchored", "round3_all_f64")
+PTA_SIZES = (67, 134, 268)
+
+
+def remaining():
+    """Stages not fully captured THIS round. A stage whose metric is
+    a family (per-N scan points, per-variant attribution, per-size
+    PTA sweep) is done only when EVERY member is in the ledger — a
+    tunnel death mid-stage must leave the stage on the to-do list.
+    Records imported from the round-4 raw capture file (flagged
+    "imported": pre-hybrid configuration) don't count as done — the
+    whole point of round 5 is measuring the production post-hybrid
+    config on chip. Error records don't count either."""
+    recs = [r for r in bench.load_tpu_records().values()
+            if not r.get("imported") and "error" not in r]
+
+    def have(metric, **kv):
+        return any(r.get("metric") == metric
+                   and all(r.get(k) == v for k, v in kv.items())
+                   for r in recs)
+
+    out = []
+    for stage, metric in STAGES.items():
+        if stage == "scan":
+            done = all(have(metric, ntoa=n) for n in SCAN_NS)
+        elif stage == "attr":
+            done = all(have(metric, variant=v) for v in ATTR_VARIANTS)
+        elif stage == "pta_scale":
+            done = all(have(metric, npulsars=n) for n in PTA_SIZES)
+        else:
+            done = have(metric)
+        if not done:
+            out.append(stage)
+    return out
+
+
+def _init_jax():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from pint_tpu.config import enable_compile_cache
+
+    enable_compile_cache("PINT_TPU_BENCH_JIT_CACHE",
+                         os.path.join(REPO, ".jax_compile_cache"))
+    backend = jax.default_backend()
+    bench.log(f"capture backend: {backend} devices: {jax.devices()}")
+    if backend != "tpu" and "--allow-cpu" not in sys.argv:
+        bench.log("not on TPU; refusing to write the on-chip ledger")
+        sys.exit(3)
+    return backend
+
+
+def stage_north_star(backend):
+    """Production (post-hybrid) fit step: the number VERDICT.md round 4
+    flagged as never measured on chip. Auto flags (anchored + f32
+    Jacobian + f32 MXU matmul + hybrid) all engage on TPU."""
+    model, toas = bench.build_problem()
+    t, chi2, jitted, args, step_fn = bench.measure_step(model, toas)
+    rec = {"metric": STAGES["north_star"],
+           "backend": backend, "unit": "TOA/s",
+           "dispatch_ms": round(t * 1e3, 2), "chi2": round(chi2, 1)}
+    per_iter = t
+    try:
+        tc = bench.measure_step_chained((step_fn, args), k=8)
+        rec["step_ms_chained8"] = round(tc * 1e3, 2)
+        per_iter = min(per_iter, tc)
+    except Exception as e:
+        bench.log(f"  chained failed: {e!r}")
+    rec["step_ms"] = round(per_iter * 1e3, 2)
+    rec["value"] = round(toas.ntoas / per_iter, 1)
+    rec.update(bench.roofline_fields(jitted, args, per_iter, backend))
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def stage_scan(backend):
+    bench.scan_nscaling()  # appends per-N records itself on TPU
+
+
+def stage_attr(backend):
+    """Per-variant attribution of the production configuration: what
+    each redesign (anchored delta-phase, f32/dd32 Jacobian, f32-MXU
+    normal equations, hybrid analytic/AD Jacobian) buys ON CHIP."""
+    model, toas = bench.build_problem()
+    flag_sets = {
+        "production": {},
+        "no_hybrid_jac": {"hybrid_jac": False},
+        "jac_f64": {"jac_f32": False},
+        "matmul_f64": {"matmul_f32": False},
+        "unanchored": {"anchored": False},
+        "round3_all_f64": {"jac_f32": False, "matmul_f32": False,
+                           "anchored": False, "hybrid_jac": False},
+    }
+    for name in ATTR_VARIANTS:
+        flags = flag_sets[name]
+        try:
+            t, chi2, jitted, args, step_fn = bench.measure_step(
+                model, toas, reps=3, **flags)
+            rec = {"metric": STAGES["attr"], "variant": name,
+                   "backend": backend,
+                   "dispatch_ms": round(t * 1e3, 2),
+                   "chi2": round(chi2, 2)}
+            try:
+                tc = bench.measure_step_chained((step_fn, args), k=8)
+                rec["chained_ms"] = round(tc * 1e3, 2)
+            except Exception as e:
+                bench.log(f"  {name} chained failed: {e!r}")
+            per_iter = min(t, rec.get("chained_ms", t * 1e3) / 1e3)
+            rec.update(bench.roofline_fields(jitted, args, per_iter,
+                                             backend))
+        except Exception as e:
+            rec = {"metric": STAGES["attr"], "variant": name,
+                   "backend": backend, "error": repr(e)}
+        bench.tpu_record_append(rec)
+        print(json.dumps(rec), flush=True)
+
+
+def _config_stage(fn, backend):
+    rec = fn()
+    rec["backend"] = backend
+    # (config3's one-kernel step record was already appended inside
+    # the config function; rec here is its downhill full-fit metric)
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def stage_pta_scale(backend):
+    """PTA batch scaling beyond 67 pulsars (VERDICT round-4 item 5):
+    grow the array until the chip saturates; report TOA/s and the
+    device-solve share at each size."""
+    from bench_pta import build_pulsar
+
+    from pint_tpu.parallel import fit_pta
+
+    for npsr in PTA_SIZES:
+        t0 = time.perf_counter()
+        pulsars = [build_pulsar(k, 100) for k in range(npsr)]
+        build_s = time.perf_counter() - t0
+        res = fit_pta([(t, m) for m, t, _ in pulsars], maxiter=2)
+        stats = fit_pta.last_stats
+        n_ok = sum(
+            1 for (m, t, truth), r in zip(pulsars, res)
+            if abs(m.F0.value - truth["F0"]) < 5 * r["errors"]["F0"])
+        rec = {"metric": STAGES["pta_scale"], "backend": backend,
+               "npulsars": npsr, "unit": "TOA/s",
+               "value": round(stats["toas_per_sec"], 1),
+               "ntoa_total": stats["ntoa_total"],
+               "device_solve_ms":
+                   round(stats["device_solve_s"] * 1e3, 1),
+               "build_s": round(build_s, 1),
+               "recovered_5sigma": n_ok}
+        bench.tpu_record_append(rec)
+        print(json.dumps(rec), flush=True)
+
+
+def run_stage(name, backend):
+    bench.log(f"=== stage {name} ===")
+    t0 = time.perf_counter()
+    if name == "north_star":
+        stage_north_star(backend)
+    elif name == "scan":
+        stage_scan(backend)
+    elif name == "attr":
+        stage_attr(backend)
+    elif name == "config2":
+        _config_stage(bench.config2_b1855like, backend)
+    elif name == "config3":
+        _config_stage(bench.config3_j1713like_wideband, backend)
+    elif name == "config4":
+        _config_stage(bench.config4_j0613like_fullcov, backend)
+    elif name == "config5":
+        _config_stage(bench.config5_pta, backend)
+    elif name == "pta_scale":
+        stage_pta_scale(backend)
+    else:
+        raise SystemExit(f"unknown stage {name}")
+    bench.log(f"=== stage {name} done in "
+              f"{time.perf_counter() - t0:.0f}s ===")
+
+
+def main():
+    if "--remaining" in sys.argv:
+        print(" ".join(remaining()))
+        return
+    backend = _init_jax()
+    if "--auto" in sys.argv:
+        for name in remaining():
+            run_stage(name, backend)
+        return
+    if "--stage" in sys.argv:
+        run_stage(sys.argv[sys.argv.index("--stage") + 1], backend)
+        return
+    raise SystemExit("usage: tpu_capture.py "
+                     "[--remaining | --auto | --stage NAME] "
+                     "[--allow-cpu]")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+#!/bin/bash
+# Axon-tunnel watcher: the tunnel dies for hours and revives for
+# ~tens-of-minutes windows (round-4 session: one 40-min window was the
+# round's only on-chip access). Poll with a BOUNDED probe (a wedged
+# tunnel hangs jax.devices() forever rather than erroring); the moment
+# it answers, capture the remaining on-chip benchmark stages
+# (tools/tpu_capture.py) one at a time, committing BENCH_TPU.jsonl
+# after each so a mid-window death loses at most the in-flight stage.
+#
+# Usage: nohup tools/tpu_watcher.sh >/tmp/tpu_watcher_repo.log 2>&1 &
+# Stateless: stage completion is read from the committed ledger.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+LOG=/tmp/tpu_watcher_repo.log
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-150}
+STAGE_TIMEOUT=${STAGE_TIMEOUT:-2400}
+SLEEP_S=${SLEEP_S:-530}
+
+say() { echo "$(date -u '+%F %T') $*" >>"$LOG"; }
+
+while :; do
+  # bounded: --remaining only reads the ledger, but every python in
+  # this env imports jax via sitecustomize — never trust it unbounded
+  rem=$(cd "$REPO" && timeout 120 python tools/tpu_capture.py --remaining)
+  if [ -z "$rem" ]; then
+    say "all stages captured; watcher exiting"
+    exit 0
+  fi
+  if timeout "$PROBE_TIMEOUT" python -c \
+      "import jax; jax.devices()" >/dev/null 2>&1; then
+    say "tunnel ALIVE; remaining stages: $rem"
+    for st in $rem; do
+      say "stage $st starting"
+      ( cd "$REPO" && timeout "$STAGE_TIMEOUT" \
+          python tools/tpu_capture.py --stage "$st" \
+          >>/tmp/tpu_capture.out 2>>/tmp/tpu_capture.err )
+      rc=$?
+      say "stage $st rc=$rc"
+      if ! git -C "$REPO" diff --quiet -- BENCH_TPU.jsonl 2>/dev/null \
+          || [ -n "$(git -C "$REPO" status --porcelain BENCH_TPU.jsonl)" ]; then
+        git -C "$REPO" add BENCH_TPU.jsonl
+        git -C "$REPO" commit -q -m "On-chip bench capture: $st" \
+          -- BENCH_TPU.jsonl && say "committed ledger after $st"
+      fi
+      # stage failed AND probe now dead -> window closed, back to poll
+      if [ "$rc" -ne 0 ]; then
+        if ! timeout "$PROBE_TIMEOUT" python -c \
+            "import jax; jax.devices()" >/dev/null 2>&1; then
+          say "tunnel died mid-window"
+          break
+        fi
+      fi
+    done
+  else
+    say "tunnel dead"
+  fi
+  sleep "$SLEEP_S"
+done
