@@ -444,7 +444,7 @@ def config2_b1855like():
     freqs = np.tile([1400.0, 1400.0, 430.0, 430.0], n // 4)
     model, toas = _make_model_toas(par, mjds, freqs, seed=2,
                                    flag_sets={"be": lambda i: "X"})
-    t, chi2, _, args, step_fn = measure_step(model, toas)
+    t, chi2, jitted2, args, step_fn = measure_step(model, toas)
     per_iter = t
     dispatch_ms = None
     label = "single-dispatch (chained meas. FAILED)"
@@ -467,6 +467,13 @@ def config2_b1855like():
            "step_ms": round(per_iter * 1e3, 2)}
     if dispatch_ms is not None:
         rec["dispatch_ms"] = dispatch_ms
+    import jax
+
+    # reuse measure_step's jitted object: a fresh jax.jit wrapper has
+    # an empty cache and would re-trace + recompile the whole step
+    # (multi-minute over the tunnel) just to read the cost analysis
+    rec.update(roofline_fields(jitted2, args, per_iter,
+                               jax.default_backend()))
     return rec
 
 
@@ -509,8 +516,8 @@ def config3_j1713like_wideband():
     # the one-kernel wideband iteration (the TPU path; reported under
     # its own metric key — the downhill metric keeps its historical
     # meaning of full-fit throughput including the host loop)
-    t_step, _, _, args_w, step_w = measure_step(model, toas,
-                                                wideband=True)
+    t_step, _, jitted_w, args_w, step_w = measure_step(model, toas,
+                                                       wideband=True)
     per_iter = t_step
     rec3 = {"metric": "config3_j1713like_wideband_step_2k",
             "value": round(toas.ntoas / per_iter, 1), "unit": "TOA/s",
@@ -526,6 +533,9 @@ def config3_j1713like_wideband():
         log(f"  config3 chained failed: {e!r}")
     import jax
 
+    rec3.update(roofline_fields(jitted_w, args_w,
+                                rec3["step_ms"] / 1e3,
+                                jax.default_backend()))
     rec3["backend"] = jax.default_backend()
     if rec3["backend"] == "tpu":
         tpu_record_append(rec3)

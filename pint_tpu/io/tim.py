@@ -1,7 +1,6 @@
 """``.tim`` TOA-file parser/writer (TEMPO2 "FORMAT 1" plus the TEMPO
-Princeton and Parkes column formats; ITOA lines are detected and
-rejected with a clear error, matching the reference, whose
-parse_TOA_line raises "not implemented" for ITOA).
+Princeton, Parkes and ITOA column formats — ITOA goes beyond the
+reference, whose parse_TOA_line raises "not implemented" there).
 
 Reference behavior: src/pint/toa.py (.tim parsing in get_TOAs / TOA
 class). Key property preserved here: **the MJD never passes through a
@@ -10,9 +9,11 @@ single float64** — it stays a decimal string until
 (int day, double-double fraction).
 
 Supported commands: FORMAT, MODE, INCLUDE, C/CC/# comments, SKIP/NOSKIP,
-END, TIME (accumulated offset, seconds), EFAC/EQUAD (scoped multipliers,
-recorded as flags), JUMP (toggle pairs → ``-tim_jump N`` flag, mirroring
-the reference's jump-flag behavior), PHASE/TRACK (recorded as flags).
+END, TIME (accumulated offset, seconds), PHASE (accumulated turns →
+``-padd`` flag, applied by Residuals), EFAC/EQUAD (scoped error
+scaling), EMIN/EMAX/FMIN/FMAX (cuts on the scaled error / frequency),
+JUMP (toggle pairs → ``-tim_jump N`` flag, mirroring the reference's
+jump-flag behavior), TRACK/INFO (ignored).
 """
 
 from __future__ import annotations
@@ -98,15 +99,43 @@ def _parse_princeton_line(line: str) -> Optional[TimTOA]:
                   obs=obs, name=name)
 
 
+def _parse_itoa_line(line: str) -> Optional[TimTOA]:
+    """ITOA column format (detected by the TOA decimal point at
+    column 15): name(1:2), blanks(3:9), MJD(10:28), error-us(29:34),
+    freq-MHz(35:45), DM correction pc/cm^3 (46:55, recorded as the
+    ``ddm`` flag), 2-char observatory code(58:59). Goes beyond the
+    reference here: its parse_TOA_line raises 'not implemented' on
+    ITOA lines."""
+    if len(line) < 59 or line[14:15] != ".":
+        return None
+    if line[2:9].strip():  # cols 3-9 must be blank in ITOA
+        return None
+    name = line[0:2].strip()
+    mjd = line[9:28].strip().replace(" ", "")
+    err = line[28:34].strip()
+    freq = line[34:45].strip()
+    ddm = line[45:55].strip()
+    obs = line[57:59].strip()
+    if not (mjd and err and freq and obs):
+        return None
+    if not (_is_number(mjd) and _is_number(err) and _is_number(freq)):
+        return None
+    toa = TimTOA(mjd_str=mjd, freq_mhz=float(freq),
+                 error_us=float(err), obs=obs, name=name)
+    if ddm and _is_number(ddm) and float(ddm) != 0.0:
+        toa.flags["ddm"] = ddm
+    return toa
+
+
 def _parse_parkes_line(line: str) -> Optional[TimTOA]:
     """TEMPO Parkes column format (detected by a blank first column
-    and a decimal point at column 41): name(1:18), freq-MHz(25:34),
+    and a decimal point at column 41): name(1:25), freq-MHz(25:34),
     MJD(34:55), phase offset(55:63), error-us(63:71), 1-char
     observatory(79). The MJD field is already one decimal string."""
     if len(line) < 80 or not line.startswith(" ") \
             or line[41:42] != ".":
         return None
-    name = line[1:18].strip()
+    name = line[1:25].strip()
     freq = line[25:34].strip()
     mjd = line[34:55].strip().replace(" ", "")
     err = line[63:71].strip()
@@ -133,33 +162,46 @@ def parse_tim(source, _depth: int = 0,
 
     INCLUDE is followed relative to the including file's directory.
     """
-    toas, _fmt, _jc = _parse_tim_stream(source, _depth=_depth,
-                                        _jump_base=_jump_base)
-    return toas
+    state = _fresh_state()
+    state["jump_count"] = _jump_base
+    return _parse_tim_stream(source, state, _depth=_depth)
 
 
-def _parse_tim_stream(source, _depth: int = 0, _jump_base: int = 0,
-                      _fmt: str = "Unknown"):
-    """parse_tim worker returning (toas, fmt, jump_count): FORMAT and
-    jump numbering are properties of the expanded line STREAM, exactly
-    as in the reference's single linear loop — an INCLUDEd file
-    inherits the current format mode, and a FORMAT command inside it
-    stays in force after the include returns."""
+def _fresh_state() -> dict:
+    """Command state of the expanded line stream. ONE dict is shared
+    by the whole INCLUDE tree: every command (FORMAT, TIME, PHASE,
+    EFAC/EQUAD, EMIN/EMAX/FMIN/FMAX, SKIP, JUMP toggling) is a
+    property of the linear stream exactly as in the reference's
+    single loop — a command inside an INCLUDEd file stays in force
+    after the include returns."""
+    return {
+        "skipping": False,
+        "fmt": "Unknown",  # FORMAT 1 switches later lines to TEMPO2
+        "time_offset_s": 0.0,
+        "phase_turns": 0.0,
+        "efac": 1.0,
+        "equad_us": 0.0,
+        "emin_us": None, "emax_us": None,
+        "fmin_mhz": None, "fmax_mhz": None,
+        "jump_active": False,
+        # jump ids number ACROSS include boundaries: physically
+        # distinct JUMP blocks must not share a -tim_jump id (that
+        # would merge them into one fitted parameter)
+        "jump_count": 0,
+        "ended": False,  # END terminates the WHOLE stream, not just
+        # the file it appears in (an END inside an include stops the
+        # includer too)
+    }
+
+
+def _parse_tim_stream(source, st: dict, _depth: int = 0):
+    """parse_tim worker: one file/stream of the INCLUDE tree, sharing
+    the command state ``st`` (see _fresh_state)."""
     from pint_tpu.io.par import resolve_source
 
     lines, base_dir = resolve_source(source, kind="tim")
 
     toas: List[TimTOA] = []
-    skipping = False
-    fmt = _fmt  # FORMAT 1 switches every later line to TEMPO2
-    time_offset_s = 0.0
-    efac = 1.0
-    equad_us = 0.0
-    jump_active = False
-    # jump ids number ACROSS include boundaries: an included file's
-    # JUMP blocks are physically independent of the includer's, and a
-    # reused -tim_jump id would merge them into one fitted parameter
-    jump_count = _jump_base
 
     for raw in lines:
         line = raw.rstrip("\n")
@@ -172,15 +214,16 @@ def _parse_tim_stream(source, _depth: int = 0, _jump_base: int = 0,
         head = parts[0].upper()
 
         # inside SKIP...NOSKIP, commands are inert too (only NOSKIP exits)
-        if skipping and head != "NOSKIP":
+        if st["skipping"] and head != "NOSKIP":
             continue
 
         if head in _COMMANDS:
             if head == "SKIP":
-                skipping = True
+                st["skipping"] = True
             elif head == "NOSKIP":
-                skipping = False
+                st["skipping"] = False
             elif head == "END":
+                st["ended"] = True
                 break
             elif head == "INCLUDE" and len(parts) > 1:
                 if _depth > 10:
@@ -188,59 +231,86 @@ def _parse_tim_stream(source, _depth: int = 0, _jump_base: int = 0,
                 inc = parts[1]
                 if not os.path.isabs(inc):
                     inc = os.path.join(base_dir, inc)
-                sub, fmt, sub_jc = _parse_tim_stream(
-                    inc, _depth=_depth + 1, _jump_base=jump_count,
-                    _fmt=fmt)
-                jump_count = max(jump_count, sub_jc)
-                toas.extend(sub)
+                toas.extend(_parse_tim_stream(inc, st,
+                                              _depth=_depth + 1))
+                if st["ended"]:
+                    break
             elif head == "TIME" and len(parts) > 1:
-                time_offset_s += float(parts[1])
+                st["time_offset_s"] += float(parts[1])
+            elif head == "PHASE" and len(parts) > 1:
+                # accumulated phase offset [turns] applied to later
+                # TOAs via the -padd flag, which Residuals adds to
+                # the phase residual (reference: PHASE command ->
+                # padd flag -> calc_phase_resids)
+                st["phase_turns"] += float(parts[1])
             elif head == "EFAC" and len(parts) > 1:
-                efac = float(parts[1])
+                st["efac"] = float(parts[1])
             elif head == "EQUAD" and len(parts) > 1:
-                equad_us = float(parts[1])
+                st["equad_us"] = float(parts[1])
+            elif head == "EMIN" and len(parts) > 1:
+                st["emin_us"] = float(parts[1])
+            elif head == "EMAX" and len(parts) > 1:
+                st["emax_us"] = float(parts[1])
+            elif head == "FMIN" and len(parts) > 1:
+                st["fmin_mhz"] = float(parts[1])
+            elif head == "FMAX" and len(parts) > 1:
+                st["fmax_mhz"] = float(parts[1])
             elif head == "JUMP":
-                jump_active = not jump_active
-                if jump_active:
-                    jump_count += 1
+                st["jump_active"] = not st["jump_active"]
+                if st["jump_active"]:
+                    st["jump_count"] += 1
             elif head == "FORMAT" and len(parts) > 1:
-                fmt = "Tempo2" if parts[1] == "1" else "Unknown"
-            # MODE/PHASE/TRACK/INFO: recorded implicitly or ignored
+                st["fmt"] = "Tempo2" if parts[1] == "1" else "Unknown"
+            # MODE/TRACK/INFO: recorded implicitly or ignored
             continue
 
         # per-line format detection (the reference's _toa_format):
         # after a FORMAT 1 command every line is TEMPO2-tokenized;
         # otherwise the Parkes column signature is checked FIRST (a
         # Parkes line tokenizes numerically and would be swallowed by
-        # the free-form parser), then free-form/Princeton, and a line
-        # none of them accept with the ITOA signature — the TOA
-        # decimal point in column 15 (index 14) — gets the reference's
-        # explicit rejection instead of a generic parse error
-        if fmt == "Tempo2":
+        # the free-form parser), then free-form/Princeton, then ITOA
+        # (detected by its TOA decimal point in column 15, index 14)
+        if st["fmt"] == "Tempo2":
             toa = _parse_format1_line(parts)
         elif line.startswith(" ") and line[41:42] == ".":
             toa = _parse_parkes_line(line)
+        elif line[14:15] == "." and not line[2:9].strip():
+            # ITOA column signature (checked before free-form: an
+            # ITOA line tokenizes numerically and the free-form
+            # parser would mis-assign its fields)
+            toa = _parse_itoa_line(line)
         else:
             toa = _parse_format1_line(parts)
             if toa is None:
                 toa = _parse_princeton_line(line)
-            if toa is None and line[14:15] == ".":
-                raise NotImplementedError(
-                    f"ITOA-format TOA lines are not supported (the "
-                    f"reference's parse_TOA_line raises here too): "
-                    f"{line!r}")
         if toa is None:
             raise ValueError(f"unparseable TOA line: {line!r}")
-        if time_offset_s != 0.0:
-            toa.flags["to"] = repr(time_offset_s)
-        if efac != 1.0:
-            toa.error_us *= efac
-        if equad_us != 0.0:
-            toa.error_us = (toa.error_us ** 2 + equad_us ** 2) ** 0.5
-        if jump_active:
-            toa.flags.setdefault("tim_jump", str(jump_count))
+        if st["time_offset_s"] != 0.0:
+            toa.flags["to"] = repr(st["time_offset_s"])
+        if st["phase_turns"] != 0.0:
+            toa.flags["padd"] = repr(st["phase_turns"])
+        if st["efac"] != 1.0:
+            toa.error_us *= st["efac"]
+        if st["equad_us"] != 0.0:
+            toa.error_us = (toa.error_us ** 2
+                            + st["equad_us"] ** 2) ** 0.5
+        # EMIN/EMAX/FMIN/FMAX cuts apply to the SCALED error, after
+        # the scoped EFAC/EQUAD (reference command semantics: the cut
+        # sees what the fit would see)
+        if st["emin_us"] is not None and toa.error_us < st["emin_us"]:
+            continue
+        if st["emax_us"] is not None and toa.error_us > st["emax_us"]:
+            continue
+        if st["fmin_mhz"] is not None \
+                and toa.freq_mhz < st["fmin_mhz"]:
+            continue
+        if st["fmax_mhz"] is not None \
+                and toa.freq_mhz > st["fmax_mhz"]:
+            continue
+        if st["jump_active"]:
+            toa.flags.setdefault("tim_jump", str(st["jump_count"]))
         toas.append(toa)
-    return toas, fmt, jump_count
+    return toas
 
 
 def write_tim(path_or_file, toas: List[TimTOA], comment: str = "") -> None:

@@ -162,6 +162,16 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     f32mm = _use_f32_matmul(matmul_f32)
     jac32 = _use_f32_jac(jac_f32)
 
+    # per-TOA PHASE-command offsets (tim -padd flags, turns): folded
+    # into the device residual exactly where the host Residuals adds
+    # them (before mean subtraction), so the device fitters cannot
+    # silently ignore a PHASE command the host path honors. Constant
+    # in the parameters, so the Jacobian paths are untouched.
+    padd_np = np.array([float(f.get("padd", 0.0)) for f in toas.flags])
+    has_padd = bool(np.any(padd_np != 0.0))
+    if has_padd:
+        sc = {**sc, "padd": jnp.asarray(padd_np)}
+
     # hybrid Jacobian: closed-form columns for the linear params, AD
     # tangents only for the rest (40 -> 11 tangents at the north-star
     # shape). Static split at build time (finalized after the scale
@@ -367,6 +377,8 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
                 return f.hi + f.lo
 
         frac = phase_f64(th)
+        if has_padd:
+            frac = frac + cache["padd"]
         i = f0_src[1]
         if anchored_on and f0_src[0] == "free":
             f0 = f0_ref + (th[i] + tl[i])  # th carries delta-theta

@@ -31,6 +31,15 @@ class PlkState:
         self.yaxis = "residual"
         self.color_mode = "default"
         self.show_prefit = False
+        # view-limit state (zoom): None = autoscale to the data. A
+        # stack of previous views backs zoom_out, like the
+        # reference's plk zoom history.
+        self.xlim: Optional[Tuple[float, float]] = None
+        self.ylim: Optional[Tuple[float, float]] = None
+        self._view_stack: list = []
+        # random-models overlay curves (aligned with the current TOA
+        # set; invalidated by any TOA-count or fit change)
+        self.random_curves: Optional[list] = None
 
     # -------------------------------------------------------- arrays
 
@@ -84,6 +93,79 @@ class PlkState:
             m |= self.pulsar.selected
         self.pulsar.select(m)
         return int(m.sum())
+
+    def zoom_rectangle(self, x1, x2, y1=None, y2=None) -> None:
+        """Zoom to a box in current axis coordinates (reference: plk
+        right-drag zoom). The previous view is pushed so zoom_out
+        steps back through the history. Zero-area boxes (a plain
+        click: RectangleSelector fires on release even without a
+        drag) are ignored — they would blank the plot and pollute
+        the history."""
+        if x1 == x2 or (y1 is not None and y2 is not None
+                        and y1 == y2):
+            return
+        self._view_stack.append((self.xlim, self.ylim))
+        self.xlim = (min(x1, x2), max(x1, x2))
+        if y1 is not None and y2 is not None:
+            self.ylim = (min(y1, y2), max(y1, y2))
+
+    def zoom_out(self) -> None:
+        """Step back one zoom level (autoscale when the history is
+        empty)."""
+        if self._view_stack:
+            self.xlim, self.ylim = self._view_stack.pop()
+        else:
+            self.xlim = self.ylim = None
+
+    def reset_view(self) -> None:
+        self.xlim = self.ylim = None
+        self._view_stack.clear()
+
+    def set_axis(self, xaxis: Optional[str] = None,
+                 yaxis: Optional[str] = None) -> None:
+        """Change plot axes AND reset the view: zoom limits are in
+        axis units, so keeping them across an axis switch would show
+        an empty plot (mjd limits applied to a 0-1 orbital phase)."""
+        if xaxis is not None:
+            self.xaxis = xaxis
+        if yaxis is not None:
+            self.yaxis = yaxis
+        self.reset_view()
+
+    def visible_mask(self) -> np.ndarray:
+        """Boolean mask of points inside the current view limits —
+        lets selection operations act on what the user sees."""
+        x, y, _, _ = self.xy()
+        m = np.ones(len(x), dtype=bool)
+        if self.xlim is not None:
+            m &= (x >= self.xlim[0]) & (x <= self.xlim[1])
+        if self.ylim is not None:
+            m &= (y >= self.ylim[0]) & (y <= self.ylim[1])
+        return m
+
+    def compute_random_models(self, n: int = 10, rng=None) -> list:
+        """Fit-covariance draw curves for the overlay, computed
+        through the Pulsar facade and cached on the state (the Tk
+        widget is a pure view). Requires a completed fit."""
+        self.random_curves = self.pulsar.random_models(n=n, rng=rng)
+        return self.random_curves
+
+    def clear_random_models(self) -> None:
+        self.random_curves = None
+
+    def overlay_arrays(self, x: np.ndarray) -> list:
+        """Random-model curves as (x, y_us) pairs aligned with the
+        current plot arrays; silently drops (and clears) the overlay
+        when the TOA set changed under it."""
+        if self.random_curves is None:
+            return []
+        out = []
+        for curve in self.random_curves:
+            if len(curve) != len(x):
+                self.random_curves = None
+                return []
+            out.append((x, np.asarray(curve) * 1e6))
+        return out
 
     def title(self, data: Optional[dict] = None) -> str:
         if data is None:
@@ -142,9 +224,14 @@ class PlkWidget:
         self.canvas.get_tk_widget().pack(side=tk.TOP, fill=tk.BOTH,
                                          expand=1)
         NavigationToolbar2Tk(self.canvas, self.frame)
+        # left-drag: box selection; right-drag: zoom (reference plk
+        # bindings); both are thin event shims over PlkState
         self.selector = RectangleSelector(self.ax, self._on_select,
                                           useblit=True, button=[1])
-        self._random_curves = None
+        self.zoomer = RectangleSelector(self.ax, self._on_zoom,
+                                        useblit=True, button=[3])
+        tk.Button(top, text="Zoom out",
+                  command=self.zoom_out).pack(side=tk.LEFT)
         self.update_plot()
 
     # ------------------------------------------------------- actions
@@ -155,19 +242,28 @@ class PlkWidget:
                                     extend=eclick.key == "shift")
         self.update_plot()
 
+    def _on_zoom(self, eclick, erelease):
+        self.state.zoom_rectangle(eclick.xdata, erelease.xdata,
+                                  eclick.ydata, erelease.ydata)
+        self.update_plot()
+
+    def zoom_out(self):
+        self.state.zoom_out()
+        self.update_plot()
+
     def fit(self):
         self.state.pulsar.fit()
-        self._random_curves = None
+        self.state.clear_random_models()
         self.update_plot()
 
     def undo(self):
         self.state.pulsar.undo()
-        self._random_curves = None  # TOA count may have changed
+        self.state.clear_random_models()  # TOA count may have changed
         self.update_plot()
 
     def delete(self):
         self.state.pulsar.delete_TOAs()
-        self._random_curves = None
+        self.state.clear_random_models()
         self.update_plot()
 
     def jump(self):
@@ -183,11 +279,11 @@ class PlkWidget:
         self.update_plot()
 
     def random_models(self):
-        self._random_curves = self.state.pulsar.random_models(n=10)
+        self.state.compute_random_models(n=10)
         self.update_plot()
 
     def set_xaxis(self, value):
-        self.state.xaxis = value
+        self.state.set_axis(xaxis=value)  # resets zoom (axis units)
         self.update_plot()
 
     def set_color_mode(self, value):
@@ -207,14 +303,14 @@ class PlkWidget:
         if sel.any():
             self.ax.scatter(x[sel], y[sel], facecolors="none",
                             edgecolors="#e34a33", s=60, zorder=3)
-        if self._random_curves is not None and \
-                self.state.xaxis == "mjd":
-            for curve in self._random_curves:
-                if len(curve) != len(x):  # TOAs changed under us
-                    self._random_curves = None
-                    break
-                self.ax.plot(x, np.asarray(curve) * 1e6,
-                             color="#31a354", alpha=0.3, zorder=0)
+        if self.state.xaxis == "mjd":
+            for cx, cy in self.state.overlay_arrays(x):
+                self.ax.plot(cx, cy, color="#31a354", alpha=0.3,
+                             zorder=0)
+        if self.state.xlim is not None:
+            self.ax.set_xlim(*self.state.xlim)
+        if self.state.ylim is not None:
+            self.ax.set_ylim(*self.state.ylim)
         self.ax.set_xlabel(self.state.xaxis)
         self.ax.set_ylabel("residual (us)"
                            if self.state.yaxis == "residual"

@@ -60,6 +60,14 @@ class Residuals:
             full = np.asarray(ph.frac)
         else:
             raise ValueError(f"unknown track_mode {self.track_mode!r}")
+        # per-TOA phase adjustments from tim-file PHASE commands
+        # (flag -padd, turns; reference: Residuals applies padd in
+        # calc_phase_resids — a phase command inserts whole/fractional
+        # turns into the residual, not a time shift)
+        padd = np.array([float(f.get("padd", 0.0))
+                         for f in self.toas.flags])
+        if np.any(padd != 0.0):
+            full = full + padd
         if self.subtract_mean:
             full = full - self._mean(full)
         return full

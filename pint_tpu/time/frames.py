@@ -7,9 +7,11 @@ with an equinox-based chain:
     GCRS = P(t) · N(t) · R3(−GAST) · W · ITRF
 
 - P: IAU-2006-compatible precession (Capitaine polynomials for ζ, z, θ);
-- N: IAU2000B nutation truncated to the 10 largest lunisolar terms
-  (~10 mas worst-case vs full series → ≲30 cm on the geocenter-to-site
-  vector ≈ 1 ns of Roemer — see error budget in ARCHITECTURE.md);
+- N: IAU2000B nutation, 31 leading lunisolar terms with t-dependent
+  and out-of-phase coefficients + the fixed planetary bias (~1-2 mas
+  worst-case vs the full 77-term table → ≲6 cm on the
+  geocenter-to-site vector ≈ 0.2 ns of Roemer — error budget in
+  ARCHITECTURE.md);
 - GAST = GMST(ERA) + Δψ cos ε (equation of the equinoxes, leading term);
 - W: polar motion, identity by default (no IERS tables offline; ~0.3″
   ≈ 9 m ≈ 30 ns — irrelevant for self-consistent fixtures, hook provided
@@ -63,21 +65,54 @@ def obliquity06(tt_mjd):
     return eps * ASEC2RAD
 
 
-# IAU2000B truncated: (l, l', F, D, Om multipliers), dpsi_sin, deps_cos
-# in arcsec. Ten largest terms of the lunisolar series.
+# IAU 2000B lunisolar nutation, leading 31 terms of the published
+# 77-term table (McCarthy & Luzum 2003): per row the Delaunay-argument
+# multipliers (l, l', F, D, Om) and the coefficients
+#   Δψ: ps·sin(arg) + pst·t·sin(arg) + pc·cos(arg)
+#   Δε: ec·cos(arg) + ect·t·cos(arg) + es·sin(arg)
+# in arcsec (pst/ect per Julian century). Terms 32-77 have amplitudes
+# <0.8 mas each (omitted tail RSS ~1-2 mas ≈ <0.1 ns of Roemer on the
+# site vector — error budget in ARCHITECTURE.md); the table is data,
+# further extension stays mechanical.
 _NUT_TERMS = np.array([
-    # l   l'  F   D  Om     dpsi        deps
-    (0.0, 0.0, 0.0, 0.0, 1.0, -17.2064161, 9.2052331),
-    (0.0, 0.0, 2.0, -2.0, 2.0, -1.3170906, 0.5730336),
-    (0.0, 0.0, 2.0, 0.0, 2.0, -0.2276413, 0.0978459),
-    (0.0, 0.0, 0.0, 0.0, 2.0, 0.2074554, -0.0897492),
-    (0.0, 1.0, 0.0, 0.0, 0.0, 0.1475877, 0.0073871),
-    (0.0, 1.0, 2.0, -2.0, 2.0, -0.0516821, 0.0224386),
-    (1.0, 0.0, 0.0, 0.0, 0.0, 0.0711159, -0.0006750),
-    (0.0, 0.0, 2.0, 0.0, 1.0, -0.0387298, 0.0200728),
-    (1.0, 0.0, 2.0, 0.0, 2.0, -0.0301461, 0.0129025),
-    (0.0, -1.0, 2.0, -2.0, 2.0, 0.0215829, -0.0095929),
+    # l  l'  F   D  Om     ps         pst        pc         ec         ect        es
+    (0, 0, 0, 0, 1, -17.2064161, -0.0174666, 0.0033386, 9.2052331, 0.0009086, 0.0015377),
+    (0, 0, 2, -2, 2, -1.3170906, -0.0001675, -0.0013696, 0.5730336, -0.0003015, -0.0004587),
+    (0, 0, 2, 0, 2, -0.2276413, -0.0000234, 0.0002796, 0.0978459, -0.0000485, 0.0001374),
+    (0, 0, 0, 0, 2, 0.2074554, 0.0000207, -0.0000698, -0.0897492, 0.0000470, -0.0000291),
+    (0, 1, 0, 0, 0, 0.1475877, -0.0003633, 0.0011817, 0.0073871, -0.0000184, -0.0001924),
+    (0, 1, 2, -2, 2, -0.0516821, 0.0001226, -0.0000524, 0.0224386, -0.0000677, -0.0000174),
+    (1, 0, 0, 0, 0, 0.0711159, 0.0000073, -0.0000872, -0.0006750, 0.0, 0.0000358),
+    (0, 0, 2, 0, 1, -0.0387298, -0.0000367, 0.0000380, 0.0200728, 0.0000018, 0.0000318),
+    (1, 0, 2, 0, 2, -0.0301461, -0.0000036, 0.0000816, 0.0129025, -0.0000063, 0.0000367),
+    (0, -1, 2, -2, 2, 0.0215829, -0.0000494, 0.0000111, -0.0095929, 0.0000299, 0.0000132),
+    (0, 0, 2, -2, 1, 0.0128227, 0.0000137, 0.0000181, -0.0068982, -0.0000009, 0.0000039),
+    (-1, 0, 2, 0, 2, 0.0123457, 0.0000011, 0.0000019, -0.0053311, 0.0000032, -0.0000004),
+    (-1, 0, 0, 2, 0, 0.0156994, 0.0000010, -0.0000168, -0.0000127, 0.0, 0.0000082),
+    (1, 0, 0, 0, 1, 0.0063110, 0.0000063, 0.0000027, -0.0033228, 0.0, -0.0000009),
+    (-1, 0, 0, 0, 1, -0.0057976, -0.0000063, -0.0000189, 0.0031429, 0.0, -0.0000075),
+    (-1, 0, 2, 2, 2, -0.0059641, -0.0000011, 0.0000149, 0.0025543, -0.0000011, 0.0000066),
+    (1, 0, 2, 0, 1, -0.0051613, -0.0000042, 0.0000129, 0.0026366, 0.0, 0.0000078),
+    (-2, 0, 2, 0, 1, 0.0045893, 0.0000050, 0.0000031, -0.0024236, -0.0000010, 0.0000020),
+    (0, 0, 0, 2, 0, 0.0063384, 0.0000011, -0.0000150, -0.0001220, 0.0, 0.0000029),
+    (0, 0, 2, 2, 2, -0.0038571, -0.0000001, 0.0000158, 0.0016452, -0.0000011, 0.0000068),
+    (0, -2, 2, -2, 2, 0.0032481, 0.0, 0.0, -0.0013870, 0.0, 0.0),
+    (-2, 0, 0, 2, 0, -0.0047722, 0.0, -0.0000018, 0.0000477, 0.0, -0.0000025),
+    (2, 0, 2, 0, 2, -0.0031046, -0.0000001, 0.0000131, 0.0013238, -0.0000011, 0.0000059),
+    (1, 0, 2, -2, 2, 0.0028593, 0.0, -0.0000001, -0.0012338, 0.0000010, -0.0000003),
+    (-1, 0, 2, 0, 1, 0.0020441, 0.0000021, 0.0000010, -0.0010758, 0.0, -0.0000003),
+    (2, 0, 0, 0, 0, 0.0029243, 0.0, -0.0000074, -0.0000609, 0.0, 0.0000013),
+    (0, 0, 2, 0, 0, 0.0025887, 0.0, -0.0000066, -0.0000550, 0.0, 0.0000011),
+    (0, 1, 0, 0, 1, -0.0014053, -0.0000025, 0.0000079, 0.0008551, -0.0000002, -0.0000045),
+    (-1, 0, 0, 2, 1, 0.0015164, 0.0000010, 0.0000011, -0.0008001, 0.0, -0.0000001),
+    (0, 2, 2, -2, 2, -0.0015794, 0.0000072, -0.0000016, 0.0006850, -0.0000042, -0.0000005),
+    (0, 0, -2, 2, 0, 0.0021783, 0.0, 0.0000013, -0.0000167, 0.0, 0.0000013),
 ])
+
+# IAU2000B fixed planetary-nutation bias (arcsec): the model's account
+# of the planetary terms it omits relative to IAU2000A.
+_NUT_PLANETARY_PSI = -0.000135
+_NUT_PLANETARY_EPS = 0.000388
 
 
 def _fundamental_args(t):
@@ -91,15 +126,20 @@ def _fundamental_args(t):
 
 
 def nutation00b_truncated(tt_mjd):
-    """(Δψ, Δε) in radians, 10-term truncation of IAU2000B."""
+    """(Δψ, Δε) in radians: 31-term IAU2000B lunisolar series with
+    the t-dependent and out-of-phase coefficients, plus the model's
+    fixed planetary bias. Truncation vs the full 77-term table is
+    ~1-2 mas (see _NUT_TERMS comment); vs IAU2000A the 2000B model
+    itself is ~1 mas 1995-2050."""
     t = _jc(tt_mjd)
     l, lp, F, D, Om = _fundamental_args(t)
-    dpsi = np.zeros_like(t)
-    deps = np.zeros_like(t)
-    for cl, clp, cF, cD, cOm, sp, ce in _NUT_TERMS:
+    dpsi = np.full_like(t, _NUT_PLANETARY_PSI)
+    deps = np.full_like(t, _NUT_PLANETARY_EPS)
+    for cl, clp, cF, cD, cOm, ps, pst, pc, ec, ect, es in _NUT_TERMS:
         arg = cl * l + clp * lp + cF * F + cD * D + cOm * Om
-        dpsi = dpsi + sp * np.sin(arg)
-        deps = deps + ce * np.cos(arg)
+        s, c = np.sin(arg), np.cos(arg)
+        dpsi = dpsi + (ps + pst * t) * s + pc * c
+        deps = deps + (ec + ect * t) * c + es * s
     return dpsi * ASEC2RAD, deps * ASEC2RAD
 
 

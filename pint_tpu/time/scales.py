@@ -3,13 +3,20 @@
 Replaces astropy.time scale chains + ERFA ``dtdb``
 (reference: src/pint/toa.py TOAs.compute_TDBs; SURVEY.md Appendix A.3).
 
-TDB−TT uses a truncated Fairhead–Bretagnon analytic series (36 leading
-terms of the ERFA/FB1990 expansion). Truncation error vs the full ~800-term
-series is a few hundred ns worst-case — adequate for bring-up and fully
-self-consistent for the simulate→fit oracle; the term table is data, so
-extending it later is mechanical. The additional topocentric term
-−(v_⊕·r_obs)/c² (~2 µs diurnal) is applied in the TOA pipeline where the
-observatory GCRS vectors are available.
+TDB−TT uses a truncated Fairhead–Bretagnon analytic series: 60 t^0
+terms, 16 t^1 terms, 6 t^2 terms and the leading t^3 term of the
+FB1990 expansion (the published constants, embedded as data). Honest
+truncation estimate vs the full ~790-term series: the largest omitted
+t^0 amplitude is ~0.028 µs and the omitted tail RSSes to ~0.1 µs
+worst-case (the full table cannot be re-derived offline; the table is
+data, so extending further stays mechanical). Independent-method
+cross-check: tests/test_time_truth.py integrates the defining
+relativistic rate with the in-repo ephemeris and agrees to <5 µs over
+12 yr — limited by the Keplerian ephemeris's missing indirect
+planetary perturbations of Earth's orbit, not by this series. The
+additional topocentric term −(v_⊕·r_obs)/c² (~2 µs diurnal) is
+applied in the TOA pipeline where the observatory GCRS vectors are
+available.
 """
 
 from __future__ import annotations
@@ -57,8 +64,40 @@ _FB_T0 = np.array([
     (0.119979e-6, 38.133035638, 4.551585768),
     (0.118971e-6, 5486.777843175, 1.914547226),
     (0.116120e-6, 1059.381930189, 0.873504123),
+    # terms 31-60 of the published t^0 table (round-5 extension;
+    # amplitudes 0.028-0.102 us)
+    (0.101868e-6, -5573.142801634, 5.984503847),
+    (0.098358e-6, 2352.866153772, 6.145309371),
+    (0.080164e-6, 206.185548437, 2.095377709),
+    (0.079645e-6, 4694.002954708, 2.949233637),
+    (0.075019e-6, 2942.463423292, 4.980931759),
+    (0.064397e-6, 5746.271337896, 1.280308748),
+    (0.063814e-6, 5760.498431898, 4.167901731),
+    (0.062617e-6, 20.775395492, 2.654394814),
+    (0.058844e-6, 426.598190876, 4.839650148),
+    (0.054139e-6, 17260.154654690, 3.411091093),
+    (0.048373e-6, 155.420399434, 2.251573730),
+    (0.048042e-6, 2146.165416475, 1.495846011),
+    (0.046551e-6, -0.980321068, 0.921573539),
+    (0.042732e-6, 632.783739313, 5.720622217),
+    (0.042560e-6, 161000.685737473, 1.270837679),
+    (0.042411e-6, 6275.962302991, 2.869567043),
+    (0.040759e-6, 12352.852604545, 3.981496998),
+    (0.040480e-6, 15720.838784878, 2.546610123),
+    (0.040184e-6, -7.113547001, 3.565975565),
+    (0.036955e-6, 3154.687084896, 5.071801441),
+    (0.036564e-6, 5088.628839767, 3.324679049),
+    (0.036507e-6, 801.820931124, 6.248866009),
+    (0.034867e-6, 522.577418094, 5.210064075),
+    (0.033529e-6, 9437.762934887, 2.404714239),
+    (0.033477e-6, 6062.663207553, 4.144987272),
+    (0.032438e-6, 6076.890301554, 0.749317412),
+    (0.032423e-6, 8827.390269875, 5.541473556),
+    (0.030215e-6, 7084.896781115, 3.389610345),
+    (0.029247e-6, -71430.695617928, 4.183178762),
+    (0.028244e-6, -6286.598968340, 5.069663519),
 ])
-# t^1 group:
+# t^1 group (16 leading terms):
 _FB_T1 = np.array([
     (102.156724e-6, 6283.075849991, 4.249032005),
     (1.706807e-6, 12566.151699983, 4.205904248),
@@ -66,6 +105,29 @@ _FB_T1 = np.array([
     (0.265919e-6, 529.690965095, 5.836047367),
     (0.210568e-6, -3.523118349, 6.262738348),
     (0.077996e-6, 5223.693919802, 4.670344204),
+    (0.059641e-6, 26.298319800, 1.083044735),
+    (0.054764e-6, 1577.343542448, 4.534800170),
+    (0.034420e-6, -398.149003408, 5.980077351),
+    (0.033595e-6, 5507.553238667, 5.980162321),
+    (0.032088e-6, 18849.227549974, 5.869584648),
+    (0.029198e-6, 5856.477659115, 0.313144238),
+    (0.027764e-6, 155.420399434, 0.419288904),
+    (0.025190e-6, 5746.271337896, 2.776244623),
+    (0.024976e-6, 5760.498431898, 2.689294301),
+    (0.022997e-6, -796.298006816, 1.255488919),
+])
+# t^2 group:
+_FB_T2 = np.array([
+    (4.322990e-6, 6283.075849991, 2.642893748),
+    (0.406495e-6, 0.0, 4.712388980),
+    (0.122605e-6, 12566.151699983, 2.438140634),
+    (0.019476e-6, 213.299095438, 1.642186981),
+    (0.016916e-6, 529.690965095, 4.510959344),
+    (0.013374e-6, -3.523118349, 1.502210314),
+])
+# t^3 leading term:
+_FB_T3 = np.array([
+    (0.143388e-6, 6283.075849991, 1.131453581),
 ])
 
 
@@ -117,13 +179,17 @@ def tt_mjd_to_utc_mjd(day, frac):
 def tdb_minus_tt_seconds(tt_mjd_f64):
     """Truncated Fairhead–Bretagnon TDB−TT [s] at TT MJD(s) (f64 is ample:
     the series slope is ~1e-7 s/s, so µs-level argument error is harmless).
+    w = Σ_k t^k Σ_i A_ki sin(ω_ki t + φ_ki), t in TT millennia.
     """
     t = (np.asarray(tt_mjd_f64, np.float64) - MJD_J2000) / 365250.0
     w = np.zeros_like(t)
-    for A, om, ph in _FB_T0:
-        w = w + A * np.sin(om * t + ph)
-    for A, om, ph in _FB_T1:
-        w = w + t * (A * np.sin(om * t + ph))
+    tk = np.ones_like(t)
+    for table in (_FB_T0, _FB_T1, _FB_T2, _FB_T3):
+        g = np.zeros_like(t)
+        for A, om, ph in table:
+            g = g + A * np.sin(om * t + ph)
+        w = w + tk * g
+        tk = tk * t
     return w
 
 

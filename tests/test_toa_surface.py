@@ -71,14 +71,42 @@ class TestParkesFormat:
         assert t.flags["be"] == "X"
 
 
-class TestITOARejected:
-    def test_itoa_line_raises_clear_error(self):
-        # real ITOA signature: the TOA decimal point sits in column 15
-        # (index 14) of a fixed-width line that no other parser accepts
-        line = "XX  name 50123.8864714985  5.00  1420.0000  0.00 AO"
+class TestITOAParsed:
+    @staticmethod
+    def _itoa_line(name, mjd19, err6, freq11, ddm10, obs2):
+        # cols (1-based): name 1-2, blank 3-9, MJD 10-28, err 29-34,
+        # freq 35-45, DM correction 46-55, blank 56-57, obs 58-59
+        line = (f"{name:<2s}" + " " * 7 + f"{mjd19:<19s}"
+                + f"{err6:>6s}" + f"{freq11:>11s}" + f"{ddm10:>10s}"
+                + "  " + f"{obs2:<2s}")
         assert line[14] == "."
-        with pytest.raises(NotImplementedError, match="ITOA"):
-            parse_tim(line + "\n")
+        return line
+
+    def test_itoa_line_parses(self):
+        # round 5: ITOA is parsed (beyond the reference, whose
+        # parse_TOA_line raises 'not implemented' for it)
+        line = self._itoa_line("AA", "50123.8864714985", "5.00",
+                               "1420.0000", "0.00", "AO")
+        t = parse_tim(line + "\n")[0]
+        assert t.name == "AA"
+        assert t.mjd_str == "50123.8864714985"
+        assert t.error_us == 5.0
+        assert t.freq_mhz == 1420.0
+        assert t.obs == "AO"
+        assert "ddm" not in t.flags
+
+    def test_itoa_ddm_flag_and_blank_guard(self):
+        line = self._itoa_line("B1", "50124.1234567890", "2.50",
+                               "430.0000", "0.0031", "GB")
+        t = parse_tim(line + "\n")[0]
+        assert float(t.flags["ddm"]) == 0.0031
+        assert t.obs == "GB"
+        # a line with content in the must-be-blank cols 3-9 is NOT
+        # ITOA and must fail parsing loudly, not be half-swallowed
+        bad = "XX  name 50123.8864714985  5.00  1420.0000  0.00 AO"
+        assert bad[14] == "."
+        with pytest.raises(ValueError, match="unparseable"):
+            parse_tim(bad + "\n")
 
 
 class TestFormatThreadsThroughInclude:

@@ -20,8 +20,17 @@ say() { echo "$(date -u '+%F %T') $*" >>"$LOG"; }
 
 while :; do
   # bounded: --remaining only reads the ledger, but every python in
-  # this env imports jax via sitecustomize — never trust it unbounded
+  # this env imports jax via sitecustomize — never trust it unbounded.
+  # rc matters: a timeout/crash also yields empty stdout, which must
+  # NOT read as "all captured" (that would exit the watcher during
+  # exactly the dead-tunnel condition it exists to poll through)
   rem=$(cd "$REPO" && timeout 120 python tools/tpu_capture.py --remaining)
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    say "--remaining probe failed rc=$rc; retrying next cycle"
+    sleep "$SLEEP_S"
+    continue
+  fi
   if [ -z "$rem" ]; then
     say "all stages captured; watcher exiting"
     exit 0
