@@ -1,0 +1,177 @@
+"""Realistic-scale stress problem (VERDICT r4 item 7): a
+NANOGrav-like single pulsar — 10k TOAs over 12 yr, ~100 free DMX
+windows, 5 receivers each carrying its own EFAC/EQUAD/ECORR, per-
+receiver JUMPs and FDJUMPs, ELL1 binary, achromatic red noise + DM
+noise — fit end-to-end with the production downhill configuration.
+This exercises maskParameter scaling and compile-key behavior at
+real-PTA free-parameter counts (~124 free / 125 design columns),
+which the 40-parameter north-star shape never does. Reference fixture analog: the NANOGrav
+9/12.5-yr per-pulsar par/tim pairs (SURVEY §4.1).
+
+Run: python bench_stress.py  (prints one JSON line; shares bench.py's
+hang-proof probe/fallback protocol). The slow-marked test
+tests/test_stress_fixture.py runs the same build at reduced size.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import sys
+import time
+import warnings
+
+RECEIVERS = ("rcvr800", "rcvr1400", "rcvr2100", "guppi", "puppi")
+
+
+def build_stress_problem(ntoa=10_000, ndmx=100, seed=7,
+                         span=(53000.0, 57383.0)):
+    """(model, toas, truth): simulated NANOGrav-like dataset with
+    injected noise drawn from the model's own covariance."""
+    import numpy as np
+
+    from bench import _clustered_mjds
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+    span0, span1 = span
+    par = [
+        "PSR J1600-3053x",
+        "RAJ 16:00:51.90 1", "DECJ -30:53:49.3 1",
+        "PMRA -0.95 1", "PMDEC -6.9 1", "PX 0.5 1",
+        "F0 277.9377112429746 1", "F1 -7.3387e-16 1",
+        "DM 52.33", "DM1 0", "DM2 0",
+        "PEPOCH 55000", "POSEPOCH 55000", "DMEPOCH 55000",
+        "TZRMJD 55000.1", "TZRSITE @", "TZRFRQ 1400", "UNITS TDB",
+        "BINARY ELL1", "PB 14.348466 1", "A1 8.8016531 1",
+        "TASC 55000.2 1", "EPS1 2.0e-4 1", "EPS2 -1.7e-4 1",
+        "M2 0.27 1", "SINI 0.87 1",
+    ]
+    # per-receiver white noise (maskParameter families)
+    for i, r in enumerate(RECEIVERS):
+        par.append(f"EFAC -be {r} {1.0 + 0.05 * i}")
+        par.append(f"EQUAD -be {r} {0.1 + 0.05 * i}")
+        par.append(f"ECORR -be {r} {0.4 + 0.1 * i}")
+    # per-receiver JUMP (first receiver is the un-jumped reference)
+    for r in RECEIVERS[1:]:
+        par.append(f"JUMP -be {r} 1e-6 1")
+    # per-receiver FDJUMP order 1+2 on two receivers (profile
+    # evolution per backend)
+    for r in RECEIVERS[3:]:
+        par.append(f"FDJUMP -be {r} 1e-6 1")
+        par.append(f"FD2JUMP -be {r} 5e-7 1")
+    # global FD
+    par.append("FD1 1e-5 1")
+    par.append("FD2 -4e-6 1")
+    # red + DM noise
+    par.append("TNREDAMP -14.2")
+    par.append("TNREDGAM 3.8")
+    par.append("TNREDC 30")
+    par.append("TNDMAMP -13.6")
+    par.append("TNDMGAM 2.9")
+    par.append("TNDMC 30")
+    # ~ndmx free DMX windows tiling the span
+    import numpy as _np
+
+    edges = _np.linspace(span0, span1, ndmx + 1)
+    for i in range(ndmx):
+        par.append(f"DMX_{i + 1:04d} 0.0 1")
+        par.append(f"DMXR1_{i + 1:04d} {edges[i]:.4f}")
+        par.append(f"DMXR2_{i + 1:04d} {edges[i + 1]:.4f}")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO("\n".join(par) + "\n"))
+        rng = np.random.default_rng(seed)
+        mjds = _clustered_mjds(span0, span1, ntoa)
+        # 4 distinct sub-bands per receiver epoch cluster with
+        # per-TOA channel jitter — REQUIRED, not decoration: with
+        # only two distinct frequencies {offset, FD1, FD2} (and each
+        # receiver's {JUMP, FDJUMP, FD2JUMP}) span a two-point space,
+        # making the normal matrix exactly singular and the
+        # Cholesky-only device step garbage-prone. Clustered epochs
+        # so the per-receiver ECORR quantization has real structure;
+        # flags passed INTO the simulation so the flag-selected
+        # noise models shape the injected draw
+        freqs = (np.tile([430.0, 820.0, 1400.0, 2100.0], ntoa // 4)
+                 * (1.0 + rng.uniform(-0.06, 0.06, ntoa)))
+        flags = [{"be": RECEIVERS[(i // 4) % len(RECEIVERS)]}
+                 for i in range(ntoa)]
+        toas = make_fake_toas_fromMJDs(
+            mjds, model, error_us=0.3, freq_mhz=freqs,
+            add_noise=True, add_correlated_noise=True, rng=rng,
+            flags=flags)
+    truth = {"F0": model.F0.value, "PB": model.PB.value}
+    # perturb so the fit has real work to do
+    model.F0.add_delta(3e-11)
+    model.get_param("JUMP1").value += 2e-7
+    model.invalidate_cache(params_only=True)
+    return model, toas, truth
+
+
+def main():
+    import os
+
+    if not os.environ.get("PINT_TPU_BENCH_FALLBACK") and \
+            os.environ.get("PALLAS_AXON_POOL_IPS"):
+        from bench import accelerator_responsive, cpu_fallback_env
+
+        if not accelerator_responsive():
+            print("accelerator unresponsive; re-running on CPU",
+                  file=sys.stderr)
+            os.execvpe(sys.executable,
+                       [sys.executable, __file__] + sys.argv[1:],
+                       cpu_fallback_env())
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from pint_tpu.config import enable_compile_cache
+
+    enable_compile_cache(
+        "PINT_TPU_BENCH_JIT_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_compile_cache"))
+
+    t0 = time.perf_counter()
+    model, toas, truth = build_stress_problem()
+    build_s = time.perf_counter() - t0
+    nfree = len(model.free_params)
+    print(f"built: {toas.ntoas} TOAs, {nfree} free params "
+          f"({build_s:.0f}s)", file=sys.stderr)
+
+    from pint_tpu.gls import DeviceDownhillGLSFitter
+
+    # warm-up fit on a structurally identical model so the timed run
+    # measures the fit, not the one-time XLA compile (the compile key
+    # covers structure only; a rebuilt model reuses it)
+    import io as _io
+
+    from pint_tpu.models import get_model as _gm
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        warm_model = _gm(_io.StringIO(model.as_parfile()))
+    DeviceDownhillGLSFitter(toas, warm_model).fit_toas(maxiter=12)
+    print("warm-up fit done", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    fit = DeviceDownhillGLSFitter(toas, model)
+    chi2 = fit.fit_toas(maxiter=12)
+    wall = time.perf_counter() - t0
+    dof = toas.ntoas - nfree - 1
+    ok = abs(model.F0.value - truth["F0"]) < \
+        5 * float(model.F0.uncertainty)
+    rec = {"metric": "stress_nanograv_like_10k_fit",
+           "value": round(toas.ntoas * fit.stats.iterations / wall, 1),
+           "unit": "TOA/s", "ntoa": toas.ntoas, "nfree": nfree,
+           "fit_wall_s": round(wall, 2),
+           "iterations": fit.stats.iterations,
+           "chi2_dof": round(chi2 / dof, 4),
+           "f0_recovered_5sigma": bool(ok),
+           "backend": jax.default_backend()}
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

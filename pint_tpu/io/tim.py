@@ -274,13 +274,19 @@ def _parse_tim_stream(source, st: dict, _depth: int = 0):
             toa = _parse_format1_line(parts)
         elif line.startswith(" ") and line[41:42] == ".":
             toa = _parse_parkes_line(line)
-        elif line[14:15] == "." and not line[2:9].strip():
-            # ITOA column signature (checked before free-form: an
-            # ITOA line tokenizes numerically and the free-form
-            # parser would mis-assign its fields)
-            toa = _parse_itoa_line(line)
         else:
-            toa = _parse_format1_line(parts)
+            toa = None
+            if line[14:15] == "." and not line[2:9].strip():
+                # ITOA column signature, checked before free-form: a
+                # real ITOA line tokenizes numerically and the
+                # free-form parser would mis-assign its fields. On a
+                # near-miss (signature matches but the columns don't
+                # parse as ITOA) fall THROUGH to free-form — e.g. a
+                # short-name free-form line whose frequency decimal
+                # point happens to land in column 15.
+                toa = _parse_itoa_line(line)
+            if toa is None:
+                toa = _parse_format1_line(parts)
             if toa is None:
                 toa = _parse_princeton_line(line)
         if toa is None:

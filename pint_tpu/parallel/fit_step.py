@@ -167,7 +167,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
     # them (before mean subtraction), so the device fitters cannot
     # silently ignore a PHASE command the host path honors. Constant
     # in the parameters, so the Jacobian paths are untouched.
-    padd_np = np.array([float(f.get("padd", 0.0)) for f in toas.flags])
+    padd_np = np.array(toas.get_flag_value("padd", 0.0, float))
     has_padd = bool(np.any(padd_np != 0.0))
     if has_padd:
         sc = {**sc, "padd": jnp.asarray(padd_np)}

@@ -64,8 +64,7 @@ class Residuals:
         # (flag -padd, turns; reference: Residuals applies padd in
         # calc_phase_resids — a phase command inserts whole/fractional
         # turns into the residual, not a time shift)
-        padd = np.array([float(f.get("padd", 0.0))
-                         for f in self.toas.flags])
+        padd = np.array(self.toas.get_flag_value("padd", 0.0, float))
         if np.any(padd != 0.0):
             full = full + padd
         if self.subtract_mean:
