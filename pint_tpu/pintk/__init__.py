@@ -34,6 +34,7 @@ def main(argv=None) -> int:
     except ImportError as e:  # pragma: no cover - env without Tk
         raise SystemExit(f"pintk needs tkinter: {e}")
 
+    from pint_tpu.pintk.fitbox import FitboxWidget
     from pint_tpu.pintk.paredit import ParWidget
     from pint_tpu.pintk.plk import PlkWidget
     from pint_tpu.pintk.timedit import TimWidget
@@ -45,11 +46,21 @@ def main(argv=None) -> int:
     plk = PlkWidget(root, pulsar)
     plk.frame.pack(side=tk.LEFT, fill=tk.BOTH, expand=1)
 
+    fitbox = FitboxWidget(root, pulsar, on_apply=plk.update_plot)
+    fitbox.frame.pack(side=tk.LEFT, fill=tk.Y)
+    # GUI jumps / par edits can add or free parameters; the fitbox
+    # must rebuild its checkbutton set or Apply would re-freeze them
+    plk.on_model_change = fitbox.refresh
+
+    def _applied():
+        plk.update_plot()
+        fitbox.refresh()
+
     side = tk.Frame(root)
     side.pack(side=tk.RIGHT, fill=tk.BOTH)
-    par = ParWidget(side, pulsar, on_apply=plk.update_plot)
+    par = ParWidget(side, pulsar, on_apply=_applied)
     par.frame.pack(side=tk.TOP, fill=tk.BOTH, expand=1)
-    tim = TimWidget(side, pulsar, on_apply=plk.update_plot)
+    tim = TimWidget(side, pulsar, on_apply=_applied)
     tim.frame.pack(side=tk.BOTTOM, fill=tk.BOTH, expand=1)
 
     root.mainloop()

@@ -17,7 +17,8 @@ from pint_tpu.pintk.colormodes import point_colors
 
 __all__ = ["PlkState", "PlkWidget", "XAXIS_CHOICES", "YAXIS_CHOICES"]
 
-XAXIS_CHOICES = ["mjd", "orbital_phase", "serial", "frequency"]
+XAXIS_CHOICES = ["mjd", "year", "day_of_year", "orbital_phase",
+                 "serial", "frequency", "toa_error", "elongation"]
 YAXIS_CHOICES = ["residual", "residual_phase"]
 
 
@@ -56,6 +57,15 @@ class PlkState:
         data["jump_ids"] = self._jump_ids()
         if self.xaxis == "mjd":
             x = data["mjds"]
+        elif self.xaxis == "year":
+            # Julian-epoch year (reference plk "year" axis)
+            x = 2000.0 + (data["mjds"] - 51544.5) / 365.25
+        elif self.xaxis == "day_of_year":
+            # days since the most recent Jan 1 (UTC, civil-year
+            # approximation adequate for a plot axis)
+            yr = np.floor((data["mjds"] - 51544.0) / 365.25)
+            jan1 = 51544.0 + yr * 365.25
+            x = data["mjds"] - np.floor(jan1)
         elif self.xaxis == "orbital_phase":
             x = data.get("orbital_phase")
             if x is None:
@@ -65,6 +75,13 @@ class PlkState:
             x = np.arange(len(data["mjds"]), dtype=float)
         elif self.xaxis == "frequency":
             x = data["freqs"]
+        elif self.xaxis == "toa_error":
+            x = data["errors_us"]
+        elif self.xaxis == "elongation":
+            x = data.get("elongation")
+            if x is None:
+                raise ValueError("no solar-elongation data (TOAs "
+                                 "lack Sun positions)")
         else:
             raise ValueError(f"unknown x axis {self.xaxis!r}")
         y = data["resids_us"]
@@ -179,7 +196,12 @@ class PlkState:
 
 
 class PlkWidget:
-    """Tk shell over PlkState (requires a display)."""
+    """Tk shell over PlkState (requires a display). Set
+    ``on_model_change`` to be notified after actions that can change
+    the model's parameter structure (fit/jump/unjump/undo) — the
+    fitbox refreshes its checkbuttons from it."""
+
+    on_model_change = None
 
     def __init__(self, master, pulsar):
         import tkinter as tk
@@ -251,15 +273,21 @@ class PlkWidget:
         self.state.zoom_out()
         self.update_plot()
 
+    def _model_changed(self):
+        if self.on_model_change:
+            self.on_model_change()
+
     def fit(self):
         self.state.pulsar.fit()
         self.state.clear_random_models()
         self.update_plot()
+        self._model_changed()
 
     def undo(self):
         self.state.pulsar.undo()
         self.state.clear_random_models()  # TOA count may have changed
         self.update_plot()
+        self._model_changed()
 
     def delete(self):
         self.state.pulsar.delete_TOAs()
@@ -269,10 +297,12 @@ class PlkWidget:
     def jump(self):
         self.state.pulsar.jump_selection()
         self.update_plot()
+        self._model_changed()  # may have added a free JUMP param
 
     def unjump(self):
         self.state.pulsar.unjump_selection()
         self.update_plot()
+        self._model_changed()
 
     def track_pn(self):
         self.state.pulsar.compute_pulse_numbers()

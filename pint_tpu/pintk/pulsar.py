@@ -205,6 +205,71 @@ class Pulsar:
         self.all_toas._touch()
         self.track_mode = None
 
+    # ------------------------------------------------- fit-param box
+
+    def fittable_params(self) -> list:
+        """Parameter names the fit checkbox column offers (reference:
+        pintk's fitbox): every value-carrying numeric parameter kind
+        that the fitters can take a derivative against."""
+        from pint_tpu.models.parameter import (AngleParameter,
+                                               MJDParameter,
+                                               floatParameter)
+
+        out = []
+        for nm in self.model.params:
+            p = self.model.get_param(nm)
+            if getattr(p, "value", None) is None:
+                continue
+            if isinstance(p, (floatParameter, MJDParameter,
+                              AngleParameter)):
+                out.append(nm)
+        return out
+
+    def set_fit_params(self, names) -> None:
+        """Freeze/unfreeze so that exactly ``names`` are free
+        (reference: the pintk fitbox apply path). Names that are not
+        fittable raise (a silently-ignored name would freeze
+        everything and fail far from the cause); the structure change
+        drops compiled fits and the cached fitter."""
+        names = set(names)
+        fittable = self.fittable_params()
+        unknown = names - set(fittable)
+        if unknown:
+            raise KeyError(
+                f"not fittable parameter(s): {sorted(unknown)}")
+        for nm in fittable:
+            p = self.model.get_param(nm)
+            p.frozen = nm not in names
+        self.model.invalidate_cache()
+        self._fitter_obj = None  # stale structure (like delete/jump)
+
+    # ----------------------------------------------------- TOA info
+
+    def toa_info(self, index: int) -> dict:
+        """Everything the plk click-info popup shows for one TOA
+        (reference: plk's per-point info): MJD, freq, error, obs,
+        flags, pre/post-fit residual, and its serial index. Reuses
+        the Residuals most recently computed by plot_data (every GUI
+        redraw refreshes it), so a click-info popup doesn't pay an
+        O(N) model evaluation for one scalar."""
+        t = self.all_toas
+        i = int(index)
+        res = getattr(self, "_last_resids", None)
+        if res is None or len(res.time_resids) != t.ntoas:
+            res = (self.postfit_resids if self.fitted
+                   else self.prefit_resids)
+        return {
+            "index": i,
+            "mjd": float(np.asarray(t.get_mjds())[i]),
+            "freq_mhz": float(np.asarray(t.get_freqs())[i]),
+            "error_us": float(np.asarray(t.get_errors())[i]),
+            "obs": t.get_obss()[i],
+            "name": t.names[i] if getattr(t, "names", None) else "",
+            "flags": dict(t.flags[i]),
+            "resid_us": float(res.time_resids[i] * 1e6),
+            "selected": bool(self.selected[i]),
+        }
+
     # ------------------------------------------------------------ fit
 
     def _make_fitter(self):
@@ -254,6 +319,7 @@ class Pulsar:
         binary), selection mask."""
         res = (self.postfit_resids if postfit and self.fitted
                else self.prefit_resids)
+        self._last_resids = res  # reused by toa_info (O(1) popup)
         mjds = np.asarray(self.all_toas.get_mjds())
         data = {
             "mjds": mjds,
@@ -277,6 +343,20 @@ class Pulsar:
             t0 = _opt("T0")
         if pb and t0:
             data["orbital_phase"] = np.mod((mjds - t0) / pb, 1.0)
+        # solar elongation [deg] (reference plk axis): angle between
+        # the observatory->Sun and observatory->pulsar directions
+        sun = getattr(self.all_toas, "obs_sun_pos", None)
+        if sun is not None:
+            sun = np.asarray(sun)
+            try:  # _host_psr_dir owns the astrometry dispatch
+                n = self.model._host_psr_dir(self.all_toas)
+            except (KeyError, ValueError):
+                n = None  # no astrometry component: no elongation
+            if n is not None:
+                cosd = np.sum(sun * n, axis=-1) / \
+                    np.linalg.norm(sun, axis=-1)
+                data["elongation"] = np.degrees(
+                    np.arccos(np.clip(cosd, -1.0, 1.0)))
         return data
 
     # -------------------------------------------------------- file IO

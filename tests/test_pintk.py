@@ -296,3 +296,49 @@ def test_plk_state_random_models_overlay(psr):
     assert st.random_curves is None
     st.clear_random_models()
     assert st.overlay_arrays(x) == []
+
+
+def test_plk_extra_axes(psr):
+    """Round-5 axis parity: year, day-of-year, toa_error, elongation
+    (reference plk axis choices)."""
+    from pint_tpu.pintk.plk import XAXIS_CHOICES, PlkState
+
+    st = PlkState(psr)
+    data = psr.plot_data(postfit=False)
+    assert "elongation" in data
+    assert np.all((data["elongation"] >= 0)
+                  & (data["elongation"] <= 180))
+    for ax in XAXIS_CHOICES:
+        st.set_axis(xaxis=ax)
+        x, y, _, _ = st.xy()
+        assert len(x) == len(y)
+        assert np.all(np.isfinite(x)), ax
+    st.set_axis(xaxis="year")
+    x, _, _, _ = st.xy()
+    assert np.all((x > 1990) & (x < 2040))
+    st.set_axis(xaxis="day_of_year")
+    x, _, _, _ = st.xy()
+    assert np.all((x >= 0) & (x < 367))
+
+
+def test_fitbox_and_toa_info(psr):
+    """Round-5 facade parity: the fitbox param toggle and the
+    per-TOA click-info dict (reference: pintk fitbox + plk info)."""
+    fp = psr.fittable_params()
+    assert "F0" in fp and "PB" in fp and "PSR" not in fp
+    before = set(psr.model.free_params)
+    try:
+        psr.set_fit_params(["F0", "F1"])
+        assert set(psr.model.free_params) == {"F0", "F1"}
+        with pytest.raises(KeyError):
+            psr.set_fit_params(["F0", "NOPE"])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            psr.fit()  # structure change recompiles and still fits
+    finally:
+        psr.set_fit_params(before)
+    info = psr.toa_info(3)
+    assert info["index"] == 3
+    assert info["freq_mhz"] > 0 and info["error_us"] > 0
+    assert isinstance(info["flags"], dict)
+    assert np.isfinite(info["resid_us"])
