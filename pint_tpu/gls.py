@@ -24,6 +24,7 @@ as the benchmark denominator (BASELINE.md measurement protocol).
 from __future__ import annotations
 
 import time
+import warnings
 from functools import partial
 from typing import Optional
 
@@ -508,6 +509,35 @@ class DeviceDownhillGLSFitter(GLSFitter):
         self.update_model(
             np.concatenate([np.zeros(noff), delta_f64]), names)
         self.set_uncertainties(cov, names)
+        # degeneracy detector: at a genuine optimum the final
+        # proposed GLS correction is <~1 sigma of its own reported
+        # uncertainty. "Converged" with a HUGE proposed-but-rejected
+        # step means the quadratic model and the chi2 surface
+        # disagree — the Cholesky-only device solve produced a
+        # non-descent direction, which is what a (near-)singular
+        # design does (measured failure: an FD/FDJUMP model with only
+        # two distinct frequencies stalls at chi2/dof ~2-6 while the
+        # host SVD-capable fitters reach ~1). Warn and point at the
+        # fallback rather than silently reporting the stall as a fit.
+        with np.errstate(invalid="ignore", divide="ignore"):
+            sig_steps = np.abs(np.asarray(dp, np.float64)) / \
+                np.sqrt(np.abs(np.diagonal(np.asarray(cov))))
+        # non-finite entries ARE the most degenerate outcome (a NaN
+        # step after the first dispatch passes the entry guard): flag
+        # them instead of letting nanmax swallow them silently
+        bad = bool(sig_steps.size) and \
+            not np.all(np.isfinite(sig_steps))
+        finite = sig_steps[np.isfinite(sig_steps)]
+        worst = float(finite.max()) if finite.size else 0.0
+        if converged and (bad or worst > 1e3):
+            warnings.warn(
+                f"device downhill converged but the last proposed "
+                f"correction is "
+                f"{'non-finite' if bad else f'{worst:.1e} sigma'} — "
+                f"the system is likely singular/degenerate (collinear "
+                f"design columns?); prefer GLSFitter/"
+                f"DownhillGLSFitter (SVD fallback) for this model",
+                RuntimeWarning, stacklevel=2)
         # final host refresh at the accepted optimum: residuals and
         # the ML noise realization (the device step returns neither
         # the basis amplitudes nor DM residuals)
