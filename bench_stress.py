@@ -25,9 +25,16 @@ RECEIVERS = ("rcvr800", "rcvr1400", "rcvr2100", "guppi", "puppi")
 
 
 def build_stress_problem(ntoa=10_000, ndmx=100, seed=7,
-                         span=(53000.0, 57383.0)):
+                         span=(53000.0, 57383.0), dm_noise=True):
     """(model, toas, truth): simulated NANOGrav-like dataset with
-    injected noise drawn from the model's own covariance."""
+    injected noise drawn from the model's own covariance.
+
+    ``dm_noise=False`` drops the PLDMNoise term — required for the
+    wideband variant: attach_wideband_dm generates DM measurements
+    from the DETERMINISTIC model DM, so a DM-noise realization
+    injected into the arrival times would contradict the DM channel
+    (the times say DM wiggles, the channel says it doesn't) and
+    inflate chi2 by construction."""
     import numpy as np
 
     from bench import _clustered_mjds
@@ -47,11 +54,14 @@ def build_stress_problem(ntoa=10_000, ndmx=100, seed=7,
         "TASC 55000.2 1", "EPS1 2.0e-4 1", "EPS2 -1.7e-4 1",
         "M2 0.27 1", "SINI 0.87 1",
     ]
-    # per-receiver white noise (maskParameter families)
+    # per-receiver white noise (maskParameter families); the DM-side
+    # scalings only engage in wideband mode (attach_wideband_dm)
     for i, r in enumerate(RECEIVERS):
         par.append(f"EFAC -be {r} {1.0 + 0.05 * i}")
         par.append(f"EQUAD -be {r} {0.1 + 0.05 * i}")
         par.append(f"ECORR -be {r} {0.4 + 0.1 * i}")
+    par.append("DMEFAC -be rcvr1400 1.1")
+    par.append("DMEQUAD -be guppi 1e-4")
     # per-receiver JUMP (first receiver is the un-jumped reference)
     for r in RECEIVERS[1:]:
         par.append(f"JUMP -be {r} 1e-6 1")
@@ -67,9 +77,10 @@ def build_stress_problem(ntoa=10_000, ndmx=100, seed=7,
     par.append("TNREDAMP -14.2")
     par.append("TNREDGAM 3.8")
     par.append("TNREDC 30")
-    par.append("TNDMAMP -13.6")
-    par.append("TNDMGAM 2.9")
-    par.append("TNDMC 30")
+    if dm_noise:
+        par.append("TNDMAMP -13.6")
+        par.append("TNDMGAM 2.9")
+        par.append("TNDMC 30")
     # ~ndmx free DMX windows tiling the span
     import numpy as _np
 
@@ -109,6 +120,31 @@ def build_stress_problem(ntoa=10_000, ndmx=100, seed=7,
     return model, toas, truth
 
 
+def attach_wideband_dm(model, toas, rng=None):
+    """Attach per-TOA wideband DM measurements (-pp_dm/-pp_dme flags)
+    consistent with the model's own DM at each TOA, plus white
+    measurement noise — turning the stress problem into a wideband
+    joint [time; DM] fit (reference: the NANOGrav wideband data
+    convention)."""
+    import numpy as np
+
+    rng = rng or np.random.default_rng(17)
+    dm = model.total_dm(toas)
+    # quoted per-TOA DM sigma is 2e-4; the injected draw must come
+    # from the MODEL's DM-channel covariance, i.e. the
+    # DMEFAC/DMEQUAD-scaled sigma (self-consistency contract of this
+    # fixture) — set the flags first so scaled_dm_uncertainty sees
+    # the quoted values, then perturb by the scaled draw
+    for f in toas.flags:
+        f["pp_dme"] = "2e-4"
+        f["pp_dm"] = "0"  # placeholder until the draw below
+    sig = np.asarray(model.scaled_dm_uncertainty(toas), np.float64)
+    for i, f in enumerate(toas.flags):
+        # repr(float(...)): numpy-2 scalar repr is "np.float64(x)",
+        # which the flag consumers can't parse back
+        f["pp_dm"] = repr(float(dm[i] + rng.normal(0.0, sig[i])))
+
+
 def main():
     import os
 
@@ -133,12 +169,16 @@ def main():
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_compile_cache"))
 
+    wideband = "--wideband" in sys.argv
+
     t0 = time.perf_counter()
-    model, toas, truth = build_stress_problem()
+    model, toas, truth = build_stress_problem(dm_noise=not wideband)
+    if wideband:
+        attach_wideband_dm(model, toas)
     build_s = time.perf_counter() - t0
     nfree = len(model.free_params)
     print(f"built: {toas.ntoas} TOAs, {nfree} free params "
-          f"({build_s:.0f}s)", file=sys.stderr)
+          f"wideband={wideband} ({build_s:.0f}s)", file=sys.stderr)
 
     from pint_tpu.gls import DeviceDownhillGLSFitter
 
@@ -152,17 +192,19 @@ def main():
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         warm_model = _gm(_io.StringIO(model.as_parfile()))
-    DeviceDownhillGLSFitter(toas, warm_model).fit_toas(maxiter=12)
+    DeviceDownhillGLSFitter(toas, warm_model,
+                            wideband=wideband).fit_toas(maxiter=12)
     print("warm-up fit done", file=sys.stderr)
 
     t0 = time.perf_counter()
-    fit = DeviceDownhillGLSFitter(toas, model)
+    fit = DeviceDownhillGLSFitter(toas, model, wideband=wideband)
     chi2 = fit.fit_toas(maxiter=12)
     wall = time.perf_counter() - t0
-    dof = toas.ntoas - nfree - 1
+    dof = fit.stats.dof
     ok = abs(model.F0.value - truth["F0"]) < \
         5 * float(model.F0.uncertainty)
-    rec = {"metric": "stress_nanograv_like_10k_fit",
+    rec = {"metric": "stress_nanograv_like_10k_fit"
+                     + ("_wideband" if wideband else ""),
            "value": round(toas.ntoas * fit.stats.iterations / wall, 1),
            "unit": "TOA/s", "ntoa": toas.ntoas, "nfree": nfree,
            "fit_wall_s": round(wall, 2),

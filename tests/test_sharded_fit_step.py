@@ -85,13 +85,14 @@ def test_sharded_matches_unsharded(problem):
 
 
 @pytest.mark.slow
-def test_long_context_sharded_step():
+@pytest.mark.parametrize("n", [32768, 131072])
+def test_long_context_sharded_step(n):
     """SURVEY §5 long-context: the TOA axis is the sequence axis and
     the sharded Woodbury must scale to N far beyond a single shard's
-    comfort — 32k TOAs block-sharded over the 8-device mesh, with the
-    normal-equation reduction riding psum (the ring-reduce over ICI
-    on real hardware). Oracle: same chi2 and parameter step as the
-    unsharded build."""
+    comfort — 32k and 131k TOAs block-sharded over the 8-device mesh,
+    with the normal-equation reduction riding psum (the ring-reduce
+    over ICI on real hardware). Oracle: same chi2 and parameter step
+    as the unsharded build."""
     par = [
         "PSR J0002+0002", "RAJ 09:00:00.0 1", "DECJ 10:00:00.0 1",
         "F0 311.0 1", "F1 -3e-15 1", "PEPOCH 55000",
@@ -100,7 +101,6 @@ def test_long_context_sharded_step():
         "EFAC -be X 1.05", "TNREDAMP -13.6", "TNREDGAM 3.2",
         "TNREDC 15",
     ]
-    n = 32768
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         model = get_model(io.StringIO("\n".join(par) + "\n"))

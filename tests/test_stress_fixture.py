@@ -71,3 +71,24 @@ def test_full_stress_fit_10k():
     assert 0.8 < chi2 / dof < 1.2
     assert abs(model.F0.value - truth["F0"]) < \
         5 * float(model.F0.uncertainty)
+
+
+def test_reduced_stress_wideband_fit():
+    """The stress problem as a wideband joint [time; DM] fit (flags
+    attached by attach_wideband_dm, self-consistent with the model's
+    own DM): the production device wideband path converges with sane
+    chi2 over the stacked dof."""
+    from bench_stress import attach_wideband_dm
+
+    from pint_tpu.gls import DeviceDownhillGLSFitter
+
+    model, toas, truth = build_stress_problem(ntoa=1600, ndmx=30,
+                                              seed=12, dm_noise=False)
+    attach_wideband_dm(model, toas)
+    fit = DeviceDownhillGLSFitter(toas, model, wideband=True)
+    chi2 = fit.fit_toas(maxiter=12)
+    dof = fit.stats.dof
+    assert dof == 2 * toas.ntoas - len(model.free_params) - 1
+    assert 0.8 < chi2 / dof < 1.2
+    assert abs(model.F0.value - truth["F0"]) < \
+        5 * float(model.F0.uncertainty)
