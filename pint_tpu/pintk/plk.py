@@ -90,8 +90,10 @@ class PlkState:
             f0 = self.pulsar.model.F0.value
             y = y * 1e-6 * f0
             yerr = yerr * 1e-6 * f0
-        return np.asarray(x, dtype=float), np.asarray(y), \
-            np.asarray(yerr), data
+        out = (np.asarray(x, dtype=float), np.asarray(y),
+               np.asarray(yerr), data)
+        self._last_xy = out[:2]  # reused by nearest_point (O(1) pick)
+        return out
 
     def colors(self, data) -> list:
         return point_colors(self.color_mode, data)
@@ -184,6 +186,44 @@ class PlkState:
             out.append((x, np.asarray(curve) * 1e6))
         return out
 
+    def nearest_point(self, x, y=None,
+                      max_frac: float = 0.02) -> Optional[int]:
+        """Index of the plotted point nearest (x, y) in the current
+        axis coordinates, or None if nothing is within ``max_frac``
+        of the VISIBLE span (a click on empty space selects nothing,
+        and a zoomed view picks what's under the cursor, not an
+        off-screen point). Reuses the arrays of the last xy() call —
+        update_plot just computed them — so a pick costs no model
+        evaluation."""
+        cached = getattr(self, "_last_xy", None)
+        if cached is None or \
+                len(cached[0]) != self.pulsar.all_toas.ntoas:
+            self.xy()  # none cached / stale after a TOA edit
+            cached = self._last_xy
+        px, py = cached
+        # normalize by (and restrict the pick to) the current view
+        if self.xlim is not None:
+            sx = self.xlim[1] - self.xlim[0] or 1.0
+        else:
+            sx = np.ptp(px) or 1.0
+        if y is not None and self.ylim is not None:
+            sy = self.ylim[1] - self.ylim[0] or 1.0
+        else:
+            sy = np.ptp(py) or 1.0
+        vis = np.ones(len(px), dtype=bool)
+        if self.xlim is not None:
+            vis &= (px >= self.xlim[0]) & (px <= self.xlim[1])
+        if self.ylim is not None:
+            vis &= (py >= self.ylim[0]) & (py <= self.ylim[1])
+        if not vis.any():
+            return None
+        d2 = ((px - x) / sx) ** 2
+        if y is not None:
+            d2 = d2 + ((py - y) / sy) ** 2
+        d2 = np.where(vis, d2, np.inf)
+        i = int(np.argmin(d2))
+        return i if float(np.sqrt(d2[i])) <= max_frac else None
+
     def title(self, data: Optional[dict] = None) -> str:
         if data is None:
             data = self.pulsar.plot_data(postfit=self.pulsar.fitted
@@ -245,6 +285,10 @@ class PlkWidget:
         self.canvas = FigureCanvasTkAgg(self.fig, master=self.frame)
         self.canvas.get_tk_widget().pack(side=tk.TOP, fill=tk.BOTH,
                                          expand=1)
+        # middle-click a point -> per-TOA info popup (reference: the
+        # plk click-info behavior); all content comes from the
+        # headless Pulsar.toa_info
+        self.canvas.mpl_connect("button_press_event", self._on_click)
         NavigationToolbar2Tk(self.canvas, self.frame)
         # left-drag: box selection; right-drag: zoom (reference plk
         # bindings); both are thin event shims over PlkState
@@ -263,6 +307,26 @@ class PlkWidget:
                                     eclick.ydata, erelease.ydata,
                                     extend=eclick.key == "shift")
         self.update_plot()
+
+    def _on_click(self, event):
+        if event.button != 2 or event.inaxes is not self.ax \
+                or event.xdata is None:
+            return
+        idx = self.state.nearest_point(event.xdata, event.ydata)
+        if idx is None:
+            return
+        info = self.state.pulsar.toa_info(idx)
+        import tkinter.messagebox as mb
+
+        lines = [f"TOA #{info['index']}  {info['name']}",
+                 f"MJD {info['mjd']:.8f}",
+                 f"freq {info['freq_mhz']:.3f} MHz",
+                 f"resid {info['resid_us']:.3f} us "
+                 f"+- {info['error_us']:.3f}",
+                 f"obs {info['obs']}"]
+        lines += [f"-{k} {v}" for k, v in
+                  sorted(info["flags"].items())]
+        mb.showinfo("TOA info", "\n".join(lines))
 
     def _on_zoom(self, eclick, erelease):
         self.state.zoom_rectangle(eclick.xdata, erelease.xdata,

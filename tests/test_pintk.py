@@ -342,3 +342,42 @@ def test_fitbox_and_toa_info(psr):
     assert info["freq_mhz"] > 0 and info["error_us"] > 0
     assert isinstance(info["flags"], dict)
     assert np.isfinite(info["resid_us"])
+
+
+def test_plk_nearest_point_pick(psr):
+    """Headless click-pick: nearest_point returns the right index in
+    current-axis coordinates and None on empty space (backs the Tk
+    middle-click TOA-info popup)."""
+    from pint_tpu.pintk.plk import PlkState
+
+    st = PlkState(psr)
+    st.set_axis(xaxis="mjd")
+    x, y, _, _ = st.xy()
+    k = 7
+    assert st.nearest_point(float(x[k]), float(y[k])) == k
+    # x-only pick (no y): still finds the point
+    assert st.nearest_point(float(x[k])) is not None
+    # far off the data span: no pick
+    assert st.nearest_point(float(x.max() + 10 * np.ptp(x))) is None
+    info = psr.toa_info(st.nearest_point(float(x[k]), float(y[k])))
+    assert info["index"] == k
+
+
+def test_plk_nearest_point_zoom_aware(psr):
+    """Zoomed pick: normalization and candidate set follow the VIEW,
+    so an off-screen point can't win and empty visible space picks
+    nothing."""
+    from pint_tpu.pintk.plk import PlkState
+
+    st = PlkState(psr)
+    st.set_axis(xaxis="serial")
+    x, y, _, _ = st.xy()
+    # zoom to the first three points only
+    st.zoom_rectangle(-0.5, 2.5)
+    k = st.nearest_point(2.0, float(y[2]))
+    assert k == 2
+    # point 30 is outside the view: clicking near the view edge must
+    # not return it
+    k2 = st.nearest_point(2.5, float(y[30]))
+    assert k2 in (None, 0, 1, 2)
+    st.reset_view()
