@@ -1,0 +1,103 @@
+"""Property-based fuzz of the .tim command-stream parser (SURVEY §4.3
+property-test layer): random interleavings of TOA lines and commands
+must preserve the stream invariants however they compose — the parser
+state machine (pint_tpu/io/tim.py) has no "weird order" escape
+hatches. Complements tests/test_tim_torture.py's exact-value cases.
+"""
+
+import hypothesis.strategies as st
+import numpy as np
+from hypothesis import given, settings
+
+from pint_tpu.io.tim import parse_tim
+
+# commands the fuzzer interleaves (each a line factory taking rng-ish
+# draws; kept to values that keep every TOA parseable)
+_toa_counter = [0]
+
+
+def _toa_line(freq, err):
+    _toa_counter[0] += 1
+    return (f"t{_toa_counter[0]} {freq:.3f} "
+            f"5{3000 + _toa_counter[0] % 999}.{_toa_counter[0] % 10}"
+            f"00000 {err:.3f} gbt")
+
+
+line_strategy = st.one_of(
+    st.tuples(st.just("toa"),
+              st.floats(400.0, 3000.0, allow_nan=False),
+              st.floats(0.5, 9.0, allow_nan=False)),
+    st.tuples(st.just("TIME"), st.floats(-2.0, 2.0, allow_nan=False),
+              st.just(0)),
+    st.tuples(st.just("PHASE"), st.integers(-3, 3), st.just(0)),
+    st.tuples(st.just("EFAC"), st.floats(0.5, 3.0, allow_nan=False),
+              st.just(0)),
+    st.tuples(st.just("EQUAD"), st.floats(0.0, 5.0, allow_nan=False),
+              st.just(0)),
+    st.tuples(st.just("SKIP"), st.just(0), st.just(0)),
+    st.tuples(st.just("NOSKIP"), st.just(0), st.just(0)),
+    st.tuples(st.just("JUMP"), st.just(0), st.just(0)),
+    st.tuples(st.just("FORMAT"), st.just(1), st.just(0)),
+)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(line_strategy, min_size=1, max_size=40))
+def test_tim_stream_invariants(items):
+    _toa_counter[0] = 0
+    lines = ["FORMAT 1"]
+    # replay the command semantics independently to predict flags
+    time_off = 0.0
+    phase = 0.0
+    efac, equad = 1.0, 0.0
+    skipping = False
+    jump_on = False
+    expected = []  # (name, err_scaled, to, padd, jumped)
+    for kind, a, b in items:
+        if kind == "toa":
+            line = _toa_line(a, b)
+            lines.append(line)
+            if not skipping:
+                name = line.split()[0]
+                # the line carries %.3f-rounded values; the oracle
+                # must start from what the parser actually reads
+                b_line = float(f"{b:.3f}")
+                err = (b_line * efac) ** 2 + equad ** 2
+                expected.append((name, err ** 0.5, time_off, phase,
+                                 jump_on))
+        else:
+            lines.append(f"{kind} {a}".strip()
+                         if kind not in ("SKIP", "NOSKIP", "JUMP")
+                         else kind)
+            if skipping and kind != "NOSKIP":
+                continue
+            if kind == "TIME":
+                time_off += a
+            elif kind == "PHASE":
+                phase += a
+            elif kind == "EFAC":
+                efac = a
+            elif kind == "EQUAD":
+                equad = a
+            elif kind == "SKIP":
+                skipping = True
+            elif kind == "NOSKIP":
+                skipping = False
+            elif kind == "JUMP":
+                jump_on = not jump_on
+
+    toas = parse_tim("\n".join(lines) + "\n")
+    assert len(toas) == len(expected)
+    for t, (name, err, to, padd, jumped) in zip(toas, expected):
+        assert t.name == name
+        np.testing.assert_allclose(t.error_us, err, rtol=1e-12)
+        if to != 0.0:
+            np.testing.assert_allclose(float(t.flags["to"]), to,
+                                       rtol=0, atol=1e-12)
+        else:
+            assert "to" not in t.flags
+        if padd != 0.0:
+            assert float(t.flags["padd"]) == padd
+        else:
+            assert "padd" not in t.flags
+        assert ("tim_jump" in t.flags) == jumped
