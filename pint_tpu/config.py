@@ -19,9 +19,31 @@ from typing import Optional
 
 __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "obs_override", "enable_compile_cache", "solve_device",
-           "solve_scope", "dispatch_rtt_ms", "auto_steps_per_dispatch"]
+           "solve_scope", "dispatch_rtt_ms", "auto_steps_per_dispatch",
+           "serve_bucket_edges", "serve_window_s", "serve_max_batch",
+           "serve_queue_cap"]
 
 _RTT_MS: dict = {}
+_WARNED_ENV: set = set()
+
+
+def _env_number(name: str, default, cast=float):
+    """Parse a numeric env override, warning (once per distinct bad
+    value) instead of silently ignoring a typo — the ADVICE round-5
+    failure mode for $PINT_TPU_DISPATCH_RTT_MS."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        if (name, raw) not in _WARNED_ENV:
+            _WARNED_ENV.add((name, raw))
+            from pint_tpu.logging import log
+
+            log.warning("unparsable $%s=%r; using %r", name, raw,
+                        default)
+        return default
 
 
 def dispatch_rtt_ms() -> float:
@@ -32,22 +54,21 @@ def dispatch_rtt_ms() -> float:
     tunnel (measured round 4). The device fitters size their
     steps-per-dispatch chaining from it instead of a hard-coded 8.
     Override with $PINT_TPU_DISPATCH_RTT_MS (a float) to skip the
-    measurement."""
+    measurement — read BEFORE the per-backend cache so a mid-process
+    override (or a changed one) takes effect immediately; an
+    unparsable value logs a warning instead of silently falling back
+    (ADVICE round 5)."""
     import time
 
     import jax
     import jax.numpy as jnp
 
+    env = _env_number("PINT_TPU_DISPATCH_RTT_MS", None)
+    if env is not None:
+        return float(env)
     backend = jax.default_backend()
     if backend in _RTT_MS:
         return _RTT_MS[backend]
-    env = os.environ.get("PINT_TPU_DISPATCH_RTT_MS")
-    if env:
-        try:
-            _RTT_MS[backend] = float(env)
-            return _RTT_MS[backend]
-        except ValueError:
-            pass
     f = jax.jit(lambda x: x + 1.0)
     x = jnp.asarray(0.0)
     float(f(x))  # compile + first dispatch
@@ -230,3 +251,56 @@ def ephem_dir() -> Optional[Path]:
 def obs_override() -> Optional[Path]:
     d = os.environ.get("PINT_TPU_OBS_OVERRIDE")
     return Path(d) if d else None
+
+
+# ---------------------------------------------------------- serving
+
+
+def serve_bucket_edges() -> tuple:
+    """TOA-count bucket edges for the serve layer's shape classes
+    (pint_tpu.serve.bucket): requests pad up to the smallest edge
+    that fits, so compiled-executable count is bounded by the edge
+    count. Default: powers of two 64..16384 (the 64 floor keeps tiny
+    requests from fragmenting into many micro-classes; 16384 covers
+    the NANOGrav-scale stress shape). Override with
+    $PINT_TPU_SERVE_BUCKETS, a comma-separated ascending int list."""
+    raw = os.environ.get("PINT_TPU_SERVE_BUCKETS")
+    if raw:
+        try:
+            edges = tuple(sorted(int(x) for x in raw.split(",")
+                                 if x.strip()))
+            if edges and all(e > 0 for e in edges):
+                return edges
+        except ValueError:
+            pass
+        if ("PINT_TPU_SERVE_BUCKETS", raw) not in _WARNED_ENV:
+            _WARNED_ENV.add(("PINT_TPU_SERVE_BUCKETS", raw))
+            from pint_tpu.logging import log
+
+            log.warning("unparsable $PINT_TPU_SERVE_BUCKETS=%r; "
+                        "using defaults", raw)
+    return tuple(64 * 2 ** k for k in range(9))  # 64..16384
+
+
+def serve_window_s() -> float:
+    """Coalescing window of the threaded serving loop [s]: how long
+    the scheduler holds the first request of a burst open for
+    batchmates. Default 5 ms — several multiples of a local dispatch
+    RTT (so coalescing actually wins) while staying far inside any
+    human-facing latency budget. $PINT_TPU_SERVE_WINDOW_MS
+    overrides (milliseconds)."""
+    return float(_env_number("PINT_TPU_SERVE_WINDOW_MS", 5.0)) / 1e3
+
+
+def serve_max_batch() -> int:
+    """Max requests coalesced into one dispatch (the batch axis also
+    pads to a power of two <= this). $PINT_TPU_SERVE_MAX_BATCH."""
+    return max(1, int(_env_number("PINT_TPU_SERVE_MAX_BATCH", 64,
+                                  cast=int)))
+
+
+def serve_queue_cap() -> int:
+    """Admission-queue capacity; a full queue rejects submits with
+    ServeOverload (backpressure). $PINT_TPU_SERVE_QUEUE_CAP."""
+    return max(1, int(_env_number("PINT_TPU_SERVE_QUEUE_CAP", 4096,
+                                  cast=int)))

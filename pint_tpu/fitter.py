@@ -143,11 +143,19 @@ class Fitter:
         return self.stats
 
     @staticmethod
-    def auto(toas, model, downhill=True, device=None, **kw):
+    def auto(toas, model, downhill=True, device=None, serve=None,
+             **kw):
         """Pick a fitter from model contents and data (reference:
         Fitter.auto): wideband when TOAs carry -pp_dm DM channels, GLS
         when correlated-noise components are present, WLS otherwise;
         downhill wrappers by default.
+
+        ``serve`` routes the fit through a running
+        ``pint_tpu.serve.ServeEngine``: the returned ServeGLSFitter
+        submits each iteration as a FitStepRequest, so this fit's
+        solves coalesce with whatever else the engine is batching
+        (the serving deployment's fit path — one padded vmapped
+        dispatch amortizes the RTT across concurrent fits).
 
         ``device`` selects the DeviceDownhillGLSFitter — whole
         downhill fits as one jitted kernel per trial. Default: auto-on
@@ -159,6 +167,21 @@ class Fitter:
 
         from pint_tpu.wideband import has_wideband_dm
 
+        if serve is not None:
+            if device:
+                raise ValueError(
+                    "serve= and device=True are exclusive: the serve "
+                    "path batches solves across requests, the device "
+                    "path chains iterations within one request")
+            if has_wideband_dm(toas):
+                raise ValueError(
+                    "serve= cannot fit wideband TOAs: the batched "
+                    "serve solve has no [time; DM] stacked system — "
+                    "dropping the DM channels silently would corrupt "
+                    "the fit. Use Fitter.auto without serve=")
+            from pint_tpu.serve import ServeGLSFitter
+
+            return ServeGLSFitter(toas, model, engine=serve, **kw)
         wideband = has_wideband_dm(toas)
         if device and not downhill:
             raise ValueError(

@@ -276,7 +276,8 @@ def _parse_tim_stream(source, st: dict, _depth: int = 0):
             toa = _parse_parkes_line(line)
         else:
             toa = None
-            if line[14:15] == "." and not line[2:9].strip():
+            itoa_sig = line[14:15] == "." and not line[2:9].strip()
+            if itoa_sig:
                 # ITOA column signature, checked before free-form: a
                 # real ITOA line tokenizes numerically and the
                 # free-form parser would mis-assign its fields. On a
@@ -285,10 +286,32 @@ def _parse_tim_stream(source, st: dict, _depth: int = 0):
                 # short-name free-form line whose frequency decimal
                 # point happens to land in column 15.
                 toa = _parse_itoa_line(line)
+                fell_through = toa is None
+            else:
+                fell_through = False
             if toa is None:
                 toa = _parse_format1_line(parts)
             if toa is None:
                 toa = _parse_princeton_line(line)
+            if toa is not None and fell_through:
+                # ITOA-signature line swallowed by a fallback parser:
+                # only accept it when the resulting MJD is plausible.
+                # A truncated/misaligned ITOA line tokenizes
+                # numerically with SWAPPED fields (verified, ADVICE
+                # round 5: a 57-char ITOA-like line free-form-parsed
+                # with mjd='5.00', freq=50123.88) — an implausible
+                # MJD is that swap, not a real TOA, and must fail at
+                # the parse site instead of poisoning the dataset.
+                try:
+                    mjd_f = float(toa.mjd_str)
+                except ValueError:
+                    mjd_f = float("nan")
+                if not (15000.0 <= mjd_f <= 100000.0):
+                    raise ValueError(
+                        f"ambiguous ITOA-like line (free-form "
+                        f"fallback produced implausible MJD "
+                        f"{toa.mjd_str!r} — truncated or misaligned "
+                        f"ITOA columns?): {line!r}")
         if toa is None:
             raise ValueError(f"unparseable TOA line: {line!r}")
         if st["time_offset_s"] != 0.0:

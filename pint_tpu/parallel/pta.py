@@ -64,13 +64,29 @@ def build_problem(toas, model, track_mode=None) -> PulsarProblem:
                          nvec, F, phi, names, model=model, toas=toas)
 
 
-def stack_problems(problems: Sequence[PulsarProblem]):
+def stack_problems(problems: Sequence[PulsarProblem],
+                   shape: Optional[Tuple[int, int, int, int]] = None):
     """Pad every pulsar to the batch maxima and stack:
-    returns dict of (P, ...) arrays."""
+    returns dict of (P, ...) arrays.
+
+    ``shape`` optionally fixes the padded target (P, N, pmax, qmax) —
+    each component must be >= the batch's own maximum. The serve
+    layer's shape-bucketing passes it so heterogeneous request batches
+    land on a bounded set of compiled shapes instead of one shape per
+    batch; extra batch slots beyond len(problems) are fully padded
+    pulsars (valid = pvalid = 0, unit nvec/phi), which the masked
+    kernel solves to the identity system (dparams 0, chi2 0)."""
     P = len(problems)
     N = max(p.M.shape[0] for p in problems)
     pmax = max(p.M.shape[1] for p in problems)
     qmax = max(p.F.shape[1] for p in problems)
+    if shape is not None:
+        Pt, Nt, pt, qt = shape
+        if Pt < P or Nt < N or pt < pmax or qt < qmax:
+            raise ValueError(
+                f"target shape {shape} smaller than batch maxima "
+                f"({P}, {N}, {pmax}, {qmax})")
+        P, N, pmax, qmax = Pt, Nt, pt, qt
     M = np.zeros((P, N, pmax))
     F = np.zeros((P, N, qmax))
     phi = np.ones((P, qmax))
@@ -94,7 +110,13 @@ def stack_problems(problems: Sequence[PulsarProblem]):
 
 def _solve_one(M, F, phi, r, nvec, valid, pvalid):
     """Masked, preconditioned basis-Woodbury solve for one pulsar
-    (same algebra as pint_tpu.gls._gls_kernel with padding guards)."""
+    (same algebra as pint_tpu.gls._gls_kernel with padding guards).
+
+    Returns (dparams, cov, chi2, chi2r): ``chi2`` is the linearized
+    post-fit chi2 (parameters AND bases marginalized); ``chi2r`` is
+    the chi2 of the residuals at the CURRENT point with only the
+    noise bases marginalized — the quantity Residuals.chi2 reports
+    (r^T C^-1 r), which the serve layer's residual requests return."""
     p = M.shape[1]
     w = valid / nvec
     M = M * pvalid[None, :]
@@ -120,10 +142,26 @@ def _solve_one(M, F, phi, r, nvec, valid, pvalid):
     xhat = jax.scipy.linalg.cho_solve(cf, b / d) / d
     inv = jax.scipy.linalg.cho_solve(
         cf, jnp.eye(Sigma.shape[0])) / jnp.outer(d, d)
-    chi2 = jnp.sum(r * r * w) - xhat @ b
+    rCr = jnp.sum(r * r * w)
+    chi2 = rCr - xhat @ b
+    # bases-only marginalization (see _gls_core's chi2): whiten by the
+    # noise block alone so chi2r is r^T C^-1 r at the current point.
+    # On an all-padded batch slot (q columns with unit prior, zero
+    # data) the basis block is the identity and chi2r collapses to 0.
+    q = F.shape[1]
+    if q:
+        bF = b[p:]
+        SF = Sigma[p:, p:]
+        dF = d[p:]
+        cfF = jax.scipy.linalg.cho_factor(SF / jnp.outer(dF, dF),
+                                          lower=True)
+        chi2r = rCr - bF @ (jax.scipy.linalg.cho_solve(
+            cfF, bF / dF) / dF)
+    else:
+        chi2r = rCr
     dparams = -xhat[:p] / colmax / norm * pvalid
     cov = inv[:p, :p] / jnp.outer(colmax, colmax) / jnp.outer(norm, norm)
-    return dparams, cov, chi2
+    return dparams, cov, chi2, chi2r
 
 
 _pta_kernel = jax.jit(jax.vmap(_solve_one))
@@ -177,7 +215,7 @@ def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,
                     for t, m in pairs]
         stacked = stack_problems(problems)
         t0 = _time.perf_counter()
-        dparams, cov, chi2 = pta_solve(stacked, mesh=mesh)
+        dparams, cov, chi2, _ = pta_solve(stacked, mesh=mesh)
         solve_s += _time.perf_counter() - t0
         for k, pr in enumerate(problems):
             names = pr.names
@@ -192,7 +230,7 @@ def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,
                 for t, m in pairs]
     stacked = stack_problems(problems)
     t0 = _time.perf_counter()
-    dparams, cov, chi2 = pta_solve(stacked, mesh=mesh)
+    dparams, cov, chi2, _ = pta_solve(stacked, mesh=mesh)
     solve_s += _time.perf_counter() - t0
     for k, pr in enumerate(problems):
         errs = {}

@@ -1,0 +1,265 @@
+"""Offline serving daemon: JSONL requests on stdin -> coalesced
+batched dispatches -> JSONL results on stdout.
+
+The demo surface of ``pint_tpu.serve``: each input line is one
+request; the threaded ServeEngine coalesces whatever arrives within
+the window into padded vmapped dispatches. Request forms:
+
+    {"kind": "fit_step",  "par": P, "tim": T, "id": ..., "deadline_ms": ...}
+    {"kind": "residuals", "par": P, "tim": T, ...}
+    {"kind": "phase", "par": P, "mjds": [...], "obs": "@",
+     "seg_min": 60.0, ...}
+
+(par, tim) pairs are loaded once and cached — repeated requests
+against the same pulsar are the serving-state hot path, paying only
+the batched solve. Phase requests generate (and cache) polycos
+covering the requested MJDs, then split the MJDs per segment into
+PhasePredictRequests. ``--demo N`` synthesizes an N-request
+mixed-shape workload instead of reading stdin.
+
+One JSON result line per request (input order NOT guaranteed — lines
+carry the request id); the final line is the engine metrics snapshot
+({"metric": "serve_session", ...}).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+
+__all__ = ["main"]
+
+
+def _load_pair(cache, par, tim):
+    key = ("pair", par, tim)
+    if key not in cache:
+        import warnings
+
+        from pint_tpu.models import get_model_and_toas
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            cache[key] = get_model_and_toas(par, tim)
+    return cache[key]
+
+
+def _polycos_for(cache, par, obs, mjd_lo, mjd_hi, seg_min):
+    key = ("polyco", par, obs, round(mjd_lo, 6), round(mjd_hi, 6),
+           seg_min)
+    if key not in cache:
+        import warnings
+
+        from pint_tpu.models import get_model
+        from pint_tpu.polycos import Polycos
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            model = get_model(par)
+            cache[key] = Polycos.generate_polycos(
+                model, mjd_lo, mjd_hi, obs, seg_length_min=seg_min)
+    return cache[key]
+
+
+def _submit_line(engine, cache, rec, emit):
+    """Parse one request record and submit it; wire result emission
+    through the future's done-callback so the daemon never blocks on
+    a single request."""
+    import numpy as np
+
+    from pint_tpu.serve import (
+        FitStepRequest,
+        PhasePredictRequest,
+        ResidualsRequest,
+    )
+
+    rid = rec.get("id")
+    kind = rec.get("kind", "fit_step")
+    deadline_s = rec["deadline_ms"] / 1e3 \
+        if rec.get("deadline_ms") is not None else None
+
+    def finish(kind):
+        def cb(fut):
+            out = {"id": rid, "kind": kind}
+            try:
+                res = fut.result(timeout=0)
+            except Exception as e:
+                out.update(ok=False, error=f"{type(e).__name__}: {e}")
+                emit(out)
+                return
+            out["ok"] = True
+            if kind == "fit_step":
+                out["chi2"] = res.chi2
+                out["chi2_prefit"] = res.chi2r
+                out["dparams"] = {n: float(v) for n, v in
+                                  zip(res.names, res.dparams)}
+                out["errors"] = res.errors()
+            elif kind == "residuals":
+                out["chi2"] = res.chi2
+                out["rms_us"] = res.rms_us
+                out["n"] = len(res.time_resids)
+            else:
+                out["phase_int"] = np.asarray(res.phase_int).tolist()
+                out["phase_frac"] = np.asarray(res.phase_frac).tolist()
+            emit(out)
+        return cb
+
+    if kind in ("fit_step", "residuals"):
+        model, toas = _load_pair(cache, rec["par"], rec["tim"])
+        cls = FitStepRequest if kind == "fit_step" else ResidualsRequest
+        fut = engine.submit(cls(toas, model, deadline_s=deadline_s))
+        fut.add_done_callback(finish(kind))
+        return 1
+    if kind == "phase":
+        mjds = np.atleast_1d(np.asarray(rec["mjds"], np.float64))
+        seg_min = float(rec.get("seg_min", 60.0))
+        pad = seg_min / 1440.0
+        pcs = _polycos_for(cache, rec["par"], rec.get("obs", "@"),
+                           float(mjds.min()) - pad,
+                           float(mjds.max()) + pad, seg_min)
+        idx = pcs._entry_for(mjds)
+        nsub = 0
+        for s in np.unique(idx):
+            fut = engine.submit(PhasePredictRequest(
+                pcs.entries[int(s)], mjds[idx == s],
+                deadline_s=deadline_s))
+            fut.add_done_callback(finish("phase"))
+            nsub += 1
+        return nsub
+    raise ValueError(f"unknown request kind {kind!r}")
+
+
+def _demo_requests(n: int):
+    """Synthesize a mixed-shape workload: small simulated pulsars in
+    three TOA-count classes + polyco phase reads."""
+    import io
+    import warnings
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.polycos import PolycoEntry
+    from pint_tpu.serve import (
+        FitStepRequest,
+        PhasePredictRequest,
+        ResidualsRequest,
+    )
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    sizes = (50, 100, 200)
+    pairs = []
+    for k, ntoa in enumerate(sizes):
+        par = (f"PSR J{1200 + k}\nRAJ 12:0{k}:00.0 1\n"
+               f"DECJ 30:0{k}:00.0 1\nF0 {150.0 + 31.0 * k} 1\n"
+               f"F1 -1e-15 1\nPEPOCH 55000\nPOSEPOCH 55000\n"
+               f"DM {10 + k} 1\nTZRMJD 55000.1\nTZRSITE @\n"
+               f"TZRFRQ 1400\nUNITS TDB\n")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(io.StringIO(par))
+            t = make_fake_toas_uniform(
+                54000, 56000, ntoa, m, error_us=1.0, add_noise=True,
+                rng=np.random.default_rng(k))
+        m.F0.add_delta(1e-10)
+        m.invalidate_cache(params_only=True)
+        pairs.append((m, t))
+    entry = PolycoEntry(psrname="DEMO", tmid=55000.0, rphase_int=1e9,
+                        rphase_frac=0.25, f0=200.0, obs="@",
+                        span_min=60.0,
+                        coeffs=np.array([0.02, 1e-3, -2e-5, 1e-7]))
+    reqs = []
+    for i in range(n):
+        m, t = pairs[i % len(pairs)]
+        if i % 7 == 6:
+            mjds = 55000.0 + np.linspace(-0.01, 0.01, 24)
+            reqs.append(("phase", PhasePredictRequest(entry, mjds)))
+        elif i % 3 == 2:
+            reqs.append(("residuals", ResidualsRequest(t, m)))
+        else:
+            reqs.append(("fit_step", FitStepRequest(t, m)))
+    return reqs
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pint_serve",
+        description="JSONL serving daemon over the coalescing "
+                    "batch scheduler (pint_tpu.serve)")
+    p.add_argument("--window-ms", type=float, default=None,
+                   help="coalescing window (default "
+                        "$PINT_TPU_SERVE_WINDOW_MS or 5)")
+    p.add_argument("--max-batch", type=int, default=None)
+    p.add_argument("--queue-cap", type=int, default=None)
+    p.add_argument("--demo", type=int, default=None, metavar="N",
+                   help="serve N synthesized mixed requests instead "
+                        "of reading stdin")
+    args = p.parse_args(argv)
+
+    from pint_tpu.config import enable_user_compile_cache
+
+    enable_user_compile_cache()
+
+    from pint_tpu.serve import ServeEngine
+
+    engine = ServeEngine(
+        window_s=None if args.window_ms is None
+        else args.window_ms / 1e3,
+        max_batch=args.max_batch, queue_cap=args.queue_cap)
+
+    out_lock = threading.Lock()
+    pending = threading.Semaphore(0)
+    nsub = 0
+
+    def emit(obj):
+        with out_lock:
+            print(json.dumps(obj), flush=True)
+        pending.release()
+
+    if args.demo is not None:
+        reqs = _demo_requests(args.demo)
+        engine.start()
+        for kind, rq in reqs:
+            fut = engine.submit(rq)
+
+            def cb(fut, kind=kind):
+                try:
+                    fut.result(timeout=0)
+                    emit({"kind": kind, "ok": True})
+                except Exception as e:
+                    emit({"kind": kind, "ok": False, "error": repr(e)})
+            fut.add_done_callback(cb)
+            nsub += 1
+    else:
+        engine.start()
+        cache: dict = {}
+        for line in sys.stdin:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                rec = json.loads(line)
+                nsub += _submit_line(engine, cache, rec, emit)
+            except Exception as e:
+                # malformed line: report directly (NOT via emit — its
+                # semaphore release is the per-submitted-request
+                # completion count)
+                with out_lock:
+                    print(json.dumps(
+                        {"ok": False,
+                         "error": f"{type(e).__name__}: {e}",
+                         "line": line[:200]}), flush=True)
+
+    engine.stop(drain=True)
+    for _ in range(nsub):
+        pending.acquire()
+    snap = engine.metrics.snapshot()
+    snap["metric"] = "serve_session"
+    with out_lock:
+        print(json.dumps(snap), flush=True)
+    print(engine.metrics.report(), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

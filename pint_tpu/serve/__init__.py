@@ -1,0 +1,40 @@
+"""Shape-bucketed batch serving layer.
+
+The ROADMAP north star is a system serving heavy traffic; the library
+half of that is here. The moving parts (one module each):
+
+- ``serve.request``: typed requests (fit step / residuals / phase
+  prediction) with deadlines and result futures;
+- ``serve.bucket``: power-of-two shape-class bucketing + the bounded
+  executable cache (compiles scale with bucket count, not traffic);
+- ``serve.scheduler``: the coalescing ServeEngine (admission queue,
+  window batching, backpressure, single-request fallback) and the
+  ``Fitter.auto(serve=...)``-routed fitter;
+- ``serve.metrics``: per-bucket occupancy / waste / latency /
+  compile counters, fed through the profiling hooks.
+
+Entry points: ``scripts/pint_serve.py`` (stdin JSONL daemon) and
+``bench_serve.py`` (sequential-vs-coalesced throughput artifact).
+"""
+
+from pint_tpu.serve.request import (  # noqa: F401
+    DeadlineExceeded,
+    FitStepRequest,
+    FitStepResult,
+    PhasePredictRequest,
+    PhasePredictResult,
+    ResidualsRequest,
+    ResidualsResult,
+    ServeFuture,
+    ServeOverload,
+)
+from pint_tpu.serve.scheduler import (  # noqa: F401
+    ServeEngine,
+    ServeGLSFitter,
+)
+from pint_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from pint_tpu.serve.bucket import (  # noqa: F401
+    ExecutableCache,
+    bucket_for,
+    pow2_ceil,
+)

@@ -1,0 +1,204 @@
+"""Shape-class bucketing + the bounded executable cache.
+
+The continuous-batching insight from inference serving applied to
+timing: XLA compiles one executable per input SHAPE, so a naive
+serving loop compiles once per distinct request (unbounded, and each
+compile is multi-second — multi-minute over the axon tunnel). Here
+every request is padded to a shape CLASS:
+
+- the TOA/MJD axis pads to a power-of-two bucket edge
+  (``config.serve_bucket_edges``, default 64..16384);
+- the parameter and noise-basis axes pad to multiples of 8 (padded
+  columns are identity-pinned / unit-prior, exactly the
+  ``parallel.pta`` masking contract);
+- the batch (request) axis pads to a power of two up to
+  ``config.serve_max_batch`` (and to a mesh multiple when the engine
+  shards the batch axis over a device mesh).
+
+Total executables are then bounded by the product of the (few) bucket
+counts — never by the request count. ``ExecutableCache`` owns fresh
+jitted kernels (so its compile accounting is per-engine, not
+process-global) and tracks every shape class it has dispatched.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.parallel.pta import _solve_one, stack_problems
+
+__all__ = ["bucket_for", "pad_dim", "pow2_ceil", "ExecutableCache",
+           "gls_shape_class", "phase_shape_class"]
+
+
+def pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << (max(1, int(n)) - 1).bit_length()
+
+
+def bucket_for(n: int, edges: Tuple[int, ...]) -> Optional[int]:
+    """Smallest bucket edge >= n, or None when n exceeds every edge
+    (the scheduler's single-request fallback case)."""
+    for e in edges:
+        if n <= e:
+            return e
+    return None
+
+
+def pad_dim(d: int, multiple: int = 8) -> int:
+    """Pad a (parameter / basis) axis to a multiple; 0 stays 0 so
+    white-noise models don't drag a dead basis block through the
+    solve."""
+    if d == 0:
+        return 0
+    return ((d + multiple - 1) // multiple) * multiple
+
+
+def gls_shape_class(n: int, p: int, q: int, edges: Tuple[int, ...]):
+    """(kind, N_bucket, p_pad, q_pad) for a fit/residuals request —
+    or None when the TOA count exceeds every bucket edge. Fit and
+    residual requests share classes: the solve kernel is
+    structure-agnostic (it consumes padded matrices), so the
+    component-structure part of the serve cache key collapses to the
+    request kind class; the structure-sensitive compiles (the phase
+    chain per model) stay cached in the model layer
+    (``TimingModel._get_compiled``)."""
+    nb = bucket_for(n, edges)
+    if nb is None:
+        return None
+    return ("gls", nb, pad_dim(p), pad_dim(q))
+
+
+def phase_shape_class(nmjd: int, ncoeff: int, edges: Tuple[int, ...]):
+    """(kind, N_bucket, k_pad) for a phase-prediction request."""
+    nb = bucket_for(nmjd, edges)
+    if nb is None:
+        return None
+    return ("phase", nb, pad_dim(ncoeff, 4))
+
+
+def _phase_eval_one(coeffs, tmid, rphase_int, rphase_frac, f0, mjds,
+                    valid):
+    """One polyco segment's absolute phase at padded MJDs (device
+    mirror of ``polycos.PolycoEntry.abs_phase``). Horner from the
+    highest coefficient — the same evaluation order as
+    np.polynomial.polynomial.polyval, but XLA may fuse the
+    multiply-add into an FMA, so the host oracle agrees only to ~1
+    ulp of fractional phase (~1e-16 turn, orders below the 10 ps
+    oracle budget); zero-padded high coefficients contribute exact
+    zeros. Padded MJD slots carry dt=0 and are zeroed by ``valid``
+    on the way out."""
+    import jax.numpy as jnp
+
+    dt = (mjds - tmid) * 1440.0
+    poly = jnp.zeros_like(dt)
+    for i in range(coeffs.shape[0] - 1, -1, -1):
+        poly = poly * dt + coeffs[i]
+    spin = 60.0 * f0 * dt
+    spin_i = jnp.floor(spin)
+    frac = rphase_frac + (spin - spin_i) + poly
+    carry = jnp.floor(frac)
+    return (rphase_int + spin_i + carry) * valid, \
+        (frac - carry) * valid
+
+
+class ExecutableCache:
+    """Per-engine compiled-executable registry.
+
+    One fresh ``jax.jit`` wrapper per kernel kind (NOT the module
+    globals), so jit-cache growth is attributable to THIS engine: a
+    compile happens exactly when a shape class first dispatches, and
+    ``compile_count`` == the number of distinct classes seen. With a
+    ``mesh``, batch-axis inputs are placed block-sharded over
+    ``axis`` before the call (input shardings are part of XLA's cache
+    key, so a mesh engine and a local engine never share entries —
+    which is why each engine owns its wrappers)."""
+
+    def __init__(self, mesh=None, axis: str = "pulsar"):
+        import jax
+
+        self.mesh = mesh
+        self.axis = axis
+        self._gls = jax.jit(jax.vmap(_solve_one))
+        self._phase = jax.jit(jax.vmap(_phase_eval_one))
+        self.keys: set = set()
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct shape classes dispatched == executables built.
+        Cross-checkable against the jit wrappers' own cache sizes
+        (tests do)."""
+        return len(self.keys)
+
+    def jit_cache_size(self) -> Optional[int]:
+        """Sum of the underlying jit caches' entry counts, when the
+        running jax exposes it (None otherwise)."""
+        try:
+            return int(self._gls._cache_size()) + \
+                int(self._phase._cache_size())
+        except AttributeError:
+            return None
+
+    def _place(self, arrs: dict) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        if self.mesh is None:
+            return {k: jnp.asarray(v) for k, v in arrs.items()}
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out = {}
+        for k, v in arrs.items():
+            v = jnp.asarray(v)
+            sh = NamedSharding(
+                self.mesh, P(self.axis, *([None] * (v.ndim - 1))))
+            out[k] = jax.device_put(v, sh)
+        return out
+
+    def gls(self, key, problems, shape):
+        """Pad ``problems`` to the class shape (``parallel.pta``
+        masking) and solve the batch in one dispatch. Returns host
+        arrays (dparams, cov, chi2, chi2r), each (P, ...). The class
+        key is recorded only on success, so a failed dispatch cannot
+        inflate ``compile_count`` past the classes actually built."""
+        st = self._place(stack_problems(problems, shape=shape))
+        out = self._gls(st["M"], st["F"], st["phi"], st["r"],
+                        st["nvec"], st["valid"], st["pvalid"])
+        host = tuple(np.asarray(o) for o in out)
+        self.keys.add(key)
+        return host
+
+    def phase(self, key, requests, nb: int, kb: int, Pb: int):
+        """Pad phase requests to (Pb, nb) MJDs x kb coefficients and
+        evaluate the batch in one dispatch (key recorded on success,
+        as in ``gls``)."""
+        coeffs = np.zeros((Pb, kb))
+        tmid = np.zeros(Pb)
+        rpi = np.zeros(Pb)
+        rpf = np.zeros(Pb)
+        f0 = np.zeros(Pb)
+        mjds = np.zeros((Pb, nb))
+        valid = np.zeros((Pb, nb))
+        for k, rq in enumerate(requests):
+            e = rq.entry
+            c = np.asarray(e.coeffs, np.float64)
+            coeffs[k, :len(c)] = c
+            tmid[k] = e.tmid
+            rpi[k] = e.rphase_int
+            rpf[k] = e.rphase_frac
+            f0[k] = e.f0
+            m = rq.mjds
+            mjds[k, :len(m)] = m
+            mjds[k, len(m):] = e.tmid  # dt = 0 on padded slots
+            valid[k, :len(m)] = 1.0
+        arrs = self._place({"coeffs": coeffs, "tmid": tmid,
+                            "rpi": rpi, "rpf": rpf, "f0": f0,
+                            "mjds": mjds, "valid": valid})
+        pi, pf = self._phase(arrs["coeffs"], arrs["tmid"], arrs["rpi"],
+                             arrs["rpf"], arrs["f0"], arrs["mjds"],
+                             arrs["valid"])
+        pi, pf = np.asarray(pi), np.asarray(pf)
+        self.keys.add(key)
+        return pi, pf

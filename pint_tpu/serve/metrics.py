@@ -1,0 +1,193 @@
+"""Serving metrics: per-bucket counters surfaced through the existing
+profiling layer.
+
+``profiling.Scoreboard`` already accumulates named wall-clock phases
+process-wide; the serve layer feeds it (``serve.assemble`` /
+``serve.dispatch`` annotations ride ``profiling.annotate``, so they
+show up in device traces too) and adds the serving-specific view a
+scoreboard cannot express: queue depth, batch occupancy, padded-waste
+fraction, per-bucket latency quantiles, compile counts.
+
+Everything here is host bookkeeping — a few dict updates per BATCH,
+not per TOA — so it stays on unconditionally (same design stance as
+``FitStats``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+__all__ = ["BucketStats", "ServeMetrics", "percentile"]
+
+# per-bucket latency reservoir cap: enough for stable p99 at serving
+# rates while bounding memory on a long-lived engine (newest kept —
+# serving cares about current behavior, not the cold start)
+_LAT_CAP = 4096
+
+
+def percentile(sorted_xs: List[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list (no numpy
+    dependency on the hot path; empty -> nan)."""
+    if not sorted_xs:
+        return float("nan")
+    k = min(len(sorted_xs) - 1,
+            max(0, int(round(q / 100.0 * (len(sorted_xs) - 1)))))
+    return sorted_xs[k]
+
+
+def _pct_ms(sorted_xs: List[float], q: float) -> Optional[float]:
+    """percentile in ms for a JSON snapshot: None (valid JSON null)
+    when there are no samples — json.dumps would otherwise emit the
+    bare NaN token, which strict parsers reject."""
+    if not sorted_xs:
+        return None
+    return round(percentile(sorted_xs, q) * 1e3, 3)
+
+
+class BucketStats:
+    """Counters for one shape class (one executable)."""
+
+    def __init__(self):
+        self.requests = 0          # requests served through this class
+        self.batches = 0           # device dispatches
+        self.slots = 0             # padded batch slots dispatched
+        self.rows_real = 0         # real TOA/MJD rows
+        self.rows_padded = 0       # padded TOA/MJD rows incl. batch pad
+        self.latencies_s: List[float] = []  # admit -> future resolved
+
+    def record(self, nreal: int, pb: int, rows_real: int,
+               rows_padded: int, lats: List[float]):
+        self.requests += nreal
+        self.batches += 1
+        self.slots += pb
+        self.rows_real += rows_real
+        self.rows_padded += rows_padded
+        self.latencies_s.extend(lats)
+        if len(self.latencies_s) > _LAT_CAP:
+            del self.latencies_s[:-_LAT_CAP]
+
+    @property
+    def occupancy(self) -> float:
+        """Mean fraction of batch slots holding real requests."""
+        return self.requests / self.slots if self.slots else 0.0
+
+    @property
+    def padded_waste(self) -> float:
+        """Fraction of dispatched rows that were padding."""
+        tot = self.rows_padded
+        return 1.0 - self.rows_real / tot if tot else 0.0
+
+    def snapshot(self) -> dict:
+        lats = sorted(self.latencies_s)
+        return {
+            "requests": self.requests, "batches": self.batches,
+            "occupancy": round(self.occupancy, 4),
+            "padded_waste": round(self.padded_waste, 4),
+            "p50_ms": _pct_ms(lats, 50),
+            "p99_ms": _pct_ms(lats, 99),
+        }
+
+
+class ServeMetrics:
+    """Engine-wide serving counters + the per-bucket table.
+
+    ``cache`` is the engine's ExecutableCache — compile counts are
+    read from it live so the metrics can never disagree with the
+    thing that actually compiled."""
+
+    def __init__(self, cache=None):
+        self.cache = cache
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0           # backpressure (queue cap) drops
+        self.deadline_missed = 0
+        self.fallback_single = 0    # no-bucket single-request path
+        self.failed = 0             # dispatch errors propagated
+        self.max_queue_depth = 0
+        self._queue_depth = 0
+        self.buckets: Dict[tuple, BucketStats] = {}
+
+    # -- gauges --------------------------------------------------------
+
+    def queue_depth(self, depth: Optional[int] = None) -> int:
+        if depth is not None:
+            self._queue_depth = depth
+            self.max_queue_depth = max(self.max_queue_depth, depth)
+        return self._queue_depth
+
+    def bucket(self, key) -> BucketStats:
+        if key not in self.buckets:
+            self.buckets[key] = BucketStats()
+        return self.buckets[key]
+
+    @property
+    def compile_count(self) -> int:
+        return self.cache.compile_count if self.cache else 0
+
+    @property
+    def bucket_count(self) -> int:
+        """Distinct shape classes admitted — the bound the executable
+        count must respect."""
+        return len(self.buckets)
+
+    # -- reporting -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able state of the engine (the daemon prints this on
+        shutdown; bench_serve embeds it in its artifact)."""
+        all_lats = sorted(
+            x for b in self.buckets.values() for x in b.latencies_s)
+        slots = sum(b.slots for b in self.buckets.values())
+        reqs = sum(b.requests for b in self.buckets.values())
+        rows_r = sum(b.rows_real for b in self.buckets.values())
+        rows_p = sum(b.rows_padded for b in self.buckets.values())
+        return {
+            "submitted": self.submitted, "completed": self.completed,
+            "rejected": self.rejected,
+            "deadline_missed": self.deadline_missed,
+            "fallback_single": self.fallback_single,
+            "failed": self.failed,
+            "queue_depth": self._queue_depth,
+            "max_queue_depth": self.max_queue_depth,
+            "compile_count": self.compile_count,
+            "bucket_count": self.bucket_count,
+            "batch_occupancy": round(reqs / slots, 4) if slots else 0.0,
+            "padded_waste": round(1.0 - rows_r / rows_p, 4)
+            if rows_p else 0.0,
+            "p50_ms": _pct_ms(all_lats, 50),
+            "p99_ms": _pct_ms(all_lats, 99),
+            "per_bucket": {self._fmt_key(k): b.snapshot()
+                           for k, b in sorted(self.buckets.items(),
+                                              key=lambda kv: str(kv[0]))},
+        }
+
+    @staticmethod
+    def _fmt_key(key) -> str:
+        return "/".join(str(x) for x in key)
+
+    def report(self) -> str:
+        """Human-readable table (mirrors Scoreboard.report's shape)."""
+        s = self.snapshot()
+        lines = [
+            f"serve: {s['completed']}/{s['submitted']} completed, "
+            f"{s['rejected']} rejected, {s['deadline_missed']} missed "
+            f"deadline, {s['fallback_single']} single-fallback, "
+            f"{s['failed']} failed",
+            f"executables: {s['compile_count']} "
+            f"(shape classes: {s['bucket_count']}), occupancy "
+            f"{s['batch_occupancy']:.2f}, padded waste "
+            f"{s['padded_waste']:.2f}, p50 {s['p50_ms']} ms, "
+            f"p99 {s['p99_ms']} ms",
+            f"{'bucket':<28} {'reqs':>6} {'batch':>6} {'occ':>6} "
+            f"{'waste':>6} {'p50ms':>8} {'p99ms':>8}",
+        ]
+        for k, b in s["per_bucket"].items():
+            lines.append(
+                f"{k:<28} {b['requests']:>6} {b['batches']:>6} "
+                f"{b['occupancy']:>6.2f} {b['padded_waste']:>6.2f} "
+                f"{b['p50_ms']:>8} {b['p99_ms']:>8}")
+        return "\n".join(lines)
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot())

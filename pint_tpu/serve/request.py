@@ -1,0 +1,184 @@
+"""Typed serve requests and their result futures.
+
+The serving layer (SURVEY.md north star: "serving heavy traffic")
+turns the library's one-model-one-call entry points into queued,
+coalescable work items. Three request kinds exist, matching the three
+hot read paths of a timing service:
+
+- ``FitStepRequest``: one linearized GLS fit iteration (the unit
+  ``parallel.fit_step`` computes and ``parallel.pta`` batches);
+- ``ResidualsRequest``: residuals + whitened chi2 at the current
+  parameter point (rides the SAME batched solve — its chi2 is the
+  bases-only-marginalized ``chi2r`` output of ``pta._solve_one``, the
+  quantity ``Residuals.chi2`` reports);
+- ``PhasePredictRequest``: absolute-phase prediction from a polyco
+  segment (``polycos.PolycoEntry``) at arbitrary MJDs — the
+  phase-ephemeris read path (fold-mode observing, online dedispersion).
+
+Every request carries an optional relative deadline and owns a
+``ServeFuture``; the scheduler resolves the future when the request's
+batch completes (or fails it with ``DeadlineExceeded`` /
+``ServeOverload``).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["ServeFuture", "DeadlineExceeded", "ServeOverload",
+           "FitStepRequest", "ResidualsRequest", "PhasePredictRequest",
+           "FitStepResult", "ResidualsResult", "PhasePredictResult"]
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before its batch dispatched."""
+
+
+class ServeOverload(RuntimeError):
+    """Admission queue at capacity — backpressure signal to the
+    caller (shed load or retry later; the queue cap is
+    ``config.serve_queue_cap``)."""
+
+
+class ServeFuture(concurrent.futures.Future):
+    """The request's result future. On a synchronous (non-threaded)
+    engine, ``result()`` pumps the engine's queue first so a plain
+    submit-then-result sequence completes without a background
+    thread; on a started engine the inherited blocking wait applies.
+    """
+
+    _sync_engine = None  # set by ServeEngine.submit when not threaded
+
+    def result(self, timeout: Optional[float] = None):
+        if self._sync_engine is not None and not self.done():
+            self._sync_engine.flush()
+        return super().result(timeout)
+
+
+class Request:
+    """Base serve request: deadline bookkeeping + future plumbing.
+
+    ``deadline_s`` is RELATIVE (seconds from submission); the engine
+    stamps the absolute expiry at admission. ``None`` = no deadline.
+    """
+
+    kind = "?"
+
+    def __init__(self, deadline_s: Optional[float] = None):
+        self.deadline_s = deadline_s
+        self.future = ServeFuture()
+        self.admitted_at: Optional[float] = None  # time.monotonic()
+        self.expires_at: Optional[float] = None
+
+    def expired(self, now: float) -> bool:
+        return self.expires_at is not None and now > self.expires_at
+
+
+@dataclass
+class FitStepResult:
+    """One GLS correction, aligned with ``names`` (same contract as
+    ``parallel.pta.fit_pta``: dparams is the correction to ADD, an
+    implicit leading "Offset" unless the model carries PHOFF)."""
+
+    names: List[str]
+    dparams: np.ndarray
+    cov: np.ndarray
+    chi2: float       # linearized post-fit chi2
+    chi2r: float      # chi2 at the current point (bases marginalized)
+
+    def errors(self) -> Dict[str, float]:
+        sig = np.sqrt(np.diag(self.cov))
+        return {n: float(s) for n, s in zip(self.names, sig)
+                if n != "Offset"}
+
+
+@dataclass
+class ResidualsResult:
+    """Residuals at the current point plus the whitened chi2 the
+    batched solve produced (= ``Residuals.chi2`` semantics)."""
+
+    time_resids: np.ndarray   # [s]
+    chi2: float
+
+    @property
+    def rms_us(self) -> float:
+        return float(np.sqrt(np.mean(self.time_resids ** 2))) * 1e6
+
+
+@dataclass
+class PhasePredictResult:
+    """Absolute phase split (int turns, frac turns) at the request's
+    MJDs — same split as ``PolycoEntry.abs_phase``."""
+
+    phase_int: np.ndarray
+    phase_frac: np.ndarray
+
+
+class _GLSRequest(Request):
+    """Shared plumbing for the two request kinds that ride the batched
+    GLS solve. Accepts either (toas, model) — assembled at dispatch —
+    or a prebuilt ``parallel.pta.PulsarProblem`` (the serving-state
+    form: a service holding hot pulsar states assembles once and
+    re-solves on every poll, so admission stays O(1))."""
+
+    def __init__(self, toas=None, model=None, problem=None,
+                 track_mode=None, deadline_s: Optional[float] = None):
+        super().__init__(deadline_s=deadline_s)
+        if problem is None and (toas is None or model is None):
+            raise ValueError(
+                f"{type(self).__name__} needs (toas, model) or a "
+                f"prebuilt PulsarProblem")
+        self.toas = toas
+        self.model = model
+        self.track_mode = track_mode
+        self.problem = problem
+
+    def ensure_problem(self):
+        """Assemble (or return the cached) linearized problem."""
+        if self.problem is None:
+            from pint_tpu.parallel.pta import build_problem
+
+            self.problem = build_problem(self.toas, self.model,
+                                         track_mode=self.track_mode)
+        return self.problem
+
+    @property
+    def sizes(self):
+        """(ntoa, nparam, nbasis) — the shape-class inputs, read off
+        the assembled problem (assembling it first if needed: any
+        size heuristic computed without assembly could drift from
+        build_problem's real shapes and misclassify the request)."""
+        pr = self.ensure_problem()
+        return (pr.M.shape[0], pr.M.shape[1], pr.F.shape[1])
+
+
+class FitStepRequest(_GLSRequest):
+    kind = "fit_step"
+
+
+class ResidualsRequest(_GLSRequest):
+    kind = "residuals"
+
+
+class PhasePredictRequest(Request):
+    """Evaluate one polyco segment's absolute phase at ``mjds``.
+
+    The entry is host-fit once (``Polycos.generate_polycos``) and then
+    served read-only; the per-request device work is the padded,
+    vmapped polynomial evaluation in ``serve.bucket``."""
+
+    kind = "phase"
+
+    def __init__(self, entry, mjds, deadline_s: Optional[float] = None):
+        super().__init__(deadline_s=deadline_s)
+        self.entry = entry
+        self.mjds = np.atleast_1d(np.asarray(mjds, np.float64))
+
+    @property
+    def sizes(self):
+        """(nmjd, ncoeff) — the phase shape-class inputs."""
+        return (len(self.mjds), len(np.asarray(self.entry.coeffs)))

@@ -1,0 +1,349 @@
+"""Coalescing request scheduler: admission queue -> shape-class
+groups -> one padded vmapped dispatch per group.
+
+The serving loop of an inference stack, applied to timing: requests
+admitted within a coalescing window (``config.serve_window_s``) are
+grouped by compatible shape class (``serve.bucket``) and solved in
+ONE device call per group via the ``parallel.pta`` batch kernel, so a
+burst of K compatible requests pays one dispatch RTT instead of K
+(over the axon tunnel that is 0.1-0.25 s EACH — see
+``config.dispatch_rtt_ms``). Compiles are bounded by the shape-class
+count, never the request count.
+
+Operation modes:
+
+- synchronous (default): ``submit()`` queues; ``flush()`` — called
+  explicitly, or implicitly by ``ServeFuture.result()`` — drains
+  everything pending. Deterministic; what the tests and bench drive.
+- threaded: ``start()`` runs a daemon loop that waits for the first
+  request, sleeps out the coalescing window to let a batch
+  accumulate, then drains. The stdin daemon
+  (``scripts/pint_serve.py``) uses this.
+
+Backpressure: the admission queue is capped
+(``config.serve_queue_cap``); a full queue rejects the submit with
+``ServeOverload`` — shedding at admission is the only honest
+overload response when every accepted request carries a deadline.
+Expired requests are failed with ``DeadlineExceeded`` at drain time,
+before any device work is spent on them. A request whose shape fits
+no configured bucket is NOT rejected: it falls back to a
+single-request dispatch at the next power-of-two shape (counted in
+``metrics.fallback_single`` — graceful, still shape-quantized).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from pint_tpu.fitter import Fitter
+from pint_tpu.profiling import annotate
+from pint_tpu.serve.bucket import (
+    ExecutableCache,
+    gls_shape_class,
+    pad_dim,
+    phase_shape_class,
+    pow2_ceil,
+)
+from pint_tpu.serve.metrics import ServeMetrics
+from pint_tpu.serve.request import (
+    DeadlineExceeded,
+    FitStepRequest,
+    FitStepResult,
+    PhasePredictRequest,
+    PhasePredictResult,
+    ResidualsRequest,
+    ResidualsResult,
+    ServeOverload,
+)
+
+__all__ = ["ServeEngine", "ServeGLSFitter"]
+
+
+class ServeEngine:
+    """The serving engine: queue, coalescer, executable cache,
+    metrics. One engine per served deployment; its compile accounting
+    (``metrics.compile_count``) is self-contained.
+
+    ``mesh`` optionally shards every dispatch's batch axis over the
+    named mesh ``axis`` (the ``parallel.pta`` pulsar axis): batch
+    slots then pad to a mesh multiple so XLA GSPMD never sees a
+    ragged shard."""
+
+    def __init__(self, window_s: Optional[float] = None,
+                 max_batch: Optional[int] = None,
+                 queue_cap: Optional[int] = None,
+                 bucket_edges: Optional[Tuple[int, ...]] = None,
+                 mesh=None, axis: str = "pulsar"):
+        from pint_tpu import config
+
+        self.window_s = config.serve_window_s() \
+            if window_s is None else float(window_s)
+        self.max_batch = config.serve_max_batch() \
+            if max_batch is None else int(max_batch)
+        self.queue_cap = config.serve_queue_cap() \
+            if queue_cap is None else int(queue_cap)
+        self.bucket_edges = tuple(sorted(
+            config.serve_bucket_edges() if bucket_edges is None
+            else bucket_edges))
+        self.mesh = mesh
+        self.axis = axis
+        self.cache = ExecutableCache(mesh=mesh, axis=axis)
+        self.metrics = ServeMetrics(self.cache)
+        self._queue: collections.deque = collections.deque()
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._dispatch_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- admission -----------------------------------------------------
+
+    def submit(self, req):
+        """Admit a request; returns its ServeFuture. Raises
+        ServeOverload when the queue is at capacity (backpressure —
+        nothing is partially accepted)."""
+        with self._cv:
+            if len(self._queue) >= self.queue_cap:
+                self.metrics.rejected += 1
+                raise ServeOverload(
+                    f"admission queue full ({self.queue_cap}); "
+                    f"shed load or raise PINT_TPU_SERVE_QUEUE_CAP")
+            now = time.monotonic()
+            req.admitted_at = now
+            if req.deadline_s is not None:
+                req.expires_at = now + float(req.deadline_s)
+            if self._thread is None:
+                # synchronous mode: result() pumps the queue itself
+                req.future._sync_engine = self
+            self._queue.append(req)
+            self.metrics.submitted += 1
+            self.metrics.queue_depth(len(self._queue))
+            self._cv.notify()
+        return req.future
+
+    # -- draining ------------------------------------------------------
+
+    def flush(self):
+        """Drain every currently-queued request (grouping, batching
+        and dispatching as one coalesced pass). Safe from any thread;
+        dispatches are serialized."""
+        while True:
+            with self._cv:
+                if not self._queue:
+                    return
+                batch = list(self._queue)
+                self._queue.clear()
+                self.metrics.queue_depth(0)
+            with self._dispatch_lock:
+                self._process(batch)
+
+    def _process(self, reqs: List):
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.expired(now):
+                self.metrics.deadline_missed += 1
+                r.future.set_exception(DeadlineExceeded(
+                    f"{r.kind} request missed its "
+                    f"{r.deadline_s}s deadline in queue"))
+            else:
+                live.append(r)
+        groups: dict = {}
+        fallbacks = []
+        for r in live:
+            try:
+                key, fb = self._class_of(r)
+            except Exception as e:
+                self.metrics.failed += 1
+                r.future.set_exception(e)
+                continue
+            if fb:
+                fallbacks.append((key, r))
+            else:
+                groups.setdefault(key, []).append(r)
+        for key, grp in groups.items():
+            for i in range(0, len(grp), self.max_batch):
+                self._dispatch(key, grp[i:i + self.max_batch])
+        for key, r in fallbacks:
+            self.metrics.fallback_single += 1
+            self._dispatch(key, [r])
+
+    def _class_of(self, r):
+        """(shape-class key, is_fallback). GLS requests are assembled
+        here (the class must reflect the REAL problem shapes, and
+        assembly has to happen before dispatch anyway); the assembled
+        problem is cached on the request."""
+        if isinstance(r, PhasePredictRequest):
+            n, k = r.sizes
+            key = phase_shape_class(n, k, self.bucket_edges)
+            if key is None:
+                return ("phase", pow2_ceil(n), pad_dim(k, 4)), True
+            return key, False
+        with annotate("serve.assemble"):
+            pr = r.ensure_problem()
+        n, p = pr.M.shape
+        q = pr.F.shape[1]
+        key = gls_shape_class(n, p, q, self.bucket_edges)
+        if key is None:
+            return ("gls", pow2_ceil(n), pad_dim(p), pad_dim(q)), True
+        return key, False
+
+    def _batch_pad(self, P: int) -> int:
+        """Pad the batch axis to a power of two (a mesh multiple of
+        one when sharding) so batch sizes, like TOA counts, land on a
+        bounded set of compiled shapes."""
+        Pb = pow2_ceil(P)
+        if self.mesh is not None:
+            m = self.mesh.shape[self.axis]
+            Pb = m * pow2_ceil(-(-P // m))
+        return Pb
+
+    def _dispatch(self, key, grp: List):
+        """One device call for one shape-class group; scatter results
+        to the group's futures. A dispatch failure fails exactly this
+        group's futures — the engine keeps serving."""
+        Pb = self._batch_pad(len(grp))
+        full_key = key + (Pb,)
+        t0 = time.monotonic()
+        try:
+            if key[0] == "phase":
+                self._dispatch_phase(key, full_key, grp, Pb)
+            else:
+                self._dispatch_gls(key, full_key, grp, Pb)
+        except Exception as e:
+            for r in grp:
+                if not r.future.done():
+                    self.metrics.failed += 1
+                    r.future.set_exception(e)
+            return
+        done = time.monotonic()
+        lats = [done - (r.admitted_at or t0) for r in grp]
+        nb = key[1]
+        rows_real = sum(self._rows_of(r) for r in grp)
+        self.metrics.bucket(full_key).record(
+            len(grp), Pb, rows_real, Pb * nb, lats)
+        self.metrics.completed += len(grp)
+
+    @staticmethod
+    def _rows_of(r) -> int:
+        if isinstance(r, PhasePredictRequest):
+            return len(r.mjds)
+        return r.problem.M.shape[0]
+
+    def _dispatch_gls(self, key, full_key, grp, Pb):
+        _, nb, pb, qb = key
+        problems = [r.problem for r in grp]
+        with annotate("serve.dispatch"):
+            dparams, cov, chi2, chi2r = self.cache.gls(
+                full_key, problems, shape=(Pb, nb, pb, qb))
+        for k, r in enumerate(grp):
+            pr = r.problem
+            p = pr.M.shape[1]
+            if isinstance(r, ResidualsRequest):
+                res = ResidualsResult(time_resids=pr.r,
+                                      chi2=float(chi2r[k]))
+            else:
+                res = FitStepResult(
+                    names=pr.names, dparams=dparams[k][:p],
+                    cov=cov[k][:p, :p], chi2=float(chi2[k]),
+                    chi2r=float(chi2r[k]))
+            r.future.set_result(res)
+
+    def _dispatch_phase(self, key, full_key, grp, Pb):
+        _, nb, kb = key
+        with annotate("serve.dispatch"):
+            pi, pf = self.cache.phase(full_key, grp, nb, kb, Pb)
+        for k, r in enumerate(grp):
+            n = len(r.mjds)
+            r.future.set_result(PhasePredictResult(
+                phase_int=pi[k][:n], phase_frac=pf[k][:n]))
+
+    # -- threaded serving loop ----------------------------------------
+
+    def start(self):
+        """Run the coalescing loop in a daemon thread. Futures then
+        resolve asynchronously; ``ServeFuture.result(timeout)`` is
+        the blocking wait."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="pint-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True):
+        """Stop the loop; by default drain what is still queued so no
+        accepted request is silently dropped."""
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=60.0)
+            self._thread = None
+        if drain:
+            self.flush()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop.is_set():
+                    self._cv.wait(timeout=0.25)
+                if self._stop.is_set() and not self._queue:
+                    return
+            # first request seen: sleep out the coalescing window so
+            # a burst lands in one batch, but dispatch immediately
+            # once a full batch is waiting
+            deadline = time.monotonic() + self.window_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if len(self._queue) >= self.max_batch or \
+                            self._stop.is_set():
+                        break
+                time.sleep(min(1e-3, max(self.window_s, 1e-4)))
+            self.flush()
+
+
+class ServeGLSFitter(Fitter):
+    """Iterated-GLS fitter routed through a ServeEngine — the
+    ``Fitter.auto(serve=engine)`` path. Each iteration submits one
+    FitStepRequest and applies the returned correction, exactly the
+    ``fit_pta`` update loop but with the solve coalesced against
+    whatever else the engine is serving. The final chi2 is the
+    bases-marginalized chi2 at the fitted point (``Residuals.chi2``
+    semantics)."""
+
+    def __init__(self, toas, model, engine: ServeEngine,
+                 residuals=None, track_mode=None):
+        super().__init__(toas, model, residuals=residuals,
+                         track_mode=track_mode)
+        self.engine = engine
+
+    def fit_toas(self, maxiter: int = 4,
+                 timeout: Optional[float] = None):
+        from pint_tpu.residuals import Residuals
+
+        t0 = time.perf_counter()
+        res = None
+        for _ in range(max(1, maxiter)):
+            fut = self.engine.submit(FitStepRequest(
+                self.toas, self.model, track_mode=self.track_mode))
+            res = fut.result(timeout=timeout)
+            self.update_model(np.asarray(res.dparams), res.names)
+        # one more pass at the fitted point: uncertainties + chi2
+        fut = self.engine.submit(FitStepRequest(
+            self.toas, self.model, track_mode=self.track_mode))
+        res = fut.result(timeout=timeout)
+        self.set_uncertainties(np.asarray(res.cov), res.names)
+        self.resids = Residuals(self.toas, self.model,
+                                track_mode=self.track_mode)
+        self.converged = True
+        chi2 = res.chi2r
+        self._record_stats(chi2, max(1, maxiter) + 1, t0)
+        return chi2

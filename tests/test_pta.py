@@ -96,7 +96,7 @@ def test_batched_solve_matches_individual(trio):
 
     problems = [build_problem(t, m) for m, t, _ in trio]
     st = stack_problems(problems)
-    dparams, cov, chi2 = pta_solve(st)
+    dparams, cov, chi2, _ = pta_solve(st)
     for k, pr in enumerate(problems):
         x, c_ind, chi2_ind, _, _, ok = _gls_kernel(
             jnp.asarray(pr.M), jnp.asarray(pr.F), jnp.asarray(pr.phi),

@@ -5,9 +5,14 @@ state machine (pint_tpu/io/tim.py) has no "weird order" escape
 hatches. Complements tests/test_tim_torture.py's exact-value cases.
 """
 
-import hypothesis.strategies as st
 import numpy as np
-from hypothesis import given, settings
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property fuzz needs hypothesis; the "
+    "zero-egress container may not ship it")
+import hypothesis.strategies as st  # noqa: E402
+from hypothesis import given, settings  # noqa: E402
 
 from pint_tpu.io.tim import parse_tim
 

@@ -108,6 +108,27 @@ class TestITOAParsed:
         with pytest.raises(ValueError, match="unparseable"):
             parse_tim(bad + "\n")
 
+    def test_truncated_itoa_rejected_not_swallowed(self):
+        # ADVICE r5: a truncated ITOA-like line (signature matches,
+        # column parse fails) used to fall through to the free-form
+        # parser with SWAPPED fields (mjd='5.00', freq=50123.88).
+        # The implausible-MJD sanity check must fail it at the parse
+        # site instead of poisoning the dataset.
+        line = "AA       50123.8864714985  5.00  1420.0000 AO"
+        assert line[14] == "." and not line[2:9].strip()
+        with pytest.raises(ValueError, match="ambiguous ITOA-like"):
+            parse_tim(line + "\n")
+
+    def test_freeform_with_itoa_signature_still_parses(self):
+        # a short-name free-form line whose frequency decimal point
+        # lands in column 15 carries a PLAUSIBLE MJD — the fallback
+        # must keep accepting it
+        line = "aa       14200.000 50123.886471 2.00 ao"
+        assert line[14] == "." and not line[2:9].strip()
+        t = parse_tim(line + "\n")[0]
+        assert t.mjd_str == "50123.886471"
+        assert t.freq_mhz == 14200.0
+
 
 class TestFormatThreadsThroughInclude:
     def test_included_file_inherits_format1(self, tmp_path):

@@ -44,6 +44,7 @@ STAGES = {
     "config5": "config5_pta_batch_67psr",
     "pta_scale": "pta_batch_scaling",
     "stress": "stress_nanograv_like_10k_fit",
+    "serve": "serve_coalesced_vs_sequential_64req",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
 ATTR_VARIANTS = ("production", "no_hybrid_jac", "jac_f64",
@@ -232,6 +233,23 @@ def stage_stress(backend):
                        f"(rc={r.returncode}): {r.stderr[-500:]}")
 
 
+def stage_serve(backend):
+    """Serving-layer coalescing speedup ON CHIP (ISSUE 2): over the
+    axon tunnel each sequential dispatch pays the full 0.1-0.25 s
+    RTT, so this is where coalescing matters most — the CPU-mesh
+    number in BENCH_r*.json is the architectural floor."""
+    import bench_serve
+
+    rec = bench_serve.run(nreq=64, repeats=3)
+    if rec.get("backend") != backend:
+        raise RuntimeError(
+            f"bench_serve ran on {rec.get('backend')!r}, not "
+            f"{backend!r} (tunnel died?); stage stays on the "
+            f"to-do list")
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
 def run_stage(name, backend):
     bench.log(f"=== stage {name} ===")
     t0 = time.perf_counter()
@@ -253,6 +271,8 @@ def run_stage(name, backend):
         stage_pta_scale(backend)
     elif name == "stress":
         stage_stress(backend)
+    elif name == "serve":
+        stage_serve(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "

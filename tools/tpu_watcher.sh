@@ -12,6 +12,19 @@
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
 LOG=/tmp/tpu_watcher_repo.log
+
+# single-instance guard (VERDICT r5 weak #4): a respawn after a
+# presumed-dead watcher must not race the live one over the same
+# stage list (double-append + double-commit of ledger lines). The
+# lock is held on fd 9 for this process's whole lifetime; a second
+# launch exits 0 immediately. Repo-local so per-checkout watchers
+# stay independent.
+LOCKFILE="$REPO/.tpu_watcher.lock"
+exec 9>"$LOCKFILE"
+if ! flock -n 9; then
+  echo "$(date -u '+%F %T') another tpu_watcher holds $LOCKFILE; exiting" >>"$LOG"
+  exit 0
+fi
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-150}
 STAGE_TIMEOUT=${STAGE_TIMEOUT:-2400}
 SLEEP_S=${SLEEP_S:-530}
