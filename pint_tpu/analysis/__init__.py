@@ -1,0 +1,16 @@
+"""pint_tpu.analysis — invariant enforcement for the framework.
+
+Two halves (ISSUE 3 / ARCHITECTURE.md "Static analysis"):
+
+- ``graftlint``: the AST/registry linter encoding the CLAUDE.md
+  conventions as rules G1-G8 (``python -m
+  pint_tpu.analysis.graftlint``);
+- ``sanitizer``: the runtime ``Sanitizer`` context manager that counts
+  jit rebuilds per TimingModel (the "params_only must not drop the
+  jit" invariant), flags host-array operands crossing into watched
+  dispatches, and optionally NaN-checks outputs.
+"""
+
+from pint_tpu.analysis.sanitizer import Sanitizer  # noqa: F401
+
+__all__ = ["Sanitizer"]

@@ -1,0 +1,1065 @@
+"""graftlint — project-specific invariant linter for pint_tpu.
+
+Reference: the conventions in CLAUDE.md / ARCHITECTURE.md that nothing
+enforced mechanically until this pass existed (the PhaseOffset
+"silently inert" bug was caught by hand in the SINK_PAR sweep; rules
+G1-G8 make that class of bug a lint failure instead of an archaeology
+find). The GLS machinery these invariants protect is the numerically
+delicate path of van Haasteren & Vallisneri (arXiv:1407.6710): one
+silent retrace, host fallback, or dtype demotion corrupts results
+without failing a test.
+
+Rules (see ARCHITECTURE.md "Static analysis" for the table):
+
+  G1  no Python-scalar coercion (float/int/bool/.item/.tolist) of
+      traced values inside jit-reachable code — each forces a device
+      sync or bakes a trace constant (a silent retrace per value)
+  G2  no numpy calls on potentially-traced data in models/ compute
+      paths — np.* on a tracer either errors late or silently hauls
+      the computation to host
+  G3  every registered Component subclass cites its reference
+      file/symbol in the class docstring
+  G4  every numeric parameter slot has a param_dimensions() spec
+      (static: the class must define/inherit an override; dynamic:
+      bare instances and the SINK_PAR kitchen-sink model must have
+      full _spec_lookup coverage)
+  G5  hybrid-Jacobian claims are paired (linear_design_names defined
+      iff linear_design_local is) and every claiming component is
+      exercised by test_all_components.py's SINK_PAR sweep
+  G6  tools// scripts/ TPU-touching invocations are timeout-bounded:
+      shell lines invoking python carry `timeout`, subprocess calls
+      pass timeout=, in-process backend touches are preceded by a
+      bounded probe (bench.accelerator_responsive)
+  G7  jax.config.update only in sanctioned entry points (the config
+      is process-global; a stray update mid-library flips x64 or the
+      platform under every other caller)
+  G8  no functools.lru_cache/cache on methods (the cache keys `self`
+      — a model leak — and any array arg is unhashable or, worse,
+      hashed by object id: a retrace hazard)
+
+jit-reachability is inferred statically, seeded by project
+conventions: any function whose early positional parameters include
+``pv`` (the traced parameter-value dict every Component compute
+method takes), any function named as an argument of jax.jit /
+jax.vmap / jax.pmap / shard_map anywhere in the scanned tree, any
+function decorated with a jit, and the transitive closure over
+same-module calls (``self.helper(...)`` / ``helper(...)``) plus
+lexical containment (closures defined inside a traced builder).
+
+Suppression: a central allowlist (pint_tpu/analysis/allowlist.py,
+every entry carries a written justification) or an inline pragma
+``# graftlint: allow G<n> -- reason`` on the flagged line. Stale
+allowlist entries are themselves errors, so the list cannot rot.
+
+Run: ``python -m pint_tpu.analysis.graftlint [--root DIR] [--json]
+[--no-dynamic]``. Exit 0 = clean. The repo-clean gate is
+tests/test_graftlint.py::test_repo_clean (tier-1, `-m lint`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+RULES = {
+    "G1": "no scalar coercion of traced values in jit-reachable code",
+    "G2": "no numpy host calls in models/ compute paths",
+    "G3": "component class docstring must cite its reference",
+    "G4": "every numeric parameter needs a param_dimensions spec",
+    "G5": "linear-design claims paired and exercised by SINK_PAR",
+    "G6": "TPU-touching invocations must be timeout-bounded",
+    "G7": "jax.config.update only in sanctioned entry points",
+    "G8": "no functools.lru_cache on methods",
+}
+
+# entry points allowed to mutate global jax config (G7): the package
+# root (x64 contract), the config module (compile-cache knobs), and
+# this linter's own CLI (it must pin the CPU platform before the
+# dynamic zoo import, per the CLAUDE.md wedged-tunnel gotcha)
+G7_SANCTIONED = {
+    "pint_tpu/__init__.py",
+    "pint_tpu/config.py",
+    "pint_tpu/analysis/graftlint.py",
+}
+
+# component compute-path method convention: a traced function's early
+# positional params include the pv dict (CLAUDE.md "Parameter VALUES
+# are runtime args"); host methods never take pv
+PV_PARAM = "pv"
+PV_WINDOW = 3  # pv must appear among the first 3 positional params
+
+JIT_WRAPPERS = {"jit", "vmap", "pmap", "shard_map", "jacfwd", "jacrev",
+                "grad", "value_and_grad"}
+
+COERCIONS = {"float", "int", "bool", "complex"}
+COERCION_METHODS = {"item", "tolist"}
+
+NUMERIC_PARAM_CTORS = {"floatParameter", "MJDParameter",
+                       "prefixParameter", "maskParameter",
+                       "pairParameter", "AngleParameter", "floatParam"}
+
+# abstract bases never instantiated by users (mirrors
+# tests/test_all_components.py's abstract set)
+ABSTRACT_COMPONENTS = {"Component", "DelayComponent", "PhaseComponent",
+                       "NoiseComponent"}
+
+# in-process jax calls that initialize a backend (and therefore hang
+# forever on a wedged axon tunnel — CLAUDE.md environment gotchas)
+BACKEND_TOUCHES = {"devices", "local_devices", "device_count",
+                   "local_device_count", "default_backend"}
+# a module that touches the backend in-process must probe first with
+# one of these bounded helpers (bench.accelerator_responsive runs the
+# init in a subprocess under a kill timer)
+BOUNDED_PROBES = {"accelerator_responsive"}
+
+SUBPROCESS_CALLS = {"run", "check_output", "check_call", "call"}
+
+PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*allow\s+(G\d)\s*(?:--|—|:)\s*(\S.*)")
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str        # repo-relative, forward slashes
+    line: int
+    msg: str
+    snippet: str = ""
+
+    def format(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        out = f"{self.rule} {loc}: {self.msg}"
+        if self.snippet:
+            out += f"\n    {self.snippet.strip()}"
+        return out
+
+
+@dataclass
+class LintReport:
+    violations: List[Violation] = field(default_factory=list)
+    suppressed: List[Tuple[Violation, str]] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.violations
+
+
+# --------------------------------------------------------------------
+# file collection
+# --------------------------------------------------------------------
+
+def iter_lint_files(root: str):
+    """(abspath, relpath) for every file graftlint owns: the package
+    tree plus tools/ (G6 also reads the shell scripts there)."""
+    skip_dirs = {"__pycache__", ".git", ".jax_compile_cache"}
+    for sub in ("pint_tpu", "tools"):
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+            for fn in sorted(filenames):
+                if fn.endswith((".py", ".sh")):
+                    p = os.path.join(dirpath, fn)
+                    yield p, os.path.relpath(p, root).replace(os.sep, "/")
+
+
+# --------------------------------------------------------------------
+# per-module model
+# --------------------------------------------------------------------
+
+class ModuleInfo:
+    """Parsed module + parent links + function/class indexes."""
+
+    def __init__(self, relpath: str, src: str):
+        self.relpath = relpath
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=relpath)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.functions: List[ast.FunctionDef] = []
+        self.classes: List[ast.ClassDef] = []
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.append(node)
+            elif isinstance(node, ast.ClassDef):
+                self.classes.append(node)
+        self.by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for f in self.functions:
+            self.by_name.setdefault(f.name, []).append(f)
+        self.jit_funcs: Set[ast.FunctionDef] = set()
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_class(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def in_jit_region(self, node: ast.AST) -> bool:
+        cur = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+            else self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and cur in self.jit_funcs:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+
+def _decorator_is_jit(dec: ast.AST) -> bool:
+    """@jax.jit, @jit, @partial(jax.jit, ...), @functools.partial(jit)."""
+    if isinstance(dec, ast.Call):
+        f = dec.func
+        if isinstance(f, (ast.Name, ast.Attribute)) and \
+                _tail_name(f) == "partial":
+            return any(_tail_name(a) == "jit" for a in dec.args
+                       if isinstance(a, (ast.Name, ast.Attribute)))
+        return _tail_name(f) == "jit"
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        return _tail_name(dec) == "jit"
+    return False
+
+
+def _tail_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def collect_jit_seed_names(
+        modules: List[ModuleInfo]) -> Dict[str, Set[str]]:
+    """relpath -> function NAMES passed (possibly nested, e.g.
+    jax.jit(jax.vmap(_solve_one))) to a jit wrapper. Names harvested
+    in a module seed that module; names that follow the _private
+    convention additionally seed every module (cross-module case:
+    serve/bucket.py jits parallel.pta._solve_one, so _solve_one's
+    body is traced though pta.py never calls jax.jit on it). Public
+    names deliberately do NOT cross modules — `chi2`/`f` collide with
+    unrelated host helpers everywhere."""
+    per_module: Dict[str, Set[str]] = {}
+    global_private: Set[str] = set()
+
+    def harvest(call: ast.Call, names: Set[str]):
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(a, (ast.Name, ast.Attribute)):
+                t = _tail_name(a)
+                if t and not t.startswith("jax"):
+                    names.add(t)
+            elif isinstance(a, ast.Call):
+                f = a.func
+                if _tail_name(f) in JIT_WRAPPERS:
+                    harvest(a, names)
+
+    for m in modules:
+        names: Set[str] = set()
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) and \
+                    _tail_name(node.func) in JIT_WRAPPERS:
+                harvest(node, names)
+        names -= JIT_WRAPPERS
+        per_module[m.relpath] = names
+        global_private |= {n for n in names if n.startswith("_")}
+    for relpath in per_module:
+        per_module[relpath] |= global_private
+    return per_module
+
+
+def mark_jit_regions(m: ModuleInfo, global_seed_names: Set[str]):
+    """Seed + fixpoint propagation of jit-reachability (module doc)."""
+    jit: Set[ast.FunctionDef] = set()
+    for f in m.functions:
+        args = [a.arg for a in f.args.args[:PV_WINDOW + 1]]
+        if PV_PARAM in args:
+            jit.add(f)
+        if any(_decorator_is_jit(d) for d in f.decorator_list):
+            jit.add(f)
+        if f.name in global_seed_names:
+            jit.add(f)
+    # propagate: calls from jit bodies to same-module functions, by
+    # bare name or self./cls. attribute — but a callee name locally
+    # bound in the caller (parameter, assignment, loop target) is a
+    # local callable, NOT the module function of the same name
+    changed = True
+    while changed:
+        changed = False
+        for f in list(jit):
+            local = _locally_bound_names(f)
+            for node in ast.walk(f):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                fn = node.func
+                if isinstance(fn, ast.Name):
+                    if fn.id in local:
+                        continue
+                    callee = fn.id
+                elif isinstance(fn, ast.Attribute) and \
+                        isinstance(fn.value, ast.Name) and \
+                        fn.value.id in ("self", "cls"):
+                    callee = fn.attr
+                if callee is None:
+                    continue
+                for g in m.by_name.get(callee, []):
+                    if g not in jit:
+                        jit.add(g)
+                        changed = True
+    m.jit_funcs = jit
+
+
+def _locally_bound_names(f: ast.FunctionDef) -> Set[str]:
+    """Names bound inside ``f`` (params, assignments, loop/with/comp
+    targets) — shadowing any same-named module function."""
+    out = {a.arg for a in f.args.args + f.args.kwonlyargs}
+    out.update(a.arg for a in (f.args.vararg, f.args.kwarg) if a)
+    for node in ast.walk(f):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                               ast.For, ast.AsyncFor)):
+            targets = [node.target]
+        elif isinstance(node, ast.comprehension):
+            targets = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            targets = [i.optional_vars for i in node.items
+                       if i.optional_vars is not None]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+# --------------------------------------------------------------------
+# G1 / G2 — coercions and numpy in traced code
+# --------------------------------------------------------------------
+
+HOST_ATTRS = {"value", "uncertainty", "frozen", "index", "units",
+              "name", "prefix", "ndim", "size", "ref_day"}
+HOST_ROOT_MODULES = {"math", "os", "sys"}
+HOST_CALLS = {"len", "str", "repr", "ord", "range"}
+
+
+def _is_host_expr(node: ast.AST) -> bool:
+    """Conservatively: does this expression provably involve only
+    host (non-traced) data? Unknown names are NOT host — traced
+    arrays flow through locals."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        if node.attr in HOST_ATTRS:
+            return True
+        return _root_name(node) in HOST_ROOT_MODULES
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in HOST_CALLS:
+            return True
+        if isinstance(f, ast.Attribute) and \
+                _root_name(f) in HOST_ROOT_MODULES:
+            return True
+        return False
+    if isinstance(node, ast.BinOp):
+        return _is_host_expr(node.left) and _is_host_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_host_expr(node.operand)
+    if isinstance(node, ast.Subscript):
+        return _is_host_expr(node.value)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_host_expr(e) for e in node.elts)
+    if isinstance(node, ast.BoolOp):
+        return all(_is_host_expr(v) for v in node.values)
+    if isinstance(node, ast.IfExp):
+        return _is_host_expr(node.body) and _is_host_expr(node.orelse)
+    return False
+
+
+def check_g1(m: ModuleInfo) -> List[Violation]:
+    out = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call) or not m.in_jit_region(node):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in COERCIONS:
+            if node.args and _is_host_expr(node.args[0]):
+                continue
+            out.append(Violation(
+                "G1", m.relpath, node.lineno,
+                f"{fn.id}() inside jit-reachable "
+                f"{_region_name(m, node)} coerces a potentially "
+                f"traced value to a Python scalar (device sync or "
+                f"trace constant)", m.line_text(node.lineno)))
+        elif isinstance(fn, ast.Attribute) and \
+                fn.attr in COERCION_METHODS:
+            out.append(Violation(
+                "G1", m.relpath, node.lineno,
+                f".{fn.attr}() inside jit-reachable "
+                f"{_region_name(m, node)} forces a host sync on a "
+                f"potentially traced array", m.line_text(node.lineno)))
+    return out
+
+
+def _region_name(m: ModuleInfo, node: ast.AST) -> str:
+    f = m.enclosing_function(node)
+    return f"`{f.name}`" if f is not None else "module code"
+
+
+def check_g2(m: ModuleInfo) -> List[Violation]:
+    if "/models/" not in "/" + m.relpath:
+        return []
+    out = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call) or not m.in_jit_region(node):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and \
+                _root_name(fn) in ("np", "numpy"):
+            out.append(Violation(
+                "G2", m.relpath, node.lineno,
+                f"numpy call np.{fn.attr}() inside jit-reachable "
+                f"{_region_name(m, node)}: on a tracer this is a "
+                f"host fallback (breaks jit) or a late error",
+                m.line_text(node.lineno)))
+    return out
+
+
+# --------------------------------------------------------------------
+# G3 / G4(static) / G5(static) — the component zoo, via a global
+# class graph (components subclass bases imported from other modules)
+# --------------------------------------------------------------------
+
+class ClassGraph:
+    def __init__(self, modules: List[ModuleInfo]):
+        self.defs: Dict[str, Tuple[ModuleInfo, ast.ClassDef]] = {}
+        for m in modules:
+            for c in m.classes:
+                self.defs.setdefault(c.name, (m, c))
+        self.component_classes = self._closure("Component")
+
+    def _closure(self, root: str) -> Set[str]:
+        comp = {root}
+        changed = True
+        while changed:
+            changed = False
+            for name, (m, c) in self.defs.items():
+                if name in comp:
+                    continue
+                bases = {b.id if isinstance(b, ast.Name)
+                         else _tail_name(b) for b in c.bases}
+                if bases & comp:
+                    comp.add(name)
+                    changed = True
+        return comp
+
+    def is_registered_component(self, name: str) -> bool:
+        if name not in self.component_classes or \
+                name in ABSTRACT_COMPONENTS or name.startswith("_"):
+            return False
+        m, c = self.defs[name]
+        for node in c.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "register" \
+                            and isinstance(node.value, ast.Constant) \
+                            and node.value.value is False:
+                        return False
+        return True
+
+    def defines_in_body(self, name: str, method: str) -> bool:
+        m, c = self.defs[name]
+        return any(isinstance(n, ast.FunctionDef) and n.name == method
+                   for n in c.body)
+
+    def ancestors(self, name: str) -> List[str]:
+        out, todo = [], [name]
+        while todo:
+            cur = todo.pop()
+            if cur not in self.defs:
+                continue
+            _, c = self.defs[cur]
+            for b in c.bases:
+                bn = b.id if isinstance(b, ast.Name) else _tail_name(b)
+                if bn and bn not in out:
+                    out.append(bn)
+                    todo.append(bn)
+        return out
+
+    def defines_or_inherits(self, name: str, method: str) -> bool:
+        for cand in [name] + self.ancestors(name):
+            if cand == "Component":
+                continue  # the base's empty default doesn't count
+            if cand in self.defs and self.defines_in_body(cand, method):
+                return True
+        return False
+
+
+def _registers_numeric_params(graph: ClassGraph, name: str) -> bool:
+    """Does this class (or an ancestor) construct numeric Parameter
+    objects anywhere in its body (init, setup, add_* helpers)?"""
+    for cand in [name] + graph.ancestors(name):
+        if cand not in graph.defs or cand == "Component":
+            continue
+        _, c = graph.defs[cand]
+        for node in ast.walk(c):
+            if isinstance(node, ast.Call) and \
+                    _tail_name(node.func) in NUMERIC_PARAM_CTORS:
+                return True
+    return False
+
+
+def check_g3(graph: ClassGraph) -> List[Violation]:
+    out = []
+    for name, (m, c) in sorted(graph.defs.items()):
+        if not graph.is_registered_component(name):
+            continue
+        doc = ast.get_docstring(c) or ""
+        if not re.search(r"[Rr]eference", doc):
+            out.append(Violation(
+                "G3", m.relpath, c.lineno,
+                f"component {name} does not cite its reference "
+                f"file/symbol in the class docstring "
+                f"(CLAUDE.md convention)", f"class {name}(...):"))
+    return out
+
+
+def check_g4_static(graph: ClassGraph) -> List[Violation]:
+    out = []
+    for name, (m, c) in sorted(graph.defs.items()):
+        if not graph.is_registered_component(name):
+            continue
+        if not _registers_numeric_params(graph, name):
+            continue
+        if not graph.defines_or_inherits(name, "param_dimensions"):
+            out.append(Violation(
+                "G4", m.relpath, c.lineno,
+                f"component {name} registers numeric parameters but "
+                f"neither defines nor inherits a param_dimensions() "
+                f"spec (units go dimension-unchecked)",
+                f"class {name}(...):"))
+    return out
+
+
+def check_g5_static(graph: ClassGraph) -> List[Violation]:
+    out = []
+    for name, (m, c) in sorted(graph.defs.items()):
+        if name not in graph.component_classes or name == "Component":
+            continue
+        has_names = graph.defines_in_body(name, "linear_design_names")
+        has_local = graph.defines_in_body(name, "linear_design_local")
+        if has_names != has_local:
+            missing = ("linear_design_local" if has_names
+                       else "linear_design_names")
+            out.append(Violation(
+                "G5", m.relpath, c.lineno,
+                f"component {name} defines one hybrid-Jacobian hook "
+                f"but not {missing}: claims and columns must be "
+                f"declared together", f"class {name}(...):"))
+    return out
+
+
+# --------------------------------------------------------------------
+# G6 — timeout bounds in tools/ and scripts/
+# --------------------------------------------------------------------
+
+def _g6_applies(relpath: str) -> bool:
+    return relpath.startswith("tools/") or "/scripts/" in relpath
+
+
+def check_g6_python(m: ModuleInfo) -> List[Violation]:
+    """Timeout bounds in tools//scripts Python. The bounded-probe
+    requirement is module-wide and order-insensitive — a deliberate
+    approximation (static order is undecidable across call paths);
+    the probe's presence is what reviews anchor on."""
+    if not _g6_applies(m.relpath):
+        return []
+    out = []
+    has_probe = any(
+        isinstance(n, ast.Call) and _tail_name(n.func) in BOUNDED_PROBES
+        for n in ast.walk(m.tree))
+    # `from subprocess import run [as r]` aliases
+    sub_aliases: Dict[str, str] = {}
+    for n in ast.walk(m.tree):
+        if isinstance(n, ast.ImportFrom) and n.module == "subprocess":
+            for a in n.names:
+                sub_aliases[a.asname or a.name] = a.name
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        tail = _tail_name(fn)
+        sub_call = None
+        if isinstance(fn, ast.Attribute) and \
+                _root_name(fn) == "subprocess":
+            sub_call = tail
+        elif isinstance(fn, ast.Name) and fn.id in sub_aliases:
+            sub_call = sub_aliases[fn.id]
+        if sub_call == "Popen":
+            out.append(Violation(
+                "G6", m.relpath, node.lineno,
+                "subprocess.Popen has no timeout bound of its own "
+                "(.wait() hangs on a wedged tunnel) — use "
+                "subprocess.run(timeout=...)",
+                m.line_text(node.lineno)))
+        elif sub_call in SUBPROCESS_CALLS:
+            if not any(kw.arg == "timeout" for kw in node.keywords):
+                out.append(Violation(
+                    "G6", m.relpath, node.lineno,
+                    f"subprocess.{sub_call}() without timeout=: a "
+                    f"wedged axon tunnel hangs the child forever",
+                    m.line_text(node.lineno)))
+        elif isinstance(fn, ast.Attribute) and \
+                _root_name(fn) == "jax" and tail in BACKEND_TOUCHES:
+            if not has_probe:
+                out.append(Violation(
+                    "G6", m.relpath, node.lineno,
+                    f"in-process jax.{tail}() with no bounded probe "
+                    f"in this module: a wedged tunnel hangs backend "
+                    f"init with no error (probe first with "
+                    f"bench.accelerator_responsive)",
+                    m.line_text(node.lineno)))
+    return out
+
+
+def check_g6_shell(relpath: str, src: str) -> List[Violation]:
+    """Every python invocation in a tools/ shell script must be
+    timeout-bounded: in this container every `python` imports jax via
+    sitecustomize, and backend init hangs on a wedged tunnel."""
+    if not _g6_applies(relpath):
+        return []
+    out = []
+    # join backslash continuations first — `timeout N \` + `python ...`
+    # is one bounded command, not a bare python line
+    joined: List[Tuple[int, str]] = []
+    pending: Optional[Tuple[int, str]] = None
+    for i, raw in enumerate(src.splitlines(), 1):
+        if pending is not None:
+            start, acc = pending
+            merged = acc + " " + raw.strip()
+        else:
+            start, merged = i, raw
+        if merged.rstrip().endswith("\\"):
+            pending = (start, merged.rstrip()[:-1])
+        else:
+            pending = None
+            joined.append((start, merged))
+    if pending is not None:
+        joined.append(pending)
+    for i, line in joined:
+        code = line.split("#", 1)[0]
+        if re.search(r"\bpython3?\b", code) and \
+                not re.search(r"\btimeout\b", code):
+            out.append(Violation(
+                "G6", relpath, i,
+                "python invocation without a `timeout` bound "
+                "(wedged tunnels hang, they do not error)", line))
+    return out
+
+
+# --------------------------------------------------------------------
+# G7 / G8
+# --------------------------------------------------------------------
+
+def check_g7(m: ModuleInfo) -> List[Violation]:
+    if m.relpath in G7_SANCTIONED:
+        return []
+    # `from jax import config` makes a bare config.update(...) the
+    # same process-global mutation — track the import form too
+    bare_config_is_jax = any(
+        isinstance(n, ast.ImportFrom) and n.module == "jax"
+        and any(a.name == "config" for a in n.names)
+        for n in ast.walk(m.tree))
+    out = []
+    for node in ast.walk(m.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"):
+            continue
+        target = node.func.value
+        is_jax_config = (
+            isinstance(target, ast.Attribute)
+            and target.attr == "config"
+            and _root_name(node.func) == "jax") or (
+            bare_config_is_jax and isinstance(target, ast.Name)
+            and target.id == "config")
+        if is_jax_config:
+            out.append(Violation(
+                "G7", m.relpath, node.lineno,
+                "jax.config.update() outside sanctioned entry points "
+                "(pint_tpu/__init__.py, pint_tpu/config.py): global "
+                "config flips affect every other caller in-process",
+                m.line_text(node.lineno)))
+    return out
+
+
+def check_g8(m: ModuleInfo) -> List[Violation]:
+    out = []
+    for f in m.functions:
+        if m.enclosing_class(f) is None:
+            continue
+        args = f.args.args
+        if not args or args[0].arg not in ("self", "cls"):
+            continue
+        for dec in f.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if _tail_name(target) in ("lru_cache", "cache") and \
+                    (_root_name(target) in ("functools", None) or
+                     isinstance(target, ast.Name)):
+                out.append(Violation(
+                    "G8", m.relpath, f.lineno,
+                    f"functools.{_tail_name(target)} on method "
+                    f"`{f.name}`: caches `self` (leak) and hashes "
+                    f"array args by id (retrace hazard) — use an "
+                    f"explicit keyed cache like _get_compiled",
+                    m.line_text(f.lineno)))
+    return out
+
+
+# --------------------------------------------------------------------
+# dynamic (import-the-zoo) half of G4 / G5
+# --------------------------------------------------------------------
+
+def _load_sink_par(root: str) -> Optional[str]:
+    p = os.path.join(root, "tests", "test_all_components.py")
+    if not os.path.exists(p):
+        return None
+    mobj = re.search(r'SINK_PAR = """(.*?)"""',
+                     open(p).read(), re.S)
+    return mobj.group(1) if mobj else None
+
+
+def dynamic_registry_checks(root: str) -> List[Violation]:
+    """Imports the full component zoo (CPU-pinned) and checks G4
+    coverage + G5 exercise against the committed SINK_PAR. Separated
+    so tests can run the AST half without touching jax."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+    except RuntimeError:
+        pass  # backend already initialized by the host process
+    import warnings
+
+    import pint_tpu.models  # noqa: F401 — registry side effects
+    import pint_tpu.models.binary  # noqa: F401
+    import pint_tpu.models.components_extra  # noqa: F401
+    import pint_tpu.models.components_tail  # noqa: F401
+    import pint_tpu.models.noise  # noqa: F401
+    import pint_tpu.models.tcb_conversion  # noqa: F401
+    from pint_tpu.models.timing_model import component_types
+
+    out: List[Violation] = []
+    out += check_g4_dynamic(component_types)
+    sink = _load_sink_par(root)
+    if sink is None:
+        out.append(Violation(
+            "G5", "tests/test_all_components.py", 0,
+            "SINK_PAR not found — the kitchen-sink sweep that "
+            "exercises hybrid-Jacobian claims is missing"))
+        return out
+    import io
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from pint_tpu.models import get_model
+
+        model = get_model(io.StringIO(sink))
+    out += check_g4_sink(model)
+    out += check_g5_dynamic(component_types, model)
+    return out
+
+
+def _numeric_param_types():
+    from pint_tpu.models.parameter import (
+        AngleParameter,
+        MJDParameter,
+        floatParameter,
+        maskParameter,
+        pairParameter,
+        prefixParameter,
+    )
+
+    return (floatParameter, MJDParameter, prefixParameter,
+            maskParameter, pairParameter, AngleParameter)
+
+
+def check_g4_dynamic(component_types: dict) -> List[Violation]:
+    """Bare-instance coverage: every numeric parameter registered at
+    construction must resolve through _spec_lookup."""
+    from pint_tpu.units import _spec_lookup
+
+    NUM = _numeric_param_types()
+    out = []
+    for name, cls in sorted(component_types.items()):
+        if name in ABSTRACT_COMPONENTS:
+            continue
+        comp = cls()
+        spec = comp.param_dimensions()
+        missing = [p.name for p in comp.params.values()
+                   if isinstance(p, NUM) and
+                   _spec_lookup(spec, p.name) is None]
+        if missing:
+            out.append(Violation(
+                "G4", _class_path(cls), 0,
+                f"{name}.param_dimensions() does not cover "
+                f"{missing} — units go dimension-unchecked"))
+    return out
+
+
+def check_g4_sink(model) -> List[Violation]:
+    """SINK-model coverage: prefix/mask families only materialize at
+    par parse, so the bare-instance check misses them."""
+    from pint_tpu.models.parameter import (
+        boolParameter,
+        intParameter,
+        strParameter,
+    )
+    from pint_tpu.units import _spec_lookup
+
+    out = []
+    for cname, comp in model.components.items():
+        spec = comp.param_dimensions()
+        missing = [p.name for p in comp.params.values()
+                   if not isinstance(p, (strParameter, boolParameter,
+                                         intParameter))
+                   and _spec_lookup(spec, p.name) is None]
+        if missing:
+            out.append(Violation(
+                "G4", _class_path(type(comp)), 0,
+                f"{cname}.param_dimensions() does not cover the "
+                f"SINK_PAR-materialized params {missing}"))
+    return out
+
+
+def check_g5_dynamic(component_types: dict, model) -> List[Violation]:
+    """Every component class that implements hybrid-Jacobian claims
+    must be exercised by the SINK_PAR sweep: present in the model and
+    actually claiming at least one free parameter there (CLAUDE.md:
+    claims 'must appear in test_all_components.py's SINK_PAR')."""
+    out = []
+    free = set(model.free_params)
+    for name, cls in sorted(component_types.items()):
+        if "linear_design_names" not in cls.__dict__:
+            continue
+        comp = model.components.get(name)
+        if comp is None:
+            out.append(Violation(
+                "G5", _class_path(cls), 0,
+                f"{name} implements linear_design_names but is not in "
+                f"test_all_components.py's SINK_PAR — its claims are "
+                f"never swept against the production fit step"))
+            continue
+        claims = set(comp.linear_design_names())
+        if not claims:
+            out.append(Violation(
+                "G5", _class_path(cls), 0,
+                f"{name} is in SINK_PAR but claims no free parameter "
+                f"there — free one of its claimable params so the "
+                f"sweep exercises the closed-form column"))
+        elif not claims <= free:
+            out.append(Violation(
+                "G5", _class_path(cls), 0,
+                f"{name} claims {sorted(claims - free)} which are not "
+                f"free in the SINK model (claims must be free "
+                f"params)"))
+    return out
+
+
+def _class_path(cls) -> str:
+    mod = sys.modules.get(cls.__module__)
+    f = getattr(mod, "__file__", None) or cls.__module__
+    for marker in ("pint_tpu/", "tools/"):
+        i = f.replace(os.sep, "/").rfind(marker)
+        if i >= 0:
+            return f.replace(os.sep, "/")[i:]
+    return f
+
+
+# --------------------------------------------------------------------
+# suppression: pragmas + the committed allowlist
+# --------------------------------------------------------------------
+
+def apply_suppressions(report: LintReport, allowlist: List[dict],
+                       sources: Dict[str, str]):
+    """Drop violations covered by an inline pragma or an allowlist
+    entry. An entry suppresses at most ``max_hits`` (default 1)
+    violations — a NEW violation that happens to share the substring
+    must surface for its own review, not ride an old justification.
+    Stale entries (zero hits) become violations themselves."""
+    hits = [0] * len(allowlist)
+    kept: List[Violation] = []
+    for v in report.violations:
+        line = ""
+        src = sources.get(v.path)
+        if src is not None and v.line:
+            lines = src.splitlines()
+            if v.line <= len(lines):
+                line = lines[v.line - 1]
+        pragma = PRAGMA_RE.search(line)
+        if pragma and pragma.group(1) == v.rule:
+            report.suppressed.append((v, f"pragma: {pragma.group(2)}"))
+            continue
+        hit = None
+        for i, e in enumerate(allowlist):
+            if e["rule"] != v.rule or e["file"] != v.path:
+                continue
+            if hits[i] >= e.get("max_hits", 1):
+                continue
+            if e.get("match") and e["match"] not in (line or v.snippet
+                                                     or v.msg):
+                if e["match"] not in v.msg:
+                    continue
+            hits[i] += 1
+            hit = e
+            break
+        if hit is not None:
+            report.suppressed.append((v, f"allowlist: {hit['why']}"))
+        else:
+            kept.append(v)
+    report.violations = kept
+    for i, e in enumerate(allowlist):
+        if not hits[i]:
+            report.violations.append(Violation(
+                "ALLOWLIST", e["file"], 0,
+                f"stale allowlist entry (rule {e['rule']}, match "
+                f"{e.get('match')!r}) no longer suppresses anything — "
+                f"delete it so the list stays honest"))
+
+
+# --------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------
+
+def run_lint(root: str, dynamic: bool = True,
+             use_allowlist: bool = True) -> LintReport:
+    report = LintReport()
+    modules: List[ModuleInfo] = []
+    shell: List[Tuple[str, str]] = []
+    sources: Dict[str, str] = {}
+    for abspath, relpath in iter_lint_files(root):
+        src = open(abspath, encoding="utf-8").read()
+        sources[relpath] = src
+        report.files_scanned += 1
+        if relpath.endswith(".sh"):
+            shell.append((relpath, src))
+            continue
+        try:
+            modules.append(ModuleInfo(relpath, src))
+        except SyntaxError as e:
+            report.violations.append(Violation(
+                "PARSE", relpath, e.lineno or 0, f"syntax error: {e}"))
+    seed_names = collect_jit_seed_names(modules)
+    for m in modules:
+        mark_jit_regions(m, seed_names.get(m.relpath, set()))
+        report.violations += check_g1(m)
+        report.violations += check_g2(m)
+        report.violations += check_g6_python(m)
+        report.violations += check_g7(m)
+        report.violations += check_g8(m)
+    for relpath, src in shell:
+        report.violations += check_g6_shell(relpath, src)
+    graph = ClassGraph(modules)
+    report.violations += check_g3(graph)
+    report.violations += check_g4_static(graph)
+    report.violations += check_g5_static(graph)
+    if dynamic:
+        report.violations += dynamic_registry_checks(root)
+    allow = []
+    if use_allowlist:
+        from pint_tpu.analysis.allowlist import ALLOWLIST
+
+        allow = ALLOWLIST
+    apply_suppressions(report, allow, sources)
+    report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return report
+
+
+def find_repo_root(start: Optional[str] = None) -> str:
+    cur = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.isdir(os.path.join(cur, "pint_tpu")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            raise SystemExit(
+                "graftlint: no pint_tpu/ package found above cwd "
+                "(pass --root)")
+        cur = parent
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pint_tpu.analysis.graftlint",
+        description="project invariant linter (rules G1-G8)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: walk up to pint_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--no-dynamic", action="store_true",
+                    help="skip the import-the-zoo half of G4/G5")
+    ap.add_argument("--no-allowlist", action="store_true",
+                    help="report suppressed findings too")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rid, desc in RULES.items():
+            print(f"{rid}  {desc}")
+        return 0
+    root = args.root or find_repo_root(os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    report = run_lint(root, dynamic=not args.no_dynamic,
+                      use_allowlist=not args.no_allowlist)
+    if args.json:
+        print(json.dumps({
+            "clean": report.clean,
+            "files_scanned": report.files_scanned,
+            "violations": [v.__dict__ for v in report.violations],
+            "suppressed": [
+                {**v.__dict__, "reason": why}
+                for v, why in report.suppressed],
+        }, indent=2))
+    else:
+        for v in report.violations:
+            print(v.format())
+        print(f"graftlint: {report.files_scanned} files, "
+              f"{len(report.violations)} violation(s), "
+              f"{len(report.suppressed)} suppressed")
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

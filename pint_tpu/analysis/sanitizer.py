@@ -1,0 +1,184 @@
+"""Runtime compile/dispatch sanitizer.
+
+Reference invariant (CLAUDE.md "Conventions"): parameter VALUES are
+runtime args, never trace constants — ``invalidate_cache(
+params_only=True)`` must NOT drop the jit. Before this module the
+invariant was only enforced by a comment; a regression (the config-1
+bench slowdown that motivated the compile key) re-traced every fitter
+iteration and no test failed. ``Sanitizer`` makes the compile count
+observable:
+
+- it wraps ``TimingModel._get_compiled`` / ``_get_compiled_jac``
+  class-wide for the duration of the context and counts every time a
+  FRESH jitted closure is built (object identity change), per model
+  and per kind ("phase"/"jac");
+- ``watch(jitted, label)`` snapshots a ``jax.jit`` wrapper's
+  ``_cache_size()`` so executable-level recompiles (shape/dtype/
+  static-arg churn) are attributable per call site;
+- ``wrap(fn, label, expect_device=..., nan_check=...)`` returns a
+  call-through proxy that records operand leaves crossing host<->
+  device unexpectedly (np.ndarray operands entering a device
+  dispatch mean an implicit, per-call H2D transfer) and optionally
+  blocks on the outputs to assert they are finite (debug only — the
+  sync defeats dispatch pipelining).
+
+Usage::
+
+    with Sanitizer() as san:
+        ... sweep parameter values, re-evaluate ...
+    assert san.compiles("phase") == 1   # one build, N reuses
+
+The pytest fixture ``recompile_guard`` (tests/conftest.py) wraps the
+test body in a Sanitizer; the test itself asserts on
+``.compiles()``/``.builds`` (the fixture deliberately does not
+auto-fail — what counts as "expected" is per-test).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["Sanitizer", "SanitizerError"]
+
+
+class SanitizerError(AssertionError):
+    """A sanitizer invariant (finite outputs, expected operand
+    placement) failed."""
+
+
+@dataclass
+class _WatchEntry:
+    jitted: object
+    label: str
+    start: Optional[int]
+
+
+@dataclass
+class Sanitizer:
+    """Context manager counting jit builds and flagging stray host
+    operands / NaN outputs. Re-entrant use is not supported (the
+    class-level patch is process-global while active)."""
+
+    nan_check: bool = False
+    # (model id, kind) -> build count
+    builds: Dict[Tuple[int, str], int] = field(default_factory=dict)
+    host_crossings: List[Tuple[str, int]] = field(default_factory=list)
+    _watches: List[_WatchEntry] = field(default_factory=list)
+    _saved: Optional[tuple] = None
+
+    # -------------------------------------------------- compile count
+
+    def __enter__(self) -> "Sanitizer":
+        from pint_tpu.models.timing_model import TimingModel
+
+        if self._saved is not None:
+            raise RuntimeError("Sanitizer is not re-entrant")
+        orig_phase = TimingModel._get_compiled
+        orig_jac = TimingModel._get_compiled_jac
+        san = self
+
+        def patched_phase(model):
+            before = model._jit_phase
+            fn = orig_phase(model)
+            if fn is not before:
+                san._record(model, "phase")
+            return fn
+
+        def patched_jac(model):
+            before = model._jit_jac
+            fn = orig_jac(model)
+            if fn is not before:
+                san._record(model, "jac")
+            return fn
+
+        TimingModel._get_compiled = patched_phase
+        TimingModel._get_compiled_jac = patched_jac
+        self._saved = (TimingModel, orig_phase, orig_jac)
+        return self
+
+    def __exit__(self, *exc):
+        TimingModel, orig_phase, orig_jac = self._saved
+        TimingModel._get_compiled = orig_phase
+        TimingModel._get_compiled_jac = orig_jac
+        self._saved = None
+        return False
+
+    def _record(self, model, kind: str):
+        key = (id(model), kind)
+        self.builds[key] = self.builds.get(key, 0) + 1
+
+    def compiles(self, kind: Optional[str] = None) -> int:
+        """Total fresh jit builds observed (optionally one kind)."""
+        return sum(n for (_, k), n in self.builds.items()
+                   if kind is None or k == kind)
+
+    def reset(self):
+        """Zero the counters (e.g. after a deliberate warm-up phase
+        inside the context)."""
+        self.builds.clear()
+        self.host_crossings.clear()
+
+    # ------------------------------------------------ executable count
+
+    def watch(self, jitted, label: str = "") -> None:
+        """Track a jax.jit wrapper's executable cache growth."""
+        self._watches.append(_WatchEntry(
+            jitted, label or repr(jitted), _cache_size(jitted)))
+
+    def executable_growth(self) -> Dict[str, Optional[int]]:
+        """label -> newly compiled executables since watch() (None
+        when the running jax does not expose _cache_size)."""
+        out = {}
+        for w in self._watches:
+            now = _cache_size(w.jitted)
+            out[w.label] = (None if w.start is None or now is None
+                            else now - w.start)
+        return out
+
+    # ------------------------------------------------ dispatch checks
+
+    def wrap(self, fn, label: str = "", expect_device: bool = True):
+        """Call-through proxy recording host-array operands (an
+        implicit H2D copy per dispatch when expect_device) and, with
+        nan_check, blocking to verify finite outputs."""
+        import jax
+        import numpy as np
+
+        san = self
+        name = label or getattr(fn, "__name__", repr(fn))
+
+        def guarded(*args, **kw):
+            if expect_device:
+                nhost = sum(
+                    1 for leaf in jax.tree_util.tree_leaves((args, kw))
+                    if type(leaf) is np.ndarray)
+                if nhost:
+                    san.host_crossings.append((name, nhost))
+            out = fn(*args, **kw)
+            if san.nan_check:
+                bad = [i for i, leaf in
+                       enumerate(jax.tree_util.tree_leaves(out))
+                       if np.issubdtype(np.asarray(leaf).dtype,
+                                        np.floating)
+                       and not np.all(np.isfinite(np.asarray(leaf)))]
+                if bad:
+                    raise SanitizerError(
+                        f"{name}: non-finite output leaves {bad}")
+            return out
+
+        return guarded
+
+    def assert_no_host_crossings(self):
+        if self.host_crossings:
+            raise SanitizerError(
+                f"host ndarray operands entered device dispatches: "
+                f"{self.host_crossings} — convert once with "
+                f"jnp.asarray at build time, not per call")
+
+
+def _cache_size(jitted) -> Optional[int]:
+    try:
+        return int(jitted._cache_size())
+    except AttributeError:
+        return None

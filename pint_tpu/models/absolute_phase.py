@@ -21,7 +21,19 @@ import jax.numpy as jnp
 
 
 class AbsPhase(PhaseComponent):
+    """Absolute-phase anchor parameters (reference:
+    src/pint/models/absolute_phase.py AbsPhase): declares
+    TZRMJD/TZRSITE/TZRFRQ; the TZR mini-batch is built host-side in
+    TimingModel._make_tzr_toas and the phase subtraction happens in
+    the compiled phase chain, so this component's device phase is
+    identically zero."""
+
     category = "phase_offset"
+
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {"TZRMJD": parse_unit("d"), "TZRFRQ": parse_unit("MHz")}
 
     def __init__(self):
         super().__init__()

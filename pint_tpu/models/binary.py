@@ -157,7 +157,8 @@ class PulsarBinary(DelayComponent):
                 "MTOT": parse_unit("Msun"), "XPBDOT": DIMENSIONLESS,
                 "XOMDOT": parse_unit("deg/yr"),
                 "DR": DIMENSIONLESS, "DTH": DIMENSIONLESS,
-                "A0": t, "B0": t, "LNEDOT": t ** -1}
+                "A0": t, "B0": t, "LNEDOT": t ** -1,
+                "SHAPMAX": DIMENSIONLESS}
 
     # -- orbit machinery ----------------------------------------------
 

@@ -260,6 +260,14 @@ class WaveX(DelayComponent):
                                        index_str="0001", units="s"))
         self.wavex_ids: list = []
 
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {"WXEPOCH": parse_unit("d"),
+                "WXFREQ_*": parse_unit("1/d"),
+                "WXSIN_*": parse_unit("s"),
+                "WXCOS_*": parse_unit("s")}
+
     def add_wavex_component(self, freq_per_day, index=None, wxsin=0.0,
                             wxcos=0.0, frozen=False):
         # next slot = one past the highest USED index, not the count:
@@ -364,6 +372,14 @@ class DMWaveX(DelayComponent):
                                        units="pc cm^-3"))
         self.dmwavex_ids: list = []
 
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {"DMWXEPOCH": parse_unit("d"),
+                "DMWXFREQ_*": parse_unit("1/d"),
+                "DMWXSIN_*": parse_unit("pc cm^-3"),
+                "DMWXCOS_*": parse_unit("pc cm^-3")}
+
     def add_dmwavex_component(self, freq_per_day, index=None,
                               dmwxsin=0.0, dmwxcos=0.0, frozen=False):
         """Fill or create one Fourier slot; next index is one past the
@@ -464,6 +480,11 @@ class FD(DelayComponent):
                                        index_str="1", units="s"))
         self.fd_ids: list = []
 
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {"FD*": parse_unit("s")}
+
     def setup(self):
         ids = []
         for name in self.params:
@@ -527,6 +548,12 @@ class SolarWindDispersion(DelayComponent):
         self.add_param(floatParameter("SWP", units="", value=2.0,
                                       description="radial density "
                                       "power-law index (SWM 1)"))
+
+    def param_dimensions(self):
+        from pint_tpu.units import DIMENSIONLESS, parse_unit
+
+        return {"NE_SW": parse_unit("cm^-3"), "SWM": DIMENSIONLESS,
+                "SWP": DIMENSIONLESS}
 
     def validate(self):
         if self.SWM.value not in (None, 0.0, 0, 1.0, 1):

@@ -38,11 +38,27 @@ from pint_tpu.ops.dd import DD
 
 def chromatic_index(parent, default: float = 4.0) -> float:
     """The model's chromatic spectral index alpha (TNCHROMIDX on the
-    ChromaticCM component), shared by CMX/CMWaveX/PLChromNoise."""
+    ChromaticCM component), shared by CMX/CMWaveX/PLChromNoise.
+
+    Host-side by design: the sharers read alpha as a trace constant,
+    which is only sound while TNCHROMIDX is frozen (frozen device
+    params are part of the compile key, so a value change re-keys the
+    trace). A FREE TNCHROMIDX would go stale here without retracing —
+    ChromaticCM itself reads it from the traced pv and tolerates
+    fitting, but the sharers cannot, so refuse loudly (graftlint G1
+    finding, 2026-08-02)."""
     if parent is not None and "ChromaticCM" in parent.components:
-        v = parent.components["ChromaticCM"].TNCHROMIDX.value
+        p = parent.components["ChromaticCM"].TNCHROMIDX
+        if not p.frozen:
+            raise ValueError(
+                "TNCHROMIDX is free, but ChromaticCMX/CMWaveX/"
+                "PLChromNoise share it as a trace constant — fitting "
+                "the chromatic index is only supported with "
+                "ChromaticCM alone; freeze TNCHROMIDX")
+        v = p.value
         if v is not None:
-            return float(v)
+            # frozen => host data covered by the compile key
+            return float(v)  # graftlint: allow G1 -- frozen static
     return default
 
 
@@ -227,6 +243,20 @@ class ChromaticCM(DelayComponent):
                                       aliases=["CMIDX"]))
         self.cm_ids: list = []
 
+    def param_dimensions(self):
+        from pint_tpu.units import DIMENSIONLESS, parse_unit
+
+        # CM's dimension depends on the chromatic index alpha
+        # (pc cm^-3 MHz^(alpha-2)) — outside the rational-exponent
+        # algebra, so the slot is declared exempt (callable -> None)
+        # rather than left silently unspecified
+        def cm_dim(name):
+            return None
+
+        return {"CM": cm_dim, "CM*": cm_dim,
+                "CMEPOCH": parse_unit("d"),
+                "TNCHROMIDX": DIMENSIONLESS}
+
     def setup(self):
         ids = []
         for name in self.params:
@@ -317,6 +347,15 @@ class ChromaticCMX(DelayComponent):
                                        index_str="0001", units="MJD"))
         self.cmx_ids: list = []
 
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        # CMX_ shares CM's alpha-dependent dimension (see
+        # ChromaticCM.param_dimensions): declared exempt explicitly
+        return {"CMX_*": lambda name: None,
+                "CMXR1_*": parse_unit("d"),
+                "CMXR2_*": parse_unit("d")}
+
     def setup(self):
         ids = []
         for name in self.params:
@@ -391,6 +430,16 @@ class CMWaveX(DelayComponent):
                 units="1/d" if pre == "CMWXFREQ_" else
                 "pc cm^-3 MHz^(a-2)"))
         self.cmwx_ids: list = []
+
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        # SIN/COS amplitudes share CM's alpha-dependent dimension
+        # (see ChromaticCM.param_dimensions): declared exempt
+        return {"CMWXEPOCH": parse_unit("d"),
+                "CMWXFREQ_*": parse_unit("1/d"),
+                "CMWXSIN_*": lambda name: None,
+                "CMWXCOS_*": lambda name: None}
 
     def setup(self):
         ids = []
@@ -471,6 +520,11 @@ class IFunc(PhaseComponent):
         self.add_param(pairParameter("IFUNC1", units="MJD s"))
         self.ifunc_ids: list = []
 
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {"IFUNC*": parse_unit("MJD s")}
+
     def setup(self):
         ids = []
         for name in self.params:
@@ -537,6 +591,15 @@ class PiecewiseSpindown(PhaseComponent):
                        "PWF0_": "Hz", "PWF1_": "Hz/s",
                        "PWF2_": "Hz/s^2"}[pre]))
         self.pw_ids: list = []
+
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        d, hz, s = (parse_unit("d"), parse_unit("Hz"),
+                    parse_unit("s"))
+        return {"PWEP_*": d, "PWSTART_*": d, "PWSTOP_*": d,
+                "PWPH_*": parse_unit("turn"), "PWF0_*": hz,
+                "PWF1_*": hz / s, "PWF2_*": hz / s ** 2}
 
     def setup(self):
         ids = []
@@ -643,6 +706,13 @@ class SolarWindDispersionX(DelayComponent):
                                            index_str="0001", units=unit))
         self.swx_ids: list = []
 
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {"SWXDM_*": parse_unit("pc cm^-3"),
+                "SWXR1_*": parse_unit("d"),
+                "SWXR2_*": parse_unit("d")}
+
     def setup(self):
         ids = []
         for name in self.params:
@@ -728,6 +798,14 @@ class FDJump(DelayComponent):
     def __init__(self):
         super().__init__()
         self.fdjumps: list = []  # (order, param name)
+
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        # FD{n}JUMP{i} names don't fit the numeric-suffix star
+        # convention — enumerate the materialized family instead
+        s = parse_unit("s")
+        return {name: s for name in self.params if "JUMP" in name}
 
     def add_fdjump(self, order, key, key_value, value=0.0, frozen=True,
                    index=None):

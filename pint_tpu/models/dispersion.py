@@ -81,7 +81,8 @@ class DispersionDM(Dispersion):
         for name in self.params:
             if name.startswith("DM") and name not in (
                     "DM", "DM1", "DMEPOCH") and name[2:].isdigit():
-                extras.append((int(name[2:]), name))
+                # param NAME strings are host data at trace time
+                extras.append((int(name[2:]), name))  # graftlint: allow G1 -- name str
         out.extend(nm for _, nm in sorted(extras))
         return out
 

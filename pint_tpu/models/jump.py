@@ -17,11 +17,21 @@ from pint_tpu.ops.dd import DD
 
 
 class PhaseJump(PhaseComponent):
+    """Per-TOA-subset constant offsets (reference:
+    src/pint/models/jump.py PhaseJump): each JUMPn maskParameter is
+    seconds on its selected TOAs; phase contribution is −JUMP·F0
+    (the reference's jump_phase sign convention)."""
+
     category = "phase_jump"
 
     def __init__(self):
         super().__init__()
         self.jumps: list = []
+
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {"JUMP*": parse_unit("s")}
 
     def add_jump(self, index=None, key=None, key_value=(), value=0.0,
                  frozen=True, uncertainty=None):

@@ -15,6 +15,12 @@ from pint_tpu.ops.dd import DD
 
 
 class PhaseOffset(PhaseComponent):
+    """Fittable overall phase offset (reference:
+    src/pint/models/phase_offset.py PhaseOffset): contributes −PHOFF
+    turns to every non-TZR phase and REPLACES the implicit "Offset"
+    design-matrix column and the implicit residual mean subtraction
+    (step consumers must check names[0] == "Offset")."""
+
     category = "phase_offset"
 
     # the TZR phase must NOT include PHOFF (reference: PhaseOffset —
@@ -28,6 +34,11 @@ class PhaseOffset(PhaseComponent):
     def __init__(self):
         super().__init__()
         self.add_param(floatParameter("PHOFF", units="turn", value=0.0))
+
+    def param_dimensions(self):
+        from pint_tpu.units import parse_unit
+
+        return {"PHOFF": parse_unit("turn")}
 
     def phase(self, pv, batch, cache, ctx, tb):
         off = -(pv["PHOFF"].hi + pv["PHOFF"].lo)

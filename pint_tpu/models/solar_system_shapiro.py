@@ -34,6 +34,12 @@ def shapiro_delay(obj_pos_ls, psr_dir, t_obj_s):
 
 
 class SolarSystemShapiro(DelayComponent):
+    """Sun (and optionally planet) Shapiro delay (reference:
+    src/pint/models/solar_system_shapiro.py
+    SolarSystemShapiro.solar_system_shapiro_delay): −2 T_obj
+    ln(r − r·n̂) per body; PLANET_SHAPIRO gates the planet terms as a
+    trace static (it is in the compile key)."""
+
     category = "solar_system_shapiro"
 
     def delay(self, pv, batch, cache, ctx, delay_so_far):

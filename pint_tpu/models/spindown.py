@@ -23,6 +23,11 @@ from pint_tpu.ops.taylor import dd_taylor_horner
 
 
 class Spindown(PhaseComponent):
+    """Rotational phase Σ Fᵢ·dtⁱ⁺¹/(i+1)! (reference:
+    src/pint/models/spindown.py Spindown.spindown_phase; F0..Fn
+    prefix family, PEPOCH). The F0·dt product runs in double-double
+    via dd_taylor_horner so 19-digit par values keep all bits."""
+
     category = "spindown"
 
     def __init__(self):

@@ -213,6 +213,12 @@ class MiscParams(Component):
         self.add_param(strParameter("INFO"))
         self.add_param(strParameter("MODE"))
 
+    def param_dimensions(self):
+        from pint_tpu.units import DIMENSIONLESS, parse_unit
+
+        return {"START": parse_unit("d"), "FINISH": parse_unit("d"),
+                "CHI2": DIMENSIONLESS, "TRES": parse_unit("us")}
+
 
 def floatParam(name, **kw):
     from pint_tpu.models.parameter import floatParameter
@@ -801,6 +807,9 @@ class TimingModel:
                 self._build_phase_fn()
             nl_idx_list = [i for i, nm in enumerate(free_names)
                            if nm not in lin]
+            # host-built once here, NOT inside jac_fn: graftlint G2 —
+            # np calls in a traced body are host fallbacks
+            nl_idx = np.asarray(nl_idx_list, np.int32)
 
             def jac_fn(th, tl, fh, fl, batch, sc):
                 def phase_of(thx):
@@ -808,8 +817,7 @@ class TimingModel:
                     return ph.hi + ph.lo
 
                 if nl_idx_list:
-                    idx = jnp.asarray(np.asarray(nl_idx_list,
-                                                 np.int32))
+                    idx = jnp.asarray(nl_idx)
 
                     def sub(th_nl):
                         return phase_of(th.at[idx].set(th_nl))

@@ -51,8 +51,22 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running scale-up tests")
+    config.addinivalue_line(
+        "markers", "lint: graftlint static-analysis gate "
+        "(fast standalone run: pytest -m lint)")
 
 
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture
+def recompile_guard():
+    """A Sanitizer wired around the test body: assert on
+    .compiles()/.builds to pin down jit-rebuild behavior (the
+    params_only invariant)."""
+    from pint_tpu.analysis import Sanitizer
+
+    with Sanitizer() as san:
+        yield san

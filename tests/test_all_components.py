@@ -50,6 +50,24 @@ WXEPOCH 55000
 WXFREQ_0001 0.005
 WXSIN_0001 1e-6 1
 WXCOS_0001 1e-6 1
+DMWXEPOCH 55000
+DMWXFREQ_0001 0.003
+DMWXSIN_0001 1e-4 1
+DMWXCOS_0001 2e-4 1
+CM 0.02 1
+TNCHROMIDX 4
+CMEPOCH 55000
+CMX_0001 1e-3 1
+CMXR1_0001 54800
+CMXR2_0001 55100
+CMWXEPOCH 55000
+CMWXFREQ_0001 0.004
+CMWXSIN_0001 1e-4 1
+CMWXCOS_0001 5e-5 1
+SWXDM_0001 1e-4 1
+SWXR1_0001 55000
+SWXR2_0001 55300
+FDJUMP -grp a 2e-5 1
 JUMP -grp a 1e-5 1
 PHOFF 0.01 1
 BINARY ELL1
@@ -77,6 +95,10 @@ FD_STEPS = {
     "GLPH_1": 1e-7, "GLF0_1": 1e-12,
     "PWPH_1": 1e-7, "PWF0_1": 1e-12,
     "WXSIN_0001": 1e-6, "WXCOS_0001": 1e-6,
+    "DMWXSIN_0001": 1e-5, "DMWXCOS_0001": 1e-5,
+    "CM": 1e-5, "CMX_0001": 1e-5,
+    "CMWXSIN_0001": 1e-5, "CMWXCOS_0001": 1e-5,
+    "SWXDM_0001": 1e-5, "FDJUMP1": 1e-7,
     "JUMP1": 1e-7, "PHOFF": 1e-6,
     "PB": 1e-8, "A1": 1e-7, "TASC": 1e-8,
     "EPS1": 1e-8, "EPS2": 1e-8,
@@ -109,7 +131,7 @@ def test_every_free_param_derivative_vs_fd(sink):
         warnings.simplefilter("ignore")
         M, names, units = model.designmatrix(toas, incoffset=False)
     M = np.asarray(M)
-    assert len(names) == len(model.free_params) == 27
+    assert len(names) == len(model.free_params) == 35
     failures = []
     for pname in names:
         j = names.index(pname)
@@ -203,12 +225,15 @@ def test_production_fit_step_across_component_zoo():
         warnings.simplefilter("ignore")
         model = get_model(io.StringIO(SINK_PAR))
         rng = np.random.default_rng(21)
-        # four frequency bands: FD1/FD2/DM/DMX are only separable
-        # with >= 3 distinct frequencies (each is a few-valued
-        # function of nu — fewer bands make the model itself singular)
+        # six frequency bands: the constant-in-time frequency-shape
+        # columns {offset, FD1 logv, FD2 log^2 v, DM v^-2, CM v^-4}
+        # span a 5-dim function space — with only 4 distinct
+        # frequencies they are exactly collinear and the normal
+        # matrix is singular; 6 bands leave rank margin
         toas = make_fake_toas_uniform(
             54100, 55900, 300, model, error_us=1.0,
-            freq_mhz=np.tile([1400.0, 820.0, 2100.0, 430.0], 75),
+            freq_mhz=np.tile([1400.0, 820.0, 2100.0, 430.0,
+                              327.0, 3000.0], 50),
             rng=rng)
         for i, f in enumerate(toas.flags):
             f["grp"] = "a" if i % 3 else "b"

@@ -1,0 +1,440 @@
+"""graftlint rule fixtures (a seeded positive AND a clean negative per
+rule G1-G8) plus the repo-clean gate: the live tree must lint clean,
+which is what makes every CLAUDE.md convention a failing test instead
+of a code-review hope. Run standalone with `pytest -m lint`."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import types
+
+import pytest
+
+from pint_tpu.analysis import graftlint as gl
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_py(src, relpath="pint_tpu/models/_fixture.py"):
+    """Run the per-module AST rules on one snippet."""
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    seeds = gl.collect_jit_seed_names([m])
+    gl.mark_jit_regions(m, seeds[relpath])
+    out = []
+    out += gl.check_g1(m)
+    out += gl.check_g2(m)
+    out += gl.check_g6_python(m)
+    out += gl.check_g7(m)
+    out += gl.check_g8(m)
+    graph = gl.ClassGraph([m])
+    out += gl.check_g3(graph)
+    out += gl.check_g4_static(graph)
+    out += gl.check_g5_static(graph)
+    return out
+
+
+def _rules(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ------------------------------------------------------------------ G1
+
+def test_g1_flags_coercion_in_compute_path():
+    v = _lint_py("""
+        class Thing(Component):
+            def delay(self, pv, batch, cache, ctx, delay_so_far):
+                return float(pv["DM"].hi)
+    """)
+    assert "G1" in _rules(v)
+
+
+def test_g1_flags_item_in_jitted_closure():
+    v = _lint_py("""
+        import jax
+        def build():
+            def fn(x):
+                return x.item()
+            return jax.jit(fn)
+    """)
+    assert "G1" in _rules(v)
+
+
+def test_g1_clean_on_host_code_and_host_attrs():
+    v = _lint_py("""
+        class Thing(Component):
+            def prepare(self, toas, batch, cache, prefix=""):
+                return float(toas.ntoas)  # host method: fine
+            def delay(self, pv, batch, cache, ctx, delay_so_far):
+                x = float(self.DM.value or 0.0)  # host .value: fine
+                return x
+    """)
+    assert "G1" not in _rules(v)
+
+
+def test_g1_propagates_through_self_calls():
+    v = _lint_py("""
+        class Thing(Component):
+            def helper(self, x):
+                return int(x)
+            def phase(self, pv, batch, cache, ctx, tb):
+                return self.helper(pv["F0"].hi)
+    """)
+    assert "G1" in _rules(v)
+
+
+# ------------------------------------------------------------------ G2
+
+def test_g2_flags_numpy_in_traced_models_code():
+    v = _lint_py("""
+        import numpy as np
+        class Thing(Component):
+            def delay(self, pv, batch, cache, ctx, delay_so_far):
+                return np.clip(pv["DM"].hi, 0, 1)
+    """)
+    assert "G2" in _rules(v)
+
+
+def test_g2_ignores_host_paths_and_other_packages():
+    clean_host = _lint_py("""
+        import numpy as np
+        class Thing(Component):
+            def prepare(self, toas, batch, cache, prefix=""):
+                cache["mask"] = np.zeros(3)
+    """)
+    assert "G2" not in _rules(clean_host)
+    outside_models = _lint_py("""
+        import numpy as np
+        def fn(x):
+            return np.sin(x)
+        import jax
+        g = jax.jit(fn)
+    """, relpath="pint_tpu/serve/_fixture.py")
+    assert "G2" not in _rules(outside_models)
+
+
+# ------------------------------------------------------------------ G3
+
+def test_g3_flags_missing_citation():
+    v = _lint_py("""
+        class Thing(Component):
+            '''A component with no citation at all.'''
+    """)
+    assert "G3" in _rules(v)
+
+
+def test_g3_accepts_citation_and_skips_unregistered():
+    cited = _lint_py("""
+        class Thing(Component):
+            '''Does things (reference: src/pint/models/thing.py).'''
+    """)
+    assert "G3" not in _rules(cited)
+    unregistered = _lint_py("""
+        class Thing(Component):
+            '''No citation.'''
+            register = False
+    """)
+    assert "G3" not in _rules(unregistered)
+
+
+# ------------------------------------------------------ G4 (static)
+
+def test_g4_static_flags_missing_spec():
+    v = _lint_py("""
+        class Thing(Component):
+            '''Reference: somewhere.'''
+            def __init__(self):
+                self.add_param(floatParameter("X", units="s"))
+    """)
+    assert "G4" in _rules(v)
+
+
+def test_g4_static_accepts_defined_or_inherited_spec():
+    own = _lint_py("""
+        class Thing(Component):
+            '''Reference: somewhere.'''
+            def __init__(self):
+                self.add_param(floatParameter("X", units="s"))
+            def param_dimensions(self):
+                return {"X": None}
+    """)
+    assert "G4" not in _rules(own)
+    inherited = _lint_py("""
+        class Base(Component):
+            register = False
+            def param_dimensions(self):
+                return {"X": None}
+        class Thing(Base):
+            '''Reference: somewhere.'''
+            def __init__(self):
+                self.add_param(floatParameter("X", units="s"))
+    """)
+    assert "G4" not in _rules(inherited)
+
+
+# ----------------------------------------------------- G4 (dynamic)
+
+def test_g4_dynamic_flags_uncovered_param():
+    from pint_tpu.models.parameter import floatParameter
+    from pint_tpu.models.timing_model import PhaseComponent
+
+    class _G4Missing(PhaseComponent):
+        register = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_param(floatParameter("BOGUS", units="s"))
+
+    assert gl.check_g4_dynamic({"_G4Missing": _G4Missing})
+
+
+def test_g4_dynamic_accepts_covered_param():
+    from pint_tpu.models.parameter import floatParameter
+    from pint_tpu.models.timing_model import PhaseComponent
+    from pint_tpu.units import parse_unit
+
+    class _G4Covered(PhaseComponent):
+        register = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_param(floatParameter("OK", units="s"))
+
+        def param_dimensions(self):
+            return {"OK": parse_unit("s")}
+
+    assert not gl.check_g4_dynamic({"_G4Covered": _G4Covered})
+
+
+# ------------------------------------------------------------------ G5
+
+def test_g5_static_flags_unpaired_hooks():
+    v = _lint_py("""
+        class Thing(Component):
+            '''Reference: somewhere.'''
+            def linear_design_names(self):
+                return ["X"]
+    """)
+    assert "G5" in _rules(v)
+    paired = _lint_py("""
+        class Thing(Component):
+            '''Reference: somewhere.'''
+            def linear_design_names(self):
+                return ["X"]
+            def linear_design_local(self, pv, batch, cache, ctx):
+                return {}
+    """)
+    assert "G5" not in _rules(paired)
+
+
+def test_g5_dynamic_flags_component_absent_from_sink():
+    from pint_tpu.models.timing_model import PhaseComponent
+
+    class _Claimy(PhaseComponent):
+        register = False
+
+        def linear_design_names(self):
+            return ["X"]
+
+        def linear_design_local(self, pv, batch, cache, ctx):
+            return {}
+
+    stub_model = types.SimpleNamespace(components={}, free_params=[])
+    v = gl.check_g5_dynamic({"_Claimy": _Claimy}, stub_model)
+    assert v and v[0].rule == "G5"
+
+
+# ------------------------------------------------------------------ G6
+
+def test_g6_flags_unbounded_subprocess_and_backend_touch():
+    v = _lint_py("""
+        import subprocess, jax
+        def go():
+            subprocess.run(["python", "x.py"])
+            return jax.devices()
+    """, relpath="tools/_fixture.py")
+    assert [x.rule for x in v].count("G6") == 2
+    bounded = _lint_py("""
+        import subprocess, jax
+        def go():
+            if not accelerator_responsive(240.0):
+                return None
+            subprocess.run(["python", "x.py"], timeout=60)
+            return jax.devices()
+    """, relpath="tools/_fixture.py")
+    assert "G6" not in _rules(bounded)
+
+
+def test_g6_flags_popen_and_from_import_forms():
+    popen = _lint_py("""
+        import subprocess
+        def go():
+            return subprocess.Popen(["python", "x.py"]).wait()
+    """, relpath="tools/_fixture.py")
+    assert "G6" in _rules(popen)
+    aliased = _lint_py("""
+        from subprocess import run as launch
+        def go():
+            launch(["python", "x.py"])
+    """, relpath="tools/_fixture.py")
+    assert "G6" in _rules(aliased)
+    aliased_ok = _lint_py("""
+        from subprocess import run
+        def go():
+            run(["python", "x.py"], timeout=60)
+    """, relpath="tools/_fixture.py")
+    assert "G6" not in _rules(aliased_ok)
+
+
+def test_g6_ignores_paths_outside_tools_and_scripts():
+    v = _lint_py("""
+        import subprocess
+        subprocess.run(["ls"])
+    """, relpath="pint_tpu/models/_fixture.py")
+    assert "G6" not in _rules(v)
+
+
+def test_g6_shell_requires_timeout_and_joins_continuations():
+    bad = gl.check_g6_shell("tools/x.sh", "python tools/capture.py\n")
+    assert bad and bad[0].rule == "G6"
+    ok = gl.check_g6_shell(
+        "tools/x.sh", 'timeout 60 python tools/capture.py\n')
+    assert not ok
+    continued = gl.check_g6_shell(
+        "tools/x.sh", 'timeout "$T" \\\n    python tools/capture.py\n')
+    assert not continued
+
+
+# ------------------------------------------------------------------ G7
+
+def test_g7_flags_config_update_outside_entry_points():
+    v = _lint_py("""
+        import jax
+        jax.config.update("jax_enable_x64", True)
+    """)
+    assert "G7" in _rules(v)
+    sanctioned = _lint_py("""
+        import jax
+        jax.config.update("jax_enable_x64", True)
+    """, relpath="pint_tpu/config.py")
+    assert "G7" not in _rules(sanctioned)
+
+
+def test_g7_catches_from_import_form():
+    v = _lint_py("""
+        from jax import config
+        config.update("jax_enable_x64", False)
+    """)
+    assert "G7" in _rules(v)
+    other_config = _lint_py("""
+        from myapp import config
+        config.update("verbose", True)
+    """)
+    assert "G7" not in _rules(other_config)
+
+
+# ------------------------------------------------------------------ G8
+
+def test_g8_flags_lru_cache_on_method():
+    v = _lint_py("""
+        import functools
+        class Thing:
+            @functools.lru_cache(maxsize=8)
+            def basis(self, arr):
+                return arr
+    """)
+    assert "G8" in _rules(v)
+
+
+def test_g8_allows_module_level_lru_cache():
+    v = _lint_py("""
+        import functools
+        @functools.lru_cache()
+        def table(n: int):
+            return list(range(n))
+    """)
+    assert "G8" not in _rules(v)
+
+
+# ------------------------------------------------- suppression layer
+
+def test_pragma_suppresses_only_matching_rule():
+    src = ("class Thing(Component):\n"
+           "    def delay(self, pv, batch, cache, ctx, d):\n"
+           "        return float(pv['DM'].hi)"
+           "  # graftlint: allow G1 -- fixture\n")
+    report = gl.LintReport(violations=_lint_py(src))
+    assert any(v.rule == "G1" for v in report.violations)
+    gl.apply_suppressions(
+        report, [], {"pint_tpu/models/_fixture.py": src})
+    assert not [v for v in report.violations if v.rule == "G1"]
+    assert report.suppressed
+
+
+def test_allowlist_suppresses_and_stale_entries_fail():
+    src = ("class Thing(Component):\n"
+           "    def delay(self, pv, batch, cache, ctx, d):\n"
+           "        return float(pv['DM'].hi)\n")
+    report = gl.LintReport(violations=_lint_py(src))
+    allow = [dict(rule="G1", file="pint_tpu/models/_fixture.py",
+                  match="float(pv['DM'].hi)", why="fixture")]
+    gl.apply_suppressions(
+        report, allow, {"pint_tpu/models/_fixture.py": src})
+    assert not [v for v in report.violations if v.rule == "G1"]
+    # a stale entry (matches nothing) must itself be a violation
+    report2 = gl.LintReport()
+    gl.apply_suppressions(
+        report2, [dict(rule="G1", file="nope.py", match="zzz",
+                       why="stale")], {})
+    assert [v for v in report2.violations if v.rule == "ALLOWLIST"]
+
+
+def test_allowlist_entry_suppresses_at_most_max_hits():
+    """One reviewed justification must not swallow a SECOND, future
+    violation that merely shares the substring."""
+    mk = lambda line: gl.Violation("G7", "tools/x.py", line,
+                                   "jax.config.update() outside ...")
+    report = gl.LintReport(violations=[mk(5), mk(50)])
+    allow = [dict(rule="G7", file="tools/x.py",
+                  match="jax.config.update", why="entry point")]
+    gl.apply_suppressions(report, allow, {})
+    assert len(report.suppressed) == 1
+    assert [v.line for v in report.violations] == [50]
+
+
+# ------------------------------------------------------ repo gates
+
+def test_repo_clean():
+    """THE gate: the live tree lints clean (G1-G8, dynamic checks,
+    allowlist with no stale entries). Every future PR inherits the
+    conventions as a tier-1 failure instead of a review comment."""
+    report = gl.run_lint(REPO)
+    assert report.clean, "\n".join(v.format() for v in report.violations)
+    assert report.files_scanned > 50
+
+
+def test_every_rule_is_documented():
+    """The rule table in ARCHITECTURE.md must cover every implemented
+    rule id (doc drift check)."""
+    arch = open(os.path.join(REPO, "ARCHITECTURE.md")).read()
+    for rid in gl.RULES:
+        assert rid in arch, f"rule {rid} missing from ARCHITECTURE.md"
+
+
+@pytest.mark.slow
+def test_cli_exit_code():
+    """`python -m pint_tpu.analysis.graftlint` exits 0 on the repo
+    (subprocess: the exact invocation CI/humans run)."""
+    # strip the axon vars too (as tests/test_examples.py does): a
+    # wedged tunnel must not be able to hang the subprocess either
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PALLAS_AXON")}
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "pint_tpu.analysis.graftlint",
+         "--root", REPO],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr

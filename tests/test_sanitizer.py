@@ -1,0 +1,145 @@
+"""Runtime sanitizer: the first direct test of the CLAUDE.md
+invariant "invalidate_cache(params_only=True) must NOT drop the jit".
+A regression here (value updates re-tracing the phase chain) once
+cost a full retrace per fitter iteration and no test failed — now the
+compile count is asserted, at both the model layer
+(TimingModel._get_compiled via Sanitizer) and the executable layer
+(jax.jit cache size on the production fit step)."""
+
+import io
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pint_tpu.analysis import Sanitizer
+from pint_tpu.analysis.sanitizer import SanitizerError
+from pint_tpu.models import get_model
+from pint_tpu.parallel import build_fit_step
+from pint_tpu.residuals import Residuals
+from pint_tpu.simulation import make_fake_toas_uniform
+
+PAR = """PSR J1234+5678
+RAJ 12:34:00.0 1
+DECJ 56:47:00.0 1
+F0 250.0123456789 1
+F1 -2.0e-15 1
+DM 15.0 1
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.05
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+
+def _problem(n=120):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(PAR))
+        toas = make_fake_toas_uniform(
+            54500, 55500, n, model, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 820.0], n // 2),
+            add_noise=True, rng=np.random.default_rng(7))
+    # simulation warms the compiled phase — start the tests cold so
+    # build counts are deterministic (first evaluation == build 1)
+    model.invalidate_cache()
+    return model, toas
+
+
+def test_params_only_sweep_compiles_once():
+    """3-value parameter sweep with params_only invalidation: exactly
+    ONE phase build, however many evaluations."""
+    model, toas = _problem()
+    with Sanitizer() as san:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            Residuals(toas, model).time_resids
+            for delta in (1e-11, 1e-11, -2e-11):
+                model.F0.add_delta(delta)
+                model.invalidate_cache(params_only=True)
+                Residuals(toas, model).time_resids
+    assert san.compiles("phase") == 1, san.builds
+
+
+def test_structure_change_bumps_compile_count():
+    """Freezing a parameter changes the free set (a trace static) —
+    the sanitizer must see a SECOND build; a full invalidate_cache()
+    likewise drops the jit."""
+    model, toas = _problem()
+    with Sanitizer() as san:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            Residuals(toas, model).time_resids
+            model.F1.frozen = True  # structure change
+            model.invalidate_cache(params_only=True)
+            Residuals(toas, model).time_resids
+            assert san.compiles("phase") == 2, san.builds
+            model.invalidate_cache()  # full drop: retrace expected
+            Residuals(toas, model).time_resids
+    assert san.compiles("phase") == 3, san.builds
+
+
+def test_production_fit_step_recompile_free():
+    """ISSUE 3 acceptance: the production fit step's executable cache
+    stays at ONE entry across a 3-value parameter sweep (values enter
+    as runtime args; the trace must not re-key)."""
+    model, toas = _problem()
+    step_fn, args, names = build_fit_step(model, toas)
+    jitted = jax.jit(step_fn)
+    out = jitted(*args)
+    jax.block_until_ready(out)
+    assert jitted._cache_size() == 1
+    san = Sanitizer()
+    san.watch(jitted, "fit_step")
+    for delta in (1e-11, 2e-11, -3e-11):
+        model.F0.add_delta(delta)
+        model.invalidate_cache(params_only=True)
+        _, _, th, tl, fh, fl = model._pack()
+        new_args = (jnp.asarray(th), jnp.asarray(tl),
+                    jnp.asarray(fh), jnp.asarray(fl)) + args[4:]
+        out = jitted(*new_args)
+    jax.block_until_ready(out)
+    assert jitted._cache_size() == 1
+    assert san.executable_growth()["fit_step"] == 0
+    # a changed operand STRUCTURE (dtype here) is a legitimate new
+    # executable — the counter must see it, or it could never have
+    # caught the regression in the first place
+    jitted(jnp.asarray(th, jnp.float32), *new_args[1:])
+    assert jitted._cache_size() == 2
+    assert san.executable_growth()["fit_step"] == 1
+
+
+def test_wrap_flags_host_operands_and_nans():
+    san = Sanitizer(nan_check=True)
+
+    def dispatch(x):
+        return x * 2.0
+
+    guarded = san.wrap(dispatch, "d")
+    guarded(jnp.ones(3))
+    assert not san.host_crossings
+    san.assert_no_host_crossings()
+    guarded(np.ones(3))  # host ndarray crossing into a dispatch
+    assert san.host_crossings == [("d", 1)]
+    with pytest.raises(SanitizerError):
+        san.assert_no_host_crossings()
+    bad = san.wrap(lambda: jnp.array([np.nan]), "nanfn")
+    with pytest.raises(SanitizerError):
+        bad()
+
+
+def test_recompile_guard_fixture(recompile_guard):
+    """The conftest fixture wires a Sanitizer around the test body."""
+    model, toas = _problem(60)
+    recompile_guard.reset()  # _problem's simulation warm-up counted
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        Residuals(toas, model).time_resids
+        model.DM.add_delta(1e-6)
+        model.invalidate_cache(params_only=True)
+        Residuals(toas, model).time_resids
+    assert recompile_guard.compiles("phase") == 1

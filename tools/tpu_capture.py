@@ -87,6 +87,13 @@ def remaining():
 def _init_jax():
     import jax
 
+    # bounded probe BEFORE any in-process backend touch (graftlint
+    # G6): run directly (outside the watcher's timeout), a wedged
+    # tunnel would hang jax.default_backend() below with no error
+    if not bench.accelerator_responsive():
+        bench.log("backend probe unresponsive (wedged tunnel?); "
+                  "refusing the in-process backend init")
+        sys.exit(4)
     jax.config.update("jax_enable_x64", True)
     from pint_tpu.config import enable_compile_cache
 
