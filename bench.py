@@ -98,6 +98,24 @@ def roofline_fields(jitted, args, step_t, backend):
     return out
 
 
+def attach_dispatch_counters(rec):
+    """Embed the runtime dispatch-supervisor counters (retries,
+    timeouts, breaker state, failovers) in a benchmark record, so a
+    degraded run — breaker-open, host-failover numbers — is labeled
+    in the artifact itself, never silently slow. setdefault, never
+    assignment: a record carried over from a SUBPROCESS (the late TPU
+    probe) already holds that process's counters, and this process's
+    all-zero snapshot must not erase its degradation label."""
+    try:
+        from pint_tpu.runtime import get_supervisor
+
+        rec.setdefault("dispatch_supervisor",
+                       get_supervisor().snapshot())
+    except Exception as e:  # the artifact must survive a broken import
+        log(f"  dispatch counters unavailable: {e!r}")
+    return rec
+
+
 def tpu_record_append(rec):
     """Append a benchmark record to the committed on-chip ledger
     (BENCH_TPU.jsonl) with a UTC stamp. Called for every record
@@ -900,7 +918,7 @@ def main():
                                  "committed on-chip record found")
 
     if north_star_only:
-        print(json.dumps(north))
+        print(json.dumps(attach_dispatch_counters(north)))
         return
     if backend != "tpu":
         # CPU fallback: replay the committed on-chip records so the
@@ -927,7 +945,7 @@ def main():
     except ValueError:
         log("unparseable PINT_TPU_BENCH_BUDGET_S; using 1200s")
         budget_s = 1200.0
-    print(json.dumps(north))
+    print(json.dumps(attach_dispatch_counters(north)))
     sys.stdout.flush()
 
     # free the big problem before the extra configs
@@ -951,19 +969,25 @@ def main():
             print(json.dumps(rec))
         except Exception as e:  # a config failure must not cost the
             log(f"{fn.__name__} failed: {e!r}")  # north-star artifact
-        print(json.dumps(north))
+        print(json.dumps(attach_dispatch_counters(north)))
         sys.stdout.flush()
 
     # retry the TPU late if this process is the CPU fallback: the
     # tunnel may have recovered while the heavy work ran
+    north_is_foreign = False
     if os.environ.get("PINT_TPU_BENCH_FALLBACK"):
         late = late_tpu_probe()
         if late is not None and late.get("backend") == "tpu":
             log("late TPU probe succeeded; recording TPU north star")
-            print(json.dumps(north))  # keep the CPU record visible
+            print(json.dumps(attach_dispatch_counters(north)))  # keep the CPU record visible
             north = late
+            north_is_foreign = True  # counters are the SUBPROCESS's
 
-    print(json.dumps(north))
+    if not north_is_foreign:
+        # final refresh of this process's own counters (the attach is
+        # setdefault, so configs-phase activity needs the drop first)
+        north.pop("dispatch_supervisor", None)
+    print(json.dumps(attach_dispatch_counters(north)))
 
 
 if __name__ == "__main__":

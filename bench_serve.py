@@ -19,11 +19,9 @@ count vs bucket count).
 from __future__ import annotations
 
 import argparse
-import io
 import json
 import sys
 import time
-import warnings
 
 
 def log(*a):
@@ -32,62 +30,18 @@ def log(*a):
 
 def build_workload(nreq: int):
     """nreq mixed-shape requests over 6 pulsars in three TOA classes
-    (50/100/200 -> buckets 64/128/256) plus polyco phase reads.
+    (50..200 -> buckets 64/128/256) plus polyco phase reads.
     Problems are prebuilt once — the serving-state hot path (a
     service holding hot pulsar states re-solves on every poll), so
-    the measured loop is dispatch work, not model assembly."""
-    import numpy as np
+    the measured loop is dispatch work, not model assembly. The
+    actual builder is ``pint_tpu.serve.workload.build_workload``
+    (shared with the pint_serve demo daemon — ONE workload builder,
+    per the PR-3 review)."""
+    from pint_tpu.serve.workload import BENCH_SIZES
+    from pint_tpu.serve.workload import build_workload as _build
 
-    from pint_tpu.models import get_model
-    from pint_tpu.parallel.pta import build_problem
-    from pint_tpu.polycos import PolycoEntry
-    from pint_tpu.simulation import make_fake_toas_uniform
-
-    problems = []
-    for k, ntoa in enumerate((50, 60, 100, 120, 200, 180)):
-        par = (f"PSR J{1300 + k}\nRAJ 12:0{k}:00.0 1\n"
-               f"DECJ 30:0{k}:00.0 1\nF0 {150.0 + 31.0 * k} 1\n"
-               f"F1 -1e-15 1\nPEPOCH 55000\nPOSEPOCH 55000\n"
-               f"DM {10 + k} 1\nTZRMJD 55000.1\nTZRSITE @\n"
-               f"TZRFRQ 1400\nUNITS TDB\n")
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")
-            m = get_model(io.StringIO(par))
-            t = make_fake_toas_uniform(
-                54000, 56000, ntoa, m, error_us=1.0, add_noise=True,
-                rng=np.random.default_rng(k))
-        m.F0.add_delta(1e-10)
-        m.invalidate_cache(params_only=True)
-        problems.append(build_problem(t, m))
-    entry = PolycoEntry(
-        psrname="BENCH", tmid=55000.0, rphase_int=1e9,
-        rphase_frac=0.25, f0=200.0, obs="@", span_min=60.0,
-        coeffs=np.array([0.02, 1e-3, -2e-5, 1e-7]))
-
-    def fresh():
-        """Request objects are single-shot (their future resolves
-        once): rebuild the request list per pass, sharing the
-        prebuilt problems/entry."""
-        from pint_tpu.serve import (
-            FitStepRequest,
-            PhasePredictRequest,
-            ResidualsRequest,
-        )
-
-        reqs = []
-        for i in range(nreq):
-            if i % 7 == 6:
-                mjds = 55000.0 + np.linspace(-0.01, 0.01, 24)
-                reqs.append(PhasePredictRequest(entry, mjds))
-            elif i % 3 == 2:
-                reqs.append(ResidualsRequest(
-                    problem=problems[i % len(problems)]))
-            else:
-                reqs.append(FitStepRequest(
-                    problem=problems[i % len(problems)]))
-        return reqs
-
-    return fresh
+    return _build(nreq, sizes=BENCH_SIZES, base=1300, prebuild=True,
+                  entry_name="BENCH")
 
 
 def _drive_sequential(engine, reqs):
@@ -197,6 +151,10 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
         "bucket_count": co_snap["bucket_count"],
         "p50_ms": co_snap["p50_ms"],
         "p99_ms": co_snap["p99_ms"],
+        # dispatch-supervisor counters (retries, timeouts, breaker
+        # state, failovers): a degraded run is labeled in the
+        # artifact itself, never silently slow
+        "dispatch_supervisor": co_snap.get("dispatch"),
     }
     if "coalesced_mesh" in co_best:
         rec["mesh_sharded_wall_ms"] = round(

@@ -25,4 +25,16 @@ ALLOWLIST = [
              "benchmark driver launched as its own process by "
              "tpu_watcher.sh, and must pin x64 before any trace; no "
              "library code imports it"),
+    # ------------------------------------------- G6 (dispatch layer)
+    dict(rule="G6", file="pint_tpu/config.py",
+         match="float(f(x))", max_hits=2,
+         why="dispatch_rtt_ms's trivial probe dispatch IS the "
+             "supervisor's own sizing input — routing it through the "
+             "supervisor would recurse into the deadline prediction "
+             "that needs it. The supervisor bounds it from outside: "
+             "DispatchSupervisor._measure_rtt_guarded runs this "
+             "whole function on the guarded worker under the "
+             "breaker-probe timeout; remaining direct callers "
+             "(auto_steps_per_dispatch on an accelerator) run after "
+             "the session-start bounded-probe protocol"),
 ]

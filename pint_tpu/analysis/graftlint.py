@@ -26,10 +26,20 @@ Rules (see ARCHITECTURE.md "Static analysis" for the table):
   G5  hybrid-Jacobian claims are paired (linear_design_names defined
       iff linear_design_local is) and every claiming component is
       exercised by test_all_components.py's SINK_PAR sweep
-  G6  tools// scripts/ TPU-touching invocations are timeout-bounded:
-      shell lines invoking python carry `timeout`, subprocess calls
-      pass timeout=, in-process backend touches are preceded by a
-      bounded probe (bench.accelerator_responsive)
+  G6  timeout bounds on everything that can touch a wedged backend:
+      (a) tools// scripts/ — shell lines invoking python carry
+      `timeout`, subprocess calls pass timeout=, in-process backend
+      touches are preceded by a bounded probe
+      (bench.accelerator_responsive); (b) the production dispatch
+      layer (fitter/gls/wideband_fitter/config + serve/ + parallel/)
+      — a jit-product (name assigned from jax.jit(...), a
+      jit-decorated kernel, or an immediate jax.jit(...)(...) call)
+      must not be CALLED directly: route it through
+      pint_tpu.runtime.DispatchSupervisor.dispatch (pass the callable
+      as an argument), which owns the watchdog deadline / breaker /
+      host-failover policy. Sanctioned internal sites (closures the
+      supervisor itself executes, the RTT probe) carry pragmas or
+      allowlist entries.
   G7  jax.config.update only in sanctioned entry points (the config
       is process-global; a stray update mid-library flips x64 or the
       platform under every other caller)
@@ -73,7 +83,8 @@ RULES = {
     "G3": "component class docstring must cite its reference",
     "G4": "every numeric parameter needs a param_dimensions spec",
     "G5": "linear-design claims paired and exercised by SINK_PAR",
-    "G6": "TPU-touching invocations must be timeout-bounded",
+    "G6": "TPU-touching invocations timeout-bounded; dispatch-layer "
+          "jit calls route through the runtime supervisor",
     "G7": "jax.config.update only in sanctioned entry points",
     "G8": "no functools.lru_cache on methods",
 }
@@ -599,6 +610,96 @@ def _g6_applies(relpath: str) -> bool:
     return relpath.startswith("tools/") or "/scripts/" in relpath
 
 
+# the production dispatch layer: every device call here must route
+# through pint_tpu.runtime.DispatchSupervisor (runtime/ itself is the
+# supervisor — exempt by construction). Host-side exploration tools
+# (mcmc, bayesian, templates, gridutils, pintk) are deliberately
+# outside the set: they are interactive analysis surfaces, not the
+# serving/fitting path the north star load-bears on.
+G6_DISPATCH_FILES = {"pint_tpu/fitter.py", "pint_tpu/gls.py",
+                     "pint_tpu/wideband_fitter.py",
+                     "pint_tpu/config.py"}
+G6_DISPATCH_DIRS = ("pint_tpu/serve/", "pint_tpu/parallel/")
+
+
+def _g6_dispatch_applies(relpath: str) -> bool:
+    if relpath.startswith("pint_tpu/runtime/"):
+        return False
+    return relpath in G6_DISPATCH_FILES or \
+        relpath.startswith(G6_DISPATCH_DIRS)
+
+
+def collect_jit_products(modules: List[ModuleInfo]):
+    """Names bound to jit PRODUCTS (callables whose invocation is a
+    device dispatch): assignment targets of a jit(...) call —
+    including ``self.x = jax.jit(...)`` attributes — and functions
+    decorated with a jit. Private names are shared across modules
+    (wideband_fitter imports gls's _gls_kernel); public names stay
+    module-local, same convention as the jit-reachability seeds."""
+    per_module: Dict[str, Set[str]] = {}
+    global_private: Set[str] = set()
+    for m in modules:
+        names: Set[str] = set()
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _tail_name(node.value.func) == "jit":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        names.add(t.attr)
+        for f in m.functions:
+            if any(_decorator_is_jit(d) for d in f.decorator_list):
+                names.add(f.name)
+        per_module[m.relpath] = names
+        global_private |= {n for n in names if n.startswith("_")}
+    return per_module, global_private
+
+
+def check_g6_dispatch(m: ModuleInfo,
+                      products: Set[str]) -> List[Violation]:
+    """Dispatch-layer half of G6: direct CALLS of jit products bypass
+    the runtime supervisor's watchdog/breaker/failover policy — on a
+    wedged axon tunnel that is an unbounded hang. Passing the product
+    as an argument (supervisor.dispatch(kernel, ...)) is the
+    sanctioned route and is not a call, so it never flags."""
+    if not _g6_dispatch_applies(m.relpath):
+        return []
+    out = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Call) and \
+                _tail_name(fn.func) == "jit":
+            out.append(Violation(
+                "G6", m.relpath, node.lineno,
+                "immediate jax.jit(...)(...) dispatch in the "
+                "supervised dispatch layer bypasses the runtime "
+                "supervisor (watchdog/breaker/failover) — route it "
+                "through DispatchSupervisor.dispatch",
+                m.line_text(node.lineno)))
+            continue
+        tail = _tail_name(fn)
+        if tail not in products:
+            continue
+        # flag bare names AND any attribute chain ending in a product
+        # name (self._gls, engine.cache._gls, ...) — a known limit:
+        # a local alias (k = self._k; k(x)) escapes this static
+        # check, same approximation class as the jit-reachability
+        # inference
+        if isinstance(fn, (ast.Name, ast.Attribute)):
+            out.append(Violation(
+                "G6", m.relpath, node.lineno,
+                f"direct call of jit product `{tail}` in the "
+                f"supervised dispatch layer bypasses the runtime "
+                f"supervisor (unbounded hang on a wedged tunnel) — "
+                f"pass it to DispatchSupervisor.dispatch instead",
+                m.line_text(node.lineno)))
+    return out
+
+
 def check_g6_python(m: ModuleInfo) -> List[Violation]:
     """Timeout bounds in tools//scripts Python. The bounded-probe
     requirement is module-wide and order-insensitive — a deliberate
@@ -983,11 +1084,14 @@ def run_lint(root: str, dynamic: bool = True,
             report.violations.append(Violation(
                 "PARSE", relpath, e.lineno or 0, f"syntax error: {e}"))
     seed_names = collect_jit_seed_names(modules)
+    prod_per_module, prod_private = collect_jit_products(modules)
     for m in modules:
         mark_jit_regions(m, seed_names.get(m.relpath, set()))
         report.violations += check_g1(m)
         report.violations += check_g2(m)
         report.violations += check_g6_python(m)
+        report.violations += check_g6_dispatch(
+            m, prod_per_module.get(m.relpath, set()) | prod_private)
         report.violations += check_g7(m)
         report.violations += check_g8(m)
     for relpath, src in shell:

@@ -85,6 +85,32 @@ def _wls_solve(M, r, err_s, threshold_arg=None):
     return x, cov, chi2_post
 
 
+def _wls_solve_np(M, r, err_s, threshold=None):
+    """Pure-numpy mirror of _wls_solve — the supervised dispatch's
+    host-failover path (identical two-stage scaling + thresholded
+    SVD, scipy/numpy linalg)."""
+    w = 1.0 / err_s
+    colmax = np.max(np.abs(M), axis=0)
+    colmax[colmax == 0] = 1.0
+    Mw = (M / colmax[None, :]) * w[:, None]
+    rw = r * w
+    norm = np.sqrt(np.sum(Mw * Mw, axis=0))
+    norm[norm == 0] = 1.0
+    Mn = Mw / norm[None, :]
+    U, s, Vt = np.linalg.svd(Mn, full_matrices=False)
+    thresh = (threshold if threshold is not None
+              else np.finfo(np.float64).eps * max(M.shape))
+    keep = s > thresh * s[0]
+    with np.errstate(divide="ignore"):
+        s_inv = np.where(keep, 1.0 / np.where(s == 0, 1.0, s), 0.0)
+    x_n = Vt.T @ (s_inv * (U.T @ rw))
+    x = x_n / colmax / norm
+    cov_n = (Vt.T * (s_inv ** 2)[None, :]) @ Vt
+    cov = cov_n / np.outer(colmax, colmax) / np.outer(norm, norm)
+    resid_post = rw - Mn @ x_n
+    return x, cov, float(np.sum(resid_post ** 2))
+
+
 class Fitter:
     """Base fitter: parameter bookkeeping + the fit_toas contract
     (reference: Fitter)."""
@@ -119,6 +145,29 @@ class Fitter:
         from pint_tpu.config import solve_device
 
         return solve_device(self.toas.ntoas) is not None
+
+    def _wls_dispatch(self, M, r, err_s, threshold):
+        """The WLS solve routed through the runtime dispatch
+        supervisor: watchdog deadline on accelerator backends, host
+        numpy-mirror failover when the backend is timed out, broken
+        or breaker-open (pint_tpu.runtime). Placement (jnp.asarray)
+        happens INSIDE the dispatched closure: an H2D transfer to a
+        wedged tunnel hangs exactly like a dispatch, so it must ride
+        the same watchdog; for a pinned solve the closure runs inline
+        on the caller thread, where the thread-local device scope
+        applies."""
+        from pint_tpu.runtime import get_supervisor
+
+        M_h, r_h, e_h = (np.asarray(M), np.asarray(r),
+                         np.asarray(err_s))
+
+        def run():
+            with self._solve_scope():
+                return _wls_solve(jnp.asarray(M_h), jnp.asarray(r_h), jnp.asarray(e_h), threshold_arg=threshold)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+        return get_supervisor().dispatch(
+            run, key="wls.solve", pinned=self._solve_pinned(),
+            fallback=lambda: _wls_solve_np(M_h, r_h, e_h, threshold))
 
     def _record_stats(self, chi2: float, iterations: int, t0: float,
                       dof=None):
@@ -190,6 +239,7 @@ class Fitter:
                 "for single linearized solves)")
         if device is None:
             from pint_tpu.config import solve_device
+            from pint_tpu.runtime import breaker_for
 
             device = (downhill
                       and jax.default_backend() == "tpu"
@@ -197,7 +247,12 @@ class Fitter:
                       # tiny problems route to host fitters whose
                       # solves pin to the CPU backend (_solve_scope):
                       # dispatch latency dwarfs the compute
-                      and solve_device(toas.ntoas) is None)
+                      and solve_device(toas.ntoas) is None
+                      # an OPEN circuit breaker means the backend is
+                      # wedged/dead: route new fits straight to the
+                      # host fitters until a half-open probe closes it
+                      and not breaker_for(
+                          jax.default_backend()).is_open)
         if device and downhill:
             from pint_tpu.gls import DeviceDownhillGLSFitter
 
@@ -266,10 +321,7 @@ class WLSFitter(Fitter):
             r = self.resids.time_resids
             err_s = self.toas.get_errors() * 1e-6
             M, names, units = self.get_designmatrix()
-            with self._solve_scope():
-                x, cov, _ = _wls_solve(jnp.asarray(M), jnp.asarray(r),
-                                       jnp.asarray(err_s),
-                                       threshold_arg=threshold)
+            x, cov, _ = self._wls_dispatch(M, r, err_s, threshold)
             # residual here is model-phase excess: r ≈ M·(θ−θ_true), so
             # the parameter correction is −x
             x = -np.asarray(x)
@@ -302,10 +354,7 @@ class DownhillWLSFitter(WLSFitter):
             r = self.resids.time_resids
             err_s = self.toas.get_errors() * 1e-6
             M, names, units = self.get_designmatrix()
-            with self._solve_scope():
-                x, cov, _ = _wls_solve(jnp.asarray(M), jnp.asarray(r),
-                                       jnp.asarray(err_s),
-                                       threshold_arg=threshold)
+            x, cov, _ = self._wls_dispatch(M, r, err_s, threshold)
             x = -np.asarray(x)  # see WLSFitter: correction is −solution
             lam = 1.0
             accepted = False

@@ -34,9 +34,18 @@ import numpy as np
 
 from pint_tpu.fitter import Fitter, MaxiterReached
 from pint_tpu.residuals import Residuals
+from pint_tpu.runtime import DispatchError, get_supervisor
 
 __all__ = ["GLSFitter", "DownhillGLSFitter",
-           "DeviceDownhillGLSFitter", "gls_solve_np"]
+           "DeviceDownhillGLSFitter", "gls_solve_np",
+           "NonFiniteStepError"]
+
+
+class NonFiniteStepError(ValueError):
+    """The Cholesky-only device step produced non-finite values
+    (singular/degenerate system). Subclasses ValueError for
+    backward compatibility; the device fitter catches it to fail
+    over to the host fitters' SVD-capable path."""
 
 
 @partial(jax.jit, static_argnames=("f32mm",))
@@ -152,6 +161,22 @@ def _gls_chi2_kernel(F, phi, r, nvec):
         jax.scipy.linalg.cho_solve(cf, bF / d) / d)
 
 
+def _gls_chi2_np(F, phi, r, nvec) -> float:
+    """Numpy mirror of _gls_chi2_kernel — the supervised dispatch's
+    host-failover path (same Woodbury-in-basis-space algebra with
+    scipy cho_factor)."""
+    from scipy.linalg import cho_factor, cho_solve
+
+    w = 1.0 / nvec
+    bF = (F * w[:, None]).T @ r
+    Sff = F.T @ (F * w[:, None]) + np.diag(1.0 / phi)
+    d = np.sqrt(np.diagonal(Sff)).copy()
+    d[(d == 0) | ~np.isfinite(d)] = 1.0
+    cf = cho_factor(Sff / np.outer(d, d), lower=True)
+    return float(np.sum(r * r * w)
+                 - bF @ (cho_solve(cf, bF / d) / d))
+
+
 def gls_chi2(model, toas, resids=None) -> float:
     """GLS-aware chi2 of current residuals (basis-marginalized)."""
     r = resids if resids is not None else Residuals(toas, model).time_resids
@@ -160,12 +185,22 @@ def gls_chi2(model, toas, resids=None) -> float:
     if F is None:
         return float(np.sum(np.asarray(r) ** 2 / nvec))
     phi = model.noise_model_basis_weight(toas)
-    from pint_tpu.config import solve_scope
+    from pint_tpu.config import solve_device, solve_scope
 
-    with solve_scope(toas.ntoas):
-        return float(_gls_chi2_kernel(jnp.asarray(F), jnp.asarray(phi),
-                                      jnp.asarray(r),
-                                      jnp.asarray(nvec)))
+    F_h, phi_h = np.asarray(F), np.asarray(phi)
+    r_h, nvec_h = np.asarray(r), np.asarray(nvec)
+
+    def run():
+        # placement INSIDE the dispatched closure: H2D to a wedged
+        # tunnel hangs like a dispatch, so it rides the watchdog too
+        with solve_scope(toas.ntoas):
+            return _gls_chi2_kernel(jnp.asarray(F_h), jnp.asarray(phi_h), jnp.asarray(r_h), jnp.asarray(nvec_h))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+    out = get_supervisor().dispatch(
+        run, key="gls.chi2",
+        pinned=solve_device(toas.ntoas) is not None,
+        fallback=lambda: _gls_chi2_np(F_h, phi_h, r_h, nvec_h))
+    return float(out)
 
 
 @jax.jit
@@ -226,6 +261,62 @@ def gls_solve_np(M, F, phi, r, nvec):
             F @ xhat[p:])
 
 
+def _gls_svd_np(M, F, phi, r, nvec, threshold=1e-12):
+    """Pure-numpy mirror of _gls_kernel_svd (Jacobi-preconditioned
+    eigh, small-eigenvalue dropping) — the host-failover path for the
+    explicit-threshold branch and for degenerate systems where the
+    Cholesky mirror raises or produces non-finites."""
+    p = M.shape[1]
+    w = 1.0 / nvec
+    colmax = np.max(np.abs(M), axis=0)
+    colmax[colmax == 0] = 1.0
+    Ms = M / colmax[None, :]
+    norm = np.sqrt(np.sum(Ms * Ms * w[:, None], axis=0))
+    norm[norm == 0] = 1.0
+    Mn = Ms / norm[None, :]
+    big = np.concatenate([Mn, F], axis=1)
+    bigw = big * w[:, None]
+    Sigma = big.T @ bigw + np.diag(
+        np.concatenate([np.zeros(p), 1.0 / phi]))
+    b = bigw.T @ r
+    d = np.sqrt(np.diagonal(Sigma)).copy()
+    d[(d == 0) | ~np.isfinite(d)] = 1.0
+    Sp = Sigma / np.outer(d, d)
+    s, U = np.linalg.eigh(Sp)
+    keep = s > threshold * s[-1]
+    s_inv = np.where(keep, 1.0 / np.where(keep, s, 1.0), 0.0)
+    xhat = (U @ (s_inv * (U.T @ (b / d)))) / d
+    inv = ((U * s_inv[None, :]) @ U.T) / np.outer(d, d)
+    chi2 = float(np.sum(r * r * w) - xhat @ b)
+    scale = colmax * norm
+    return (xhat[:p] / scale, inv[:p, :p] / np.outer(scale, scale),
+            chi2, F @ xhat[p:])
+
+
+def _gls_host_failover_solve(M, F, phi, r, nvec, threshold=None,
+                             what="normal matrix"):
+    """Mode-aware host failover solve (the 'degraded in speed, not
+    correctness' contract): honor an explicit SVD threshold; try the
+    Cholesky mirror otherwise; degrade to the eigh mirror — with the
+    same DegeneracyWarning the device path emits — when the system is
+    singular enough that Cholesky raises or returns non-finites. The
+    full_cov cross-check mode also lands here: the basis-Woodbury
+    mirror is the same algebra by Woodbury identity."""
+    if threshold is not None:
+        return _gls_svd_np(M, F, phi, r, nvec,
+                           threshold=float(threshold))
+    try:
+        x, cov, chi2, noise = gls_solve_np(M, F, phi, r, nvec)
+        if np.all(np.isfinite(x)) and np.isfinite(chi2):
+            return x, cov, chi2, noise
+    except np.linalg.LinAlgError:
+        pass
+    from pint_tpu.fitter import warn_degenerate
+
+    warn_degenerate(what)
+    return _gls_svd_np(M, F, phi, r, nvec)
+
+
 class GLSFitter(Fitter):
     """GLS fit with correlated noise marginalized in basis space
     (reference: GLSFitter)."""
@@ -250,35 +341,76 @@ class GLSFitter(Fitter):
         if Fb is None:
             Fb = np.zeros((self.toas.ntoas, 0))
             phi = np.ones(0)
-        with self._solve_scope():
-            # asarray INSIDE the scope: placement follows the pinned
-            # device (converting first would ship tiny solves to the
-            # accelerator just to pull them back)
-            r, M, nvec = (jnp.asarray(r), jnp.asarray(M),
-                          jnp.asarray(nvec))
-            Fb, phi = jnp.asarray(Fb), jnp.asarray(phi)
-            if self.full_cov:
-                x, cov, chi2, noise = _gls_kernel_fullcov(
-                    M, Fb, phi, r, nvec)
-            elif threshold is not None:
-                x, cov, chi2, noise, _ = _gls_kernel_svd(
-                    M, Fb, phi, r, nvec, threshold=float(threshold))
-            else:
-                from pint_tpu.parallel.fit_step import _use_f32_matmul
+        try:
+            return self._solve_once_device(M, Fb, phi, r, nvec,
+                                           names, threshold)
+        except DispatchError as e:
+            # host failover (timed-out / broken / breaker-open
+            # backend): the pure-numpy mirror of the same algebra —
+            # degraded in speed, not in correctness (mode-aware: the
+            # threshold/degenerate route gets the eigh mirror)
+            get_supervisor().note_failover("gls.solve", e)
+            x, cov, chi2, noise = _gls_host_failover_solve(
+                np.asarray(M), np.asarray(Fb), np.asarray(phi),
+                np.asarray(r), np.asarray(nvec), threshold=threshold)
+            return (-np.asarray(x), np.asarray(cov), float(chi2),
+                    np.asarray(noise), names)
 
-                # when the solve is pinned to the host CPU the f32-MXU
-                # auto-on (keyed on the process backend) is moot: CPU
-                # f64 is native, so keep full precision there
-                f32mm = False if self._solve_pinned() else \
-                    _use_f32_matmul(None)
-                x, cov, chi2, noise, _, ok = _gls_kernel(
-                    M, Fb, phi, r, nvec, f32mm=f32mm)
-                if not bool(ok):
-                    from pint_tpu.fitter import warn_degenerate
+    def _solve_once_device(self, M, Fb, phi, r, nvec, names,
+                           threshold):
+        sup = get_supervisor()
+        pinned = self._solve_pinned()
+        M_h, Fb_h, phi_h = (np.asarray(M), np.asarray(Fb),
+                            np.asarray(phi))
+        r_h, nvec_h = np.asarray(r), np.asarray(nvec)
 
-                    warn_degenerate()
-                    x, cov, chi2, noise, _ = _gls_kernel_svd(
-                        M, Fb, phi, r, nvec)
+        def place():
+            # asarray INSIDE the dispatched closure AND inside the
+            # scope: placement follows the pinned device (converting
+            # first would ship tiny solves to the accelerator just to
+            # pull them back), and an H2D transfer to a wedged tunnel
+            # hangs like a dispatch — it must ride the same watchdog
+            return (jnp.asarray(M_h), jnp.asarray(Fb_h),
+                    jnp.asarray(phi_h), jnp.asarray(r_h),
+                    jnp.asarray(nvec_h))
+
+        def run_fullcov():
+            with self._solve_scope():
+                return _gls_kernel_fullcov(*place())  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+        def run_svd(th=None):
+            with self._solve_scope():
+                if th is None:
+                    return _gls_kernel_svd(*place())  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+                return _gls_kernel_svd(*place(), threshold=th)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+        def run_chol(f32mm=False):
+            with self._solve_scope():
+                return _gls_kernel(*place(), f32mm=f32mm)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+        if self.full_cov:
+            x, cov, chi2, noise = sup.dispatch(
+                run_fullcov, key="gls.fullcov", pinned=pinned)
+        elif threshold is not None:
+            x, cov, chi2, noise, _ = sup.dispatch(
+                run_svd, kw={"th": float(threshold)},
+                key="gls.svd", pinned=pinned)
+        else:
+            from pint_tpu.parallel.fit_step import _use_f32_matmul
+
+            # when the solve is pinned to the host CPU the f32-MXU
+            # auto-on (keyed on the process backend) is moot: CPU
+            # f64 is native, so keep full precision there
+            f32mm = False if pinned else _use_f32_matmul(None)
+            x, cov, chi2, noise, _, ok = sup.dispatch(
+                run_chol, kw={"f32mm": f32mm}, key="gls.solve",
+                pinned=pinned)
+            if not bool(ok):
+                from pint_tpu.fitter import warn_degenerate
+
+                warn_degenerate()
+                x, cov, chi2, noise, _ = sup.dispatch(
+                    run_svd, key="gls.svd", pinned=pinned)
         # r ≈ M (θ − θ_true): the correction is −x (see WLSFitter)
         return (-np.asarray(x), np.asarray(cov), float(chi2),
                 np.asarray(noise), names)
@@ -391,14 +523,78 @@ class DeviceDownhillGLSFitter(GLSFitter):
         measured dispatch RTT (config.auto_steps_per_dispatch: 1 on
         CPU, ~4-8 on a local chip, 16-32 over the high-latency axon
         tunnel); the chained loop early-exits on in-kernel convergence
-        so oversizing K wastes no iterations."""
+        so oversizing K wastes no iterations.
+
+        Every device dispatch runs under the runtime supervisor's
+        watchdog deadline; an unresponsive/broken backend (or a
+        non-finite first step — the host fitters carry the SVD
+        fallback the device step lacks) fails the WHOLE fit over to
+        the host downhill fitter. The model is only ever mutated
+        after a completed dispatch loop, so the failover starts from
+        the pre-fit state and its result is bit-identical to running
+        the host fitter directly."""
+        t0 = time.perf_counter()
+        try:
+            return self._fit_device(maxiter, min_lambda,
+                                    required_chi2_decrease,
+                                    steps_per_dispatch, t0)
+        except (DispatchError, NonFiniteStepError) as e:
+            get_supervisor().note_failover("gls.device_fit", e)
+            return self._fit_host_failover(
+                maxiter, min_lambda, required_chi2_decrease, e, t0)
+
+    def _fit_host_failover(self, maxiter, min_lambda,
+                           required_chi2_decrease, cause, t0):
+        """Degraded-but-correct: rerun the fit through the host
+        downhill fitter (CPU-pinned exact-dd surfaces + SVD-capable
+        solve) and adopt its fitted state wholesale."""
+        import warnings as _warnings
+
+        if self.wideband:
+            from pint_tpu.wideband_fitter import WidebandDownhillFitter
+
+            host = WidebandDownhillFitter(self.toas, self.model,
+                                          track_mode=self.track_mode)
+        else:
+            host = DownhillGLSFitter(self.toas, self.model,
+                                     track_mode=self.track_mode)
+        _warnings.warn(
+            f"device fit unavailable ({type(cause).__name__}: "
+            f"{cause}); failed over to {type(host).__name__}",
+            RuntimeWarning, stacklevel=3)
+        chi2 = host.fit_toas(
+            maxiter=maxiter, min_lambda=min_lambda,
+            required_chi2_decrease=required_chi2_decrease)
+        self.resids = host.resids
+        self.errors = host.errors
+        self.parameter_covariance_matrix = \
+            host.parameter_covariance_matrix
+        self.noise_resids = host.noise_resids
+        if self.wideband:
+            self.dm_resids = host.dm_resids
+        self.converged = host.converged
+        self.stats = host.stats
+        if self.stats is not None:
+            # label the TRUE degraded latency: the wall must include
+            # the watchdog deadline burned before failover, not just
+            # the host rerun (degraded runs are labeled, never
+            # silently slow)
+            full_wall = time.perf_counter() - t0
+            self.stats.wall_time_s = full_wall
+            self.stats.toas_per_sec = (
+                self.stats.ntoa * max(1, self.stats.iterations)
+                / full_wall if full_wall else 0.0)
+        return chi2
+
+    def _fit_device(self, maxiter, min_lambda,
+                    required_chi2_decrease, steps_per_dispatch, t0):
         from pint_tpu.config import auto_steps_per_dispatch
         from pint_tpu.ops import dd_np
         from pint_tpu.parallel import build_fit_loop, build_fit_step
 
         if steps_per_dispatch is None:
             steps_per_dispatch = auto_steps_per_dispatch()
-        t0 = time.perf_counter()
+        sup = get_supervisor()
 
         def bump(th_, tl_, d):
             """(th, tl) + d with the low part carrying the rounding
@@ -407,7 +603,7 @@ class DeviceDownhillGLSFitter(GLSFitter):
             return np.asarray(s[0]), np.asarray(s[1])
 
         def nonfinite_error():
-            raise ValueError(
+            raise NonFiniteStepError(
                 "device fit step produced non-finite values "
                 "(singular system? use GLSFitter's SVD fallback)")
 
@@ -436,10 +632,20 @@ class DeviceDownhillGLSFitter(GLSFitter):
         iterations = 0
         converged = False
         maxed_out = False
+        chained_k = int(min(steps_per_dispatch, maxiter))
+
+        def run(th_, tl_):
+            """One supervised device dispatch. Executed on the
+            supervisor's guarded worker; the host reads happen INSIDE
+            so the watchdog deadline covers completion — over the
+            axon tunnel the dispatch ack only confirms enqueue."""
+            out = jitted(jnp.asarray(th_), jnp.asarray(tl_), *rest)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            return [np.asarray(o) for o in out]
 
         if steps_per_dispatch > 1:
             while True:
-                out = jitted(jnp.asarray(th), jnp.asarray(tl), *rest)
+                out = sup.dispatch(run, th, tl, key="gls.fit_loop",
+                                   steps=chained_k)
                 dp = np.asarray(out[2], np.float64)
                 cov = np.asarray(out[3])
                 best = float(out[4])
@@ -462,11 +668,7 @@ class DeviceDownhillGLSFitter(GLSFitter):
                     maxed_out = True
                     break
         else:
-            def run(th_, tl_):
-                return jitted(jnp.asarray(th_), jnp.asarray(tl_),
-                              *rest)
-
-            out = run(th, tl)
+            out = sup.dispatch(run, th, tl, key="gls.fit_step")
             dp = np.asarray(out[0], np.float64)
             cov = np.asarray(out[1])
             best = float(out[2])
@@ -477,7 +679,8 @@ class DeviceDownhillGLSFitter(GLSFitter):
                 lam, accepted = 1.0, False
                 while lam >= min_lambda:
                     thc, tlc = bump(th, tl, lam * dp[noff:])
-                    outc = run(thc, tlc)
+                    outc = sup.dispatch(run, thc, tlc,
+                                        key="gls.fit_step")
                     newchi2 = float(outc[2])
                     if np.isfinite(newchi2) and \
                             newchi2 <= best + 1e-12:

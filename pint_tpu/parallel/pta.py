@@ -24,7 +24,7 @@ import numpy as np
 from pint_tpu.residuals import Residuals
 
 __all__ = ["PulsarProblem", "build_problem", "stack_problems",
-           "pta_solve", "fit_pta", "PTAFitResult"]
+           "pta_solve", "pta_solve_np", "fit_pta", "PTAFitResult"]
 
 
 class PTAFitResult(list):
@@ -167,32 +167,103 @@ def _solve_one(M, F, phi, r, nvec, valid, pvalid):
 _pta_kernel = jax.jit(jax.vmap(_solve_one))
 
 
+def _solve_one_np(M, F, phi, r, nvec, valid, pvalid):
+    """Pure-numpy mirror of ``_solve_one`` (identical masked algebra,
+    scipy Cholesky) — the host-failover path the dispatch supervisor
+    takes for one padded batch slot when the device is timed out,
+    broken or breaker-open."""
+    from scipy.linalg import cho_factor, cho_solve
+
+    p = M.shape[1]
+    w = valid / nvec
+    M = M * pvalid[None, :]
+    colmax = np.max(np.abs(M), axis=0)
+    colmax = np.where(colmax == 0, 1.0, colmax)
+    Ms = M / colmax[None, :]
+    norm = np.sqrt(np.sum(Ms * Ms * w[:, None], axis=0))
+    norm = np.where(norm == 0, 1.0, norm)
+    Mn = Ms / norm[None, :]
+    big = np.concatenate([Mn, F], axis=1)
+    bigw = big * w[:, None]
+    Sigma = big.T @ bigw
+    prior = np.concatenate([np.zeros(p), 1.0 / phi])
+    Sigma = Sigma + np.diag(prior)
+    colvalid = np.concatenate([pvalid, np.ones(F.shape[1])])
+    Sigma = Sigma * np.outer(colvalid, colvalid) + \
+        np.diag(1.0 - colvalid)
+    b = bigw.T @ r * colvalid
+    d = np.sqrt(np.diagonal(Sigma)).copy()
+    d[(d == 0) | ~np.isfinite(d)] = 1.0
+    cf = cho_factor(Sigma / np.outer(d, d), lower=True)
+    xhat = cho_solve(cf, b / d) / d
+    inv = cho_solve(cf, np.eye(Sigma.shape[0])) / np.outer(d, d)
+    rCr = float(np.sum(r * r * w))
+    chi2 = rCr - xhat @ b
+    q = F.shape[1]
+    if q:
+        bF = b[p:]
+        SF = Sigma[p:, p:]
+        dF = d[p:]
+        cfF = cho_factor(SF / np.outer(dF, dF), lower=True)
+        chi2r = rCr - bF @ (cho_solve(cfF, bF / dF) / dF)
+    else:
+        chi2r = rCr
+    dparams = -xhat[:p] / colmax / norm * pvalid
+    cov = inv[:p, :p] / np.outer(colmax, colmax) / np.outer(norm, norm)
+    return dparams, cov, float(chi2), float(chi2r)
+
+
+def pta_solve_np(stacked: dict):
+    """Host-path batch solve: ``_solve_one_np`` per slot, stacked —
+    the failover target for ``pta_solve`` and the serve engine's
+    batched GLS dispatch."""
+    P = stacked["M"].shape[0]
+    outs = [_solve_one_np(stacked["M"][k], stacked["F"][k],
+                          stacked["phi"][k], stacked["r"][k],
+                          stacked["nvec"][k], stacked["valid"][k],
+                          stacked["pvalid"][k])
+            for k in range(P)]
+    return (np.stack([o[0] for o in outs]),
+            np.stack([o[1] for o in outs]),
+            np.asarray([o[2] for o in outs]),
+            np.asarray([o[3] for o in outs]))
+
+
 def pta_solve(stacked: dict, mesh=None, axis: str = "pulsar"):
-    """Solve the whole batch in one device call. With ``mesh``, the
+    """Solve the whole batch in one supervised device call (runtime
+    watchdog + host ``pta_solve_np`` failover). With ``mesh``, the
     pulsar axis is block-sharded over ``axis`` (pads P up to a mesh
     multiple)."""
-    arrs = {k: jnp.asarray(v) for k, v in stacked.items()}
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as Pspec
+    from pint_tpu.runtime import get_supervisor
 
-        nshard = mesh.shape[axis]
-        P = arrs["M"].shape[0]
-        pad = (-P) % nshard
-        if pad:
-            arrs = {k: jnp.concatenate(
-                [v, jnp.ones((pad,) + v.shape[1:]) if k in
-                 ("nvec", "phi") else jnp.zeros((pad,) + v.shape[1:])],
-                axis=0) for k, v in arrs.items()}
-        sh = {k: NamedSharding(
-            mesh, Pspec(axis, *([None] * (v.ndim - 1))))
-            for k, v in arrs.items()}
-        arrs = {k: jax.device_put(v, sh[k]) for k, v in arrs.items()}
-        out = _pta_kernel(arrs["M"], arrs["F"], arrs["phi"], arrs["r"],
-                          arrs["nvec"], arrs["valid"], arrs["pvalid"])
+    P = np.asarray(stacked["M"]).shape[0]
+
+    def run():
+        """Place + dispatch + host read, all on the supervisor's
+        guarded worker so the deadline covers completion."""
+        arrs = {k: jnp.asarray(v) for k, v in stacked.items()}
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+            nshard = mesh.shape[axis]
+            pad = (-P) % nshard
+            if pad:
+                arrs = {k: jnp.concatenate(
+                    [v, jnp.ones((pad,) + v.shape[1:]) if k in
+                     ("nvec", "phi")
+                     else jnp.zeros((pad,) + v.shape[1:])],
+                    axis=0) for k, v in arrs.items()}
+            sh = {k: NamedSharding(
+                mesh, Pspec(axis, *([None] * (v.ndim - 1))))
+                for k, v in arrs.items()}
+            arrs = {k: jax.device_put(v, sh[k])
+                    for k, v in arrs.items()}
+        out = _pta_kernel(arrs["M"], arrs["F"], arrs["phi"], arrs["r"], arrs["nvec"], arrs["valid"], arrs["pvalid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
         return tuple(np.asarray(o)[:P] for o in out)
-    out = _pta_kernel(arrs["M"], arrs["F"], arrs["phi"], arrs["r"],
-                      arrs["nvec"], arrs["valid"], arrs["pvalid"])
-    return tuple(np.asarray(o) for o in out)
+
+    return get_supervisor().dispatch(
+        run, key="pta.batch",
+        fallback=lambda: pta_solve_np(stacked))
 
 
 def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,

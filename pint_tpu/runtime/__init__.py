@@ -1,0 +1,50 @@
+"""Fault-tolerant device dispatch (the runtime supervision layer).
+
+The ROADMAP north star is a production system serving heavy traffic
+"as fast as the hardware allows" — over a hardware link that
+demonstrably wedges, dies and drifts (CLAUDE.md environment gotchas).
+This package makes degraded-but-correct the guaranteed worst case
+instead of a lucky one. Every device-touching call site (device
+fitter steps in ``gls.py``, host-fitter GLS/WLS solves, the PTA batch
+solve, ``serve`` batch dispatches) routes through here:
+
+- ``runtime.supervisor``: the ``DispatchSupervisor`` — watchdog
+  deadlines on a guarded worker, transient-error retry with jittered
+  backoff, host failover, RTT-drift re-measure + K re-pick, and the
+  counters every bench artifact embeds so degraded runs are labeled;
+- ``runtime.breaker``: per-backend circuit breaker (CLOSED/OPEN/
+  HALF_OPEN) with bounded hang-proof re-probes;
+- ``runtime.faults``: deterministic fault injection (hang, transient
+  error, NaN output, RTT drift) at the dispatch boundary, so every
+  behavior above is testable on the CPU mesh.
+
+Env knobs: $PINT_TPU_DISPATCH_DEADLINE_MS (hard deadline override),
+$PINT_TPU_DISPATCH_RETRIES, $PINT_TPU_DISPATCH_BACKOFF_MS,
+$PINT_TPU_BREAKER_THRESHOLD, $PINT_TPU_BREAKER_COOLDOWN_S,
+$PINT_TPU_BREAKER_PROBE_TIMEOUT_S (see ``pint_tpu.config``).
+"""
+
+from pint_tpu.runtime.breaker import (  # noqa: F401
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    CircuitBreaker,
+)
+from pint_tpu.runtime.faults import (  # noqa: F401
+    Fault,
+    FaultPlan,
+    FatalFault,
+    TransientFault,
+    active_plan,
+)
+from pint_tpu.runtime.supervisor import (  # noqa: F401
+    BackendUnavailable,
+    DispatchError,
+    DispatchSupervisor,
+    DispatchTimeout,
+    RuntimeMetrics,
+    bounded_backend_probe,
+    breaker_for,
+    get_supervisor,
+    reset_runtime,
+)

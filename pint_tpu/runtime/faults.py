@@ -1,0 +1,143 @@
+"""Deterministic fault injection at the dispatch boundary.
+
+The axon tunnel's real failure modes — silent hangs, transient
+connection errors, NaN garbage from a dying device, RTT drifting
+124 -> 255 ms mid-session (CLAUDE.md, VERDICT weak #5) — cannot be
+reproduced on demand, so every supervisor behavior they trigger
+(watchdog timeout, retry, breaker trip, host failover, K re-pick)
+would otherwise be untestable on the CPU mesh. This module injects
+exactly those faults, deterministically, at the single choke point
+every device call now goes through (``DispatchSupervisor.dispatch``).
+
+A plan is a list of rules matched by dispatch-key substring with
+per-rule call counters (``after``/``count``), so a test can say "the
+2nd and 3rd dispatches of the serve engine hang" and get exactly
+that, every run. No randomness anywhere — the same shape of harness
+a training/inference stack straps around its collective ops.
+
+Usage::
+
+    plan = FaultPlan([Fault(match="fit_loop", kind="hang",
+                            seconds=5.0)])
+    with plan.active():
+        ...  # every matching dispatch now sleeps past its deadline
+
+While ANY plan is active the supervisor always takes the guarded
+worker path (even on the CPU backend, where real hangs cannot
+happen) so deadline behavior is exercised by the test suite.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["Fault", "FaultPlan", "active_plan", "TransientFault",
+           "FatalFault"]
+
+KINDS = ("hang", "error", "nan", "rtt_drift")
+
+
+class TransientFault(RuntimeError):
+    """Injected error the classifier must treat as transient (the
+    retry-with-backoff class: connection resets, UNAVAILABLE)."""
+
+
+class FatalFault(ValueError):
+    """Injected error the classifier must treat as fatal (the
+    programming-error class: re-raise, no retry, no breaker trip)."""
+
+
+@dataclass
+class Fault:
+    """One injection rule.
+
+    match      substring of the dispatch key ("" matches every key)
+    kind       "hang" | "error" | "nan" | "rtt_drift"
+    after      skip this many matching dispatches first
+    count      apply to at most this many dispatches (None: forever)
+    seconds    hang duration (must exceed the configured deadline to
+               simulate a wedge; the guarded worker is abandoned and
+               never runs the payload — it sleeps out the duration
+               and raises internally, so the daemon thread lingers
+               only for ``seconds``, doing no late device work)
+    factor     rtt_drift: reported wall = factor x measured wall
+    exc        error: exception INSTANCE to raise (default: a
+               TransientFault)
+    """
+
+    match: str = ""
+    kind: str = "hang"
+    after: int = 0
+    count: Optional[int] = None
+    seconds: float = 5.0
+    factor: float = 3.0
+    exc: Optional[BaseException] = None
+    seen: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} "
+                             f"(one of {KINDS})")
+
+    def applies(self, key: str) -> bool:
+        """Match + advance this rule's deterministic counter."""
+        if self.match not in key:
+            return False
+        n = self.seen
+        self.seen += 1
+        if n < self.after:
+            return False
+        if self.count is not None and n >= self.after + self.count:
+            return False
+        return True
+
+
+class FaultPlan:
+    """An activatable set of rules + the injection log.
+
+    ``probe_ok`` overrides the breaker's bounded backend probe while
+    the plan is active: False = "tunnel still dead" (half-open never
+    opens), True = "tunnel revived" (half-open trial allowed), None =
+    use the real probe. Tests flip it mid-plan to script a recovery.
+    """
+
+    def __init__(self, rules: Optional[List[Fault]] = None,
+                 probe_ok: Optional[bool] = None):
+        self.rules: List[Fault] = list(rules or [])
+        self.probe_ok = probe_ok
+        self.applied: List[tuple] = []   # (key, kind) log for asserts
+        self._lock = threading.Lock()
+
+    def faults_for(self, key: str) -> List[Fault]:
+        """The rules firing on this dispatch (counters advanced)."""
+        with self._lock:
+            hits = [f for f in self.rules if f.applies(key)]
+            for f in hits:
+                self.applied.append((key, f.kind))
+            return hits
+
+    def clear(self):
+        """Deactivate every rule in place (scripted 'recovery')."""
+        with self._lock:
+            self.rules.clear()
+
+    @contextlib.contextmanager
+    def active(self):
+        """Install this plan process-wide for the with-block."""
+        global _ACTIVE
+        prev = _ACTIVE
+        _ACTIVE = self
+        try:
+            yield self
+        finally:
+            _ACTIVE = prev
+
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _ACTIVE

@@ -1,0 +1,588 @@
+"""Dispatch supervisor: watchdog deadlines, retry/breaker routing,
+host failover, RTT-drift re-measurement.
+
+Reference problem (CLAUDE.md environment gotchas; no reference-repo
+analog — src/pint/fitter.py never leaves the host): the axon TPU
+tunnel HANGS ``jax.devices()`` and in-flight dispatches without
+erroring, dies for entire rounds, revives in ~40-minute windows, and
+drifts RTT 124 -> 255 ms mid-session. Before this module every
+device-touching call site (device fitter steps, GLS solves, serve
+batch dispatches) was an unbounded hang waiting to happen, even
+though a bit-correct host CPU path already exists everywhere. The
+supervisor makes degraded-but-correct the guaranteed worst case:
+
+- **watchdog deadline**: each dispatch runs on a guarded daemon
+  worker; the caller waits at most a deadline predicted from the
+  measured RTT x steps-per-dispatch (plus a compile allowance on the
+  first call per dispatch key), then gets ``DispatchTimeout`` instead
+  of blocking forever ($PINT_TPU_DISPATCH_DEADLINE_MS overrides).
+  The worker thread cannot be killed (the hang is inside the XLA
+  client); it is abandoned and its eventual result discarded.
+- **classification + retry**: transient infra errors (connection
+  resets, XLA UNAVAILABLE/RESOURCE_EXHAUSTED, injected
+  ``TransientFault``) retry with jittered exponential backoff;
+  anything else is a caller bug and re-raises untouched.
+- **circuit breaker** (``runtime.breaker``): repeated timeouts/
+  transient failures trip the per-backend breaker OPEN, after which
+  dispatches short-circuit straight to their host fallback without
+  touching the backend (contacting a wedged tunnel hangs). Half-open
+  re-probes reuse the hang-proof subprocess probe recipe of
+  ``bench.accelerator_responsive`` / ``tools/tpu_capture._init_jax``.
+- **host failover**: a dispatch given a ``fallback`` callable returns
+  its result (counted, logged) whenever the device path is timed
+  out, broken or breaker-open; without one, the classified exception
+  propagates so the call site can fail over at a higher level (the
+  device fitter falls back to the whole host fitter).
+- **RTT drift** (VERDICT r5 "Next round" #7): a guarded dispatch
+  whose observed wall deviates >2x from the RTT-based prediction
+  triggers a bounded re-measure and a re-pick of the power-of-two
+  steps-per-dispatch K (``config.auto_steps_per_dispatch``) — K
+  stays inside the quantized {4,8,16,32} set, so compile keys stay
+  stable.
+
+On the plain CPU backend (every test process) dispatches run inline
+— no worker thread, no deadline — because the hang failure mode does
+not exist there; an active ``runtime.faults`` plan forces the
+guarded path so all of the above is testable on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from pint_tpu.runtime import faults
+from pint_tpu.runtime.breaker import CircuitBreaker
+
+__all__ = ["DispatchSupervisor", "RuntimeMetrics", "DispatchError",
+           "DispatchTimeout", "BackendUnavailable", "get_supervisor",
+           "breaker_for", "reset_runtime", "bounded_backend_probe"]
+
+# deadline = margin x (rtt x steps), floored: generous by design — the
+# watchdog exists to catch the wedged-tunnel hang (minutes/forever),
+# not to police a slow-but-live dispatch into a spurious failover
+_DEADLINE_MARGIN = 8.0
+_DEADLINE_FLOOR_MS = 1000.0
+# RTT guess when the backend is an accelerator and nothing has been
+# measured yet: the tunnel's measured ceiling (round 4)
+_RTT_FALLBACK_MS = 250.0
+# drift window: observed wall within [1/2x, 2x] of prediction is fine
+_DRIFT_FACTOR = 2.0
+# predictions below this are noise on any backend — no drift verdicts
+_DRIFT_FLOOR_MS = 5.0
+
+
+class DispatchError(RuntimeError):
+    """Base class for supervised-dispatch infrastructure failures
+    (never raised for caller bugs — those re-raise unclassified)."""
+
+
+class DispatchTimeout(DispatchError, TimeoutError):
+    """The watchdog deadline expired; the worker was abandoned."""
+
+
+class BackendUnavailable(DispatchError):
+    """The backend's circuit breaker is open and the call site
+    provided no host fallback."""
+
+
+class RuntimeMetrics:
+    """Supervisor counters — the observability contract: a degraded
+    run must be LABELED (bench artifacts and serve snapshots embed
+    ``snapshot()``), never silently slow."""
+
+    _COUNTERS = ("dispatches", "guarded", "retries", "timeouts",
+                 "transient_errors", "failovers",
+                 "breaker_rejections", "breaker_recoveries",
+                 "abandoned_workers", "rtt_remeasures")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        for name in self._COUNTERS:
+            setattr(self, name, 0)
+        self.last_rtt_ms: Optional[float] = None
+        self.last_k: Optional[int] = None
+
+    def bump(self, name: str, n: int = 1):
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {name: getattr(self, name)
+                   for name in self._COUNTERS}
+        if self.last_rtt_ms is not None:
+            out["last_rtt_ms"] = round(self.last_rtt_ms, 3)
+        if self.last_k is not None:
+            out["last_k"] = self.last_k
+        out["breakers"] = {b: br.snapshot()
+                           for b, br in _BREAKERS.items()}
+        return out
+
+
+# ------------------------------------------------------------------
+# per-backend breaker registry (breakers are process-global: backend
+# health is a process fact, while supervisor COUNTERS can be
+# per-engine so serve accounting stays self-contained)
+# ------------------------------------------------------------------
+
+_BREAKERS: dict = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def bounded_backend_probe(timeout_s: Optional[float] = None) -> bool:
+    """Hang-proof backend liveness probe: run the backend init in a
+    SUBPROCESS under a kill timer (the bench.accelerator_responsive /
+    tpu_capture._init_jax recipe — a wedged tunnel hangs in-process
+    ``jax.devices()`` with no error, so probing in-process is the
+    bug, not the fix)."""
+    from pint_tpu import config
+
+    if timeout_s is None:
+        timeout_s = config.breaker_probe_timeout_s()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s, capture_output=True,
+            env=dict(os.environ))
+        return r.returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _probe_for(backend: str) -> Callable[[], bool]:
+    def probe() -> bool:
+        plan = faults.active_plan()
+        if plan is not None and plan.probe_ok is not None:
+            return bool(plan.probe_ok)
+        if backend == "cpu":
+            return True  # the local host cannot wedge like the tunnel
+        return bounded_backend_probe()
+
+    return probe
+
+
+def breaker_for(backend: str) -> CircuitBreaker:
+    with _BREAKERS_LOCK:
+        if backend not in _BREAKERS:
+            _BREAKERS[backend] = CircuitBreaker(
+                backend, probe=_probe_for(backend))
+        return _BREAKERS[backend]
+
+
+# ------------------------------------------------------------------
+# the supervisor
+# ------------------------------------------------------------------
+
+
+class DispatchSupervisor:
+    """Routes device dispatches through deadline/retry/breaker/
+    failover policy. One process-global instance serves the fitters
+    (``get_supervisor``); ``ServeEngine`` owns its own (self-contained
+    counters, shared process-global breakers)."""
+
+    def __init__(self, metrics: Optional[RuntimeMetrics] = None):
+        self.metrics = metrics or RuntimeMetrics()
+        self._seen: set = set()   # dispatch keys past first call
+
+    # -- public API ----------------------------------------------------
+
+    def dispatch(self, fn, *args, key: str = "dispatch",
+                 steps: int = 1, kw: Optional[dict] = None,
+                 fallback: Optional[Callable] = None,
+                 guard: Optional[bool] = None, pinned: bool = False):
+        """Run ``fn(*args, **kw)`` under supervision.
+
+        key       stable label for this call site (deadline first-call
+                  compile allowance + fault matching + logs)
+        steps     iterations chained inside this one device program
+                  (scales the deadline prediction)
+        fallback  zero-arg host-path callable; invoked (and counted as
+                  a failover) on timeout / transient exhaustion /
+                  breaker-open. Without one the DispatchError raises.
+        guard     force (True) or suppress (False) the watchdog
+                  worker. Default: guarded on accelerator backends and
+                  whenever a fault plan is active; inline on plain CPU.
+        pinned    the call site pinned this solve to the host CPU
+                  device (config.solve_scope) — treated as hang-free,
+                  so it stays inline (a worker thread would escape the
+                  thread-local device scope).
+        """
+        import jax
+
+        kw = kw or {}
+        backend = jax.default_backend()
+        plan = faults.active_plan()
+        if guard is None:
+            # pinned solves stay inline even under a fault plan: the
+            # worker thread would escape the caller's thread-local
+            # jax.default_device(cpu) pin and silently execute on the
+            # accelerator's non-IEEE f64 (hang faults therefore don't
+            # bite pinned dispatches — the pin means host CPU, which
+            # cannot wedge; error/nan faults still apply inline)
+            guard = (backend != "cpu" or plan is not None) \
+                and not pinned
+        m = self.metrics
+        m.bump("dispatches")
+        # pinned dispatches execute on the host CPU device: they
+        # carry no evidence about the ACCELERATOR backend's health,
+        # so they neither consult nor feed its breaker — a tiny
+        # host-pinned solve succeeding must not close a tripped TPU
+        # breaker, and an open breaker must not reroute hang-free
+        # host solves to the numpy mirrors
+        br = None if pinned else breaker_for(backend)
+        gate = "proceed" if br is None else br.allow()
+        if gate == "reject":
+            m.bump("breaker_rejections")
+            return self._failover(fallback, key, BackendUnavailable(
+                f"{backend} backend circuit breaker is open "
+                f"(dispatch {key!r} short-circuited to host)"))
+        probing = gate == "probe"
+
+        from pint_tpu import config
+
+        retries = config.dispatch_retries()
+        deadline_s = self._deadline_s(key, steps, backend)
+        attempt = 0
+        while True:
+            hits = plan.faults_for(key) if plan is not None else []
+            pre_sleep = sum(f.seconds for f in hits
+                            if f.kind == "hang")
+            nan = any(f.kind == "nan" for f in hits)
+            inj_err = next((f for f in hits if f.kind == "error"),
+                           None)
+            drift = 1.0
+            for f in hits:
+                if f.kind == "rtt_drift":
+                    drift *= f.factor
+            t0 = time.perf_counter()
+            try:
+                if inj_err is not None:
+                    raise (inj_err.exc if inj_err.exc is not None
+                           else faults.TransientFault(
+                               f"injected transient error at {key}"))
+                if guard:
+                    m.bump("guarded")
+                    out = self._guarded_call(fn, args, kw, deadline_s,
+                                             pre_sleep, nan)
+                else:
+                    out = fn(*args, **kw)
+                    if nan:
+                        out = _nan_like(out)
+            except DispatchTimeout as e:
+                # a hang is not worth retrying in-process: another
+                # attempt costs another full deadline against a
+                # backend that just proved unresponsive
+                m.bump("timeouts")
+                if br is not None:
+                    br.on_result(False)
+                return self._failover(fallback, key, e)
+            except BaseException as e:
+                if not _is_transient(e):
+                    # caller bug: no retry, no breaker verdict — but a
+                    # HALF_OPEN trial must not be left dangling (the
+                    # breaker would reject everything forever)
+                    if probing:
+                        br.abort_trial()
+                    raise
+                m.bump("transient_errors")
+                if br is not None:
+                    br.on_result(False)
+                if (br is None or not br.is_open) and \
+                        attempt < retries:
+                    m.bump("retries")
+                    time.sleep(_backoff_s(attempt))
+                    attempt += 1
+                    continue
+                return self._failover(fallback, key, e)
+            wall = time.perf_counter() - t0
+            if br is not None:
+                br.on_result(True)
+            if probing:
+                m.bump("breaker_recoveries")
+                _log().warning(
+                    "%s backend recovered; circuit breaker closed",
+                    backend)
+            first_call = key not in self._seen
+            self._seen.add(key)
+            # no drift verdict on the first call per key: its wall
+            # includes the compile the deadline logic itself budgets
+            # a separate allowance for — it would read as "drift" on
+            # every cold executable
+            if not first_call:
+                self._note_wall(key, steps, wall * drift, backend)
+            return out
+
+    def note_failover(self, key: str, exc: BaseException):
+        """Record a failover performed by the CALL SITE (the device
+        fitter swaps in the whole host fitter rather than a single
+        fallback solve)."""
+        self.metrics.bump("failovers")
+        _log().warning("dispatch %s degraded to the host path: %s",
+                       key, exc)
+
+    def snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    # -- internals -----------------------------------------------------
+
+    def _failover(self, fallback, key, exc):
+        if fallback is None:
+            raise exc
+        self.note_failover(key, exc)
+        return fallback()
+
+    def _guarded_call(self, fn, args, kw, deadline_s, pre_sleep,
+                      nan):
+        box: dict = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                if pre_sleep:
+                    # injected wedge: a real wedge never completes, so
+                    # the payload is never run — the worker sleeps out
+                    # the injected duration and raises into the
+                    # (abandoned) box instead of doing late device
+                    # work at interpreter-teardown time. A hang
+                    # SHORTER than the deadline therefore degrades to
+                    # a transient error, not a slow success.
+                    time.sleep(pre_sleep)
+                    raise faults.TransientFault(
+                        "injected hang elapsed (dispatch abandoned)")
+                out = fn(*args, **kw)
+                # force the host read INSIDE the worker: an async jax
+                # dispatch returns after ENQUEUE (the axon tunnel
+                # happily acks enqueue and then wedges), so without
+                # this the caller's first np.asarray/float would
+                # block unbounded OUTSIDE the watchdog — the exact
+                # hang this supervisor exists to eliminate
+                out = _host_read(out)
+                if nan:
+                    out = _nan_like(out)
+                box["out"] = out
+            except BaseException as e:  # delivered to the caller
+                box["exc"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="pint-dispatch-worker")
+        t.start()
+        if not done.wait(deadline_s):
+            self.metrics.bump("abandoned_workers")
+            raise DispatchTimeout(
+                f"dispatch exceeded its {deadline_s:.1f}s watchdog "
+                f"deadline (wedged tunnel?); worker abandoned")
+        if "exc" in box:
+            raise box["exc"]
+        return box["out"]
+
+    def _deadline_s(self, key, steps, backend) -> float:
+        from pint_tpu import config
+
+        env = config.dispatch_deadline_ms()
+        if env is not None:
+            return float(env) / 1e3
+        rtt = self._peek_rtt_ms(backend)
+        if rtt is None:
+            rtt = self._measure_rtt_guarded()
+        dl = max(_DEADLINE_FLOOR_MS,
+                 _DEADLINE_MARGIN * rtt * max(1, steps))
+        if key not in self._seen:
+            dl += config.dispatch_compile_allowance_ms()
+        return dl / 1e3
+
+    @staticmethod
+    def _peek_rtt_ms(backend) -> Optional[float]:
+        """The RTT the deadline/drift logic may use WITHOUT triggering
+        a measurement (env override or the per-backend cache); None
+        when nothing is known yet."""
+        from pint_tpu import config
+
+        env = config._env_number("PINT_TPU_DISPATCH_RTT_MS", None)
+        if env is not None:
+            return float(env)
+        if backend == "cpu" or backend in config._RTT_MS:
+            return config.dispatch_rtt_ms()
+        return None
+
+    def _measure_rtt_guarded(self) -> float:
+        """First RTT measurement on an accelerator backend: the probe
+        dispatch itself can hang on a wedged tunnel, so run it under
+        the watchdog with the bounded-probe timeout; fall back to the
+        tunnel's measured ceiling. The fallback is CACHED into the
+        per-backend RTT table — without that, every dispatch against
+        a dead-from-the-start tunnel would repeat the full probe
+        timeout before even starting its own deadline wait (the cache
+        is dropped again by any later drift re-measure)."""
+        import jax
+
+        from pint_tpu import config
+
+        try:
+            return float(self._guarded_call(
+                config.dispatch_rtt_ms, (), {},
+                config.breaker_probe_timeout_s(), 0.0, False))
+        except DispatchError:
+            self.metrics.bump("timeouts")
+        except Exception:
+            pass
+        config._RTT_MS[jax.default_backend()] = _RTT_FALLBACK_MS
+        return _RTT_FALLBACK_MS
+
+    def _note_wall(self, key, steps, wall_s, backend):
+        """RTT drift detector (VERDICT r5 #7): observed dispatch wall
+        deviating >2x from prediction triggers a re-measure and a
+        re-pick of the power-of-two K. The window is anchored on the
+        FIXED dispatch cost, the only part the RTT model actually
+        predicts: a chained wall is rtt + K*t_step with t_step
+        unknown, so under-run fires against rtt ALONE (wall < rtt/2
+        is impossible when the cached RTT is honest — the fixed cost
+        is a lower bound) and over-run against the fully-serial bound
+        rtt*K (wall > 2*rtt*K is slower than even zero amortization).
+        A healthy chained dispatch (wall ~ rtt + K*t_step, t_step <<
+        rtt — the only regime K>1 is chosen for) sits inside the
+        window and never false-fires. Compile keys stay stable: K
+        remains inside {4,8,16,32}
+        (config.auto_steps_per_dispatch quantization)."""
+        from pint_tpu import config
+
+        if config._env_number("PINT_TPU_DISPATCH_RTT_MS",
+                              None) is not None:
+            # operator-pinned RTT: a re-measure would only re-read the
+            # env — drifting away from a pin is not possible, so a
+            # verdict is pure warning churn (e.g. a CPU-fallback run
+            # with the tunnel-tuned value still exported)
+            return
+        rtt = self._peek_rtt_ms(backend)
+        if rtt is None or rtt < _DRIFT_FLOOR_MS:
+            return
+        wall_ms = wall_s * 1e3
+        under = wall_ms < rtt / _DRIFT_FACTOR
+        over = wall_ms > _DRIFT_FACTOR * rtt * max(1, steps)
+        if not (under or over):
+            return
+        predicted_ms = rtt * max(1, steps)
+        self.metrics.bump("rtt_remeasures")
+        try:
+            new_rtt = float(self._guarded_call(
+                config.remeasure_dispatch_rtt, (), {},
+                config.breaker_probe_timeout_s(), 0.0, False))
+        except Exception:
+            return
+        self.metrics.last_rtt_ms = new_rtt
+        self.metrics.last_k = config.auto_steps_per_dispatch()
+        _log().warning(
+            "dispatch %s wall %.1f ms vs predicted %.1f ms (>%.0fx "
+            "drift): re-measured RTT %.1f ms, steps-per-dispatch "
+            "re-picked to %d", key, wall_ms, predicted_ms,
+            _DRIFT_FACTOR, new_rtt, self.metrics.last_k)
+
+
+# ------------------------------------------------------------------
+# helpers
+# ------------------------------------------------------------------
+
+# substrings marking an exception as INFRA (retry + breaker) rather
+# than a caller bug; XlaRuntimeError carries gRPC-style status text
+_TRANSIENT_MARKERS = ("unavailable", "resource_exhausted",
+                      "deadline_exceeded", "connection", "socket",
+                      "aborted", "tunnel", "failed to connect")
+
+
+def _is_transient(exc: BaseException) -> bool:
+    if isinstance(exc, faults.TransientFault):
+        return True
+    # deliberately NOT bare OSError: FileNotFoundError/PermissionError
+    # etc. are caller bugs that must re-raise, not retry/trip breakers
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    msg = str(exc).lower()
+    if type(exc).__name__ == "XlaRuntimeError":
+        return any(mk in msg for mk in _TRANSIENT_MARKERS)
+    return False
+
+
+def _backoff_s(attempt: int) -> float:
+    """Jittered exponential backoff (base $PINT_TPU_DISPATCH_BACKOFF_MS)."""
+    import random
+
+    from pint_tpu import config
+
+    base = config.dispatch_backoff_ms() / 1e3 * (2 ** attempt)
+    return base * (1.0 + 0.5 * random.random())
+
+
+def _host_read(out):
+    """Materialize every jax-array leaf as a host numpy array (a
+    completed D2H read — the only sync primitive the tunnel cannot
+    lie about; ``block_until_ready`` over axon acks enqueue only).
+    Non-array leaves and plain numpy pass through untouched."""
+    import jax
+    import numpy as np
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(leaf, out)
+
+
+def _nan_like(out):
+    """Injected-NaN transform: every floating leaf becomes all-NaN
+    (what a dying device's garbage readback looks like downstream)."""
+    import jax
+    import numpy as np
+
+    def leaf(x):
+        a = np.asarray(x)
+        if np.issubdtype(a.dtype, np.floating):
+            return np.full_like(a, np.nan)
+        return x
+
+    return jax.tree_util.tree_map(leaf, out)
+
+
+def _log():
+    from pint_tpu.logging import log
+
+    return log
+
+
+# ------------------------------------------------------------------
+# process-global supervisor + test reset
+# ------------------------------------------------------------------
+
+_GLOBAL: Optional[DispatchSupervisor] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_supervisor() -> DispatchSupervisor:
+    """The process-global supervisor used by the fitters and the PTA
+    batch path (serve engines own their own for self-contained
+    accounting; breakers are shared either way)."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = DispatchSupervisor()
+        return _GLOBAL
+
+
+def reset_runtime():
+    """Drop all breakers + reset the global supervisor's counters
+    (tests: a tripped breaker — or one constructed under a
+    monkeypatched threshold — must never leak into the next test)."""
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is not None:
+            _GLOBAL.metrics = RuntimeMetrics()
+            _GLOBAL._seen.clear()

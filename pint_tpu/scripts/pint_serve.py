@@ -62,10 +62,13 @@ def _polycos_for(cache, par, obs, mjd_lo, mjd_hi, seg_min):
     return cache[key]
 
 
-def _submit_line(engine, cache, rec, emit):
+def _submit_line(engine, cache, rec, emit, report):
     """Parse one request record and submit it; wire result emission
     through the future's done-callback so the daemon never blocks on
-    a single request."""
+    a single request. Returns the number of requests actually
+    submitted (= the number of ``emit`` calls this line will
+    eventually produce — the pending-semaphore contract); failures
+    that submit NOTHING go through ``report`` (uncounted)."""
     import numpy as np
 
     from pint_tpu.serve import (
@@ -119,11 +122,28 @@ def _submit_line(engine, cache, rec, emit):
                            float(mjds.min()) - pad,
                            float(mjds.max()) + pad, seg_min)
         idx = pcs._entry_for(mjds)
+        segs = np.unique(idx)
         nsub = 0
-        for s in np.unique(idx):
-            fut = engine.submit(PhasePredictRequest(
-                pcs.entries[int(s)], mjds[idx == s],
-                deadline_s=deadline_s))
+        for s in segs:
+            try:
+                fut = engine.submit(PhasePredictRequest(
+                    pcs.entries[int(s)], mjds[idx == s],
+                    deadline_s=deadline_s))
+            except Exception as e:
+                # PARTIAL submit (PR-3 review bug): the segments
+                # already admitted WILL emit and release the pending
+                # semaphore, so the count returned below must include
+                # them; the shed remainder is reported through the
+                # UNCOUNTED path, or the final session snapshot would
+                # race the still-pending results. Catches EVERYTHING
+                # (not just the ServeOverload backpressure signal):
+                # any mid-fan failure after >=1 admission would
+                # otherwise escape with the count lost
+                report({"id": rid, "kind": "phase", "ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                        "segments_submitted": nsub,
+                        "segments_shed": int(len(segs) - nsub)})
+                break
             fut.add_done_callback(finish("phase"))
             nsub += 1
         return nsub
@@ -132,53 +152,15 @@ def _submit_line(engine, cache, rec, emit):
 
 def _demo_requests(n: int):
     """Synthesize a mixed-shape workload: small simulated pulsars in
-    three TOA-count classes + polyco phase reads."""
-    import io
-    import warnings
+    three TOA-count classes + polyco phase reads. Delegates to
+    ``pint_tpu.serve.workload`` — the ONE workload builder, shared
+    with bench_serve.py (PR-3 review: the two copies had already
+    started to drift)."""
+    from pint_tpu.serve.workload import DEMO_SIZES, build_workload
 
-    import numpy as np
-
-    from pint_tpu.models import get_model
-    from pint_tpu.polycos import PolycoEntry
-    from pint_tpu.serve import (
-        FitStepRequest,
-        PhasePredictRequest,
-        ResidualsRequest,
-    )
-    from pint_tpu.simulation import make_fake_toas_uniform
-
-    sizes = (50, 100, 200)
-    pairs = []
-    for k, ntoa in enumerate(sizes):
-        par = (f"PSR J{1200 + k}\nRAJ 12:0{k}:00.0 1\n"
-               f"DECJ 30:0{k}:00.0 1\nF0 {150.0 + 31.0 * k} 1\n"
-               f"F1 -1e-15 1\nPEPOCH 55000\nPOSEPOCH 55000\n"
-               f"DM {10 + k} 1\nTZRMJD 55000.1\nTZRSITE @\n"
-               f"TZRFRQ 1400\nUNITS TDB\n")
-        with warnings.catch_warnings():
-            warnings.simplefilter("ignore")
-            m = get_model(io.StringIO(par))
-            t = make_fake_toas_uniform(
-                54000, 56000, ntoa, m, error_us=1.0, add_noise=True,
-                rng=np.random.default_rng(k))
-        m.F0.add_delta(1e-10)
-        m.invalidate_cache(params_only=True)
-        pairs.append((m, t))
-    entry = PolycoEntry(psrname="DEMO", tmid=55000.0, rphase_int=1e9,
-                        rphase_frac=0.25, f0=200.0, obs="@",
-                        span_min=60.0,
-                        coeffs=np.array([0.02, 1e-3, -2e-5, 1e-7]))
-    reqs = []
-    for i in range(n):
-        m, t = pairs[i % len(pairs)]
-        if i % 7 == 6:
-            mjds = 55000.0 + np.linspace(-0.01, 0.01, 24)
-            reqs.append(("phase", PhasePredictRequest(entry, mjds)))
-        elif i % 3 == 2:
-            reqs.append(("residuals", ResidualsRequest(t, m)))
-        else:
-            reqs.append(("fit_step", FitStepRequest(t, m)))
-    return reqs
+    return build_workload(n, sizes=DEMO_SIZES, base=1200,
+                          prebuild=False, with_kinds=True,
+                          entry_name="DEMO")()
 
 
 def main(argv=None) -> int:
@@ -216,11 +198,26 @@ def main(argv=None) -> int:
             print(json.dumps(obj), flush=True)
         pending.release()
 
+    def report(obj):
+        """Result line for a request that was never admitted — NOT
+        via emit: its semaphore release is the per-SUBMITTED-request
+        completion count."""
+        with out_lock:
+            print(json.dumps(obj), flush=True)
+
     if args.demo is not None:
+        from pint_tpu.serve import ServeOverload
+
         reqs = _demo_requests(args.demo)
         engine.start()
         for kind, rq in reqs:
-            fut = engine.submit(rq)
+            try:
+                fut = engine.submit(rq)
+            except ServeOverload as e:
+                # PR-3 review bug: backpressure during the demo burst
+                # crashed the daemon instead of shedding the request
+                report({"kind": kind, "ok": False, "error": repr(e)})
+                continue
 
             def cb(fut, kind=kind):
                 try:
@@ -239,16 +236,14 @@ def main(argv=None) -> int:
                 continue
             try:
                 rec = json.loads(line)
-                nsub += _submit_line(engine, cache, rec, emit)
+                nsub += _submit_line(engine, cache, rec, emit,
+                                     report)
             except Exception as e:
-                # malformed line: report directly (NOT via emit — its
-                # semaphore release is the per-submitted-request
-                # completion count)
-                with out_lock:
-                    print(json.dumps(
-                        {"ok": False,
-                         "error": f"{type(e).__name__}: {e}",
-                         "line": line[:200]}), flush=True)
+                # malformed line (or a zero-submission overload):
+                # report through the uncounted path
+                report({"ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                        "line": line[:200]})
 
     engine.stop(drain=True)
     for _ in range(nsub):

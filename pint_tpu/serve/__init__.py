@@ -11,7 +11,16 @@ half of that is here. The moving parts (one module each):
   window batching, backpressure, single-request fallback) and the
   ``Fitter.auto(serve=...)``-routed fitter;
 - ``serve.metrics``: per-bucket occupancy / waste / latency /
-  compile counters, fed through the profiling hooks.
+  compile counters, fed through the profiling hooks (plus the
+  engine's runtime dispatch-supervisor counters — timeouts,
+  failovers, breaker state — so degraded serving is labeled);
+- ``serve.workload``: the ONE synthetic mixed-shape workload
+  builder shared by bench_serve.py and the demo daemon.
+
+Every device dispatch routes through the engine's
+``pint_tpu.runtime.DispatchSupervisor`` (watchdog deadline, circuit
+breaker, host failover) — a wedged backend degrades a batch to the
+host path instead of hanging it.
 
 Entry points: ``scripts/pint_serve.py`` (stdin JSONL daemon) and
 ``bench_serve.py`` (sequential-vs-coalesced throughput artifact).

@@ -27,7 +27,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from pint_tpu.parallel.pta import _solve_one, stack_problems
+from pint_tpu.parallel.pta import _solve_one, pta_solve_np, \
+    stack_problems
 
 __all__ = ["bucket_for", "pad_dim", "pow2_ceil", "ExecutableCache",
            "gls_shape_class", "phase_shape_class"]
@@ -116,13 +117,21 @@ class ExecutableCache:
     key, so a mesh engine and a local engine never share entries —
     which is why each engine owns its wrappers)."""
 
-    def __init__(self, mesh=None, axis: str = "pulsar"):
+    def __init__(self, mesh=None, axis: str = "pulsar",
+                 supervisor=None):
         import jax
+
+        from pint_tpu.runtime import get_supervisor
 
         self.mesh = mesh
         self.axis = axis
         self._gls = jax.jit(jax.vmap(_solve_one))
         self._phase = jax.jit(jax.vmap(_phase_eval_one))
+        # every dispatch routes through the runtime supervisor:
+        # watchdog deadline + host failover (numpy mirror for GLS,
+        # PolycoEntry.abs_phase for phase) so a wedged backend can
+        # never hang a serve batch — only slow it down, labeled
+        self.supervisor = supervisor or get_supervisor()
         self.keys: set = set()
 
     @property
@@ -159,21 +168,40 @@ class ExecutableCache:
 
     def gls(self, key, problems, shape):
         """Pad ``problems`` to the class shape (``parallel.pta``
-        masking) and solve the batch in one dispatch. Returns host
-        arrays (dparams, cov, chi2, chi2r), each (P, ...). The class
-        key is recorded only on success, so a failed dispatch cannot
-        inflate ``compile_count`` past the classes actually built."""
-        st = self._place(stack_problems(problems, shape=shape))
-        out = self._gls(st["M"], st["F"], st["phi"], st["r"],
-                        st["nvec"], st["valid"], st["pvalid"])
-        host = tuple(np.asarray(o) for o in out)
-        self.keys.add(key)
-        return host
+        masking) and solve the batch in one SUPERVISED dispatch
+        (runtime watchdog; host ``pta_solve_np`` failover). Returns
+        host arrays (dparams, cov, chi2, chi2r), each (P, ...). The
+        class key is recorded only on success, so a failed dispatch
+        cannot inflate ``compile_count`` past the classes actually
+        built — and a failed-over (host-solved) dispatch does not
+        record one either: no executable was built for it."""
+        stacked = stack_problems(problems, shape=shape)
+
+        def run():
+            # place + dispatch + host read on the guarded worker so
+            # the deadline covers completion, not just enqueue
+            st = self._place(stacked)
+            out = self._gls(st["M"], st["F"], st["phi"], st["r"], st["nvec"], st["valid"], st["pvalid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            return tuple(np.asarray(o) for o in out)
+
+        fell_over = []
+
+        def host():
+            fell_over.append(True)
+            return pta_solve_np(stacked)
+
+        host_out = self.supervisor.dispatch(
+            run, key=f"serve.gls/{'/'.join(str(x) for x in key)}",
+            fallback=host)
+        if not fell_over:
+            self.keys.add(key)
+        return host_out
 
     def phase(self, key, requests, nb: int, kb: int, Pb: int):
         """Pad phase requests to (Pb, nb) MJDs x kb coefficients and
-        evaluate the batch in one dispatch (key recorded on success,
-        as in ``gls``)."""
+        evaluate the batch in one supervised dispatch (host failover:
+        per-entry ``PolycoEntry.abs_phase``; key recorded on a real
+        device dispatch only, as in ``gls``)."""
         coeffs = np.zeros((Pb, kb))
         tmid = np.zeros(Pb)
         rpi = np.zeros(Pb)
@@ -193,12 +221,30 @@ class ExecutableCache:
             mjds[k, :len(m)] = m
             mjds[k, len(m):] = e.tmid  # dt = 0 on padded slots
             valid[k, :len(m)] = 1.0
-        arrs = self._place({"coeffs": coeffs, "tmid": tmid,
-                            "rpi": rpi, "rpf": rpf, "f0": f0,
-                            "mjds": mjds, "valid": valid})
-        pi, pf = self._phase(arrs["coeffs"], arrs["tmid"], arrs["rpi"],
-                             arrs["rpf"], arrs["f0"], arrs["mjds"],
-                             arrs["valid"])
-        pi, pf = np.asarray(pi), np.asarray(pf)
-        self.keys.add(key)
+
+        def run():
+            arrs = self._place({"coeffs": coeffs, "tmid": tmid,
+                                "rpi": rpi, "rpf": rpf, "f0": f0,
+                                "mjds": mjds, "valid": valid})
+            pi, pf = self._phase(arrs["coeffs"], arrs["tmid"], arrs["rpi"], arrs["rpf"], arrs["f0"], arrs["mjds"], arrs["valid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            return np.asarray(pi), np.asarray(pf)
+
+        fell_over = []
+
+        def host():
+            fell_over.append(True)
+            pi = np.zeros((Pb, nb))
+            pf = np.zeros((Pb, nb))
+            for k, rq in enumerate(requests):
+                n = len(rq.mjds)
+                hi, hf = rq.entry.abs_phase(rq.mjds)
+                pi[k, :n] = hi
+                pf[k, :n] = hf
+            return pi, pf
+
+        pi, pf = self.supervisor.dispatch(
+            run, key=f"serve.phase/{'/'.join(str(x) for x in key)}",
+            fallback=host)
+        if not fell_over:
+            self.keys.add(key)
         return pi, pf

@@ -96,8 +96,9 @@ class ServeMetrics:
     read from it live so the metrics can never disagree with the
     thing that actually compiled."""
 
-    def __init__(self, cache=None):
+    def __init__(self, cache=None, supervisor=None):
         self.cache = cache
+        self.supervisor = supervisor
         self.submitted = 0
         self.completed = 0
         self.rejected = 0           # backpressure (queue cap) drops
@@ -142,7 +143,7 @@ class ServeMetrics:
         reqs = sum(b.requests for b in self.buckets.values())
         rows_r = sum(b.rows_real for b in self.buckets.values())
         rows_p = sum(b.rows_padded for b in self.buckets.values())
-        return {
+        out = {
             "submitted": self.submitted, "completed": self.completed,
             "rejected": self.rejected,
             "deadline_missed": self.deadline_missed,
@@ -161,6 +162,12 @@ class ServeMetrics:
                            for k, b in sorted(self.buckets.items(),
                                               key=lambda kv: str(kv[0]))},
         }
+        if self.supervisor is not None:
+            # the dispatch-supervisor counters (timeouts, retries,
+            # breaker state, failovers): a degraded run must be
+            # LABELED in the artifact, never silently slow
+            out["dispatch"] = self.supervisor.snapshot()
+        return out
 
     @staticmethod
     def _fmt_key(key) -> str:
@@ -182,6 +189,19 @@ class ServeMetrics:
             f"{'bucket':<28} {'reqs':>6} {'batch':>6} {'occ':>6} "
             f"{'waste':>6} {'p50ms':>8} {'p99ms':>8}",
         ]
+        disp = s.get("dispatch")
+        if disp and (disp.get("timeouts") or disp.get("failovers")
+                     or disp.get("retries")
+                     or disp.get("breaker_rejections")):
+            states = ", ".join(
+                f"{b}:{v['state']}"
+                for b, v in sorted(disp.get("breakers", {}).items()))
+            lines.insert(2, (
+                f"DEGRADED dispatch: {disp.get('failovers', 0)} "
+                f"failovers, {disp.get('timeouts', 0)} timeouts, "
+                f"{disp.get('retries', 0)} retries, "
+                f"{disp.get('breaker_rejections', 0)} breaker "
+                f"rejections ({states})"))
         for k, b in s["per_bucket"].items():
             lines.append(
                 f"{k:<28} {b['requests']:>6} {b['batches']:>6} "

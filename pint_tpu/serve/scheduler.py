@@ -26,9 +26,16 @@ Backpressure: the admission queue is capped
 overload response when every accepted request carries a deadline.
 Expired requests are failed with ``DeadlineExceeded`` at drain time,
 before any device work is spent on them. A request whose shape fits
-no configured bucket is NOT rejected: it falls back to a
-single-request dispatch at the next power-of-two shape (counted in
-``metrics.fallback_single`` — graceful, still shape-quantized).
+no configured bucket is NOT rejected: it falls back to the next
+power-of-two shape class (counted in ``metrics.fallback_single`` —
+graceful, still shape-quantized), and fallback requests landing on
+the SAME class coalesce into one shared padded dispatch.
+
+Every device dispatch routes through the engine's
+``runtime.DispatchSupervisor`` (watchdog deadline, circuit breaker,
+host numpy/polyco failover): a wedged backend degrades a batch to
+the host path — counted, never hung — so every admitted future
+always completes.
 """
 
 from __future__ import annotations
@@ -80,6 +87,7 @@ class ServeEngine:
                  bucket_edges: Optional[Tuple[int, ...]] = None,
                  mesh=None, axis: str = "pulsar"):
         from pint_tpu import config
+        from pint_tpu.runtime import DispatchSupervisor
 
         self.window_s = config.serve_window_s() \
             if window_s is None else float(window_s)
@@ -92,8 +100,15 @@ class ServeEngine:
             else bucket_edges))
         self.mesh = mesh
         self.axis = axis
-        self.cache = ExecutableCache(mesh=mesh, axis=axis)
-        self.metrics = ServeMetrics(self.cache)
+        # engine-owned dispatch supervisor: its counters (timeouts,
+        # failovers, retries) are this deployment's — self-contained
+        # like the compile accounting — while breaker state stays
+        # process-global (backend health is a process fact)
+        self.supervisor = DispatchSupervisor()
+        self.cache = ExecutableCache(mesh=mesh, axis=axis,
+                                     supervisor=self.supervisor)
+        self.metrics = ServeMetrics(self.cache,
+                                    supervisor=self.supervisor)
         self._queue: collections.deque = collections.deque()
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
@@ -169,9 +184,18 @@ class ServeEngine:
         for key, grp in groups.items():
             for i in range(0, len(grp), self.max_batch):
                 self._dispatch(key, grp[i:i + self.max_batch])
+        # oversize requests (no configured bucket) still coalesce:
+        # the fallback shape class IS a shape class, so requests that
+        # land on the same power-of-two dims share one padded
+        # dispatch instead of going one-at-a-time (compile count
+        # stays <= bucket count + oversize classes either way)
+        fb_groups: dict = {}
         for key, r in fallbacks:
-            self.metrics.fallback_single += 1
-            self._dispatch(key, [r])
+            fb_groups.setdefault(key, []).append(r)
+        for key, grp in fb_groups.items():
+            self.metrics.fallback_single += len(grp)
+            for i in range(0, len(grp), self.max_batch):
+                self._dispatch(key, grp[i:i + self.max_batch])
 
     def _class_of(self, r):
         """(shape-class key, is_fallback). GLS requests are assembled

@@ -29,8 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.fitter import Fitter, MaxiterReached
-from pint_tpu.gls import _gls_kernel, _gls_kernel_svd
+from pint_tpu.gls import (
+    _gls_host_failover_solve,
+    _gls_kernel,
+    _gls_kernel_svd,
+)
 from pint_tpu.residuals import Residuals
+from pint_tpu.runtime import DispatchError, get_supervisor
 from pint_tpu.wideband import DMResiduals, get_wideband_dm
 
 __all__ = ["WidebandTOAFitter", "WidebandDownhillFitter"]
@@ -84,28 +89,60 @@ class WidebandTOAFitter(Fitter):
             # DM-process bases (PLDMNoise) couple into the DM rows
             F_dm = self.model.noise_model_dm_designmatrix(self.toas)
             F = np.concatenate([F_t, F_dm], axis=0)
-        with self._solve_scope():
-            # asarray inside the scope: placement follows the pinned
-            # device (see GLSFitter._solve_once)
-            args = (jnp.asarray(M), jnp.asarray(F), jnp.asarray(phi),
-                    jnp.asarray(r), jnp.asarray(nvec))
-            if threshold is not None:
-                x, cov, chi2, noise, _ = _gls_kernel_svd(
-                    *args, threshold=float(threshold))
-            else:
-                from pint_tpu.parallel.fit_step import _use_f32_matmul
-
-                f32mm = False if self._solve_pinned() else \
-                    _use_f32_matmul(None)
-                x, cov, chi2, noise, _, ok = _gls_kernel(
-                    *args, f32mm=f32mm)
-                if not bool(ok):
-                    from pint_tpu.fitter import warn_degenerate
-
-                    warn_degenerate("wideband normal matrix")
-                    x, cov, chi2, noise, _ = _gls_kernel_svd(*args)
+        try:
+            x, cov, chi2, noise = self._solve_stacked_device(
+                M, F, phi, r, nvec, threshold)
+        except DispatchError as e:
+            # host failover: the numpy mirror on the same stacked
+            # [time; DM] system — degraded in speed, not correctness
+            # (mode-aware: eigh mirror for threshold/degenerate)
+            get_supervisor().note_failover("wideband.solve", e)
+            x, cov, chi2, noise = _gls_host_failover_solve(
+                M, F, phi, r, nvec, threshold=threshold,
+                what="wideband normal matrix")
         return (-np.asarray(x), np.asarray(cov), float(chi2),
                 np.asarray(noise)[:n], names)
+
+    def _solve_stacked_device(self, M, F, phi, r, nvec, threshold):
+        sup = get_supervisor()
+        pinned = self._solve_pinned()
+
+        def place():
+            # asarray inside the dispatched closure AND the scope:
+            # placement follows the pinned device, and H2D to a
+            # wedged tunnel hangs like a dispatch — it must ride the
+            # watchdog (see GLSFitter._solve_once_device)
+            return (jnp.asarray(M), jnp.asarray(F), jnp.asarray(phi),
+                    jnp.asarray(r), jnp.asarray(nvec))
+
+        def run_svd(th=None):
+            with self._solve_scope():
+                if th is None:
+                    return _gls_kernel_svd(*place())  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+                return _gls_kernel_svd(*place(), threshold=th)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+        def run_chol(f32mm=False):
+            with self._solve_scope():
+                return _gls_kernel(*place(), f32mm=f32mm)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+        if threshold is not None:
+            x, cov, chi2, noise, _ = sup.dispatch(
+                run_svd, kw={"th": float(threshold)},
+                key="wideband.svd", pinned=pinned)
+        else:
+            from pint_tpu.parallel.fit_step import _use_f32_matmul
+
+            f32mm = False if pinned else _use_f32_matmul(None)
+            x, cov, chi2, noise, _, ok = sup.dispatch(
+                run_chol, kw={"f32mm": f32mm},
+                key="wideband.solve", pinned=pinned)
+            if not bool(ok):
+                from pint_tpu.fitter import warn_degenerate
+
+                warn_degenerate("wideband normal matrix")
+                x, cov, chi2, noise, _ = sup.dispatch(
+                    run_svd, key="wideband.svd", pinned=pinned)
+        return x, cov, chi2, noise
 
     def fit_toas(self, maxiter=1, threshold=None):
         t0 = time.perf_counter()

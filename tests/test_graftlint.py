@@ -296,6 +296,104 @@ def test_g6_ignores_paths_outside_tools_and_scripts():
     assert "G6" not in _rules(v)
 
 
+def _lint_dispatch(src, relpath="pint_tpu/serve/_fixture.py"):
+    """Run only the dispatch-layer half of G6 on one snippet."""
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    per, priv = gl.collect_jit_products([m])
+    return gl.check_g6_dispatch(m, per[relpath] | priv)
+
+
+def test_g6_dispatch_flags_direct_jit_product_call():
+    v = _lint_dispatch("""
+        import jax
+        kernel = jax.jit(lambda x: x + 1)
+        def solve(x):
+            return kernel(x)
+    """)
+    assert [x.rule for x in v] == ["G6"]
+    assert "DispatchSupervisor" in v[0].msg
+
+
+def test_g6_dispatch_flags_self_attr_and_immediate_forms():
+    v = _lint_dispatch("""
+        import jax
+        class Cache:
+            def __init__(self, f):
+                self._k = jax.jit(f)
+            def run(self, x):
+                return self._k(x)
+        def quick(g, x):
+            return jax.jit(g)(x)
+    """)
+    assert [x.rule for x in v] == ["G6", "G6"]
+
+
+def test_g6_dispatch_flags_attribute_chain_calls():
+    """Reaching a jit product through ANY attribute chain (not just
+    self.) still bypasses the supervisor and must flag."""
+    v = _lint_dispatch("""
+        import jax
+        class Cache:
+            def __init__(self, f):
+                self._k = jax.jit(f)
+        def sneaky(engine, x):
+            return engine.cache._k(x)
+    """)
+    assert [x.rule for x in v] == ["G6"]
+
+
+def test_g6_dispatch_supervised_route_is_clean():
+    """Passing the jit product as an ARGUMENT to the supervisor is
+    the sanctioned route — never flagged; a decorated kernel passed
+    the same way is clean too."""
+    v = _lint_dispatch("""
+        import jax
+        from functools import partial
+
+        kernel = jax.jit(lambda x: x + 1)
+
+        @partial(jax.jit, static_argnames=("flag",))
+        def decorated(x, flag=False):
+            return x
+
+        def solve(sup, x):
+            a = sup.dispatch(kernel, x, key="k")
+            b = sup.dispatch(decorated, x, kw={"flag": True},
+                             key="d")
+            return a, b
+    """)
+    assert not v
+
+
+def test_g6_dispatch_flags_decorated_kernel_direct_call():
+    v = _lint_dispatch("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("f32mm",))
+        def _kern(x, f32mm=False):
+            return x
+
+        def solve(x):
+            return _kern(x, f32mm=True)
+    """, relpath="pint_tpu/gls.py")
+    assert [x.rule for x in v] == ["G6"]
+
+
+def test_g6_dispatch_only_applies_to_the_dispatch_layer():
+    src = """
+        import jax
+        kernel = jax.jit(lambda x: x + 1)
+        def solve(x):
+            return kernel(x)
+    """
+    assert _lint_dispatch(src, relpath="pint_tpu/gridutils.py") == []
+    assert _lint_dispatch(
+        src, relpath="pint_tpu/runtime/supervisor.py") == []
+    assert _lint_dispatch(
+        src, relpath="pint_tpu/parallel/pta.py") != []
+
+
 def test_g6_shell_requires_timeout_and_joins_continuations():
     bad = gl.check_g6_shell("tools/x.sh", "python tools/capture.py\n")
     assert bad and bad[0].rule == "G6"

@@ -240,6 +240,29 @@ def test_oversize_falls_back_to_single(zoo):
     assert futs[1].result(timeout=0).chi2 > 0
 
 
+def test_oversize_shared_class_coalesces(zoo):
+    """ISSUE-4 satellite: oversize requests landing on the SAME
+    fallback shape class share ONE padded dispatch instead of going
+    one-at-a-time; the executable bound (<= bucket count + oversize
+    classes) holds and results still match a dedicated engine."""
+    m, t = zoo[4]  # 200 TOAs > the only configured edge
+    eng = ServeEngine(bucket_edges=(64,))
+    futs = [eng.submit(FitStepRequest(t, m)) for _ in range(3)]
+    eng.flush()
+    res = [f.result(timeout=0) for f in futs]
+    assert eng.metrics.fallback_single == 3
+    # exactly one dispatch served the whole shared oversize class
+    fb = [b for k, b in eng.metrics.buckets.items() if k[1] == 256]
+    assert len(fb) == 1 and fb[0].batches == 1 and fb[0].requests == 3
+    snap = eng.metrics.snapshot()
+    assert snap["compile_count"] <= snap["bucket_count"]
+    ref = ServeEngine().submit(FitStepRequest(t, m)).result()
+    for r in res:
+        np.testing.assert_allclose(r.dparams, ref.dparams,
+                                   rtol=1e-9, atol=1e-18)
+        assert r.chi2 == pytest.approx(ref.chi2, rel=1e-9)
+
+
 def test_mesh_engine_matches_local(zoo):
     """An engine sharding the batch axis over the 8-virtual-device
     mesh agrees with the local engine (same tolerance as the pta
@@ -364,6 +387,100 @@ def test_daemon_demo_smoke(capsys):
     assert len(results) == 12 and all(r["ok"] for r in results)
     assert snap["completed"] == 12
     assert snap["compile_count"] <= snap["bucket_count"]
+
+
+def test_daemon_demo_sheds_overload_instead_of_crashing(capsys):
+    """PR-3 review bug (deferred to ISSUE 4): demo mode left
+    ServeOverload unhandled — a backpressured submit crashed the
+    daemon. Queue cap 1 + a long window guarantees the burst
+    overloads; every request must still get a result line (ok or a
+    shed report) and the session snapshot must still print LAST."""
+    import json
+
+    from pint_tpu.scripts.pint_serve import main
+
+    assert main(["--demo", "12", "--queue-cap", "1",
+                 "--window-ms", "60"]) == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    snap = lines[-1]
+    assert snap["metric"] == "serve_session"
+    results = lines[:-1]
+    assert len(results) == 12
+    shed = [r for r in results if not r["ok"]]
+    assert all("ServeOverload" in r["error"] for r in shed)
+    assert snap["completed"] == 12 - len(shed)
+    assert snap["rejected"] == len(shed)
+
+
+def test_phase_partial_submit_counts_semaphore_correctly():
+    """PR-3 review bug (deferred to ISSUE 4): a phase request fanning
+    out over several polyco segments that hit backpressure MID-FAN
+    returned a count excluding the already-admitted segments, so the
+    daemon's pending semaphore undercounted and the session snapshot
+    could race still-pending results. The count must equal the
+    requests actually submitted; the shed remainder goes through the
+    uncounted report path."""
+    import types
+
+    from pint_tpu.scripts import pint_serve
+
+    class StubEngine:
+        def __init__(self, cap):
+            self.cap = cap
+            self.submitted = []
+
+        def submit(self, req):
+            if len(self.submitted) >= self.cap:
+                raise ServeOverload("full")
+            self.submitted.append(req)
+            return req.future
+
+    mjds = [55000.0, 55000.001, 55000.04, 55000.041, 55000.08]
+    seg_min = 60.0
+    pad = seg_min / 1440.0
+    lo = round(min(mjds) - pad, 6)
+    hi = round(max(mjds) + pad, 6)
+    pcs = types.SimpleNamespace(
+        entries=[_entry(0), _entry(1), _entry(2)],
+        _entry_for=lambda m: np.array([0, 0, 1, 1, 2]))
+    cache = {("polyco", "fake.par", "@", lo, hi, seg_min): pcs}
+    eng = StubEngine(cap=2)
+    emitted, reported = [], []
+    n = pint_serve._submit_line(
+        eng, cache, {"kind": "phase", "par": "fake.par", "id": "r1",
+                     "mjds": mjds},
+        emitted.append, reported.append)
+    # 3 segments, cap 2: two admitted (and counted), one shed
+    assert n == 2
+    assert len(eng.submitted) == 2
+    assert len(reported) == 1
+    assert reported[0]["segments_submitted"] == 2
+    assert reported[0]["segments_shed"] == 1
+    assert "ServeOverload" in reported[0]["error"]
+
+
+def test_workload_builder_shared_by_bench_and_demo():
+    """ISSUE-4 satellite: ONE workload builder. bench_serve and the
+    demo daemon both delegate to serve.workload."""
+    import bench_serve
+
+    from pint_tpu.scripts.pint_serve import _demo_requests
+    from pint_tpu.serve.request import Request
+
+    reqs = _demo_requests(9)
+    assert len(reqs) == 9
+    kinds = {k for k, _ in reqs}
+    assert kinds == {"fit_step", "residuals", "phase"}
+    assert all(isinstance(r, Request) for _, r in reqs)
+    fresh = bench_serve.build_workload(9)
+    bench_reqs = fresh()
+    assert len(bench_reqs) == 9
+    # bench mode prebuilds problems (the serving-state hot path)
+    assert any(getattr(r, "problem", None) is not None
+               for r in bench_reqs)
+    # demo mode assembles at dispatch
+    assert all(getattr(r, "problem", None) is None for _, r in reqs)
 
 
 # ---------------------------------------------------------- config

@@ -44,6 +44,7 @@ STAGES = {
     "config5": "config5_pta_batch_67psr",
     "pta_scale": "pta_batch_scaling",
     "stress": "stress_nanograv_like_10k_fit",
+    "stress_wideband": "stress_nanograv_like_10k_fit_wideband",
     "serve": "serve_coalesced_vs_sequential_64req",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
@@ -210,22 +211,27 @@ def stage_pta_scale(backend):
         print(json.dumps(rec), flush=True)
 
 
-def stage_stress(backend):
+def stage_stress(backend, wideband=False):
     """NANOGrav-scale full production fit (bench_stress): 10k TOAs,
     124 free params, per-receiver noise families — the realistic
     full-fit workload on chip, with the chained device dispatch
-    doing real amortization work."""
+    doing real amortization work. ``wideband=True`` runs the joint
+    [time; DM] variant (the stress_wideband stage, VERDICT r5 item
+    5)."""
     import subprocess
 
-    r = subprocess.run([sys.executable,
-                        os.path.join(REPO, "bench_stress.py")],
-                       capture_output=True, text=True, timeout=2100)
+    stage = "stress_wideband" if wideband else "stress"
+    cmd = [sys.executable, os.path.join(REPO, "bench_stress.py")]
+    if wideband:
+        cmd.append("--wideband")
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=2100)
     for line in (r.stdout or "").strip().splitlines():
         try:
             rec = json.loads(line)
         except json.JSONDecodeError:
             continue
-        if rec.get("metric") == STAGES["stress"]:
+        if rec.get("metric") == STAGES[stage]:
             if rec.get("backend") != backend:
                 # the subprocess has its own hang-proof CPU fallback;
                 # a host number must NOT mark the on-chip stage done
@@ -278,6 +284,8 @@ def run_stage(name, backend):
         stage_pta_scale(backend)
     elif name == "stress":
         stage_stress(backend)
+    elif name == "stress_wideband":
+        stage_stress(backend, wideband=True)
     elif name == "serve":
         stage_serve(backend)
     else:

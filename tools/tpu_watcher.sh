@@ -31,7 +31,16 @@ SLEEP_S=${SLEEP_S:-530}
 
 say() { echo "$(date -u '+%F %T') $*" >>"$LOG"; }
 
+# UTC heartbeat, one line per probe cycle (VERDICT r5 item 5): a
+# session can verify the watcher is ALIVE — not just launched — by
+# checking this file's last stamp is fresher than one SLEEP_S cycle.
+HEARTBEAT="$REPO/.tpu_watcher_heartbeat"
+CYCLE=0
+
 while :; do
+  CYCLE=$((CYCLE + 1))
+  echo "$(date -u '+%FT%TZ') cycle=$CYCLE pid=$$" >"$HEARTBEAT"
+  say "heartbeat: cycle $CYCLE"
   # bounded: --remaining only reads the ledger, but every python in
   # this env imports jax via sitecustomize — never trust it unbounded.
   # rc matters: a timeout/crash also yields empty stdout, which must
