@@ -113,7 +113,31 @@ def attach_dispatch_counters(rec):
                        get_supervisor().snapshot())
     except Exception as e:  # the artifact must survive a broken import
         log(f"  dispatch counters unavailable: {e!r}")
+    rec.setdefault("lint", _lint_state_cached())
     return rec
+
+
+_LINT_STATE = None
+
+
+def _lint_state_cached():
+    """Analyzer-state label for the artifact (graftlint clean bool +
+    suppression surface): a record produced from a tree that no
+    longer lints clean is flagged in the artifact itself, the same
+    degraded-but-labeled policy as the dispatch counters. Cached —
+    the static lint pass costs ~a second and every artifact line in
+    one run describes the same tree."""
+    global _LINT_STATE
+    if _LINT_STATE is None:
+        try:
+            from pint_tpu.analysis import lint_state_safe
+
+            _LINT_STATE = lint_state_safe()
+        except Exception as e:  # analyzer package unimportable
+            _LINT_STATE = {"clean": None, "error": repr(e)}
+        if _LINT_STATE.get("error"):
+            log(f"  lint state degraded: {_LINT_STATE['error']}")
+    return _LINT_STATE
 
 
 def tpu_record_append(rec):

@@ -155,6 +155,10 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
         # state, failovers): a degraded run is labeled in the
         # artifact itself, never silently slow
         "dispatch_supervisor": co_snap.get("dispatch"),
+        # analyzer state (graftlint clean bool + suppression
+        # surface): a record from a tree that no longer lints clean
+        # carries its own warning label, same policy as dispatch
+        "lint": _lint_block(),
     }
     if "coalesced_mesh" in co_best:
         rec["mesh_sharded_wall_ms"] = round(
@@ -163,6 +167,15 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
             seq_best / co_best["coalesced_mesh"], 2)
     log(co_eng.metrics.report())
     return rec
+
+
+def _lint_block():
+    try:
+        from pint_tpu.analysis import lint_state_safe
+
+        return lint_state_safe()
+    except Exception as e:  # analyzer package unimportable
+        return {"clean": None, "error": repr(e)}
 
 
 def main():

@@ -1,16 +1,66 @@
 """pint_tpu.analysis — invariant enforcement for the framework.
 
-Two halves (ISSUE 3 / ARCHITECTURE.md "Static analysis"):
+Three layers (ISSUE 3 + ISSUE 6 / ARCHITECTURE.md "Static analysis"):
 
 - ``graftlint``: the AST/registry linter encoding the CLAUDE.md
-  conventions as rules G1-G8 (``python -m
+  conventions as rules G1-G10 (``python -m
   pint_tpu.analysis.graftlint``);
-- ``sanitizer``: the runtime ``Sanitizer`` context manager that counts
-  jit rebuilds per TimingModel (the "params_only must not drop the
-  jit" invariant), flags host-array operands crossing into watched
-  dispatches, and optionally NaN-checks outputs.
+- ``graftflow`` (+ ``cfg``, ``precision_registry``): the dataflow
+  half — dtype-provenance (G9: demotions only at registered
+  precision boundaries, no f32 into the dd chain) and trace-constant
+  analysis (G10: parameter values are runtime args, cross-checked
+  against TimingModel._compile_key), with runtime differential
+  validation of its dtype predictions;
+- ``sanitizer``: the runtime ``Sanitizer`` context manager that
+  counts jit rebuilds per TimingModel (the "params_only must not
+  drop the jit" invariant), flags host-array operands crossing into
+  watched dispatches (nested pytrees and opaque request objects
+  included), NaN-checks outputs, and carries the dtype-probe mode
+  that closes the differential loop.
 """
 
 from pint_tpu.analysis.sanitizer import Sanitizer  # noqa: F401
 
-__all__ = ["Sanitizer"]
+__all__ = ["Sanitizer", "lint_state", "lint_state_safe"]
+
+
+def lint_state(root=None) -> dict:
+    """Analyzer-state block for perf artifacts (bench.py /
+    bench_serve.py): a degraded-analysis state — violations in the
+    tree, a bloated suppression surface — is labeled in the artifact
+    itself, exactly like degraded dispatch already is
+    (dispatch_supervisor counters). Static rules only: the dynamic
+    zoo half belongs to the test gate, and here it would double the
+    artifact's cost for no labeling value."""
+    from pint_tpu.analysis import graftlint
+    from pint_tpu.analysis.allowlist import ALLOWLIST
+    from pint_tpu.analysis.precision_registry import DEMOTIONS, PROBES
+
+    if root is None:
+        root = graftlint.find_repo_root(__file__)
+    report = graftlint.run_lint(root, dynamic=False)
+    # ALLOWLIST-stale findings can be artifacts of skipping the
+    # dynamic half (an entry only the zoo checks hit); the lint GATE
+    # judges staleness, the artifact label judges the code
+    real = [v for v in report.violations if v.rule != "ALLOWLIST"]
+    return {
+        "clean": not real,
+        "violations": len(real),
+        "suppressed": len(report.suppressed),
+        "allowlist_entries": len(ALLOWLIST),
+        "precision_registry_entries": len(DEMOTIONS),
+        "dtype_probes": len(PROBES),
+        "static_only": True,
+    }
+
+
+def lint_state_safe() -> dict:
+    """lint_state that never raises — the ONE wrapper every artifact
+    embedder (bench.py, bench_serve.py) shares, so the degraded-
+    label shape cannot drift between drivers: a broken analyzer
+    yields {"clean": None, "error": ...} instead of killing the
+    benchmark record."""
+    try:
+        return lint_state()
+    except Exception as e:
+        return {"clean": None, "error": repr(e)}

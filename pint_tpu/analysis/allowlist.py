@@ -25,6 +25,72 @@ ALLOWLIST = [
              "benchmark driver launched as its own process by "
              "tpu_watcher.sh, and must pin x64 before any trace; no "
              "library code imports it"),
+    # ------------------------------------------------------------ G10
+    # Reviewed trace-constant captures. The common shape: a builder
+    # computes a REFERENCE point from the current parameter values,
+    # bakes it into the traced closure, and the runtime arguments
+    # carry only deltas/substitutions against it. Staleness is
+    # structurally impossible in each case because the reference and
+    # the closure are (re)built together — the exact situation the
+    # pv-convention's "values are runtime args" rule is relaxing for.
+    dict(rule="G10", file="pint_tpu/parallel/fit_step.py",
+         match="def step_fn(th, tl, fh, fl, batch, cache",
+         max_hits=2,
+         why="step_fn captures `afn`/`f0_ref`: the anchored delta-"
+             "phase convention — build_anchor computes the exact "
+             "reference ONCE on the host and the step's (th, tl) "
+             "arguments carry only theta - theta_ref; the anchor "
+             "closure and reference are committed together (the "
+             "commit-only-after-success block), and every "
+             "build_fit_step call rebuilds both"),
+    dict(rule="G10", file="pint_tpu/parallel/fit_step.py",
+         match="def make_pv(thx, tlx, fhx, flx):", max_hits=3,
+         why="make_pv captures `th0_c`/`tl0_c`/`ref32_c`: the "
+             "anchored reference pairs the auxiliary DM channel "
+             "reconstructs absolute pv values from (ref + delta). "
+             "Same build-together lifetime as step_fn's anchor; the "
+             "dd32 copy exists so the f32 Jacobian path reconstructs "
+             "in its own dtype"),
+    dict(rule="G10", file="pint_tpu/models/timing_model.py",
+         match="def fn(dth, dtl, fh, fl, batch, cache):", max_hits=2,
+         why="_build_anchored_fn's closure captures `ref64`/`ref32`: "
+             "these ARE the anchored convention's baked statics — "
+             "(dth, dtl) arguments are exact host-computed deltas "
+             "against them. Rebuilt with every _build_anchored_fn "
+             "call (build_fit_step rebuilds anchor + closure "
+             "atomically)"),
+    dict(rule="G10", file="pint_tpu/models/timing_model.py",
+         match="def phase_of(x):", max_hits=4,
+         why="d_phase_d_param's one-shot jacfwd probe captures the "
+             "CURRENT packed values (th/tl/fh/fl) by design: the "
+             "closure is built, differentiated at that point, and "
+             "discarded within a single call — no later call can "
+             "observe a stale capture"),
+    dict(rule="G10", file="pint_tpu/bayesian.py",
+         match="def frac_fn(tl_eff):", max_hits=3,
+         why="the dd-low-word sampling convention: the sampled theta "
+             "enters ONLY through tl_eff (a runtime arg) while "
+             "th0_j and the frozen pairs are the baked reference "
+             "point — deliberately, so XLA cannot constant-fold the "
+             "tiny low word and every representable theta evaluates "
+             "exactly (build_batched_phase_eval docstring). "
+             "Reference and closure are built together per call"),
+    dict(rule="G10", file="pint_tpu/bayesian.py",
+         match="def lnlike_core(tl_eff):",
+         why="lnlike_core bakes `f0` (reference F0) as the turns->"
+             "seconds scale of the whitened residuals: the error of "
+             "using F0_ref instead of the sampled F0 is second-order "
+             "in the sampled delta (delta_F0/F0 ~ 1e-12 at MSP "
+             "precision) — same reviewed convention as frac_fn's "
+             "baked reference point, rebuilt per BayesianTiming "
+             "construction"),
+    dict(rule="G10", file="pint_tpu/gridutils.py",
+         match="def eval_node(gvals):", max_hits=2,
+         why="the grid evaluator captures the frozen baseline pairs "
+             "(fh0/fl_z) and substitutes node coordinates through "
+             "the runtime `gvals` argument (fh0.at[gidx].set) — the "
+             "gridded params were just frozen by _build_grid_eval "
+             "itself, and the closure dies with the grid call"),
     # ------------------------------------------- G6 (dispatch layer)
     dict(rule="G6", file="pint_tpu/config.py",
          match="float(f(x))", max_hits=2,

@@ -1,0 +1,918 @@
+"""graftflow — dataflow precision & trace-constant analysis (G9/G10).
+
+Reference: the two historical bug classes the per-node graftlint
+rules (G1-G8) cannot see, because both are *dataflow* properties:
+
+- **G9 (precision demotion)**: TPU f64 is software-emulated and not
+  correctly rounded (~2^-48 — PAPER.md / ARCHITECTURE.md "Precision
+  strategy"), so the residual path runs dd error-free transforms and
+  only *engineered* boundaries may demote to f32 (the build_fit_step
+  jac_f32 / matmul_f32 sites, the Pallas Z^2 kernel). graftflow
+  tracks a small dtype-provenance lattice {dd, f64, f32, unknown}
+  through assignments, calls and returns (pint_tpu.analysis.cfg) over
+  the jit-reachable closure graftlint already infers, and enforces:
+  (a) every syntactic demotion (``.astype(float32)``, ``dd_to_dd32``
+  and friends, f32-typed literals/ctors) matches a justified entry in
+  ``analysis/precision_registry.py`` — stale entries fail, declared
+  gate flags are statically verified against the enclosing guards;
+  (b) inside the exact-precision modules (``models/timing_model.py``,
+  ``residuals.py``, ``gls.py``) no ``ops/dd.py``/``ops/dd_np.py``
+  consumer may receive an f32-provenance value; (c) mixed known-f32 x
+  known-f64 array arithmetic is a demotion site like any other.
+
+- **G10 (trace constants)**: parameter VALUES are runtime args, never
+  trace constants (CLAUDE.md conventions; the chromatic_index
+  TNCHROMIDX and silently-inert PhaseOffset incidents). Two checks:
+  (a) ``.value``/``.quantity`` reads inside jit-reachable code are
+  flagged unless the read is provably covered by the
+  ``TimingModel._compile_key`` fields — str/bool/int parameter kinds
+  (keyed statics; kinds are recovered from the Parameter-constructor
+  calls in the class bodies), presence checks (``.value is not
+  None``: keyed via the device-param name tuple), PLANET_SHAPIRO
+  (keyed explicitly), or a frozen-guarded read (the function raises
+  on a free param first — frozen values are keyed via frozen_vals);
+  (b) a jit-traced closure must not CAPTURE a parameter-value-derived
+  binding from its builder (th0/anchor-reference pairs etc.) — the
+  pval dataflow taints ``.value`` reads, ``_pack()`` value slots and
+  ``build_anchor`` results through the builder's locals and flags any
+  tainted free variable of a traced inner function. Sanctioned
+  anchored-reference captures ride the ordinary allowlist, each with
+  its written justification.
+
+The compile-key cross-check is live, not aspirational: graftflow
+PARSES ``_compile_key`` and recovers which parameter kinds are keyed;
+if the key ever stops covering str/bool/int statics, frozen values,
+or ref_day, every sanctioning rule that leaned on that coverage
+turns into a G10 violation ("compile key drifted").
+
+Differential validation: ``predict_profile(**flags)`` exports, per
+``precision_registry.PROBES`` entry, whether the probed boundary
+fires and with which dtype under a production flag assignment;
+``tests/test_dtype_probe.py`` traces the real ``build_fit_step``
+configurations under ``Sanitizer.dtype_probe()`` and asserts the
+observed dtypes match. The analyzer tests the code; the runtime
+tests the analyzer.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from pint_tpu.analysis import cfg as _cfg
+from pint_tpu.analysis import graftlint as _gl
+from pint_tpu.analysis import precision_registry as _reg
+
+Violation = _gl.Violation
+
+__all__ = ["run_flow_checks", "predict_profile", "check_g9_module",
+           "check_g10_module", "ParamKinds", "FlowContext"]
+
+# ---------------------------------------------------------- lattice
+
+F32 = "f32"       # f32-provenance: demoted somewhere upstream
+F64 = "f64"       # known plain float64
+DDV = "dd"        # double-double pair (f64 halves)
+UNKNOWN = "unknown"
+
+
+def join_dtype(a: str, b: str) -> str:
+    if a == b:
+        return a
+    if F32 in (a, b):
+        return F32   # taint survives every join
+    return UNKNOWN
+
+
+# Parameter constructors and which kinds the compile key treats as
+# sanctioned trace statics (cross-checked against _compile_key).
+PARAM_CTORS = {"floatParameter", "MJDParameter", "prefixParameter",
+               "maskParameter", "pairParameter", "AngleParameter",
+               "strParameter", "boolParameter", "intParameter"}
+DEFAULT_KEYED_KINDS = {"strParameter", "boolParameter", "intParameter"}
+
+# dd-consumer protection zone: the exact-precision chain
+PROTECTED_MODULES = {"pint_tpu/models/timing_model.py",
+                     "pint_tpu/residuals.py", "pint_tpu/gls.py"}
+
+DD32_CONVERTERS = {"dd_to_dd32", "f64_to_dd32", "_tree_to32",
+                   "_split32"}
+
+PVAL_SOURCE_CALLS = {"build_anchor"}
+PACK_CALL = "_pack"
+
+_PARAM_NAME_RE = re.compile(r"^[A-Z][A-Z0-9_]*$")
+
+
+# ------------------------------------------------------------------
+# shared context: parameter kinds + compile-key cross-check
+# ------------------------------------------------------------------
+
+class ParamKinds:
+    """PARAM name -> constructor kind, recovered from the
+    ``xParameter("NAME", ...)`` calls in the scanned class bodies."""
+
+    def __init__(self, modules: List["_gl.ModuleInfo"]):
+        self.kinds: Dict[str, str] = {}
+        for m in modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                ctor = _gl._tail_name(node.func)
+                if ctor not in PARAM_CTORS:
+                    continue
+                name = None
+                if node.args and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    name = node.args[0].value
+                for kw in node.keywords:
+                    if kw.arg == "name" and \
+                            isinstance(kw.value, ast.Constant):
+                        name = kw.value.value
+                if name:
+                    # a name built by several ctors (rare) keeps the
+                    # LEAST sanctioned kind: only uniform str/bool/int
+                    # construction makes a read safe
+                    prev = self.kinds.get(name)
+                    if prev is not None and prev != ctor:
+                        self.kinds[name] = "ambiguous"
+                    else:
+                        self.kinds[name] = ctor
+
+    def kind(self, name: str) -> Optional[str]:
+        return self.kinds.get(name)
+
+
+def parse_compile_key(modules) -> Tuple[Set[str], List[Violation]]:
+    """Recover the sanctioned-static coverage from
+    ``TimingModel._compile_key``'s AST. Returns (keyed ctor kinds,
+    violations). A missing or drifted key is a G10 violation: every
+    allowance G10 grants leans on the key covering these fields."""
+    tm = None
+    for m in modules:
+        if m.relpath == "pint_tpu/models/timing_model.py":
+            tm = m
+            break
+    if tm is None:
+        # fixture mode (the module under test is not the real tree):
+        # fall back to the documented kinds without complaint
+        return set(DEFAULT_KEYED_KINDS), []
+    fn = None
+    for f in tm.functions:
+        if f.name == "_compile_key":
+            fn = f
+            break
+    out: List[Violation] = []
+    if fn is None:
+        out.append(Violation(
+            "G10", tm.relpath, 0,
+            "TimingModel._compile_key not found — graftflow's "
+            "sanctioned-static rules assume its coverage; restore it "
+            "or update graftflow.parse_compile_key", scope="repo"))
+        return set(DEFAULT_KEYED_KINDS), out
+    src = ast.unparse(fn)
+    kinds = {k for k in DEFAULT_KEYED_KINDS if k in src}
+    for feature, msg in (
+            ("frozen_vals", "frozen device-param values"),
+            ("ref_day", "the static reference epoch"),
+            ("PLANET_SHAPIRO", "the PLANET_SHAPIRO branch static")):
+        if feature not in src:
+            out.append(Violation(
+                "G10", tm.relpath, fn.lineno,
+                f"_compile_key no longer covers {msg} ({feature!r}) "
+                f"— G10's sanctioning of reads keyed through it is "
+                f"now unsound; re-add the field or rework the rule",
+                scope="repo"))
+    if kinds != DEFAULT_KEYED_KINDS:
+        missing = sorted(DEFAULT_KEYED_KINDS - kinds)
+        out.append(Violation(
+            "G10", tm.relpath, fn.lineno,
+            f"_compile_key no longer keys {missing} parameter values "
+            f"— their in-trace reads are no longer sanctioned",
+            scope="repo"))
+    return kinds or set(DEFAULT_KEYED_KINDS), out
+
+
+class FlowContext:
+    """Everything the per-module checks share."""
+
+    def __init__(self, modules, param_kinds: Optional[ParamKinds] = None,
+                 registry: Optional[List[dict]] = None):
+        self.modules = modules
+        self.param_kinds = param_kinds or ParamKinds(modules)
+        self.registry = _reg.DEMOTIONS if registry is None else registry
+        self.keyed_kinds, self.key_violations = \
+            parse_compile_key(modules)
+        self.registry_hits = [0] * len(self.registry)
+        self.suppressed: List[Tuple[Violation, str]] = []
+
+
+# ------------------------------------------------------------------
+# G9 — demotion sites + dd-consumer taint
+# ------------------------------------------------------------------
+
+def _mentions_dtype(node: ast.AST, name: str) -> bool:
+    """jnp.float32 / np.float32 / bare float32 AND the string
+    spelling "float32" — astype("float32") is common numpy idiom and
+    must not slip past the rule."""
+    for n in ast.walk(node):
+        if isinstance(n, (ast.Attribute, ast.Name)) and \
+                _gl._tail_name(n) == name:
+            return True
+        if isinstance(n, ast.Constant) and n.value == name:
+            return True
+    return False
+
+
+def demotion_kind(node: ast.Call) -> Optional[str]:
+    """Classify a call as a precision demotion, or None."""
+    tail = _gl._tail_name(node.func)
+    if tail == "astype" and any(_mentions_dtype(a, "float32")
+                                for a in node.args):
+        return "astype(float32)"
+    if tail in DD32_CONVERTERS:
+        return f"{tail}()"
+    if tail == "float32" and (node.args or node.keywords):
+        return "float32(...) literal"
+    # dtype-typed constructors: asarray(x, jnp.float32) /
+    # zeros(n, dtype="float32") / full(..., dtype=np.float32)
+    for kw in node.keywords:
+        if kw.arg == "dtype" and _mentions_dtype(kw.value, "float32"):
+            return "dtype=float32 construction"
+    for a in node.args[1:]:
+        if (isinstance(a, (ast.Attribute, ast.Name)) and
+                _gl._tail_name(a) == "float32") or \
+                (isinstance(a, ast.Constant) and a.value == "float32"):
+            return "f32-dtype argument"
+    return None
+
+
+def _registry_lookup(ctx: FlowContext, relpath: str, func: str,
+                     line_text: str) -> Optional[dict]:
+    for i, e in enumerate(ctx.registry):
+        if e["file"] != relpath or e["func"] != func:
+            continue
+        if e.get("match") and e["match"] not in line_text:
+            continue
+        if ctx.registry_hits[i] >= e.get("max_hits", 1):
+            continue
+        ctx.registry_hits[i] += 1
+        return e
+    return None
+
+
+def _guard_satisfied(m: "_gl.ModuleInfo", node: ast.AST,
+                     guard: str) -> bool:
+    """The declared gate name must actually gate the site: the node
+    sits in the TRUE branch of an enclosing ``if <guard>...`` (a
+    demotion in the else-branch runs precisely when the flag is OFF
+    — that is drift, not gating; a bare ``if not <guard>`` inverts
+    the branches), or the enclosing function takes the gate as a
+    parameter (the _symm_mm pattern: the flag selects behavior
+    inside the function)."""
+    prev, cur = node, m.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.If) and guard in ast.unparse(cur.test):
+            negated = (isinstance(cur.test, ast.UnaryOp) and
+                       isinstance(cur.test.op, ast.Not))
+            in_body = any(prev is s for s in cur.body)
+            in_else = any(prev is s for s in cur.orelse)
+            if (in_body and not negated) or (in_else and negated):
+                return True
+            # wrong branch: keep walking — an outer guard may gate
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {a.arg for a in cur.args.args +
+                      cur.args.kwonlyargs}
+            if guard in params:
+                return True
+        prev, cur = cur, m.parents.get(cur)
+    return False
+
+
+def _dd_consumer_names(m: "_gl.ModuleInfo") -> Set[str]:
+    """Names this module imports from ops.dd / ops.dd_np (plus the
+    ``dd_np.x`` attribute form): the consumers G9 protects."""
+    out: Set[str] = set()
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                ("ops.dd" in node.module or node.module == "ops"):
+            for a in node.names:
+                out.add(a.asname or a.name)
+    out.discard("dd_np")  # attribute calls handled separately
+    return out
+
+
+class _DtypeFlow:
+    """Per-function dtype-provenance pass (the cfg client).
+    ``protected`` switches on the dd-consumer check (the exact-
+    precision modules); mixed-dtype arithmetic flags everywhere."""
+
+    def __init__(self, m, fn, consumers: Set[str], ctx: FlowContext,
+                 record: Optional[List[Violation]] = None,
+                 protected: bool = True):
+        self.m = m
+        self.fn = fn
+        self.consumers = consumers
+        self.ctx = ctx
+        self.record = record
+        self.protected = protected
+
+    def eval(self, node: ast.AST, env: Dict[str, str]) -> str:
+        if node is None:
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Constant):
+            return UNKNOWN  # python scalars are weakly typed in jax
+        if isinstance(node, ast.Attribute):
+            # x.hi / x.lo keep x's provenance; everything else is a
+            # fresh unknown unless the base is tainted
+            base = self.eval(node.value, env)
+            return base if base == F32 else (
+                base if node.attr in ("hi", "lo") else UNKNOWN)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.BinOp):
+            lt = self.eval(node.left, env)
+            rt = self.eval(node.right, env)
+            if {lt, rt} == {F32, F64} and self.record is not None:
+                line = self.m.line_text(node.lineno)
+                entry = _registry_lookup(
+                    self.ctx, self.m.relpath, self.fn.name, line)
+                v = Violation(
+                    "G9", self.m.relpath, node.lineno,
+                    f"mixed f32 x f64 arithmetic in jit-reachable "
+                    f"`{self.fn.name}`: the f32 operand already lost "
+                    f"the bits the f64 side is carrying — demote "
+                    f"only at a registered boundary", line)
+                if entry is not None:
+                    self.ctx.suppressed.append((v, f"registry: "
+                                                f"{entry['why']}"))
+                else:
+                    self.record.append(v)
+            return join_dtype(lt, rt)
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, env)
+        if isinstance(node, ast.IfExp):
+            return join_dtype(self.eval(node.body, env),
+                              self.eval(node.orelse, env))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = UNKNOWN
+            first = True
+            for e in node.elts:
+                t = self.eval(e, env)
+                out = t if first else join_dtype(out, t)
+                first = False
+            return out
+        if isinstance(node, ast.Subscript):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        return UNKNOWN
+
+    def _eval_call(self, node: ast.Call, env) -> str:
+        tail = _gl._tail_name(node.func)
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        arg_ts = [self.eval(a, env) for a in args]
+        joined = UNKNOWN
+        for i, t in enumerate(arg_ts):
+            joined = t if i == 0 else join_dtype(joined, t)
+        if isinstance(node.func, ast.Attribute):
+            # method calls on a tainted RECEIVER stay tainted —
+            # x.reshape(-1)/.sum()/.ravel() of an f32 value must not
+            # launder its provenance past the dd-consumer check
+            joined = join_dtype(joined,
+                                self.eval(node.func.value, env))
+        kind = demotion_kind(node)
+        if kind:
+            return F32
+        if tail == "astype" and any(
+                _mentions_dtype(a, "float64") for a in node.args):
+            # upcasts produce f64 VALUES but do not launder f32
+            # provenance: the bits are already gone
+            base = self.eval(node.func.value, env) \
+                if isinstance(node.func, ast.Attribute) else UNKNOWN
+            return F32 if base == F32 else F64
+        if self.record is not None and self.protected and (
+                tail in self.consumers or
+                (isinstance(node.func, ast.Attribute) and
+                 _gl._root_name(node.func) == "dd_np")):
+            bad = [i for i, t in enumerate(arg_ts) if t == F32]
+            if bad:
+                line = self.m.line_text(node.lineno)
+                self.record.append(Violation(
+                    "G9", self.m.relpath, node.lineno,
+                    f"dd consumer `{tail}` in exact-precision module "
+                    f"receives f32-provenance argument(s) "
+                    f"{bad} inside `{self.fn.name}`: the dd error-"
+                    f"free transforms assume full-precision inputs "
+                    f"(demotions belong in parallel/fit_step's "
+                    f"registered boundaries)", line))
+        if tail in ("dd", "DD", "dd_from_parts"):
+            return F32 if joined == F32 else DDV
+        # taint propagates through arbitrary calls: zeros_like(f32),
+        # concatenate([f32...]), helper(f32) all stay f32-provenance
+        return F32 if joined == F32 else UNKNOWN
+
+    # ------------------------------------------------------ transfer
+
+    def transfer(self, st: ast.stmt, env: Dict[str, str],
+                 is_header: bool):
+        if is_header:
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                t = self.eval(st.iter, env)
+                for n in ast.walk(st.target):
+                    if isinstance(n, ast.Name):
+                        env[n.id] = t
+            elif isinstance(st, ast.If):
+                self.eval(st.test, env)
+            elif isinstance(st, ast.While):
+                self.eval(st.test, env)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    t = self.eval(item.context_expr, env)
+                    if item.optional_vars is not None:
+                        for n in ast.walk(item.optional_vars):
+                            if isinstance(n, ast.Name):
+                                env[n.id] = t
+            return
+        if isinstance(st, ast.Assign):
+            t = self.eval(st.value, env)
+            for tgt in st.targets:
+                self._bind(tgt, st.value, t, env)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            t = self.eval(st.value, env)
+            self._bind(st.target, st.value, t, env)
+        elif isinstance(st, ast.AugAssign):
+            t = join_dtype(self.eval(st.target, env),
+                           self.eval(st.value, env))
+            if isinstance(st.target, ast.Name):
+                env[st.target.id] = t
+        elif isinstance(st, ast.Expr):
+            self.eval(st.value, env)
+        elif isinstance(st, ast.Return) and st.value is not None:
+            self.eval(st.value, env)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            env[st.name] = UNKNOWN
+        elif isinstance(st, (ast.If, ast.While, ast.Try, ast.With,
+                             ast.Match)):
+            pass  # headers handled above
+        elif isinstance(st, (ast.Raise, ast.Assert)):
+            if getattr(st, "exc", None) is not None:
+                self.eval(st.exc, env)
+
+    def _bind(self, tgt, value_node, t: str, env):
+        if isinstance(tgt, ast.Name):
+            env[tgt.id] = t
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            # element-wise when the RHS is a literal tuple, else the
+            # joined provenance lands on every target
+            if isinstance(value_node, (ast.Tuple, ast.List)) and \
+                    len(value_node.elts) == len(tgt.elts):
+                for sub_t, sub_v in zip(tgt.elts, value_node.elts):
+                    self._bind(sub_t, sub_v, self.eval(sub_v, env),
+                               env)
+            else:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        env[n.id] = t
+
+
+def check_g9_module(m: "_gl.ModuleInfo", ctx: FlowContext
+                    ) -> List[Violation]:
+    """Demotion-site scan (jit regions, registry-sanctioned) plus the
+    dd-consumer taint pass in the exact-precision modules."""
+    out: List[Violation] = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call) or not m.in_jit_region(node):
+            continue
+        kind = demotion_kind(node)
+        if not kind:
+            continue
+        fn = m.enclosing_function(node)
+        fname = fn.name if fn is not None else "<module>"
+        line = m.line_text(node.lineno)
+        entry = _registry_lookup(ctx, m.relpath, fname, line)
+        if entry is None:
+            out.append(Violation(
+                "G9", m.relpath, node.lineno,
+                f"precision demotion [{kind}] in jit-reachable "
+                f"`{fname}` outside the precision-boundary registry "
+                f"— if this demotion is engineered and safe, declare "
+                f"it in analysis/precision_registry.py with its "
+                f"justification", line))
+            continue
+        guard = entry.get("guard")
+        if guard and not _guard_satisfied(m, node, guard):
+            out.append(Violation(
+                "G9", m.relpath, node.lineno,
+                f"registered boundary site declares guard "
+                f"`{guard}` (flag {entry.get('flag')!r}) but the "
+                f"site is not in the TRUE branch of an enclosing "
+                f"`if {guard}` and no enclosing function takes "
+                f"`{guard}` as a parameter — the registry's gating "
+                f"claim drifted from the code", line))
+        else:
+            v = Violation("G9", m.relpath, node.lineno,
+                          f"demotion [{kind}] in `{fname}`", line)
+            ctx.suppressed.append((v, f"registry: {entry['why']}"))
+    protected = m.relpath in PROTECTED_MODULES
+    consumers = _dd_consumer_names(m) if protected else set()
+    for fn in m.functions:
+        if fn not in m.jit_funcs:
+            continue
+        out.extend(_run_dtype_pass(m, fn, consumers, ctx, protected))
+    return out
+
+
+def _run_dtype_pass(m, fn, consumers, ctx,
+                    protected: bool) -> List[Violation]:
+    graph = _cfg.build_cfg(fn)
+    flow = _DtypeFlow(m, fn, consumers, ctx, protected=protected)
+    in_envs = _cfg.run_dataflow(
+        graph, {}, flow.transfer, join_dtype)
+    found: List[Violation] = []
+    rec = _DtypeFlow(m, fn, consumers, ctx, record=found,
+                     protected=protected)
+    for b in graph.blocks:
+        env = dict(in_envs.get(b.bid, {}))
+        for st in b.stmts:
+            rec.transfer(st, env, st in b.headers)
+    return found
+
+
+# ------------------------------------------------------------------
+# G10 — trace constants: in-trace .value reads + tainted captures
+# ------------------------------------------------------------------
+
+def _is_presence_check(m: "_gl.ModuleInfo", node: ast.AST) -> bool:
+    """``X.value is (not) None``: a structural presence test —
+    covered by the compile key's device-param name tuple (params
+    without a value are not device params at all)."""
+    cur = node
+    parent = m.parents.get(cur)
+    while parent is not None and isinstance(
+            parent, (ast.Compare, ast.BoolOp, ast.UnaryOp)):
+        if isinstance(parent, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in parent.ops) and \
+                    any(isinstance(c, ast.Constant) and c.value is None
+                        for c in parent.comparators):
+                return True
+        cur, parent = parent, m.parents.get(parent)
+    return False
+
+
+def _frozen_guarded_names(fn: ast.FunctionDef) -> Dict[str, int]:
+    """The chromatic_index pattern, PER PARAMETER: {receiver: guard
+    line} for receivers whose free-ness the function refuses with a
+    raise — ``if not p.frozen: raise`` (possibly or-joined). Frozen
+    values are covered by the compile key's frozen_vals, so reads of
+    exactly THOSE receivers, AFTER the guard line, cannot go
+    silently stale. Scoping it per-parameter and requiring the read
+    to follow the guard closes two holes a blanket function-level
+    exemption would leave open: a later-added read of a DIFFERENT
+    free parameter, and a read on an early-return path the guard
+    never dominates (lexical order approximates dominance — exact on
+    the straight-line guard-first idiom this sanctions)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        if not any(isinstance(n, ast.Raise) for n in node.body):
+            continue
+        # only the refusing polarity sanctions: `not X.frozen` (or
+        # any or-combination containing it)
+        for n in ast.walk(node.test):
+            if isinstance(n, ast.UnaryOp) and \
+                    isinstance(n.op, ast.Not) and \
+                    isinstance(n.operand, ast.Attribute) and \
+                    n.operand.attr == "frozen":
+                base = _gl._tail_name(n.operand.value)
+                if base:
+                    out[base] = min(out.get(base, node.lineno),
+                                    node.lineno)
+    return out
+
+
+def check_g10_reads(m: "_gl.ModuleInfo", ctx: FlowContext
+                    ) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(m.tree):
+        if not (isinstance(node, ast.Attribute) and
+                node.attr in ("value", "quantity")):
+            continue
+        if not m.in_jit_region(node):
+            continue
+        if _is_presence_check(m, node):
+            continue
+        pname = _gl._tail_name(node.value)
+        if pname == "PLANET_SHAPIRO":
+            continue  # the one MiscParams static, keyed explicitly
+        kind = ctx.param_kinds.kind(pname) if pname and \
+            _PARAM_NAME_RE.match(pname) else None
+        if kind in ctx.keyed_kinds:
+            continue  # str/bool/int param values are compile-keyed
+        fn = m.enclosing_function(node)
+        if fn is not None and pname:
+            guards = _frozen_guarded_names(fn)
+            if pname in guards and node.lineno > guards[pname]:
+                continue  # refused free BEFORE this read; frozen
+                # values are compile-keyed
+        out.append(Violation(
+            "G10", m.relpath, node.lineno,
+            f".{node.attr} read of "
+            f"{'parameter ' + pname if pname else 'a parameter'} "
+            f"inside jit-reachable "
+            f"`{fn.name if fn else '<module>'}` bakes a trace "
+            f"constant (pv-convention: values are runtime args). "
+            f"Route it through pv[...], or guard frozen-ness with a "
+            f"raise (frozen values are compile-keyed)",
+            m.line_text(node.lineno)))
+    return out
+
+
+class _PvalFlow:
+    """Taints builder-function locals that derive from parameter
+    values: .value/.quantity reads (non-keyed kinds), the value slots
+    of ``_pack()``, and ``build_anchor`` results — propagated through
+    calls, subscripts, attributes and arithmetic."""
+
+    def __init__(self, m, ctx: FlowContext):
+        self.m = m
+        self.ctx = ctx
+
+    def eval(self, node, env) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return bool(env.get(node.id, False))
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("value", "quantity"):
+                pname = _gl._tail_name(node.value)
+                kind = self.ctx.param_kinds.kind(pname) if pname \
+                    else None
+                if kind in self.ctx.keyed_kinds:
+                    return False
+                return True
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Call):
+            tail = _gl._tail_name(node.func)
+            if tail in PVAL_SOURCE_CALLS:
+                return True
+            args = list(node.args) + [k.value for k in node.keywords]
+            if any(self.eval(a, env) for a in args):
+                return True
+            if isinstance(node.func, ast.Attribute):
+                # method call on a tainted object stays tainted
+                return self.eval(node.func.value, env) \
+                    if node.func.attr not in ("keys", "items") \
+                    else False
+            return False
+        if isinstance(node, (ast.BinOp,)):
+            return self.eval(node.left, env) or \
+                self.eval(node.right, env)
+        if isinstance(node, ast.UnaryOp):
+            return self.eval(node.operand, env)
+        if isinstance(node, ast.IfExp):
+            return self.eval(node.body, env) or \
+                self.eval(node.orelse, env)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.eval(e, env) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.eval(v, env)
+                       for v in list(node.keys) + list(node.values)
+                       if v is not None)
+        if isinstance(node, ast.Subscript):
+            return self.eval(node.value, env)
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, env)
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            return any(self.eval(n, env) for n in ast.walk(node)
+                       if isinstance(n, ast.Name))
+        return False
+
+    def transfer(self, st, env, is_header):
+        if is_header:
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                t = self.eval(st.iter, env)
+                for n in ast.walk(st.target):
+                    if isinstance(n, ast.Name):
+                        env[n.id] = t
+            return
+        if isinstance(st, ast.Assign):
+            self._assign(st.targets, st.value, env)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            self._assign([st.target], st.value, env)
+        elif isinstance(st, ast.AugAssign):
+            if isinstance(st.target, ast.Name):
+                env[st.target.id] = bool(
+                    env.get(st.target.id, False)) or \
+                    self.eval(st.value, env)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            env[st.name] = False  # captures are checked per-function
+
+    def _assign(self, targets, value, env):
+        # the _pack convention: (free_names, frozen_names, th, tl,
+        # fh, fl) — positions >= 2 are parameter VALUES, 0-1 are
+        # name lists (strings; capturing those is fine)
+        is_pack = isinstance(value, ast.Call) and \
+            _gl._tail_name(value.func) == PACK_CALL
+        for tgt in targets:
+            if is_pack and isinstance(tgt, (ast.Tuple, ast.List)):
+                pos = 0
+                for el in tgt.elts:
+                    if isinstance(el, ast.Starred):
+                        for n in ast.walk(el):
+                            if isinstance(n, ast.Name):
+                                env[n.id] = True
+                        pos = 6
+                        continue
+                    if isinstance(el, ast.Name):
+                        env[el.id] = pos >= 2
+                    pos += 1
+                continue
+            t = True if is_pack else self.eval(value, env)
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name):
+                    env[n.id] = t
+
+
+def _free_names(fn: ast.FunctionDef) -> Set[str]:
+    loaded = {n.id for n in ast.walk(fn)
+              if isinstance(n, ast.Name) and
+              isinstance(n.ctx, ast.Load)}
+    return loaded - _gl._locally_bound_names(fn) - {fn.name}
+
+
+def check_g10_captures(m: "_gl.ModuleInfo", ctx: FlowContext
+                       ) -> List[Violation]:
+    """A jit-traced inner function must not capture a parameter-
+    value-derived binding from its builder."""
+    out: List[Violation] = []
+    seen: Set[Tuple[str, str, str]] = set()
+    module_names = {f.name for f in m.functions} | \
+        {c.name for c in m.classes}
+    env_cache: Dict[ast.FunctionDef, Dict[str, bool]] = {}
+
+    def final_env(outer: ast.FunctionDef) -> Dict[str, bool]:
+        if outer not in env_cache:
+            graph = _cfg.build_cfg(outer)
+            flow = _PvalFlow(m, ctx)
+            in_envs = _cfg.run_dataflow(
+                graph, {}, flow.transfer,
+                lambda a, b: bool(a) or bool(b))
+            # the function's final state: join over every block's
+            # OUT env (captures can be created anywhere, not only on
+            # the path that reaches the exit)
+            joined: Dict[str, bool] = {}
+            for b in graph.blocks:
+                env = dict(in_envs.get(b.bid, {}))
+                for st in b.stmts:
+                    flow.transfer(st, env, st in b.headers)
+                for k, v in env.items():
+                    joined[k] = joined.get(k, False) or v
+            env_cache[outer] = joined
+        return env_cache[outer]
+
+    for fn in m.functions:
+        if fn not in m.jit_funcs:
+            continue
+        outer = m.enclosing_function(fn)
+        if outer is None:
+            continue
+        free = _free_names(fn) - module_names
+        chain = []
+        cur = outer
+        while cur is not None:
+            chain.append(cur)
+            cur = m.enclosing_function(cur)
+        for name in sorted(free):
+            for binder in chain:
+                if name not in _gl._locally_bound_names(binder) and \
+                        not any(isinstance(s, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))
+                                and s.name == name
+                                for s in ast.walk(binder)):
+                    continue
+                key = (m.relpath, binder.name, name)
+                env = final_env(binder)
+                if env.get(name, False) and key not in seen:
+                    seen.add(key)
+                    out.append(Violation(
+                        "G10", m.relpath, fn.lineno,
+                        f"traced closure `{fn.name}` captures "
+                        f"`{name}` from `{binder.name}`, a binding "
+                        f"derived from parameter VALUES (pv-"
+                        f"convention: values are runtime args). "
+                        f"Thread it through the step arguments, or "
+                        f"allowlist it as a reviewed anchored-"
+                        f"reference static",
+                        m.line_text(fn.lineno)))
+                break
+    return out
+
+
+def check_g10_module(m: "_gl.ModuleInfo", ctx: FlowContext
+                     ) -> List[Violation]:
+    return check_g10_reads(m, ctx) + check_g10_captures(m, ctx)
+
+
+# ------------------------------------------------------------------
+# registry bookkeeping + probe verification
+# ------------------------------------------------------------------
+
+def registry_stale_violations(ctx: FlowContext) -> List[Violation]:
+    out = []
+    for i, e in enumerate(ctx.registry):
+        if not ctx.registry_hits[i]:
+            out.append(Violation(
+                "REGISTRY", e["file"], 0,
+                f"stale precision-registry entry (func "
+                f"{e['func']!r}, match {e.get('match')!r}) no longer "
+                f"covers any demotion site — delete it so the "
+                f"registry stays honest", scope="repo"))
+    return out
+
+
+def verify_probes(modules, probes: Optional[List[dict]] = None
+                  ) -> List[Violation]:
+    """Every runtime probe must still point at a real call site in
+    its declared file (the static half of the differential loop)."""
+    probes = _reg.PROBES if probes is None else probes
+    by_path = {m.relpath: m for m in modules}
+    out = []
+    for p in probes:
+        m = by_path.get(p["file"])
+        if m is None:
+            continue  # fixture runs do not carry the real tree
+        called = any(isinstance(n, ast.Call) and
+                     _gl._tail_name(n.func) == p["callee"]
+                     for n in ast.walk(m.tree))
+        if not called:
+            out.append(Violation(
+                "REGISTRY", p["file"], 0,
+                f"dtype-probe {p['label']!r} points at "
+                f"`{p['callee']}` but nothing in the file calls it "
+                f"any more — the differential validation lost a "
+                f"site; update precision_registry.PROBES",
+                scope="repo"))
+    return out
+
+
+# ------------------------------------------------------------------
+# prediction (the static half of the differential validation)
+# ------------------------------------------------------------------
+
+def _eval_flag(expr: Optional[str], flags: Dict[str, object]):
+    if expr is None:
+        return None
+    return eval(expr, {"__builtins__": {}}, dict(flags))  # noqa: S307
+    # (registry-authored expressions over four booleans, not user
+    # input — the restricted globals keep it a pure flag calculus)
+
+
+def predict_profile(jac32: bool = False, f32mm: bool = False,
+                    anchored: bool = False, hybrid: bool = False
+                    ) -> Dict[str, dict]:
+    """{probe label: {"active": bool, "dtype": str | None}} under a
+    production flag assignment. ``hybrid`` means "hybrid Jacobian
+    enabled AND the model actually claims columns" — the caller owns
+    that conjunction (an empty claim set never calls the column
+    assembler)."""
+    flags = dict(jac32=bool(jac32), f32mm=bool(f32mm),
+                 anchored=bool(anchored), hybrid=bool(hybrid),
+                 True_=True)
+    out: Dict[str, dict] = {}
+    for p in _reg.PROBES:
+        active = bool(_eval_flag(p["flag"], flags))
+        out[p["label"]] = {
+            "active": active,
+            "dtype": _eval_flag(p.get("dtype"), flags)
+            if active else None,
+        }
+    return out
+
+
+# ------------------------------------------------------------------
+# driver
+# ------------------------------------------------------------------
+
+def run_flow_checks(modules, param_kinds: Optional[ParamKinds] = None,
+                    registry: Optional[List[dict]] = None,
+                    verify_probe_sites: bool = True):
+    """(violations, suppressed) across G9/G10 + registry hygiene.
+    ``modules`` must already carry graftlint's jit marks
+    (mark_jit_regions)."""
+    ctx = FlowContext(modules, param_kinds=param_kinds,
+                      registry=registry)
+    violations: List[Violation] = list(ctx.key_violations)
+    for m in modules:
+        violations += check_g9_module(m, ctx)
+        violations += check_g10_module(m, ctx)
+    violations += registry_stale_violations(ctx)
+    if verify_probe_sites:
+        violations += verify_probes(modules)
+    return violations, ctx.suppressed

@@ -46,6 +46,20 @@ Rules (see ARCHITECTURE.md "Static analysis" for the table):
   G8  no functools.lru_cache/cache on methods (the cache keys `self`
       — a model leak — and any array arg is unhashable or, worse,
       hashed by object id: a retrace hazard)
+  G9  precision demotions (astype(float32), dd32 conversions,
+      f32-typed literals, mixed f32 x f64 arithmetic) only at
+      declared boundary sites (analysis/precision_registry.py), and
+      no ops/dd consumer in the exact-precision modules may receive
+      an f32-provenance value — the dataflow half lives in
+      analysis/graftflow.py (lattice {dd, f64, f32, unknown} over
+      analysis/cfg.py CFGs)
+  G10 jit-traced code must not bake parameter VALUES as trace
+      constants: in-trace .value/.quantity reads are legal only when
+      covered by TimingModel._compile_key (str/bool/int kinds,
+      presence checks, PLANET_SHAPIRO, frozen-guarded reads), and
+      traced closures must not capture parameter-value-derived
+      bindings from their builders (graftflow's pval taint pass,
+      cross-checked against a live parse of _compile_key)
 
 jit-reachability is inferred statically, seeded by project
 conventions: any function whose early positional parameters include
@@ -62,7 +76,11 @@ every entry carries a written justification) or an inline pragma
 allowlist entries are themselves errors, so the list cannot rot.
 
 Run: ``python -m pint_tpu.analysis.graftlint [--root DIR] [--json]
-[--no-dynamic]``. Exit 0 = clean. The repo-clean gate is
+[--format json] [--changed-only] [--no-dynamic]``. Exit 0 = clean.
+``--format json`` emits one {file,line,rule,msg} record per line
+(JSONL) for machines; ``--changed-only`` scopes findings to files
+changed vs HEAD for fast pre-commit runs (tools/check.sh chains it
+with the lint + fast pytest lanes). The repo-clean gate is
 tests/test_graftlint.py::test_repo_clean (tier-1, `-m lint`).
 """
 
@@ -87,6 +105,10 @@ RULES = {
           "jit calls route through the runtime supervisor",
     "G7": "jax.config.update only in sanctioned entry points",
     "G8": "no functools.lru_cache on methods",
+    "G9": "precision demotions only at registered boundary sites; "
+          "no f32-provenance value reaches the dd chain",
+    "G10": "no parameter values baked as trace constants (reads and "
+           "closure captures cross-checked against the compile key)",
 }
 
 # entry points allowed to mutate global jax config (G7): the package
@@ -106,7 +128,7 @@ PV_PARAM = "pv"
 PV_WINDOW = 3  # pv must appear among the first 3 positional params
 
 JIT_WRAPPERS = {"jit", "vmap", "pmap", "shard_map", "jacfwd", "jacrev",
-                "grad", "value_and_grad"}
+                "grad", "value_and_grad", "pallas_call"}
 
 COERCIONS = {"float", "int", "bool", "complex"}
 COERCION_METHODS = {"item", "tolist"}
@@ -132,7 +154,7 @@ BOUNDED_PROBES = {"accelerator_responsive"}
 SUBPROCESS_CALLS = {"run", "check_output", "check_call", "call"}
 
 PRAGMA_RE = re.compile(
-    r"#\s*graftlint:\s*allow\s+(G\d)\s*(?:--|—|:)\s*(\S.*)")
+    r"#\s*graftlint:\s*allow\s+(G\d+)\s*(?:--|—|:)\s*(\S.*)")
 
 
 @dataclass
@@ -142,6 +164,10 @@ class Violation:
     line: int
     msg: str
     snippet: str = ""
+    # "file": anchored to one file's content; "repo": a repo-global
+    # fact (stale allowlist/registry entries, dynamic zoo findings,
+    # compile-key drift) that --changed-only must never filter away
+    scope: str = "file"
 
     def format(self) -> str:
         loc = f"{self.path}:{self.line}" if self.line else self.path
@@ -293,7 +319,11 @@ def collect_jit_seed_names(
                     names.add(t)
             elif isinstance(a, ast.Call):
                 f = a.func
-                if _tail_name(f) in JIT_WRAPPERS:
+                # see through nesting (jit(vmap(f))) AND partial
+                # binding (pallas_call(partial(_kernel, m), ...)) —
+                # the bound function's body is traced either way
+                if _tail_name(f) in JIT_WRAPPERS or \
+                        _tail_name(f) == "partial":
                     harvest(a, names)
 
     for m in modules:
@@ -383,7 +413,12 @@ def _locally_bound_names(f: ast.FunctionDef) -> Set[str]:
 HOST_ATTRS = {"value", "uncertainty", "frozen", "index", "units",
               "name", "prefix", "ndim", "size", "ref_day"}
 HOST_ROOT_MODULES = {"math", "os", "sys"}
-HOST_CALLS = {"len", "str", "repr", "ord", "range"}
+# frozen_trace_value is the sanctioned host read of a frozen param
+# (models/timing_model.py — raises on a free param, compile-keyed
+# otherwise), so coercing ITS result is host arithmetic, not a
+# traced-value coercion
+HOST_CALLS = {"len", "str", "repr", "ord", "range",
+              "frozen_trace_value"}
 
 
 def _is_host_expr(node: ast.AST) -> bool:
@@ -1058,7 +1093,7 @@ def apply_suppressions(report: LintReport, allowlist: List[dict],
                 "ALLOWLIST", e["file"], 0,
                 f"stale allowlist entry (rule {e['rule']}, match "
                 f"{e.get('match')!r}) no longer suppresses anything — "
-                f"delete it so the list stays honest"))
+                f"delete it so the list stays honest", scope="repo"))
 
 
 # --------------------------------------------------------------------
@@ -1100,16 +1135,51 @@ def run_lint(root: str, dynamic: bool = True,
     report.violations += check_g3(graph)
     report.violations += check_g4_static(graph)
     report.violations += check_g5_static(graph)
+    # the dataflow rule families (G9/G10) live in analysis/graftflow;
+    # imported lazily so the AST fixtures in tests can drive the
+    # per-rule halves without the registry machinery
+    from pint_tpu.analysis import graftflow
+
+    flow_violations, flow_suppressed = graftflow.run_flow_checks(
+        modules)
+    report.violations += flow_violations
     if dynamic:
-        report.violations += dynamic_registry_checks(root)
+        for v in dynamic_registry_checks(root):
+            v.scope = "repo"
+            report.violations.append(v)
     allow = []
     if use_allowlist:
         from pint_tpu.analysis.allowlist import ALLOWLIST
 
         allow = ALLOWLIST
     apply_suppressions(report, allow, sources)
+    # registry-sanctioned demotion sites are reviewed suppressions,
+    # same standing as allowlist hits — recorded after the allowlist
+    # pass (they never were candidate violations)
+    report.suppressed.extend(flow_suppressed)
     report.violations.sort(key=lambda v: (v.path, v.line, v.rule))
     return report
+
+
+def changed_file_set(root: str) -> Set[str]:
+    """Repo-relative paths changed vs HEAD (staged + unstaged +
+    untracked) — the --changed-only scope. Bounded subprocesses (a
+    repo on a wedged network mount must not hang the linter)."""
+    import subprocess
+
+    out: Set[str] = set()
+    for args in (["git", "diff", "--name-only", "HEAD"],
+                 ["git", "ls-files", "--others",
+                  "--exclude-standard"]):
+        try:
+            r = subprocess.run(args, cwd=root, capture_output=True,
+                               text=True, timeout=30)
+        except Exception:
+            continue
+        if r.returncode == 0:
+            out.update(p.strip() for p in r.stdout.splitlines()
+                       if p.strip())
+    return out
 
 
 def find_repo_root(start: Optional[str] = None) -> str:
@@ -1132,7 +1202,20 @@ def main(argv=None) -> int:
     ap.add_argument("--root", default=None,
                     help="repo root (default: walk up to pint_tpu/)")
     ap.add_argument("--json", action="store_true",
-                    help="machine-readable output")
+                    help="single-document machine-readable output")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text",
+                    help="json: one {file,line,rule,msg} record per "
+                         "line (JSONL) plus a trailing summary "
+                         "record — the pre-commit/CI wire format")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report only findings in files changed vs "
+                         "HEAD (git diff + untracked) — the fast "
+                         "pre-commit mode; repo-global findings "
+                         "(stale allowlist/registry entries, "
+                         "dynamic zoo checks) are skipped unless "
+                         "their file changed. The full run remains "
+                         "the gate")
     ap.add_argument("--no-dynamic", action="store_true",
                     help="skip the import-the-zoo half of G4/G5")
     ap.add_argument("--no-allowlist", action="store_true",
@@ -1145,8 +1228,43 @@ def main(argv=None) -> int:
         return 0
     root = args.root or find_repo_root(os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    changed = None
+    if args.changed_only:
+        changed = changed_file_set(root)
+        scanned = {rel for _, rel in iter_lint_files(root)}
+        # the dynamic zoo half is repo-global and slow; in the fast
+        # pre-commit mode run it only when model/test structure moved
+        zoo_trigger = any(c.startswith("pint_tpu/models/") or
+                          c.startswith("tests/") for c in changed)
+        if not (changed & scanned) and not zoo_trigger:
+            if args.format == "json":
+                print(json.dumps({"summary": True, "clean": True,
+                                  "files_scanned": 0, "violations": 0,
+                                  "changed_only": True}))
+            else:
+                print("graftlint: no lintable files changed")
+            return 0
+        if args.no_dynamic is False and not zoo_trigger:
+            args.no_dynamic = True
     report = run_lint(root, dynamic=not args.no_dynamic,
                       use_allowlist=not args.no_allowlist)
+    if changed is not None:
+        # repo-scope findings (stale allowlist/registry entries, the
+        # dynamic zoo checks, compile-key drift) survive the filter:
+        # they are facts about the tree, not about unchanged files
+        report.violations = [v for v in report.violations
+                             if v.path in changed or
+                             v.scope == "repo"]
+    if args.format == "json":
+        for v in report.violations:
+            print(json.dumps({"file": v.path, "line": v.line,
+                              "rule": v.rule, "msg": v.msg}))
+        print(json.dumps({"summary": True, "clean": report.clean,
+                          "files_scanned": report.files_scanned,
+                          "violations": len(report.violations),
+                          "suppressed": len(report.suppressed),
+                          "changed_only": bool(args.changed_only)}))
+        return 0 if report.clean else 1
     if args.json:
         print(json.dumps({
             "clean": report.clean,

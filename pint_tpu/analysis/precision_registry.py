@@ -1,0 +1,140 @@
+"""Declared precision-boundary sites — the ONLY places f64/dd may
+legally demote to f32 in jit-reachable code (graftflow rule G9).
+
+Policy (ARCHITECTURE.md "Static analysis"): TPU f64 is emulated and
+not correctly rounded (~2^-48), which is why the dd error-free-
+transform chain exists and why the production fit step demotes
+precision only at *engineered* boundaries (jac_f32 / matmul_f32 /
+anchored — CLAUDE.md "Production fit-step configuration"). Every
+entry here is such an engineered boundary: it cites WHY the demotion
+is numerically safe (what accuracy the consumer actually needs, and
+which CPU equality oracle pins it). A demotion found by graftflow
+anywhere else is a G9 violation — the historical failure mode is a
+silent f32 creeping into the absolute-phase/dd chain, where it
+costs ~100 ns-level residual corruption without failing any test.
+
+Entry fields:
+  file      repo-relative path of the boundary site
+  func      enclosing function name ("<module>" for module level)
+  match     optional substring of the flagged source line (anchors
+            the entry when one function hosts several boundaries)
+  flag      production-flag expression over {jac32, f32mm, anchored,
+            hybrid} telling WHEN the site is active — this is what
+            the runtime differential validation checks against the
+            actually-traced dtypes (tests/test_dtype_probe.py)
+  guard     optional name that must appear in an enclosing `if` test
+            or the enclosing function's parameters — the static
+            cross-check that the declared flag really gates the site;
+            None requires the `why` to say where the gate lives
+  max_hits  how many demotion findings the entry may cover
+            (default 1); a NEW demotion sharing the function must
+            surface for its own review, exactly like the allowlist
+  why       mandatory justification
+
+The stale rule from the allowlist applies: an entry that no longer
+matches any demotion site fails the lint run, so this registry
+cannot rot into a blanket waiver.
+"""
+
+DEMOTIONS = [
+    # ---------------------------------------- f32 Jacobian input pack
+    dict(file="pint_tpu/parallel/fit_step.py", func="conv",
+         flag="jac32", guard=None, max_hits=2,
+         why="_tree_to32's per-leaf converter IS the declared "
+             "f64->dd32 boundary of the f32 Jacobian path: DD pairs "
+             "are SPLIT via dd_to_dd32 (48 bits survive), plain f64 "
+             "leaves cast to f32. Design columns need only ~1e-6 "
+             "relative accuracy (they feed equilibrated normal "
+             "equations); tests/test_jac32.py is the CPU equality "
+             "oracle. Gate lives at the call sites: _tree_to32 is "
+             "invoked only inside step_fn's `if jac32:` block."),
+    dict(file="pint_tpu/parallel/fit_step.py", func="_split32",
+         flag="jac32", guard=None,
+         why="device-side f64 -> (f32, f32) error-free split of the "
+             "step's parameter-pair inputs for the f32 Jacobian "
+             "re-trace (splitting, not truncating). Gate lives at "
+             "the call sites inside step_fn's `if jac32:` block."),
+    dict(file="pint_tpu/parallel/fit_step.py", func="step_fn",
+         flag="jac32", guard="jac32", max_hits=7,
+         why="the f32 Jacobian block of the production step: batch/"
+             "cache/scale/f0/valid demote together so the WHOLE "
+             "design-matrix re-trace runs dd32/f32 at native VPU "
+             "speed while the residual path stays f64/dd. Lexically "
+             "inside `if jac32:`; equality oracle test_jac32.py; "
+             "the F8+ scale-window fallback clears jac32 when no "
+             "safe exponent window exists (see build_fit_step)."),
+    # --------------------------------------------- f32 matmul (Gram)
+    dict(file="pint_tpu/parallel/fit_step.py", func="_symm_mm",
+         flag="f32mm", guard="f32", max_hits=2,
+         why="the normal-equation Gram matmul boundary: HIGHEST-"
+             "precision f32 passes deliver the ~1e-7 relative "
+             "accuracy the equilibrated normal equations need, and "
+             "_gls_core retries the whole solve with f64 "
+             "accumulation when the f32 Cholesky trips (in-kernel "
+             "degeneracy rescue). Guarded by the f32 parameter "
+             "(False upcasts to f64 and accumulates exactly)."),
+    # ------------------------------------- photon-phase Pallas kernel
+    dict(file="pint_tpu/ops/pallas_kernels.py",
+         func="z2_harmonics_pallas", flag=None, guard=None,
+         max_hits=3,
+         why="the Z^2_m harmonic-sum Pallas kernel is f32 BY DESIGN: "
+             "photon phases enter in [0, 1) turns (no large "
+             "magnitudes to cancel) and the Z^2 statistic needs "
+             "~1e-6 relative accuracy; f32 keeps the kernel on the "
+             "VPU 8x128 fast path. Never feeds the dd chain — "
+             "consumers are event statistics, not timing residuals."),
+    dict(file="pint_tpu/ops/pallas_kernels.py",
+         func="_harmonics_kernel", flag=None, guard=None, max_hits=2,
+         why="f32 literal constants inside the Z^2 Pallas kernel "
+             "body (2*pi and the harmonic index) — same "
+             "justification as z2_harmonics_pallas: the whole "
+             "kernel is a declared f32 surface."),
+]
+
+
+# Runtime probe table: the differential-validation contract between
+# graftflow's static predictions and the dtypes actually traced on
+# the production build_fit_step configurations. Each probe names a
+# function the Sanitizer dtype-probe mode intercepts during ONE
+# jax.eval_shape trace of the step; `flag` predicts when the probe
+# fires and `dtype` (an expression over the same flags) predicts the
+# recorded dtype. tests/test_dtype_probe.py asserts observed ==
+# predicted for every production flag combination — the analyzer
+# tests the code, the runtime tests the analyzer.
+PROBES = [
+    dict(label="dd32_split", file="pint_tpu/parallel/fit_step.py",
+         callee="dd_to_dd32", flag="jac32", dtype="'float32'",
+         why="the f64->dd32 split only runs when the f32 Jacobian "
+             "path is on; its output pairs must be f32"),
+    dict(label="symm_mm", file="pint_tpu/parallel/fit_step.py",
+         callee="_symm_mm", flag="True",
+         dtype="'float32' if jac32 else 'float64'",
+         why="the Gram contraction always runs; its INPUT dtype "
+             "follows the Jacobian dtype (M sets mdt)"),
+    dict(label="symm_mm_f32", file="pint_tpu/parallel/fit_step.py",
+         callee="_symm_mm", flag="f32mm", dtype="'float32'",
+         why="an f32-accumulated Gram pass happens iff matmul_f32 "
+             "(the f64 rescue branch also traces, so the probe "
+             "looks for ANY f32 pass, not the only pass)"),
+    dict(label="phase_frac", file="pint_tpu/parallel/fit_step.py",
+         callee="dd_frac", flag="not anchored",
+         dtype="'float64'",
+         why="the direct chain extracts the fractional phase from "
+             "the absolute dd value in f64; anchored mode never "
+             "forms the absolute phase in the step at all"),
+    dict(label="linear_design_columns",
+         file="pint_tpu/models/timing_model.py",
+         callee="linear_design_columns", flag="hybrid",
+         dtype="'float32' if jac32 else 'float64'",
+         why="closed-form design columns are assembled only under "
+             "the hybrid Jacobian, in the dtype of the Jacobian "
+             "path that consumes them"),
+]
+
+
+def entry_count() -> int:
+    return len(DEMOTIONS)
+
+
+def probe_count() -> int:
+    return len(PROBES)

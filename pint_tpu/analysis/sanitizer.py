@@ -20,7 +20,23 @@ observable:
   device unexpectedly (np.ndarray operands entering a device
   dispatch mean an implicit, per-call H2D transfer) and optionally
   blocks on the outputs to assert they are finite (debug only — the
-  sync defeats dispatch pipelining).
+  sync defeats dispatch pipelining). The operand scan walks NESTED
+  structures — dicts/tuples/lists, NamedTuple pytrees (DD), and
+  plain objects that are not registered pytrees (request/entry
+  dataclasses reaching the serve bucket dispatch hide their arrays
+  from ``jax.tree_util.tree_leaves``, which treats an unregistered
+  object as one opaque leaf);
+- ``dtype_probe()`` is the runtime half of graftflow's differential
+  validation (ISSUE 6): for the duration of the context it
+  intercepts the registered precision-boundary functions of the
+  production fit step (``parallel.fit_step._symm_mm`` /
+  ``dd_to_dd32`` / ``dd_frac`` and
+  ``TimingModel.linear_design_columns``) and records the dtypes of
+  TRACED operands flowing through them. Tracing a built step under
+  the probe (``jax.eval_shape(step_fn, *args)``) yields an observed
+  profile to compare against ``graftflow.predict_profile(...)`` —
+  the analyzer predicts, the trace confirms
+  (tests/test_dtype_probe.py).
 
 Usage::
 
@@ -64,6 +80,8 @@ class Sanitizer:
     # (model id, kind) -> build count
     builds: Dict[Tuple[int, str], int] = field(default_factory=dict)
     host_crossings: List[Tuple[str, int]] = field(default_factory=list)
+    # (probe label, dtype name) records from dtype_probe()
+    dtype_records: List[Tuple[str, str]] = field(default_factory=list)
     _watches: List[_WatchEntry] = field(default_factory=list)
     _saved: Optional[tuple] = None
 
@@ -141,7 +159,11 @@ class Sanitizer:
     def wrap(self, fn, label: str = "", expect_device: bool = True):
         """Call-through proxy recording host-array operands (an
         implicit H2D copy per dispatch when expect_device) and, with
-        nan_check, blocking to verify finite outputs."""
+        nan_check, blocking to verify finite outputs. The operand
+        scan recurses through nested pytree leaves AND unregistered
+        container objects (see _count_host_arrays) — serve bucket
+        dispatches carry dicts/tuples of operands and request/entry
+        objects that tree_leaves treats as opaque leaves."""
         import jax
         import numpy as np
 
@@ -150,9 +172,7 @@ class Sanitizer:
 
         def guarded(*args, **kw):
             if expect_device:
-                nhost = sum(
-                    1 for leaf in jax.tree_util.tree_leaves((args, kw))
-                    if type(leaf) is np.ndarray)
+                nhost = _count_host_arrays((args, kw))
                 if nhost:
                     san.host_crossings.append((name, nhost))
             out = fn(*args, **kw)
@@ -175,6 +195,135 @@ class Sanitizer:
                 f"host ndarray operands entered device dispatches: "
                 f"{self.host_crossings} — convert once with "
                 f"jnp.asarray at build time, not per call")
+
+    # ------------------------------------------------- dtype probing
+
+    def dtype_probe(self):
+        """Context manager: intercept the registered precision-
+        boundary functions (analysis/precision_registry.PROBES) and
+        record (label, dtype) for every TRACED operand that crosses
+        them. Trace a built production step inside the context —
+        ``jax.eval_shape(step_fn, *args)`` is enough, no compile —
+        then compare ``observed_profile()`` against
+        ``graftflow.predict_profile(...)``. Records only tracers, so
+        host-side build work (the anchor's numpy dd32 splits) never
+        pollutes the profile."""
+        import contextlib
+
+        import jax
+
+        import pint_tpu.parallel.fit_step as _fs
+        from pint_tpu.models.timing_model import TimingModel
+
+        _Tracer = getattr(jax.core, "Tracer", None)
+        san = self
+
+        def traced(x):
+            if _Tracer is not None:
+                return isinstance(x, _Tracer)
+            # jax moved/removed jax.core.Tracer: duck-type — every
+            # tracer class is named *Tracer and carries an aval;
+            # concrete arrays are ArrayImpl and fail the name test
+            return type(x).__name__.endswith("Tracer") and \
+                hasattr(x, "aval")
+
+        orig_symm = _fs._symm_mm
+        orig_dd32 = _fs.dd_to_dd32
+        orig_frac = _fs.dd_frac
+        orig_cols = TimingModel.linear_design_columns
+
+        def symm_mm(X, Y, f32):
+            if traced(X):
+                san.dtype_records.append(("symm_mm", X.dtype.name))
+                if f32:
+                    san.dtype_records.append(
+                        ("symm_mm_f32", "float32"))
+            return orig_symm(X, Y, f32)
+
+        def dd32(a):
+            out = orig_dd32(a)
+            if traced(out.hi):
+                san.dtype_records.append(
+                    ("dd32_split", out.hi.dtype.name))
+            return out
+
+        def frac(a):
+            if traced(a.hi):
+                san.dtype_records.append(
+                    ("phase_frac", a.hi.dtype.name))
+            return orig_frac(a)
+
+        def cols(model, pv, batch, cache, names):
+            if traced(batch.freq_mhz):
+                san.dtype_records.append(
+                    ("linear_design_columns",
+                     batch.freq_mhz.dtype.name))
+            return orig_cols(model, pv, batch, cache, names)
+
+        @contextlib.contextmanager
+        def _ctx():
+            _fs._symm_mm = symm_mm
+            _fs.dd_to_dd32 = dd32
+            _fs.dd_frac = frac
+            TimingModel.linear_design_columns = cols
+            try:
+                yield san
+            finally:
+                _fs._symm_mm = orig_symm
+                _fs.dd_to_dd32 = orig_dd32
+                _fs.dd_frac = orig_frac
+                TimingModel.linear_design_columns = orig_cols
+
+        return _ctx()
+
+    def observed_profile(self) -> Dict[str, dict]:
+        """{probe label: {"active": True, "dtypes": set}} from the
+        dtype records — absent labels mean the boundary never fired
+        during the probed trace."""
+        out: Dict[str, dict] = {}
+        for label, dt in self.dtype_records:
+            d = out.setdefault(label, {"active": True,
+                                       "dtypes": set()})
+            d["dtypes"].add(dt)
+        return out
+
+
+def _count_host_arrays(obj) -> int:
+    """np.ndarray count (subclasses included) across nested pytree
+    leaves AND plain container objects. jax.tree_util.tree_leaves
+    descends registered pytrees only — an unregistered request/entry
+    object is one opaque leaf and its member arrays would escape the
+    scan (the serve bucket dispatch carries exactly such operands)."""
+    import jax
+    import numpy as np
+
+    count = 0
+    seen = set()
+    stack = [(obj, 0)]
+    while stack:
+        cur, depth = stack.pop()
+        if depth > 8 or id(cur) in seen:
+            continue
+        if isinstance(cur, (str, bytes, int, float, bool,
+                            complex)) or cur is None:
+            continue
+        seen.add(id(cur))
+        if isinstance(cur, jax.Array):
+            continue
+        if isinstance(cur, np.ndarray):
+            count += 1
+            continue
+        if isinstance(cur, dict):
+            stack.extend((v, depth + 1) for v in cur.values())
+            continue
+        if isinstance(cur, (list, tuple, set, frozenset)):
+            stack.extend((v, depth + 1) for v in cur)
+            continue
+        d = getattr(cur, "__dict__", None)
+        if isinstance(d, dict) and not isinstance(cur, type) and \
+                not callable(cur):
+            stack.extend((v, depth + 1) for v in d.values())
+    return count
 
 
 def _cache_size(jitted) -> Optional[int]:

@@ -20,7 +20,11 @@ from pint_tpu.models.parameter import (
     prefixParameter,
     split_prefixed_name,
 )
-from pint_tpu.models.timing_model import DelayComponent, PhaseComponent
+from pint_tpu.models.timing_model import (
+    DelayComponent,
+    PhaseComponent,
+    frozen_trace_value,
+)
 from pint_tpu.ops.dd import DD
 
 SECS_PER_DAY = 86400.0
@@ -308,8 +312,9 @@ class WaveX(DelayComponent):
                     raise ValueError(f"WXFREQ_{istr} missing {pre}{istr}")
 
     def _epoch(self):
-        return self.WXEPOCH.value if self.WXEPOCH.value is not None \
-            else self._parent.PEPOCH.value
+        # trace constant: legal only while frozen (compile-keyed) —
+        # a free epoch would go silently stale (graftflow G10)
+        return frozen_trace_value(self.WXEPOCH, self._parent.PEPOCH)
 
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         if not self.wavex_ids:
@@ -419,9 +424,8 @@ class DMWaveX(DelayComponent):
         if not self.dmwavex_ids:
             return jnp.zeros_like(batch.freq_mhz)
         ref = self._parent.ref_day
-        epoch = self.DMWXEPOCH.value
-        if epoch is None:
-            epoch = self._parent.PEPOCH.value
+        epoch = frozen_trace_value(self.DMWXEPOCH,
+                                   self._parent.PEPOCH)
         t = (batch.tdb_day - ref) + batch.tdb_frac.hi \
             + batch.tdb_frac.lo - (epoch - ref)
         dm = jnp.zeros_like(batch.freq_mhz)
@@ -448,9 +452,8 @@ class DMWaveX(DelayComponent):
         if not self.dmwavex_ids:
             return {}
         ref = self._parent.ref_day
-        epoch = self.DMWXEPOCH.value
-        if epoch is None:
-            epoch = self._parent.PEPOCH.value
+        epoch = frozen_trace_value(self.DMWXEPOCH,
+                                   self._parent.PEPOCH)
         t = (batch.tdb_day - ref) + batch.tdb_frac.hi \
             + batch.tdb_frac.lo - (epoch - ref)
         bf = ctx.get("bfreq", batch.freq_mhz)
@@ -589,7 +592,10 @@ class SolarWindDispersion(DelayComponent):
         rho = jnp.arccos(jnp.clip(cosr, -1.0, 1.0))
         r_m = r_lts * C_M_S
         sinr = jnp.maximum(jnp.sin(rho), 1e-9)
-        if int(self.SWM.value or 0) == 1:
+        # SWM is a model-structure switch baked into the trace:
+        # frozen-guarded read (graftflow G10) — a free SWM would flip
+        # geometry without retracing
+        if int(frozen_trace_value(self.SWM) or 0) == 1:
             # n_e = NE_SW (AU/r)^SWP: DM = NE_SW AU^p b^{1-p}
             #   ∫_{rho-pi/2}^{pi/2} cos^{p-2} dphi, b = r sin(rho)
             # (You et al. 2007 geometry; reference: SWM 1 branch of

@@ -32,7 +32,11 @@ from pint_tpu.models.parameter import (
     prefixParameter,
     split_prefixed_name,
 )
-from pint_tpu.models.timing_model import DelayComponent, PhaseComponent
+from pint_tpu.models.timing_model import (
+    DelayComponent,
+    PhaseComponent,
+    frozen_trace_value,
+)
 from pint_tpu.ops.dd import DD
 
 
@@ -266,9 +270,9 @@ class ChromaticCM(DelayComponent):
         self.cm_ids = sorted(ids)
 
     def _epoch(self):
-        if self.CMEPOCH.value is not None:
-            return self.CMEPOCH.value
-        return self._parent.PEPOCH.value
+        # trace constant: legal only while frozen (compile-keyed) —
+        # a free epoch would go silently stale (graftflow G10)
+        return frozen_trace_value(self.CMEPOCH, self._parent.PEPOCH)
 
     def cm_value_device(self, pv, batch, cache, ctx):
         ref = self._parent.ref_day
@@ -451,9 +455,10 @@ class CMWaveX(DelayComponent):
         self.cmwx_ids = sorted(ids)
 
     def _epoch(self):
-        if self.CMWXEPOCH.value is not None:
-            return self.CMWXEPOCH.value
-        return self._parent.PEPOCH.value
+        # trace constant: legal only while frozen (compile-keyed) —
+        # a free epoch would go silently stale (graftflow G10)
+        return frozen_trace_value(self.CMWXEPOCH,
+                                  self._parent.PEPOCH)
 
     def delay(self, pv, batch, cache, ctx, delay_so_far):
         if not self.cmwx_ids:

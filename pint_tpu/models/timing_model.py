@@ -234,6 +234,35 @@ def _category_rank(comp: Component) -> int:
         return len(cats)
 
 
+def frozen_trace_value(param, fallback=None):
+    """Trace-static parameter read for device code (graftflow G10;
+    reference precedent: components_tail.chromatic_index, the
+    TNCHROMIDX incident fix).
+
+    Some parameters enter delay/phase kernels as trace constants —
+    reference epochs (WXEPOCH/DMWXEPOCH/CMEPOCH/CMWXEPOCH and their
+    PEPOCH fallbacks) and model-structure switches (SWM). That is
+    sound ONLY while the parameter is frozen: frozen device-param
+    values are part of the compile key (``_compile_key``'s
+    frozen_vals), so a value change re-keys and re-traces. A FREE
+    parameter read this way would go silently stale mid-fit — the
+    exact bug class graftflow G10 exists for — so refuse loudly
+    instead of baking it. ``fallback`` (another Parameter) is
+    consulted, under the same frozen requirement, when the primary
+    has no value."""
+    if not param.frozen:
+        raise ValueError(
+            f"{param.name} is free, but device code bakes its value "
+            f"as a trace constant (compile-keyed only while frozen) "
+            f"— fitting it is not supported; freeze {param.name}")
+    v = param.value
+    if v is not None:
+        return float(v)
+    if fallback is not None:
+        return frozen_trace_value(fallback)
+    return None
+
+
 class TimingModel:
     """Ordered component container + compiled evaluation engine."""
 

@@ -9,12 +9,34 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ["JAX_ENABLE_X64"] = "1"
+
+
+def _lint_only_run(argv) -> bool:
+    """True when this pytest invocation selects EXACTLY the lint
+    marker (`pytest -m lint`). The lint lane is pure AST work — it
+    never dispatches — so the 8-virtual-device mesh and the
+    persistent compile cache are dead weight there; skipping them is
+    what makes the gate run in seconds from a cold process
+    (tools/check.sh). Any other marker expression (including
+    `lint or ...`) keeps the full setup."""
+    for i, a in enumerate(argv):
+        if a == "-m" and i + 1 < len(argv) and \
+                argv[i + 1].strip() == "lint":
+            return True
+        if a.startswith("-m=") and a[3:].strip() == "lint":
+            return True
+    return False
+
+
+_LINT_ONLY = _lint_only_run(sys.argv)
+
+if not _LINT_ONLY:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -30,17 +52,23 @@ jax.config.update("jax_enable_x64", True)
 # Persistent compilation cache: the suite is jit-compile dominated
 # (hundreds of distinct model structures); caching compiled executables
 # across runs cuts wall-clock by more than half on a warm cache.
-from pint_tpu.config import enable_compile_cache  # noqa: E402
+# Skipped in the lint-only lane (_lint_only_run): nothing compiles
+# there, and the cache-dir probing is cold-start latency for nothing.
+if not _LINT_ONLY:
+    from pint_tpu.config import enable_compile_cache  # noqa: E402
 
-_cache_dir = enable_compile_cache(
-    "PINT_TPU_TEST_JIT_CACHE",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                 ".jax_compile_cache"))
-# CLI smoke tests call script main()s, which enable the USER compile
-# cache (config.enable_user_compile_cache) — point it at the test
-# cache so they don't repoint jax's global cache at ~/.cache mid-suite
-if _cache_dir:
-    os.environ.setdefault("PINT_TPU_JIT_CACHE", _cache_dir)
+    _cache_dir = enable_compile_cache(
+        "PINT_TPU_TEST_JIT_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_compile_cache"))
+    # CLI smoke tests call script main()s, which enable the USER
+    # compile cache (config.enable_user_compile_cache) — point it at
+    # the test cache so they don't repoint jax's global cache at
+    # ~/.cache mid-suite
+    if _cache_dir:
+        os.environ.setdefault("PINT_TPU_JIT_CACHE", _cache_dir)
+    else:
+        os.environ.setdefault("PINT_TPU_JIT_CACHE", "0")
 else:
     os.environ.setdefault("PINT_TPU_JIT_CACHE", "0")
 

@@ -1,0 +1,116 @@
+"""Differential validation of graftflow's static dtype predictions
+(ISSUE 6 tentpole): the analyzer predicts, per production
+build_fit_step configuration, which precision boundaries fire and
+with which dtypes; the Sanitizer dtype probe records what the trace
+ACTUALLY does; this test asserts they agree. The analyzer tests the
+code, the runtime tests the analyzer — if either the registry's flag
+expressions or the step's demotion plumbing drifts, the two sides
+disagree and this fails in the fast lane.
+
+Trace-only (jax.eval_shape): no compile, no dispatch, so the probe is
+cheap enough to sweep every flag combination."""
+
+import io
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from pint_tpu.analysis import Sanitizer, graftflow
+from pint_tpu.models import get_model
+from pint_tpu.parallel import build_fit_step
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+
+BASE = """PSR J0000+0000
+RAJ 12:00:00.0 1
+DECJ 30:00:00.0 1
+F0 300.123456789 1
+F1 -1.0e-15 1
+DM 20.0 1
+PEPOCH 55000
+POSEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+
+@pytest.fixture(scope="module")
+def problem():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(BASE))
+        rng = np.random.default_rng(3)
+        mjds = np.sort(rng.uniform(54001, 55999, 60))
+        toas = make_fake_toas_fromMJDs(
+            mjds, model, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 820.0], 30),
+            add_noise=True, rng=rng)
+    return model, toas
+
+
+# every production-relevant corner: the all-f64 oracle shape, the
+# full TPU production stack, and the two mixed configs that pin the
+# flag coupling (jac32 without f32mm; f32mm without jac32)
+CONFIGS = [
+    dict(anchored=False, jac_f32=False, matmul_f32=False,
+         hybrid_jac=False),
+    dict(anchored=True, jac_f32=True, matmul_f32=True,
+         hybrid_jac=True),
+    dict(anchored=False, jac_f32=True, matmul_f32=False,
+         hybrid_jac=True),
+    dict(anchored=True, jac_f32=False, matmul_f32=True,
+         hybrid_jac=False),
+    dict(anchored=False, jac_f32=False, matmul_f32=True,
+         hybrid_jac=True),
+]
+
+
+@pytest.mark.parametrize("flags", CONFIGS,
+                         ids=lambda f: "-".join(
+                             k for k, v in f.items() if v) or "f64")
+def test_static_predictions_match_traced_dtypes(problem, flags):
+    model, toas = problem
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step_fn, args, _ = build_fit_step(model, toas, **flags)
+    san = Sanitizer()
+    with san.dtype_probe():
+        jax.eval_shape(step_fn, *args)
+    observed = san.observed_profile()
+    # graftflow's `hybrid` flag means "enabled AND the model claims
+    # columns" — the conjunction the caller owns (predict_profile doc)
+    hybrid_active = bool(flags["hybrid_jac"]) and \
+        bool(model.linear_design_names())
+    predicted = graftflow.predict_profile(
+        jac32=flags["jac_f32"], f32mm=flags["matmul_f32"],
+        anchored=flags["anchored"], hybrid=hybrid_active)
+    assert predicted, "registry PROBES table is empty"
+    for label, pred in predicted.items():
+        obs = observed.get(label)
+        assert (obs is not None) == pred["active"], (
+            f"{label}: graftflow predicts "
+            f"active={pred['active']} under {flags}, trace says "
+            f"{'fired' if obs else 'silent'}")
+        if pred["active"]:
+            assert pred["dtype"] in obs["dtypes"], (
+                f"{label}: predicted dtype {pred['dtype']}, traced "
+                f"{sorted(obs['dtypes'])} under {flags}")
+    # no boundary fired that the registry does not know about
+    assert set(observed) <= set(predicted)
+
+
+def test_probe_records_only_tracers(problem):
+    """Host-side build work (the anchored reference's numpy dd32
+    splits) must not pollute the profile: with no trace inside the
+    context, nothing is recorded even though build_fit_step itself
+    calls dd_to_dd32 on host values."""
+    model, toas = problem
+    san = Sanitizer()
+    with san.dtype_probe():
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            build_fit_step(model, toas, anchored=True, jac_f32=True)
+    assert san.dtype_records == []

@@ -1,0 +1,533 @@
+"""graftflow rule fixtures (positive AND negative per rule family,
+test_graftlint.py style): G9 precision demotions / dd-consumer taint,
+G10 trace-constant reads and closure captures — including a fixture
+REINTRODUCING the chromatic_index TNCHROMIDX hazard that motivated
+the rule — plus the registry/probe hygiene checks and the
+--format json / --changed-only CLI satellites. Run standalone with
+`pytest -m lint`."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from pint_tpu.analysis import cfg as fcfg
+from pint_tpu.analysis import graftflow as gf
+from pint_tpu.analysis import graftlint as gl
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _flow(src, relpath="pint_tpu/models/_fixture.py", registry=None,
+          verify_probes=False):
+    """Run the graftflow checks on one snippet module."""
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    seeds = gl.collect_jit_seed_names([m])
+    gl.mark_jit_regions(m, seeds[relpath])
+    violations, suppressed = gf.run_flow_checks(
+        [m], registry=[] if registry is None else registry,
+        verify_probe_sites=verify_probes)
+    return violations, suppressed
+
+
+def _rules(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ------------------------------------------------------------------ G9
+
+def test_g9_flags_demotion_outside_registry():
+    v, _ = _flow("""
+        import jax.numpy as jnp
+        def step(pv, batch, cache):
+            return batch.t.astype(jnp.float32)
+    """)
+    assert "G9" in _rules(v)
+    assert "precision_registry" in v[0].msg
+
+
+def test_g9_flags_string_dtype_spellings():
+    """Review regression: astype("float32") / dtype="float32" /
+    zeros(n, "float32") are common numpy idiom and must flag like
+    the jnp.float32 attribute forms."""
+    v, _ = _flow("""
+        import jax.numpy as jnp
+        def step(pv, batch, cache):
+            a = batch.t.astype("float32")
+            b = jnp.asarray(batch.t, dtype="float32")
+            c = jnp.zeros(3, "float32")
+            return a, b, c
+    """)
+    assert [x.rule for x in v].count("G9") == 3
+
+
+def test_g9_flags_f32_ctors_and_dtype_args():
+    v, _ = _flow("""
+        import jax.numpy as jnp
+        def step(pv, batch, cache):
+            a = jnp.float32(0.5)
+            b = jnp.asarray(batch.t, dtype=jnp.float32)
+            c = jnp.zeros(3, jnp.float32)
+            return a, b, c
+    """)
+    assert [x.rule for x in v].count("G9") == 3
+
+
+def test_g9_registered_boundary_is_sanctioned_and_stale_fails():
+    reg = [dict(file="pint_tpu/models/_fixture.py", func="step",
+                flag="jac32", guard="jac32", why="fixture boundary")]
+    v, sup = _flow("""
+        import jax.numpy as jnp
+        def step(pv, batch, cache, jac32=False):
+            if jac32:
+                return batch.t.astype(jnp.float32)
+            return batch.t
+    """, registry=reg)
+    assert "G9" not in _rules(v)
+    assert any("fixture boundary" in why for _, why in sup)
+    # a registry entry matching nothing is itself a violation
+    v2, _ = _flow("def host():\n    return 1\n", registry=reg)
+    assert [x.rule for x in v2] == ["REGISTRY"]
+
+
+def test_g9_guard_claim_must_match_the_code():
+    """An entry declaring a gate that the code does not actually
+    have (no enclosing `if`, no parameter) is a drifted claim."""
+    reg = [dict(file="pint_tpu/models/_fixture.py", func="step",
+                flag="jac32", guard="jac32", why="fixture")]
+    v, _ = _flow("""
+        import jax.numpy as jnp
+        def step(pv, batch, cache):
+            return batch.t.astype(jnp.float32)
+    """, registry=reg)
+    assert "G9" in _rules(v)
+    assert "drifted" in v[0].msg
+
+
+def test_g9_dd_consumer_rejects_f32_provenance_in_protected_module():
+    v, _ = _flow("""
+        import jax.numpy as jnp
+        from pint_tpu.ops.dd import DD, dd_add
+        def _kernel(pv, r):
+            r32 = r.astype(jnp.float32)
+            return dd_add(DD(r32, r32), pv["X"])
+    """, relpath="pint_tpu/gls.py")
+    msgs = [x.msg for x in v if x.rule == "G9"]
+    assert any("dd consumer" in m for m in msgs)
+
+
+def test_g9_dd_consumer_clean_on_f64_and_outside_protected_set():
+    clean = _flow("""
+        from pint_tpu.ops.dd import DD, dd_add
+        def _kernel(pv, r):
+            return dd_add(DD(r, r), pv["X"])
+    """, relpath="pint_tpu/gls.py")[0]
+    assert not [x for x in clean if "dd consumer" in x.msg]
+    # same taint in a non-protected module: the demotion still flags
+    # (registry) but the consumer rule does not apply there
+    outside = _flow("""
+        import jax.numpy as jnp
+        from pint_tpu.ops.dd import DD, dd_add
+        def _kernel(pv, r):
+            r32 = r.astype(jnp.float32)
+            return dd_add(DD(r32, r32), pv["X"])
+    """, relpath="pint_tpu/gridutils.py")[0]
+    assert not [x for x in outside if "dd consumer" in x.msg]
+
+
+def test_g9_taint_survives_branches_and_upcasts():
+    """The dataflow half: provenance joins across an if/else (may-
+    analysis) and an astype(float64) upcast does not launder it."""
+    v, _ = _flow("""
+        import jax.numpy as jnp
+        from pint_tpu.ops.dd import DD, dd_add
+        def _kernel(pv, r, fast):
+            x = r
+            if fast:
+                x = r.astype(jnp.float32)
+            y = x.astype(jnp.float64)
+            return dd_add(DD(y, y), pv["X"])
+    """, relpath="pint_tpu/gls.py")
+    assert any("dd consumer" in x.msg for x in v)
+
+
+def test_g9_taint_survives_method_call_hops():
+    """Review regression: a method call on a tainted receiver
+    (.reshape/.sum/.ravel) must not launder f32 provenance before it
+    reaches a dd consumer."""
+    v, _ = _flow("""
+        import jax.numpy as jnp
+        from pint_tpu.ops.dd import DD, dd_add
+        def _kernel(pv, r):
+            x = r.astype(jnp.float32)
+            y = x.reshape(-1)
+            return dd_add(DD(y, y), pv["X"])
+    """, relpath="pint_tpu/gls.py")
+    assert any("dd consumer" in x.msg for x in v)
+
+
+def test_g9_guard_check_rejects_the_else_branch():
+    """Review regression: a demotion in the ELSE branch of
+    `if jac32:` runs exactly when the flag is off — the registry's
+    gating claim must not accept it (and `if not jac32:` inverts the
+    branches)."""
+    reg = [dict(file="pint_tpu/models/_fixture.py", func="step",
+                flag="jac32", guard="jac32", why="fixture")]
+    wrong_branch, _ = _flow("""
+        import jax.numpy as jnp
+        def step(pv, batch, cache):
+            jac32 = bool(cache)
+            if jac32:
+                y = batch.t
+            else:
+                y = batch.t.astype(jnp.float32)
+            return y
+    """, registry=reg)
+    assert any("drifted" in x.msg for x in wrong_branch)
+    inverted_ok, sup = _flow("""
+        import jax.numpy as jnp
+        def step(pv, batch, cache):
+            jac32 = bool(cache)
+            if not jac32:
+                y = batch.t
+            else:
+                y = batch.t.astype(jnp.float32)
+            return y
+    """, registry=reg)
+    assert not any(x.rule == "G9" for x in inverted_ok)
+    assert sup
+
+
+def test_g9_flags_mixed_known_dtype_arithmetic():
+    v, _ = _flow("""
+        import jax.numpy as jnp
+        def step(pv, batch):
+            a = batch.t.astype(jnp.float32)
+            b = batch.t.astype(jnp.float64)
+            return a * b
+    """)
+    assert any("mixed f32 x f64" in x.msg for x in v)
+
+
+# ----------------------------------------------------------------- G10
+
+CHROMIDX_FIXTURE = """
+    from pint_tpu.models.parameter import floatParameter
+    class ChromaticFixture(Component):
+        '''Reference: fixture.'''
+        def __init__(self):
+            self.add_param(floatParameter("TNCHROMIDX", units=""))
+        def delay(self, pv, batch, cache, ctx, delay_so_far):
+            alpha = self.TNCHROMIDX.value
+            return batch.freq_mhz ** -alpha
+"""
+
+
+def test_g10_catches_the_tnchromidx_trace_constant_hazard():
+    """The incident fixture: reading a float parameter's .value
+    inside a traced compute method bakes it — a free TNCHROMIDX
+    would go silently stale (the original bug, reintroduced)."""
+    v, _ = _flow(CHROMIDX_FIXTURE)
+    assert "G10" in _rules(v)
+    assert any("TNCHROMIDX" in x.msg for x in v)
+
+
+def test_g10_catches_the_capture_form_of_the_same_hazard():
+    """The closure-capture variant: the value is read on the host
+    and captured by the traced inner function — same staleness, one
+    hop removed. This is what a naive 'fix' of the direct read
+    usually produces."""
+    v, _ = _flow("""
+        from pint_tpu.models.parameter import floatParameter
+        class ChromaticFixture(Component):
+            '''Reference: fixture.'''
+            def build(self):
+                idx = self.TNCHROMIDX.value
+                def compute(pv, batch, cache, ctx, tb):
+                    return batch.freq_mhz ** -idx
+                return compute
+    """)
+    assert "G10" in _rules(v)
+    assert any("captures" in x.msg and "idx" in x.msg for x in v)
+
+
+def test_g10_sanctions_keyed_kinds_presence_and_frozen_guard():
+    v, _ = _flow("""
+        from pint_tpu.models.parameter import (boolParameter,
+                                               strParameter,
+                                               floatParameter)
+        class Fix(Component):
+            '''Reference: fixture.'''
+            def __init__(self):
+                self.add_param(boolParameter("K96"))
+                self.add_param(strParameter("ECL"))
+                self.add_param(floatParameter("STIG", units=""))
+                self.add_param(floatParameter("CMEPOCH", units="d"))
+            def delay(self, pv, batch, cache, ctx, delay_so_far):
+                if self.K96.value:            # bool kind: keyed
+                    pass
+                frame = self.ECL.value        # str kind: keyed
+                if self.STIG.value is not None:   # presence check
+                    pass
+                return self._epoch(batch)
+            def _epoch(self, batch):
+                p = self.CMEPOCH
+                if not p.frozen:
+                    raise ValueError("freeze CMEPOCH")
+                return p.value                # frozen-guarded read
+    """)
+    assert "G10" not in _rules(v)
+
+
+def test_g10_frozen_guard_is_per_parameter_and_polarity_checked():
+    """Review regression: guarding ONE parameter's frozen-ness must
+    not sanction .value reads of a DIFFERENT parameter in the same
+    function (that would reopen the TNCHROMIDX hole for every later
+    addition), and only the refusing polarity (`not X.frozen`)
+    counts."""
+    other_param = _flow("""
+        from pint_tpu.models.parameter import floatParameter
+        class Fix(Component):
+            '''Reference: fixture.'''
+            def delay(self, pv, batch, cache, ctx, d):
+                p = self.CMEPOCH
+                if not p.frozen:
+                    raise ValueError("freeze CMEPOCH")
+                return p.value + self.TNCHROMIDX.value
+    """)[0]
+    msgs = [x.msg for x in other_param if x.rule == "G10"]
+    assert any("TNCHROMIDX" in m for m in msgs)
+    assert not any("parameter p " in m for m in msgs)
+    inverted = _flow("""
+        class Fix(Component):
+            '''Reference: fixture.'''
+            def delay(self, pv, batch, cache, ctx, d):
+                p = self.CMEPOCH
+                if p.frozen:
+                    raise ValueError("inverted guard")
+                return p.value
+    """)[0]
+    assert "G10" in _rules(inverted)
+    # review regression: a read BEFORE the guard (early-return path
+    # the guard never dominates) is not sanctioned either
+    read_first = _flow("""
+        class Fix(Component):
+            '''Reference: fixture.'''
+            def delay(self, pv, batch, cache, ctx, d):
+                p = self.CMEPOCH
+                if ctx:
+                    return p.value
+                if not p.frozen:
+                    raise ValueError("freeze it")
+                return p.value
+    """)[0]
+    assert [x.rule for x in read_first].count("G10") == 1
+
+
+def test_g10_capture_clean_when_value_threads_through_args():
+    v, _ = _flow("""
+        class Fix(Component):
+            '''Reference: fixture.'''
+            def build(self):
+                names = ["F0", "F1"]   # names, not values: fine
+                def compute(pv, batch, cache, ctx, tb):
+                    return sum(pv[nm].hi for nm in names)
+                return compute
+    """)
+    assert "G10" not in _rules(v)
+
+
+def test_g10_pack_value_slots_taint_but_name_slots_do_not():
+    v, _ = _flow("""
+        class Fix(Component):
+            '''Reference: fixture.'''
+            def build(self, model):
+                free, frozen, th, tl, fh, fl = model._pack()
+                def compute(pv, batch, cache, ctx, tb):
+                    return fh[0] + len(free)
+                return compute
+    """)
+    flagged = [x for x in v if x.rule == "G10"]
+    assert any("`fh`" in x.msg for x in flagged)
+    assert not any("`free`" in x.msg for x in flagged)
+
+
+def test_g10_pragma_and_allowlist_suppression():
+    """G10 rides the same suppression machinery as G1-G8 — including
+    two-digit rule ids in pragmas (regression: the old pragma regex
+    only matched G<single digit>)."""
+    src = ("class Fix(Component):\n"
+           "    '''Reference: fixture.'''\n"
+           "    def delay(self, pv, batch, cache, ctx, d):\n"
+           "        a = self.TNCHROMIDX.value"
+           "  # graftlint: allow G10 -- fixture\n"
+           "        return a\n")
+    m = gl.ModuleInfo("pint_tpu/models/_fixture.py", src)
+    gl.mark_jit_regions(m, gl.collect_jit_seed_names([m])[m.relpath])
+    violations, _ = gf.run_flow_checks([m], registry=[],
+                                       verify_probe_sites=False)
+    report = gl.LintReport(violations=violations)
+    gl.apply_suppressions(report, [],
+                          {"pint_tpu/models/_fixture.py": src})
+    assert not [x for x in report.violations if x.rule == "G10"]
+    assert report.suppressed
+
+
+def test_compile_key_cross_check_fails_on_drift():
+    """If TimingModel._compile_key stops covering the fields G10's
+    sanctioning leans on, the analyzer itself must fail."""
+    src = """
+        class TimingModel:
+            def _compile_key(self):
+                return (tuple(sorted(self.components)),)
+    """
+    m = gl.ModuleInfo("pint_tpu/models/timing_model.py",
+                      textwrap.dedent(src))
+    kinds, violations = gf.parse_compile_key([m])
+    assert violations, "drifted compile key must be flagged"
+    assert all(x.rule == "G10" for x in violations)
+
+
+def test_probe_table_verification_detects_lost_sites():
+    m = gl.ModuleInfo("pint_tpu/parallel/fit_step.py",
+                      "def f():\n    return 1\n")
+    v = gf.verify_probes([m])
+    assert v and all(x.rule == "REGISTRY" for x in v)
+
+
+def test_predict_profile_matches_registry_flags():
+    p = gf.predict_profile(jac32=True, f32mm=False, anchored=False,
+                           hybrid=True)
+    assert p["dd32_split"]["active"] and \
+        p["dd32_split"]["dtype"] == "float32"
+    assert p["symm_mm"]["dtype"] == "float32"
+    assert not p["symm_mm_f32"]["active"]
+    assert p["phase_frac"]["active"]
+    p64 = gf.predict_profile()
+    assert p64["symm_mm"]["dtype"] == "float64"
+    assert not p64["dd32_split"]["active"]
+
+
+# ------------------------------------------------------ cfg engine
+
+def test_cfg_joins_branches_and_loops():
+    import ast
+
+    fn = ast.parse(textwrap.dedent("""
+        def f(cond, n):
+            x = "a"
+            if cond:
+                x = "b"
+            for i in range(n):
+                y = x
+            return x
+    """)).body[0]
+    graph = fcfg.build_cfg(fn)
+
+    def transfer(st, env, is_header):
+        if isinstance(st, ast.Assign) and \
+                isinstance(st.targets[0], ast.Name):
+            v = st.value
+            if isinstance(v, ast.Constant):
+                env[st.targets[0].id] = {v.value}
+            elif isinstance(v, ast.Name):
+                env[st.targets[0].id] = set(env.get(v.id, set()))
+
+    def join(a, b):
+        return set(a) | set(b)
+
+    in_envs = fcfg.run_dataflow(graph, {}, transfer, join)
+    exit_env = in_envs[graph.exit.bid]
+    assert exit_env["x"] == {"a", "b"}      # branch join
+    assert exit_env.get("y", set()) <= {"a", "b"}  # loop body fact
+
+
+# ------------------------------------------------- CLI satellites
+
+def test_cli_format_json_emits_jsonl(tmp_path, capsys):
+    """--format json: one {file,line,rule,msg} record per line plus
+    a summary record (the pre-commit/CI wire format)."""
+    pkg = tmp_path / "pint_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import jax\n"
+        "def build():\n"
+        "    def fn(x):\n"
+        "        return x.item()\n"
+        "    return jax.jit(fn)\n")
+    rc = gl.main(["--root", str(tmp_path), "--no-dynamic",
+                  "--format", "json"])
+    out = capsys.readouterr().out.strip().splitlines()
+    records = [json.loads(line) for line in out]
+    assert rc == 1
+    assert any(r.get("rule") == "G1" for r in records)
+    assert records[-1]["summary"] is True
+    assert records[-1]["clean"] is False
+    for r in records[:-1]:
+        assert set(r) == {"file", "line", "rule", "msg"}
+
+
+def test_changed_only_tests_change_still_runs_the_zoo(tmp_path,
+                                                      capsys):
+    """Review regression: a tests/-only change is a dynamic-zoo
+    trigger and must NOT take the "no lintable files changed" early
+    exit — the zoo checks validate against tests/ content
+    (SINK_PAR), so their findings (repo scope) must surface."""
+    import subprocess
+
+    (tmp_path / "pint_tpu").mkdir()
+    (tmp_path / "pint_tpu" / "ok.py").write_text("x = 1\n")
+    (tmp_path / "tests").mkdir()
+    subprocess.run(["git", "init", "-q", str(tmp_path)], timeout=30,
+                   check=True)
+    subprocess.run(["git", "-C", str(tmp_path), "add", "-A"],
+                   timeout=30, check=True)
+    subprocess.run(["git", "-C", str(tmp_path), "-c",
+                    "user.email=t@t", "-c", "user.name=t", "commit",
+                    "-q", "-m", "seed"], timeout=30, check=True)
+    (tmp_path / "tests" / "test_new.py").write_text("def t():\n"
+                                                    "    pass\n")
+    rc = gl.main(["--root", str(tmp_path), "--changed-only",
+                  "--format", "json"])
+    out = capsys.readouterr().out.strip().splitlines()
+    records = [json.loads(line) for line in out]
+    # the dynamic half ran (this fixture tree has no SINK_PAR, a
+    # repo-scope G5 finding) instead of the early clean exit
+    assert rc == 1
+    assert any(r.get("rule") == "G5" and "SINK_PAR" in r.get("msg", "")
+               for r in records)
+
+
+def test_changed_file_set_reads_git(tmp_path):
+    import subprocess
+
+    subprocess.run(["git", "init", "-q", str(tmp_path)], timeout=30,
+                   check=True)
+    (tmp_path / "a.py").write_text("x = 1\n")
+    subprocess.run(["git", "-C", str(tmp_path), "add", "a.py"],
+                   timeout=30, check=True)
+    subprocess.run(["git", "-C", str(tmp_path), "-c",
+                    "user.email=t@t", "-c", "user.name=t", "commit",
+                    "-q", "-m", "seed"], timeout=30, check=True)
+    (tmp_path / "a.py").write_text("x = 2\n")       # modified
+    (tmp_path / "b.py").write_text("y = 1\n")       # untracked
+    changed = gl.changed_file_set(str(tmp_path))
+    assert changed == {"a.py", "b.py"}
+
+
+def test_lint_lane_detection():
+    """The conftest fast-lane switch: `-m lint` invocations skip the
+    8-virtual-device mesh + compile-cache setup (lint tests never
+    dispatch)."""
+    import conftest
+
+    assert conftest._lint_only_run(["pytest", "-m", "lint"])
+    assert conftest._lint_only_run(["pytest", "-q", "-m", "lint",
+                                    "tests/"])
+    assert not conftest._lint_only_run(["pytest", "-m", "not slow"])
+    assert not conftest._lint_only_run(["pytest", "tests/"])
+    assert not conftest._lint_only_run(
+        ["pytest", "-m", "lint or slow"])

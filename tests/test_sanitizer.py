@@ -132,6 +132,39 @@ def test_wrap_flags_host_operands_and_nans():
         bad()
 
 
+def test_wrap_walks_nested_pytree_and_opaque_operands():
+    """Regression (ISSUE 6 satellite): the host-operand scan must
+    descend NESTED structures — dicts/tuples of operands reach the
+    serve bucket dispatch — including objects that are not registered
+    pytrees (request/entry dataclasses), which tree_leaves treats as
+    one opaque leaf, hiding their member arrays entirely."""
+    import types
+
+    san = Sanitizer()
+    guarded = san.wrap(lambda *a, **k: 0, "nested")
+    # nested dict/tuple pytree operands: 3 host arrays
+    guarded({"M": np.ones(3), "aux": (np.ones(2), jnp.ones(2))},
+            extra=[np.ones(1)])
+    assert san.host_crossings == [("nested", 3)]
+    san.reset()
+    # an opaque (non-pytree) request-like object hiding arrays —
+    # jax.tree_util.tree_leaves sees ONE leaf (the object) and zero
+    # ndarrays; the walker must find both
+    req = types.SimpleNamespace(mjds=np.ones(4),
+                                entry=types.SimpleNamespace(
+                                    coeffs=np.ones(5), f0=1.0))
+    guarded(req)
+    assert san.host_crossings == [("nested", 2)]
+    san.reset()
+    # np.ndarray SUBCLASSES count too (the old check used `type is`)
+    guarded(np.ones((2, 2)).view(np.matrix))
+    assert san.host_crossings == [("nested", 1)]
+    san.reset()
+    # device arrays, scalars and strings never count
+    guarded(jnp.ones(3), 1.0, "label", flag=True)
+    assert not san.host_crossings
+
+
 def test_recompile_guard_fixture(recompile_guard):
     """The conftest fixture wires a Sanitizer around the test body."""
     model, toas = _problem(60)

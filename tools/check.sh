@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# tools/check.sh — the pre-merge gate, cheapest check first:
+#
+#   1. graftlint --changed-only (seconds: AST rules on the git diff)
+#   2. the lint lane      (pytest -m lint: full repo-clean gate,
+#                          mesh-free per tests/conftest.py)
+#   3. the fast test lane (pytest -m "not slow": the tier-1 surface)
+#
+# Every python invocation is timeout-bounded and the PALLAS_AXON_*
+# vars are stripped first: a wedged axon tunnel HANGS backend init
+# without erroring, even under JAX_PLATFORMS=cpu, unless the plugin
+# vars are removed from the environment (CLAUDE.md gotchas).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+for v in "${!PALLAS_AXON@}"; do unset "$v"; done
+export JAX_PLATFORMS=cpu
+
+echo "[check 1/3] graftlint --changed-only"
+timeout -k 10 180 python -m pint_tpu.analysis.graftlint \
+    --changed-only --format json
+
+echo "[check 2/3] lint lane (pytest -m lint)"
+timeout -k 10 300 python -m pytest tests/ -q -m lint \
+    -p no:cacheprovider
+
+echo "[check 3/3] fast test lane (pytest -m 'not slow')"
+timeout -k 10 870 python -m pytest tests/ -q -m "not slow" \
+    -p no:cacheprovider
+
+echo "[check] all gates green"
