@@ -405,6 +405,151 @@ def measure_step_chained(built, k=8, reps=3):
     return t / k
 
 
+def measure_step_pipelined(built, k=8, depth=2, reps=3):
+    """Pipelined chained dispatches (ISSUE 7): ``depth`` chained
+    programs in flight at once, blocking ONLY at result consumption
+    (double-buffering on jax's async dispatch — issue all, then
+    read). Returns the per-iteration wall amortized over depth*k
+    steps; the --scan artifact reports it next to the sync chained
+    number as the pipelined-vs-sync column. Distinct starting points
+    per in-flight program so XLA cannot collapse them."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    step_fn, args = built
+    th, tl, *rest = args
+
+    def chained(th_, tl_, *rest_):
+        def body(carry, _):
+            thc = carry
+            _, _, chi2, _ = step_fn(thc, tl_, *rest_)
+            return thc + 1e-18 * chi2, chi2
+
+        _, chis = lax.scan(body, th_, None, length=k)
+        return chis
+
+    jitted = jax.jit(chained)
+    jax.block_until_ready(jitted(th, tl, *rest))
+    ths = [th + 1e-15 * (i + 1) for i in range(depth)]
+
+    def once():
+        outs = [jitted(t_, tl, *rest) for t_ in ths]  # issue all
+        return [float(o[-1]) for o in outs]           # then consume
+
+    once()
+    t = time_fn(once, reps)
+    return t / (k * depth)
+
+
+def measure_whole_fit(model, toas, per_step_s=None, reps=3,
+                      maxiter=20, depth=2, **flags):
+    """Whole-fit-on-device dispatch-overhead measurement (ISSUE 7):
+    the ENTIRE downhill fit — damping, acceptance, convergence — as
+    ONE lax.while_loop dispatch (build_fit_loop with maxiter as the
+    runtime budget; (th, tl) donated when config.donation_enabled).
+
+    The ``dispatch_overhead`` artifact block separates the wall into
+    pure step compute and dispatch overhead. Pure step time is
+    ``step_evals x per_eval``, with the per-eval cost measured from
+    the SAME compiled program by varying only the runtime budget
+    (marginal cost between a budget-1 and a full-budget dispatch) —
+    comparing against a DIFFERENT program would fold compilation
+    artifacts into the "overhead" (measured on XLA:CPU the loop's
+    per-eval is ~2x the standalone step: compute nested in while_loop
+    bodies is not thread-parallelized there; that honest ratio is
+    reported as ``loop_step_ratio`` instead of being laundered into
+    the dispatch number). ``overhead_frac`` = (wall − pure)/wall is
+    the <10% acceptance target.
+
+    ``depth`` whole fits are additionally issued IN FLIGHT at once
+    (async dispatch, block only at consumption): on a high-RTT link
+    the fixed dispatch cost overlaps across fits, and
+    ``overhead_frac_pipelined`` is the amortized per-fit number a
+    serving deployment sees."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pint_tpu import config
+    from pint_tpu.parallel import build_fit_loop
+
+    # K=32: the largest quantized compile key of the adaptive
+    # chaining (config.auto_steps_per_dispatch) — the same executable
+    # a production whole-fit uses; maxiter rides as runtime budget
+    loop_fn, args, _ = build_fit_loop(model, toas, max_iter=32,
+                                      **flags)
+    donate = config.donation_enabled()
+    if donate:
+        jitted = jax.jit(loop_fn, donate_argnums=(0, 1))
+    else:
+        jitted = jax.jit(loop_fn)
+    th0 = np.asarray(args[0], np.float64)
+    tl0 = np.asarray(args[1], np.float64)
+    body = args[2:-1]
+    budget = min(int(maxiter), 32)
+
+    def dispatch(budget_):
+        # fresh (th, tl) device arrays per call: donation consumed
+        # the previous pair (graftlint G11 discipline)
+        return jitted(jnp.asarray(th0), jnp.asarray(tl0), *body,
+                      jnp.asarray(budget_, jnp.int32))
+
+    def once(budget_=budget):
+        out = dispatch(budget_)
+        return int(out[6]), int(out[10]), float(out[4])
+
+    t0 = time.perf_counter()
+    niter, nev, chi2 = once()   # compile + first dispatch
+    log(f"  whole-fit compile+first: {time.perf_counter() - t0:.1f}s "
+        f"iters={niter} evals={nev} chi2={chi2:.1f}")
+    t = time_fn(lambda: once(), reps)
+    block = {
+        "fit_dispatch_ms": round(t * 1e3, 2),
+        "iterations": niter,
+        "step_evals": nev,
+        "donation": donate,
+        "in_flight_depth": 1,   # a converged whole fit IS 1 dispatch
+    }
+    # marginal per-eval cost: same executable, budget=1 (entry step +
+    # the first iteration's line search) vs the full budget
+    _, nev1, _ = once(1)
+    if nev > nev1:
+        t1 = time_fn(lambda: once(1), reps)
+        per_eval = max((t - t1) / (nev - nev1), 0.0)
+        pure = nev * per_eval
+        block["per_eval_ms"] = round(per_eval * 1e3, 3)
+        block["pure_step_ms"] = round(pure * 1e3, 2)
+        block["overhead_frac"] = round((t - pure) / t, 4)
+        if per_step_s:
+            block["loop_step_ratio"] = round(per_eval / per_step_s, 2)
+    elif per_step_s:
+        # degenerate fit (one iteration): fall back to the standalone
+        # step as the pure-step reference, labeled as such
+        pure = nev * per_step_s
+        block["pure_step_ms"] = round(pure * 1e3, 2)
+        block["overhead_frac"] = round((t - pure) / t, 4)
+        block["pure_step_ref"] = "standalone_step"
+    # pipelined whole fits: depth in flight, read only at consumption
+    # — the fixed dispatch cost overlaps across fits
+    try:
+        def pipelined():
+            outs = [dispatch(budget) for _ in range(depth)]
+            return [float(o[4]) for o in outs]
+
+        pipelined()
+        tp = time_fn(pipelined, reps) / depth
+        block["fit_dispatch_ms_pipelined"] = round(tp * 1e3, 2)
+        block["pipeline_depth"] = depth
+        if "pure_step_ms" in block:
+            pure_s = block["pure_step_ms"] / 1e3
+            block["overhead_frac_pipelined"] = round(
+                (tp - pure_s) / tp, 4)
+    except Exception as e:
+        log(f"  pipelined whole-fit failed: {e!r}")
+    return block
+
+
 def measure_numpy_mirror(model, toas, reps=3):
     """The reference-algorithm CPU path: residuals + design matrix on
     the CPU backend, numpy/scipy basis-Woodbury solve (dense ECORR
@@ -755,6 +900,17 @@ def scan_nscaling():
         except Exception as e:
             log(f"  chained scan point failed: {e!r}")
             label = "single-dispatch (chained meas. FAILED)"
+        try:
+            # pipelined-vs-sync column (ISSUE 7): two chained
+            # programs in flight, read only at consumption — what
+            # async double-buffered dispatch buys at this N
+            tp = measure_step_pipelined((step_fn, args), k=8,
+                                        depth=2)
+            rec["step_ms_pipelined"] = round(tp * 1e3, 2)
+            sync_per = rec["step_ms"] / 1e3
+            rec["pipeline_speedup"] = round(sync_per / tp, 2)
+        except Exception as e:
+            log(f"  pipelined scan point failed: {e!r}")
         rec.update(roofline_fields(jitted, args,
                                    rec["step_ms"] / 1e3,
                                    rec["backend"]))
@@ -824,6 +980,22 @@ def main():
             f"({toas.ntoas / chained_t:.0f} TOA/s amortized)")
     except Exception as e:
         log(f"chained-step measurement failed: {e!r}")
+
+    # whole-fit-on-device dispatch overhead (ISSUE 7): the <10%
+    # acceptance target is machine-checked off this block
+    overhead_block = None
+    try:
+        per_step_ref = (chained_ms / 1e3
+                        if chained_ms is not None and
+                        chained_ms / 1e3 < accel_t else accel_t)
+        overhead_block = measure_whole_fit(model, toas,
+                                           per_step_s=per_step_ref)
+        log(f"whole-fit dispatch [{backend}]: "
+            f"{overhead_block['fit_dispatch_ms']} ms for "
+            f"{overhead_block['step_evals']} step evals "
+            f"(overhead_frac={overhead_block.get('overhead_frac')})")
+    except Exception as e:
+        log(f"whole-fit measurement failed: {e!r}")
 
     # transparency: the f32-Jacobian variant is auto-on only on TPU;
     # when we're on the CPU backend measure it too (it halves the CPU
@@ -913,6 +1085,8 @@ def main():
         north["step_ms_jac32"] = jac32_ms
     if chained_ms is not None:
         north["step_ms_chained8"] = chained_ms
+    if overhead_block is not None:
+        north["dispatch_overhead"] = overhead_block
     north.update(roofline_fields(jitted, args, per_iter_t, backend))
 
     # provenance merge: carry the latest committed on-chip records

@@ -88,8 +88,12 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
         f"mesh={'yes' if mesh is not None else 'no'}")
 
     fresh = build_workload(nreq)
-    seq_eng = ServeEngine()
-    engines = {"coalesced": ServeEngine()}
+    seq_eng = ServeEngine(pipeline_depth=1)
+    # coalesced = the classic synchronous drain; pipelined = the
+    # ISSUE-7 double-buffered drain (config.serve_pipeline_depth in
+    # flight) — reported side by side as pipelined-vs-sync
+    engines = {"coalesced": ServeEngine(pipeline_depth=1),
+               "coalesced_pipelined": ServeEngine()}
     if mesh is not None:
         engines["coalesced_mesh"] = ServeEngine(mesh=mesh)
 
@@ -123,6 +127,7 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
 
     seq_snap = seq_eng.metrics.snapshot()
     co_snap = co_eng.metrics.snapshot()
+    pipe_snap = engines["coalesced_pipelined"].metrics.snapshot()
     print(json.dumps({"metric": "serve_sequential_throughput",
                       "backend": backend, "unit": "req/s",
                       "value": round(nreq / seq_best, 1),
@@ -155,6 +160,20 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
         # state, failovers): a degraded run is labeled in the
         # artifact itself, never silently slow
         "dispatch_supervisor": co_snap.get("dispatch"),
+        # dispatch-overhead observability (ISSUE 7): how the number
+        # was produced — pipelining configured/achieved + donation
+        # (read off the PIPELINED engine, whatever mode won)
+        "dispatch_overhead": {
+            "pipeline_depth": pipe_snap.get("pipeline_depth"),
+            "max_inflight": (pipe_snap.get("dispatch") or {}).get(
+                "max_inflight"),
+            "donation": pipe_snap.get("donation"),
+            "pipelined_vs_sync": round(
+                co_best["coalesced"] / co_best["coalesced_pipelined"],
+                2),
+        },
+        "pipelined_wall_ms": round(
+            co_best["coalesced_pipelined"] * 1e3, 2),
         # analyzer state (graftlint clean bool + suppression
         # surface): a record from a tree that no longer lints clean
         # carries its own warning label, same policy as dispatch

@@ -39,6 +39,17 @@ rules (G1-G8) cannot see, because both are *dataflow* properties:
   anchored-reference captures ride the ordinary allowlist, each with
   its written justification.
 
+- **G11 (use-after-donate)**: buffer donation (ISSUE 7 — the fit
+  loop's (th, tl) state, the serve batch kernels' alias-exact
+  inputs) means the dispatch CONSUMES the donated buffers; a read of
+  the same variable after the call is a deleted-array error at best
+  and, pipelined, a race against XLA reusing the buffer for
+  outputs. ``check_g11_module`` resolves literal ``donate_argnums``
+  on jit products (assignment targets, ``self.x =`` attributes,
+  ``@partial(jax.jit, ...)`` decorations), then flags any later
+  lexical read of a name passed at a donated position without an
+  intervening rebinding (``x = f(x)`` is the sanctioned idiom).
+
 The compile-key cross-check is live, not aspirational: graftflow
 PARSES ``_compile_key`` and recovers which parameter kinds are keyed;
 if the key ever stops covering str/bool/int statics, frozen values,
@@ -67,7 +78,8 @@ from pint_tpu.analysis import precision_registry as _reg
 Violation = _gl.Violation
 
 __all__ = ["run_flow_checks", "predict_profile", "check_g9_module",
-           "check_g10_module", "ParamKinds", "FlowContext"]
+           "check_g10_module", "check_g11_module",
+           "collect_donated_products", "ParamKinds", "FlowContext"]
 
 # ---------------------------------------------------------- lattice
 
@@ -821,6 +833,144 @@ def check_g10_module(m: "_gl.ModuleInfo", ctx: FlowContext
 
 
 # ------------------------------------------------------------------
+# G11 — use-after-donate
+# ------------------------------------------------------------------
+
+def _donate_positions(call: ast.Call):
+    """(has_donation, positions): positions is a tuple of donated
+    argument indices when donate_argnums is a literal int/tuple, or
+    None for a non-literal / donate_argnames spelling — the caller
+    then treats EVERY position as donated (conservative: an unknown
+    donation set must not silently sanction reads)."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return True, (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and
+                isinstance(e.value, int) for e in v.elts):
+            return True, tuple(e.value for e in v.elts)
+        return True, None
+    return False, ()
+
+
+def collect_donated_products(m: "_gl.ModuleInfo"):
+    """Names bound to jit products compiled WITH buffer donation:
+    assignment targets of ``jax.jit(..., donate_argnums=...)`` —
+    including ``self.x = jax.jit(...)`` attributes — and functions
+    decorated ``@partial(jax.jit, donate_argnums=...)``. Returns
+    {name: donated positions or None (= all, see
+    _donate_positions)}. Module-local by convention: every donation
+    site in the tree declares and dispatches in the same module (the
+    run-closure pattern); a cross-module donated import would need
+    its own entry here."""
+    out = {}
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _gl._tail_name(node.value.func) == "jit":
+            has, pos = _donate_positions(node.value)
+            if not has:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = pos
+                elif isinstance(t, ast.Attribute):
+                    out[t.attr] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and \
+                        _gl._decorator_is_jit(dec):
+                    has, pos = _donate_positions(dec)
+                    if has:
+                        out[node.name] = pos
+    return out
+
+
+def check_g11_module(m: "_gl.ModuleInfo") -> List[Violation]:
+    """Use-after-donate: a variable passed in a donated argument
+    position of a donated jit product is consumed by the dispatch
+    (the buffer is deleted — jax raises "Array has been deleted" on
+    the next read — or, pipelined, silently reused for outputs); any
+    LATER lexical read of the same name in the same scope, without
+    an intervening rebinding, is flagged. ``x = f(x)`` is the
+    sanctioned idiom: the call's own assignment rebinds the name.
+    Lexical order approximates dominance, the same approximation
+    class as G10's frozen-guard check; donated args that are not
+    bare names (subscripts, attribute chains, fresh ``jnp.asarray``
+    temporaries — the dominant safe pattern) are outside the rule."""
+    donated = collect_donated_products(m)
+    if not donated:
+        return []
+    events: Dict[object, list] = {}    # scope -> (name, line, prod)
+    rebinds: Dict[object, list] = {}   # scope -> (name, line)
+    uses: Dict[object, list] = {}      # scope -> (name, line)
+
+    def scope_of(node):
+        f = m.enclosing_function(node)
+        return f if f is not None else m.tree
+
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.Call):
+            tail = _gl._tail_name(node.func)
+            if tail in donated:
+                pos = donated[tail]
+                for i, a in enumerate(node.args):
+                    if isinstance(a, ast.Starred):
+                        break   # positions past *args are unknowable
+                    if pos is not None and i not in pos:
+                        continue
+                    if isinstance(a, ast.Name):
+                        events.setdefault(scope_of(node), []).append(
+                            (a.id, node.lineno, tail))
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                               ast.For, ast.AsyncFor)):
+            targets = [node.target]
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            targets = [i.optional_vars for i in node.items
+                       if i.optional_vars is not None]
+        for t in targets:
+            for nn in ast.walk(t):
+                if isinstance(nn, ast.Name):
+                    rebinds.setdefault(scope_of(node), []).append(
+                        (nn.id, node.lineno))
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load):
+            uses.setdefault(scope_of(node), []).append(
+                (node.id, node.lineno))
+
+    out: List[Violation] = []
+    seen: Set[Tuple] = set()
+    for scope, evs in events.items():
+        rb = rebinds.get(scope, [])
+        for name, dline, product in evs:
+            for uname, uline in uses.get(scope, []):
+                if uname != name or uline <= dline:
+                    continue
+                if any(bn == name and dline <= bl < uline
+                       for bn, bl in rb):
+                    continue   # rebound (x = f(x), or later) first
+                key = (name, dline, uline)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(Violation(
+                    "G11", m.relpath, uline,
+                    f"`{name}` is read after being passed in a "
+                    f"donated argument position of `{product}` "
+                    f"(line {dline}): the dispatch consumed that "
+                    f"buffer — rebind the name from the call's "
+                    f"result, or pass a fresh temporary instead",
+                    m.line_text(uline)))
+    return out
+
+
+# ------------------------------------------------------------------
 # registry bookkeeping + probe verification
 # ------------------------------------------------------------------
 
@@ -912,6 +1062,7 @@ def run_flow_checks(modules, param_kinds: Optional[ParamKinds] = None,
     for m in modules:
         violations += check_g9_module(m, ctx)
         violations += check_g10_module(m, ctx)
+        violations += check_g11_module(m)
     violations += registry_stale_violations(ctx)
     if verify_probe_sites:
         violations += verify_probes(modules)

@@ -60,6 +60,16 @@ Rules (see ARCHITECTURE.md "Static analysis" for the table):
       traced closures must not capture parameter-value-derived
       bindings from their builders (graftflow's pval taint pass,
       cross-checked against a live parse of _compile_key)
+  G11 use-after-donate: a jit product built with donate_argnums
+      consumes the buffers passed at those positions — the donated
+      array is DELETED after the dispatch, so any later read of the
+      same variable (without an intervening rebinding) is a runtime
+      RuntimeError at best and, under pipelined dispatch, a race
+      against XLA reusing the buffer for outputs. Lexical order
+      approximates dominance (the same approximation class as
+      G10's frozen-guard check); donated positions are read from the
+      literal donate_argnums, a non-literal donates conservatively
+      at every position (graftflow.check_g11_module)
 
 jit-reachability is inferred statically, seeded by project
 conventions: any function whose early positional parameters include
@@ -109,6 +119,8 @@ RULES = {
           "no f32-provenance value reaches the dd chain",
     "G10": "no parameter values baked as trace constants (reads and "
            "closure captures cross-checked against the compile key)",
+    "G11": "no use-after-donate: a buffer passed in a donated "
+           "argument position must not be read after the dispatch",
 }
 
 # entry points allowed to mutate global jax config (G7): the package

@@ -96,16 +96,19 @@ class Sanitizer:
         orig_jac = TimingModel._get_compiled_jac
         san = self
 
-        def patched_phase(model):
+        def patched_phase(model, *a, **kw):
+            # pass-through signature: _get_compiled grew an optional
+            # donate_argnums parameter (ISSUE 7) and the wrapper must
+            # not strip it from opted-in callers
             before = model._jit_phase
-            fn = orig_phase(model)
+            fn = orig_phase(model, *a, **kw)
             if fn is not before:
                 san._record(model, "phase")
             return fn
 
-        def patched_jac(model):
+        def patched_jac(model, *a, **kw):
             before = model._jit_jac
-            fn = orig_jac(model)
+            fn = orig_jac(model, *a, **kw)
             if fn is not before:
                 san._record(model, "jac")
             return fn

@@ -24,8 +24,9 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "dispatch_retries", "dispatch_backoff_ms",
            "dispatch_compile_allowance_ms", "breaker_threshold",
            "breaker_cooldown_s", "breaker_probe_timeout_s",
+           "donation_enabled", "whole_fit_enabled",
            "serve_bucket_edges", "serve_window_s", "serve_max_batch",
-           "serve_queue_cap"]
+           "serve_queue_cap", "serve_pipeline_depth"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -98,7 +99,15 @@ def auto_steps_per_dispatch() -> int:
     powers of two bound it to 4 cache entries. The chained loop
     early-exits on in-kernel convergence (build_fit_loop's
     lax.while_loop), so a generous K costs compile size, not wasted
-    iterations."""
+    iterations.
+
+    The RTT feeding this re-pick comes only from CLEAN observations:
+    the supervisor's drift detector never issues a verdict on a
+    PIPELINED dispatch (in-flight depth > 1), whose wall includes
+    queuing behind the dispatches it overlapped — once overlapped,
+    wall per dispatch is no longer RTT-dominated in either direction,
+    and treating it as an RTT sample would false-trigger the >2x
+    re-measure (supervisor._note_wall)."""
     import jax
 
     if jax.default_backend() == "cpu":
@@ -131,7 +140,12 @@ def dispatch_deadline_ms() -> Optional[float]:
     """Hard watchdog-deadline override for every supervised dispatch
     [ms] ($PINT_TPU_DISPATCH_DEADLINE_MS). Default None: the
     supervisor predicts a deadline from measured RTT x
-    steps-per-dispatch plus a first-call compile allowance."""
+    steps-per-dispatch plus a first-call compile allowance. The
+    override is PER DISPATCH: a pipelined (async) dispatch issued at
+    in-flight depth d still waits out its d-1 predecessors before
+    its own work starts, so its effective watchdog is d x this value
+    (supervisor._deadline_s) — the bound an operator pins applies to
+    each dispatch's own window, not to a whole pipeline."""
     v = _env_number("PINT_TPU_DISPATCH_DEADLINE_MS", None)
     return None if v is None else float(v)
 
@@ -159,6 +173,45 @@ def dispatch_compile_allowance_ms() -> float:
     compile must not read as a hang. Default 10 min."""
     return max(0.0, float(_env_number(
         "PINT_TPU_DISPATCH_COMPILE_ALLOWANCE_MS", 600_000.0)))
+
+
+def donation_enabled(flag: Optional[bool] = None) -> bool:
+    """Buffer donation at the dispatch boundary ($PINT_TPU_DONATE,
+    default ON): jitted programs whose iterated state round-trips the
+    device — the fit loop's (th, tl) parameter pairs, the serve batch
+    kernels' alias-exact inputs — are compiled with donate_argnums so
+    XLA reuses the input buffers for the outputs instead of copying
+    through HBM every dispatch. Donation is only ever applied at
+    sites whose donated arguments are rebuilt fresh per dispatch
+    (graftlint G11 flags any read of a donated buffer after its
+    dispatch), and the CPU equality oracles in
+    tests/test_device_fitter.py prove donation changes nothing."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("PINT_TPU_DONATE", "").lower() \
+        not in ("off", "false", "0")
+
+
+def whole_fit_enabled(flag: Optional[bool] = None) -> bool:
+    """Whole-fit-on-device default for Fitter.auto's device route
+    ($PINT_TPU_WHOLE_FIT): run the ENTIRE downhill fit — damping,
+    acceptance, convergence — inside one deadline-supervised
+    lax.while_loop dispatch instead of K-chained chunks. Default ON
+    on accelerator backends (one dispatch = one RTT for the whole
+    fit), OFF on the CPU backend where dispatch is ~free and the
+    plain step keeps compile time down. Explicit
+    DeviceDownhillGLSFitter(whole_fit=...) / fit_toas(whole_fit=...)
+    always wins."""
+    import jax
+
+    if flag is not None:
+        return bool(flag)
+    env = os.environ.get("PINT_TPU_WHOLE_FIT", "").lower()
+    if env in ("on", "true", "1"):
+        return True
+    if env in ("off", "false", "0"):
+        return False
+    return jax.default_backend() != "cpu"
 
 
 def breaker_threshold() -> int:
@@ -383,4 +436,15 @@ def serve_queue_cap() -> int:
     """Admission-queue capacity; a full queue rejects submits with
     ServeOverload (backpressure). $PINT_TPU_SERVE_QUEUE_CAP."""
     return max(1, int(_env_number("PINT_TPU_SERVE_QUEUE_CAP", 4096,
+                                  cast=int)))
+
+
+def serve_pipeline_depth() -> int:
+    """Max shape-class dispatches the serve scheduler keeps IN FLIGHT
+    during one drain ($PINT_TPU_SERVE_PIPELINE, default 2): batch k+1
+    is issued while batch k executes (double-buffering on jax's async
+    dispatch; the supervisor's watchdog deadline scales by the
+    in-flight depth). 1 = the synchronous drain (dispatch, read,
+    scatter, next)."""
+    return max(1, int(_env_number("PINT_TPU_SERVE_PIPELINE", 2,
                                   cast=int)))

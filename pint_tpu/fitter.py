@@ -211,7 +211,14 @@ class Fitter:
         when the process backend is TPU and the model supports the
         anchored step (there the host fitters' exact-dd surfaces pin
         to the CPU backend, so the device fitter is both the fastest
-        AND the most TPU-native path); explicit True/False overrides."""
+        AND the most TPU-native path); explicit True/False overrides.
+        On accelerator backends the device fitter additionally runs
+        in WHOLE-FIT mode by default (config.whole_fit_enabled /
+        $PINT_TPU_WHOLE_FIT): damping, acceptance and convergence all
+        execute inside one donated, deadline-supervised lax.while_loop
+        dispatch, so an entire downhill fit pays ONE dispatch RTT —
+        pass ``whole_fit=``/``pipeline=`` through ``**kw`` to
+        override per fitter."""
         import jax
 
         from pint_tpu.wideband import has_wideband_dm

@@ -502,18 +502,34 @@ class DeviceDownhillGLSFitter(GLSFitter):
     this the fastest full-fit path on the hardware the framework is
     named for. Singular systems are the caller's concern (the step is
     Cholesky-only): a non-finite first step raises instead of silently
-    falling back."""
+    falling back.
+
+    Dispatch-tax killers (ISSUE 7): ``whole_fit`` runs the ENTIRE
+    downhill fit — damping, acceptance, convergence — as ONE
+    deadline-supervised ``lax.while_loop`` dispatch (the K-chained
+    loop with maxiter as a runtime budget; auto-on on accelerator
+    backends via config.whole_fit_enabled); the loop's (th, tl)
+    parameter state is DONATED (config.donation_enabled) so the
+    iterated pair aliases in place instead of round-tripping HBM; and
+    ``pipeline`` overlaps multi-chunk fits by issuing the next chunk
+    from the device-advanced pair while the host replays the ledger
+    (supervisor pipeline mode, depth-scaled watchdog deadline)."""
 
     def __init__(self, toas, model, residuals=None, track_mode=None,
-                 wideband=False, **step_flags):
+                 wideband=False, whole_fit=None, pipeline=None,
+                 **step_flags):
         super().__init__(toas, model, residuals=residuals,
                          track_mode=track_mode)
         self.wideband = wideband
+        self.whole_fit = whole_fit
+        self.pipeline = pipeline
         self.step_flags = dict(step_flags, wideband=wideband)
+        self.step_evals = None   # step_fn evaluations of the last fit
 
     def fit_toas(self, maxiter=20, min_lambda=1e-3,
                  required_chi2_decrease=1e-2,
-                 steps_per_dispatch=None):
+                 steps_per_dispatch=None, whole_fit=None,
+                 pipeline=None):
         """``steps_per_dispatch`` > 1 runs that many downhill
         iterations inside ONE device program (build_fit_loop) and
         replays the returned ledger on host in exact dd — measured on
@@ -525,6 +541,38 @@ class DeviceDownhillGLSFitter(GLSFitter):
         tunnel); the chained loop early-exits on in-kernel convergence
         so oversizing K wastes no iterations.
 
+        ``whole_fit`` (ISSUE 7 tentpole) makes the ENTIRE downhill
+        fit — damping, acceptance, convergence — ONE deadline-
+        supervised dispatch: the compiled-loop K is the smallest
+        power of two covering ``maxiter`` (same quantized compile
+        keys as the adaptive chaining — whole-fit is the K=inf case
+        of the same program) and ``maxiter`` rides along as the
+        RUNTIME iteration budget, so no fresh compile per distinct
+        maxiter and no iteration past it. Default: explicit argument
+        > constructor flag > ``config.whole_fit_enabled()`` (auto-on
+        on accelerator backends, $PINT_TPU_WHOLE_FIT). An explicit
+        ``steps_per_dispatch`` wins over whole-fit. With
+        ``config.donation_enabled()`` the loop's (th, tl) parameter
+        state is donated (donate_argnums) so the iterated pair stops
+        round-tripping HBM each dispatch.
+
+        ``pipeline`` (default: on off-CPU backends) overlaps the
+        multi-dispatch case: the next chunk is issued asynchronously
+        from the device-advanced (th', tl') pair — bit-identical to
+        the host ledger replay on IEEE hardware (see
+        build_fit_loop's precision contract) — while the host
+        replays the ledger of the chunk just read, and the
+        supervisor's watchdog deadline covers the in-flight window.
+        On TPU's non-IEEE emulated f64 the device pair differs from
+        the host replay by <=2^-48 of the (anchored) delta — the
+        SAME bound the in-kernel two-sum advance already carries
+        inside every chunk, so pipelining adds no new error class:
+        accept/reject decisions can differ only within that noise
+        floor, and the final model state always comes from the exact
+        host ledger replay either way. A whole fit that converges
+        inside one dispatch never pipelines (there is nothing in
+        flight to overlap).
+
         Every device dispatch runs under the runtime supervisor's
         watchdog deadline; an unresponsive/broken backend (or a
         non-finite first step — the host fitters carry the SVD
@@ -534,10 +582,17 @@ class DeviceDownhillGLSFitter(GLSFitter):
         the pre-fit state and its result is bit-identical to running
         the host fitter directly."""
         t0 = time.perf_counter()
+        # reset BEFORE the attempt: after a host failover the count
+        # must read None (no device evals ran), not the previous
+        # fit's number — unlabeled degradation is the failure mode
+        # the runtime layer exists to prevent
+        self.step_evals = None
         try:
             return self._fit_device(maxiter, min_lambda,
                                     required_chi2_decrease,
-                                    steps_per_dispatch, t0)
+                                    steps_per_dispatch, t0,
+                                    whole_fit=whole_fit,
+                                    pipeline=pipeline)
         except (DispatchError, NonFiniteStepError) as e:
             get_supervisor().note_failover("gls.device_fit", e)
             return self._fit_host_failover(
@@ -587,13 +642,37 @@ class DeviceDownhillGLSFitter(GLSFitter):
         return chi2
 
     def _fit_device(self, maxiter, min_lambda,
-                    required_chi2_decrease, steps_per_dispatch, t0):
+                    required_chi2_decrease, steps_per_dispatch, t0,
+                    whole_fit=None, pipeline=None):
+        from pint_tpu import config
         from pint_tpu.config import auto_steps_per_dispatch
         from pint_tpu.ops import dd_np
         from pint_tpu.parallel import build_fit_loop, build_fit_step
 
+        whole = config.whole_fit_enabled(
+            whole_fit if whole_fit is not None else self.whole_fit)
         if steps_per_dispatch is None:
-            steps_per_dispatch = auto_steps_per_dispatch()
+            if whole:
+                # whole-fit-on-device: K = the smallest power of two
+                # covering maxiter, from the SAME quantized set as
+                # the adaptive chaining ({4,8,16,32},
+                # config.auto_steps_per_dispatch) so whole-fit reuses
+                # the chained executables — chaining is just the
+                # small-budget case of this one program. maxiter
+                # itself rides along as the runtime iteration budget
+                # (build_fit_loop), so maxiter > 32 degrades to
+                # chained dispatches of 32 rather than a fresh
+                # compile key.
+                k = 4
+                while k < maxiter and k < 32:
+                    k *= 2
+                steps_per_dispatch = k
+            else:
+                steps_per_dispatch = auto_steps_per_dispatch()
+        if pipeline is None:
+            pipeline = self.pipeline
+        if pipeline is None:
+            pipeline = jax.default_backend() != "cpu"
         sup = get_supervisor()
 
         def bump(th_, tl_, d):
@@ -608,44 +687,85 @@ class DeviceDownhillGLSFitter(GLSFitter):
                 "(singular system? use GLSFitter's SVD fallback)")
 
         if steps_per_dispatch > 1:
-            # maxiter is honored at steps_per_dispatch granularity:
-            # the loop program is compiled for one fixed K (clamped
-            # so a single dispatch never exceeds maxiter), and a
-            # final partial dispatch would need its own compile, so a
-            # multi-dispatch fit may run up to K-1 iterations past
-            # maxiter before reporting MaxiterReached
+            # maxiter is honored EXACTLY: the loop program is
+            # compiled for the fixed (quantized) K but takes the
+            # remaining iteration allowance as a runtime budget
+            # argument, so neither a fresh compile per distinct
+            # maxiter nor an overshoot past it
             loop_fn, args, names = build_fit_loop(
                 self.model, self.toas,
-                max_iter=int(min(steps_per_dispatch, maxiter)),
+                max_iter=int(steps_per_dispatch),
                 min_lambda=min_lambda,
                 required_chi2_decrease=required_chi2_decrease,
                 **self.step_flags)
+            donated = config.donation_enabled()
+            if donated:
+                # the iterated (th, tl) pair aliases the loop's
+                # (th', tl') outputs exactly — donated, the
+                # parameter state stops round-tripping HBM on every
+                # dispatch (the run closure rebuilds fresh device
+                # arrays from host numpy each call, so no caller
+                # ever reads a donated buffer; graftlint G11 guards
+                # the pattern)
+                jitted = jax.jit(loop_fn, donate_argnums=(0, 1))
+            else:
+                jitted = jax.jit(loop_fn)
         else:
             loop_fn, args, names = build_fit_step(
                 self.model, self.toas, **self.step_flags)
-        jitted = jax.jit(loop_fn)
+            jitted = jax.jit(loop_fn)
         noff = 1 if names and names[0] == "Offset" else 0
         # host-side exact parameter state in the step's (th, tl) slots
         th = np.asarray(args[0], np.float64).copy()
         tl = np.asarray(args[1], np.float64).copy()
-        rest = args[2:]
         iterations = 0
+        nevals = 0
         converged = False
         maxed_out = False
-        chained_k = int(min(steps_per_dispatch, maxiter))
-
-        def run(th_, tl_):
-            """One supervised device dispatch. Executed on the
-            supervisor's guarded worker; the host reads happen INSIDE
-            so the watchdog deadline covers completion — over the
-            axon tunnel the dispatch ack only confirms enqueue."""
-            out = jitted(jnp.asarray(th_), jnp.asarray(tl_), *rest)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
-            return [np.asarray(o) for o in out]
+        chained_k = int(steps_per_dispatch)
 
         if steps_per_dispatch > 1:
+            body = args[2:-1]   # args[-1] is the default budget
+
+            def run(th_, tl_, budget_):
+                """One supervised device dispatch of the chained
+                loop. Executed on the supervisor's guarded worker;
+                the host reads happen INSIDE so the watchdog
+                deadline covers completion — over the axon tunnel
+                the dispatch ack only confirms enqueue."""
+                out = jitted(jnp.asarray(th_), jnp.asarray(tl_), *body, jnp.asarray(int(budget_), jnp.int32))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+                hs = [np.asarray(o) for o in out]
+                if donated:
+                    # OWNED arrays, not views: with donation the
+                    # loop's (th', tl') outputs alias the donated
+                    # input buffers, and a zero-copy view escaping
+                    # the closure would dangle once XLA reuses the
+                    # memory (the runtime counterpart of G11). Copy
+                    # only actual views — an accelerator D2H read is
+                    # already a fresh owned buffer.
+                    hs = [h if h.flags.owndata else h.copy()
+                          for h in hs]
+                return hs
+        else:
+            rest = args[2:]
+
+            def run(th_, tl_):
+                """One supervised device dispatch (see above; the
+                single-step path never donates)."""
+                out = jitted(jnp.asarray(th_), jnp.asarray(tl_), *rest)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+                return [np.asarray(o) for o in out]
+
+        if steps_per_dispatch > 1:
+            budget = int(min(chained_k, maxiter))
+            handle = None
             while True:
-                out = sup.dispatch(run, th, tl, key="gls.fit_loop",
-                                   steps=chained_k)
+                if handle is not None:
+                    out = handle.result()
+                    handle = None
+                else:
+                    out = sup.dispatch(run, th, tl, budget,
+                                       key="gls.fit_loop",
+                                       steps=budget)
                 dp = np.asarray(out[2], np.float64)
                 cov = np.asarray(out[3])
                 best = float(out[4])
@@ -656,12 +776,33 @@ class DeviceDownhillGLSFitter(GLSFitter):
                 niter = int(out[6])
                 deltas = np.asarray(out[8], np.float64)
                 lams = np.asarray(out[9], np.float64)
+                nevals += int(out[10])
+                done_dev = bool(out[7])   # loop converged on device
+                will_continue = (not done_dev
+                                 and iterations + niter < maxiter)
+                if will_continue:
+                    budget = int(min(chained_k,
+                                     maxiter - iterations - niter))
+                    if pipeline:
+                        # pipelined chaining: issue the next chunk
+                        # NOW from the device-advanced (th', tl')
+                        # pair — bit-identical to the ledger replay
+                        # below on IEEE hardware (build_fit_loop's
+                        # precision contract: the in-kernel two-sum
+                        # and the host dd replay are 1:1 mirrors) —
+                        # so the exact host replay overlaps the
+                        # in-flight dispatch instead of serializing
+                        # with it
+                        handle = sup.dispatch_async(
+                            run, np.asarray(out[0], np.float64),
+                            np.asarray(out[1], np.float64), budget,
+                            key="gls.fit_loop", steps=budget)
                 # exact host replay of the device's accepted updates
                 for k in range(niter):
                     if lams[k] > 0.0:
                         th, tl = bump(th, tl, deltas[k])
                 iterations += niter
-                if bool(out[7]):          # loop converged on device
+                if done_dev:
                     converged = True
                     break
                 if iterations >= maxiter:
@@ -669,6 +810,7 @@ class DeviceDownhillGLSFitter(GLSFitter):
                     break
         else:
             out = sup.dispatch(run, th, tl, key="gls.fit_step")
+            nevals += 1
             dp = np.asarray(out[0], np.float64)
             cov = np.asarray(out[1])
             best = float(out[2])
@@ -681,6 +823,7 @@ class DeviceDownhillGLSFitter(GLSFitter):
                     thc, tlc = bump(th, tl, lam * dp[noff:])
                     outc = sup.dispatch(run, thc, tlc,
                                         key="gls.fit_step")
+                    nevals += 1
                     newchi2 = float(outc[2])
                     if np.isfinite(newchi2) and \
                             newchi2 <= best + 1e-12:
@@ -700,6 +843,7 @@ class DeviceDownhillGLSFitter(GLSFitter):
                     break
             else:
                 maxed_out = True
+        self.step_evals = nevals
         # sync the model to the accepted device state even when about
         # to raise: callers catching MaxiterReached expect the best
         # point found (host DownhillGLSFitter behavior). (th, tl) are

@@ -809,11 +809,24 @@ class TimingModel:
                 tuple(self.free_params), self.ref_day, statics,
                 frozen_vals)
 
-    def _get_compiled(self):
-        key = self._compile_key()
+    def _get_compiled(self, donate_argnums=None):
+        """Cached jitted phase function. ``donate_argnums`` (opt-in,
+        part of the cache key) lets an ITERATED caller donate its
+        argument buffers — e.g. (0, 1) for a loop advancing the
+        (th, tl) pair in place (config.donation_enabled policy). The
+        default stays non-donating: the host fitters re-use their
+        packed arrays across calls, and a donated buffer is CONSUMED
+        by the dispatch (graftlint G11 — callers opting in must
+        rebuild their donated args fresh per call). One cached slot:
+        callers ALTERNATING donation modes on the same model would
+        recompile per switch — opt in only from a dedicated iterated
+        loop, not per-call."""
+        key = (self._compile_key(),
+               tuple(donate_argnums) if donate_argnums else ())
         if self._jit_phase is None or self._cache_key_params != key:
             fn, names = self._build_phase_fn()
-            self._jit_phase = jax.jit(fn)
+            self._jit_phase = jax.jit(
+                fn, donate_argnums=donate_argnums or ())
             self._names = names
             self._cache_key_params = key
         return self._jit_phase

@@ -562,15 +562,39 @@ def build_fit_loop(model, toas, max_iter: int = 8,
     Returns ``(loop_fn, args, names)`` where
 
         loop_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
-                eid, jvar) -> (th', tl', dp, cov, best_chi2, chi2_0,
-                               niter, converged, deltas, lams)
+                eid, jvar, budget) -> (th', tl', dp, cov, best_chi2,
+                                       chi2_0, niter, converged,
+                                       deltas, lams, nevals)
 
     with ``deltas`` (max_iter, p) the applied parameter updates
     (zero rows beyond ``niter`` or on the rejected final iteration),
     ``lams`` (max_iter,) the accepted step-halving factors (0 =
     rejected/unused), ``chi2_0`` the chi2 of the entry point, and
     ``converged`` True when the loop stopped for a reason other than
-    exhausting ``max_iter``.
+    exhausting the iteration budget.
+
+    ``budget`` is a RUNTIME iteration limit (int32 scalar; the
+    returned ``args`` carry ``max_iter`` as the default): the loop
+    stops at ``min(max_iter, budget)``, so ONE compiled program —
+    ``max_iter`` stays quantized to the power-of-two compile keys of
+    ``config.auto_steps_per_dispatch`` — serves every caller
+    ``maxiter`` below it instead of forcing a fresh (multi-minute,
+    remote) compile per distinct limit. This is what lets the
+    whole-fit-on-device mode (``DeviceDownhillGLSFitter.fit_toas(
+    whole_fit=True)``) reuse the K-chained executables: chaining is
+    just the small-budget case of the same program.
+
+    ``nevals`` counts the step_fn evaluations the loop actually
+    executed (the entry step plus every line-search trial) — the
+    denominator bench.py's ``dispatch_overhead`` block needs to
+    separate pure step time from dispatch wall.
+
+    The (th, tl) argument slots are DONATABLE: the loop's first two
+    outputs (th', tl') have identical shape/dtype, so a caller that
+    jits with ``donate_argnums=(0, 1)`` lets XLA alias the iterated
+    parameter state in place instead of round-tripping fresh buffers
+    through HBM every dispatch (the device fitter does exactly this
+    when ``config.donation_enabled()``).
     """
     from jax import lax
 
@@ -589,7 +613,7 @@ def build_fit_loop(model, toas, max_iter: int = 8,
         return s.hi, s.lo
 
     def loop_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
-                eid, jvar):
+                eid, jvar, budget):
         def step(a, b):
             dp, cov, chi2, _ = step_fn(a, b, fh, fl, batch, cache, F,
                                        phi, nvec, valid, eid, jvar)
@@ -602,10 +626,12 @@ def build_fit_loop(model, toas, max_iter: int = 8,
 
         def cond(c):
             k, done = c[0], c[1]
-            return jnp.logical_and(jnp.logical_not(done), k < K)
+            return jnp.logical_and(
+                jnp.logical_not(done),
+                jnp.logical_and(k < K, k < budget))
 
         def body(c):
-            k, done, thk, tlk, dpk, covk, best, deltas, lams = c
+            k, done, thk, tlk, dpk, covk, best, deltas, lams, nev = c
             d = dpk[noff:]
 
             def hcond(h):
@@ -614,7 +640,7 @@ def build_fit_loop(model, toas, max_iter: int = 8,
                                        lam >= min_lambda)
 
             def hbody(h):
-                lam, _, thc, tlc, dpc, covc, chic = h
+                lam, _, thc, tlc, dpc, covc, chic, nv = h
                 tht, tlt = _two_sum_add(thk, tlk, lam * d)
                 dpt, covt, chit = step(tht, tlt)
                 ok = jnp.logical_and(jnp.isfinite(chit),
@@ -623,12 +649,14 @@ def build_fit_loop(model, toas, max_iter: int = 8,
                 return (jnp.where(ok, lam, lam / 2.0), ok,
                         keep(tht, thc), keep(tlt, tlc),
                         keep(dpt, dpc), keep(covt, covc),
-                        keep(chit, chic))
+                        keep(chit, chic), nv + 1)
 
-            lam, acc, thc, tlc, dpc, covc, chic = lax.while_loop(
-                hcond, hbody,
-                (jnp.asarray(1.0, th.dtype), jnp.asarray(False),
-                 thk, tlk, dpk, covk, jnp.asarray(jnp.inf, th.dtype)))
+            lam, acc, thc, tlc, dpc, covc, chic, nev = \
+                lax.while_loop(
+                    hcond, hbody,
+                    (jnp.asarray(1.0, th.dtype), jnp.asarray(False),
+                     thk, tlk, dpk, covk,
+                     jnp.asarray(jnp.inf, th.dtype), nev))
 
             improved = best - chic
             applied = jnp.where(acc, lam * d, jnp.zeros_like(d))
@@ -640,17 +668,18 @@ def build_fit_loop(model, toas, max_iter: int = 8,
                 improved < required_chi2_decrease)
             return (k + 1, done, keep(thc, thk), keep(tlc, tlk),
                     keep(dpc, dpk), keep(covc, covk),
-                    keep(chic, best), deltas, lams)
+                    keep(chic, best), deltas, lams, nev)
 
-        k, done, thf, tlf, dpf, covf, best, deltas, lams = \
+        k, done, thf, tlf, dpf, covf, best, deltas, lams, nev = \
             lax.while_loop(cond, body,
                            (jnp.asarray(0, jnp.int32),
                             jnp.asarray(False), th, tl, dp0, cov0,
-                            chi2_0, deltas0, lams0))
+                            chi2_0, deltas0, lams0,
+                            jnp.asarray(1, jnp.int32)))
         return (thf, tlf, dpf, covf, best, chi2_0, k, done, deltas,
-                lams)
+                lams, nev)
 
-    return loop_fn, args, names
+    return loop_fn, args + (jnp.asarray(K, jnp.int32),), names
 
 
 def _pad_leaf(a: np.ndarray, pad: int) -> np.ndarray:

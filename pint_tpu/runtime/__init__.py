@@ -40,6 +40,7 @@ from pint_tpu.runtime.faults import (  # noqa: F401
 from pint_tpu.runtime.supervisor import (  # noqa: F401
     BackendUnavailable,
     DispatchError,
+    DispatchFuture,
     DispatchSupervisor,
     DispatchTimeout,
     RuntimeMetrics,

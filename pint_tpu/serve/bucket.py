@@ -121,12 +121,42 @@ class ExecutableCache:
                  supervisor=None):
         import jax
 
+        from pint_tpu.config import donation_enabled
         from pint_tpu.runtime import get_supervisor
 
         self.mesh = mesh
         self.axis = axis
-        self._gls = jax.jit(jax.vmap(_solve_one))
-        self._phase = jax.jit(jax.vmap(_phase_eval_one))
+        # serve-kernel donation is scoped to ACCELERATOR backends:
+        # the engine's pipelined drain executes these kernels from
+        # concurrent worker threads, and XLA:CPU's donation aliasing
+        # showed a rare buffer-reuse race under that concurrency
+        # (a real batch slot reading back another dispatch's memory
+        # — caught by the mid-pipeline fault test under load). On
+        # CPU donation buys nothing anyway (host memory, no HBM
+        # round-trip); on TPU per-device streams serialize execution
+        # and donation is the HBM win ISSUE 7 targets. The device
+        # fitter's loop donation is unaffected: its dispatches are
+        # strictly sequential, with the CPU equality oracle in
+        # tests/test_device_fitter.py.
+        self.donation = donation_enabled() and \
+            jax.default_backend() != "cpu"
+        if self.donation:
+            # alias-exact buffer donation (ISSUE 7): the GLS batch's
+            # pvalid (P, p) aliases the dparams output, the phase
+            # batch's mjds/valid (P, nb) alias the (pi, pf) outputs —
+            # XLA writes the results INTO the input buffers instead
+            # of allocating + copying fresh HBM each dispatch. Only
+            # exactly-aliasable positions are donated (an unusable
+            # donation warns per call). Every donated array is
+            # rebuilt per dispatch inside the run closure, so no
+            # caller ever reads a donated buffer (graftlint G11).
+            self._gls = jax.jit(jax.vmap(_solve_one),
+                                donate_argnums=(6,))
+            self._phase = jax.jit(jax.vmap(_phase_eval_one),
+                                  donate_argnums=(5, 6))
+        else:
+            self._gls = jax.jit(jax.vmap(_solve_one))
+            self._phase = jax.jit(jax.vmap(_phase_eval_one))
         # every dispatch routes through the runtime supervisor:
         # watchdog deadline + host failover (numpy mirror for GLS,
         # PolycoEntry.abs_phase for phase) so a wedged backend can
@@ -166,42 +196,90 @@ class ExecutableCache:
             out[k] = jax.device_put(v, sh)
         return out
 
-    def gls(self, key, problems, shape):
+    def _issue(self, run, host, dispatch_key, class_key, sync: bool):
+        """Shared issue/collect plumbing: ``sync`` runs the
+        supervised dispatch inline (the classic drain); otherwise the
+        dispatch is ISSUED on the supervisor's pipeline mode
+        (``dispatch_async``) and the returned zero-arg ``collect``
+        blocks on its DispatchFuture — batch k+1's device work then
+        overlaps batch k's result read. The class key is recorded at
+        collect time, only on a real (non-failed-over) device
+        dispatch."""
+        fell_over = []
+
+        def host_counted():
+            fell_over.append(True)
+            return host()
+
+        if sync:
+            # LAZY: the dispatch runs inside collect, so the
+            # caller's annotate("serve.dispatch") region wraps the
+            # real device work in sync mode too (an eager dispatch
+            # here would leave the profiler attributing ~0 ms)
+            def collect():
+                out = self.supervisor.dispatch(
+                    run, key=dispatch_key, fallback=host_counted)
+                if not fell_over:
+                    self.keys.add(class_key)
+                return out
+        else:
+            fut = self.supervisor.dispatch_async(
+                run, key=dispatch_key, fallback=host_counted)
+
+            def collect():
+                out = fut.result()
+                if not fell_over:
+                    self.keys.add(class_key)
+                return out
+
+        return collect
+
+    def gls_begin(self, key, problems, shape, sync: bool = False):
         """Pad ``problems`` to the class shape (``parallel.pta``
-        masking) and solve the batch in one SUPERVISED dispatch
-        (runtime watchdog; host ``pta_solve_np`` failover). Returns
-        host arrays (dparams, cov, chi2, chi2r), each (P, ...). The
-        class key is recorded only on success, so a failed dispatch
-        cannot inflate ``compile_count`` past the classes actually
-        built — and a failed-over (host-solved) dispatch does not
-        record one either: no executable was built for it."""
+        masking) and issue the batch as one SUPERVISED dispatch
+        (runtime watchdog; host ``pta_solve_np`` failover). Returns a
+        zero-arg ``collect`` whose call yields host arrays (dparams,
+        cov, chi2, chi2r), each (P, ...). The class key is recorded
+        only on success, so a failed dispatch cannot inflate
+        ``compile_count`` past the classes actually built — and a
+        failed-over (host-solved) dispatch does not record one
+        either: no executable was built for it."""
         stacked = stack_problems(problems, shape=shape)
 
         def run():
             # place + dispatch + host read on the guarded worker so
-            # the deadline covers completion, not just enqueue
+            # the deadline covers completion, not just enqueue; the
+            # placed arrays are fresh per call, so the donated
+            # pvalid buffer is never observable afterwards
             st = self._place(stacked)
             out = self._gls(st["M"], st["F"], st["phi"], st["r"], st["nvec"], st["valid"], st["pvalid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
-            return tuple(np.asarray(o) for o in out)
+            hs = tuple(np.asarray(o) for o in out)
+            if self.donation:
+                # OWNED arrays: dparams aliases the donated pvalid
+                # buffer — a zero-copy view escaping the closure
+                # would dangle once XLA reuses the memory (runtime
+                # G11). Copy only actual views; an accelerator D2H
+                # read is already a fresh owned buffer.
+                hs = tuple(h if h.flags.owndata else h.copy()
+                           for h in hs)
+            return hs
 
-        fell_over = []
+        return self._issue(
+            run, lambda: pta_solve_np(stacked),
+            f"serve.gls/{'/'.join(str(x) for x in key)}", key, sync)
 
-        def host():
-            fell_over.append(True)
-            return pta_solve_np(stacked)
+    def gls(self, key, problems, shape):
+        """Synchronous ``gls_begin`` + collect (the non-pipelined
+        drain and every pre-pipeline caller)."""
+        return self.gls_begin(key, problems, shape, sync=True)()
 
-        host_out = self.supervisor.dispatch(
-            run, key=f"serve.gls/{'/'.join(str(x) for x in key)}",
-            fallback=host)
-        if not fell_over:
-            self.keys.add(key)
-        return host_out
-
-    def phase(self, key, requests, nb: int, kb: int, Pb: int):
+    def phase_begin(self, key, requests, nb: int, kb: int, Pb: int,
+                    sync: bool = False):
         """Pad phase requests to (Pb, nb) MJDs x kb coefficients and
-        evaluate the batch in one supervised dispatch (host failover:
+        issue the batch as one supervised dispatch (host failover:
         per-entry ``PolycoEntry.abs_phase``; key recorded on a real
-        device dispatch only, as in ``gls``)."""
+        device dispatch only, as in ``gls_begin``). Returns the
+        zero-arg ``collect``."""
         coeffs = np.zeros((Pb, kb))
         tmid = np.zeros(Pb)
         rpi = np.zeros(Pb)
@@ -223,16 +301,21 @@ class ExecutableCache:
             valid[k, :len(m)] = 1.0
 
         def run():
+            # placed arrays are fresh per call: the donated
+            # mjds/valid buffers are never observable afterwards
             arrs = self._place({"coeffs": coeffs, "tmid": tmid,
                                 "rpi": rpi, "rpf": rpf, "f0": f0,
                                 "mjds": mjds, "valid": valid})
             pi, pf = self._phase(arrs["coeffs"], arrs["tmid"], arrs["rpi"], arrs["rpf"], arrs["f0"], arrs["mjds"], arrs["valid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
-            return np.asarray(pi), np.asarray(pf)
-
-        fell_over = []
+            hi, hf = np.asarray(pi), np.asarray(pf)
+            if self.donation:
+                # owned arrays: (pi, pf) alias the donated
+                # mjds/valid buffers (see the gls run above)
+                hi = hi if hi.flags.owndata else hi.copy()
+                hf = hf if hf.flags.owndata else hf.copy()
+            return hi, hf
 
         def host():
-            fell_over.append(True)
             pi = np.zeros((Pb, nb))
             pf = np.zeros((Pb, nb))
             for k, rq in enumerate(requests):
@@ -242,9 +325,11 @@ class ExecutableCache:
                 pf[k, :n] = hf
             return pi, pf
 
-        pi, pf = self.supervisor.dispatch(
-            run, key=f"serve.phase/{'/'.join(str(x) for x in key)}",
-            fallback=host)
-        if not fell_over:
-            self.keys.add(key)
-        return pi, pf
+        return self._issue(
+            run, host,
+            f"serve.phase/{'/'.join(str(x) for x in key)}", key, sync)
+
+    def phase(self, key, requests, nb: int, kb: int, Pb: int):
+        """Synchronous ``phase_begin`` + collect."""
+        return self.phase_begin(key, requests, nb, kb, Pb,
+                                sync=True)()

@@ -96,9 +96,12 @@ class ServeMetrics:
     read from it live so the metrics can never disagree with the
     thing that actually compiled."""
 
-    def __init__(self, cache=None, supervisor=None):
+    def __init__(self, cache=None, supervisor=None,
+                 pipeline_depth: int = 1, donation: bool = False):
         self.cache = cache
         self.supervisor = supervisor
+        self.pipeline_depth = pipeline_depth   # configured in-flight cap
+        self.donation = donation               # buffer donation on?
         self.submitted = 0
         self.completed = 0
         self.rejected = 0           # backpressure (queue cap) drops
@@ -162,10 +165,16 @@ class ServeMetrics:
                            for k, b in sorted(self.buckets.items(),
                                               key=lambda kv: str(kv[0]))},
         }
+        # the pipeline/donation configuration rides the snapshot so
+        # an artifact can say how a number was produced (the
+        # dispatch_overhead observability contract, ISSUE 7)
+        out["pipeline_depth"] = self.pipeline_depth
+        out["donation"] = bool(self.donation)
         if self.supervisor is not None:
             # the dispatch-supervisor counters (timeouts, retries,
-            # breaker state, failovers): a degraded run must be
-            # LABELED in the artifact, never silently slow
+            # breaker state, failovers; max_inflight = the pipelining
+            # actually achieved): a degraded run must be LABELED in
+            # the artifact, never silently slow
             out["dispatch"] = self.supervisor.snapshot()
         return out
 

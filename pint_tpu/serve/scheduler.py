@@ -36,6 +36,16 @@ Every device dispatch routes through the engine's
 host numpy/polyco failover): a wedged backend degrades a batch to
 the host path — counted, never hung — so every admitted future
 always completes.
+
+Pipelined drain (ISSUE 7): with ``pipeline_depth`` > 1 (default 2,
+``$PINT_TPU_SERVE_PIPELINE``) a drain pass keeps that many
+shape-class dispatches in flight at once — batch k+1 is issued on
+the supervisor's async pipeline (``dispatch_async``) while batch k
+executes, with explicit result collection only at scatter time
+(double-buffering on jax's async dispatch). Each in-flight dispatch
+carries its own depth-scaled watchdog deadline and host fallback, so
+a mid-pipeline backend death still drains every admitted future to
+labeled host failover — zero hung futures.
 """
 
 from __future__ import annotations
@@ -85,7 +95,8 @@ class ServeEngine:
                  max_batch: Optional[int] = None,
                  queue_cap: Optional[int] = None,
                  bucket_edges: Optional[Tuple[int, ...]] = None,
-                 mesh=None, axis: str = "pulsar"):
+                 mesh=None, axis: str = "pulsar",
+                 pipeline_depth: Optional[int] = None):
         from pint_tpu import config
         from pint_tpu.runtime import DispatchSupervisor
 
@@ -100,6 +111,14 @@ class ServeEngine:
             else bucket_edges))
         self.mesh = mesh
         self.axis = axis
+        # pipelined drain (ISSUE 7): keep up to this many shape-class
+        # dispatches IN FLIGHT during one drain pass — batch k+1 is
+        # issued on the supervisor's async pipeline while batch k
+        # executes, and results are collected in issue order. 1 = the
+        # classic synchronous drain.
+        self.pipeline_depth = max(1, config.serve_pipeline_depth()
+                                  if pipeline_depth is None
+                                  else int(pipeline_depth))
         # engine-owned dispatch supervisor: its counters (timeouts,
         # failovers, retries) are this deployment's — self-contained
         # like the compile accounting — while breaker state stays
@@ -108,7 +127,9 @@ class ServeEngine:
         self.cache = ExecutableCache(mesh=mesh, axis=axis,
                                      supervisor=self.supervisor)
         self.metrics = ServeMetrics(self.cache,
-                                    supervisor=self.supervisor)
+                                    supervisor=self.supervisor,
+                                    pipeline_depth=self.pipeline_depth,
+                                    donation=self.cache.donation)
         self._queue: collections.deque = collections.deque()
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
@@ -181,9 +202,10 @@ class ServeEngine:
                 fallbacks.append((key, r))
             else:
                 groups.setdefault(key, []).append(r)
+        units: List[Tuple] = []
         for key, grp in groups.items():
             for i in range(0, len(grp), self.max_batch):
-                self._dispatch(key, grp[i:i + self.max_batch])
+                units.append((key, grp[i:i + self.max_batch]))
         # oversize requests (no configured bucket) still coalesce:
         # the fallback shape class IS a shape class, so requests that
         # land on the same power-of-two dims share one padded
@@ -195,7 +217,25 @@ class ServeEngine:
         for key, grp in fb_groups.items():
             self.metrics.fallback_single += len(grp)
             for i in range(0, len(grp), self.max_batch):
-                self._dispatch(key, grp[i:i + self.max_batch])
+                units.append((key, grp[i:i + self.max_batch]))
+        if self.pipeline_depth <= 1 or len(units) <= 1:
+            for key, grp in units:
+                self._dispatch(key, grp)
+            return
+        # pipelined drain: a sliding window of pipeline_depth
+        # in-flight dispatches; collection stays in issue order so
+        # result scattering (and the per-bucket metrics) are
+        # deterministic. A mid-pipeline backend death drains cleanly:
+        # every issued dispatch carries its own depth-scaled watchdog
+        # deadline and host fallback, so collecting the window always
+        # terminates — zero hung futures (tests/test_runtime_faults).
+        pending: collections.deque = collections.deque()
+        for key, grp in units:
+            pending.append(self._dispatch_begin(key, grp))
+            if len(pending) >= self.pipeline_depth:
+                self._dispatch_finish(*pending.popleft())
+        while pending:
+            self._dispatch_finish(*pending.popleft())
 
     def _class_of(self, r):
         """(shape-class key, is_fallback). GLS requests are assembled
@@ -228,17 +268,65 @@ class ServeEngine:
         return Pb
 
     def _dispatch(self, key, grp: List):
-        """One device call for one shape-class group; scatter results
-        to the group's futures. A dispatch failure fails exactly this
-        group's futures — the engine keeps serving."""
+        """One synchronous device call for one shape-class group;
+        scatter results to the group's futures. A dispatch failure
+        fails exactly this group's futures — the engine keeps
+        serving."""
+        self._dispatch_finish(*self._dispatch_begin(key, grp,
+                                                    sync=True))
+
+    def _dispatch_begin(self, key, grp: List, sync: bool = False):
+        """Issue one shape-class group's device call (async on the
+        supervisor's pipeline mode unless ``sync``). Returns the
+        state tuple ``_dispatch_finish`` consumes; an assembly/issue
+        failure rides along as the collect slot and fails the group
+        at finish time, so begin never throws into the drain loop."""
         Pb = self._batch_pad(len(grp))
         full_key = key + (Pb,)
         t0 = time.monotonic()
         try:
             if key[0] == "phase":
-                self._dispatch_phase(key, full_key, grp, Pb)
+                _, nb, kb = key
+                collect = self.cache.phase_begin(
+                    full_key, grp, nb, kb, Pb, sync=sync)
             else:
-                self._dispatch_gls(key, full_key, grp, Pb)
+                _, nb, pb, qb = key
+                collect = self.cache.gls_begin(
+                    full_key, [r.problem for r in grp],
+                    shape=(Pb, nb, pb, qb), sync=sync)
+        except Exception as e:
+            collect = e
+        return key, full_key, grp, Pb, t0, collect
+
+    def _dispatch_finish(self, key, full_key, grp, Pb, t0, collect):
+        """Collect one issued dispatch and scatter results to the
+        group's futures (the wait rides the supervisor's depth-scaled
+        watchdog, so this always terminates)."""
+        try:
+            if isinstance(collect, Exception):
+                raise collect
+            with annotate("serve.dispatch"):
+                out = collect()
+            if key[0] == "phase":
+                pi, pf = out
+                for k, r in enumerate(grp):
+                    n = len(r.mjds)
+                    r.future.set_result(PhasePredictResult(
+                        phase_int=pi[k][:n], phase_frac=pf[k][:n]))
+            else:
+                dparams, cov, chi2, chi2r = out
+                for k, r in enumerate(grp):
+                    pr = r.problem
+                    p = pr.M.shape[1]
+                    if isinstance(r, ResidualsRequest):
+                        res = ResidualsResult(time_resids=pr.r,
+                                              chi2=float(chi2r[k]))
+                    else:
+                        res = FitStepResult(
+                            names=pr.names, dparams=dparams[k][:p],
+                            cov=cov[k][:p, :p], chi2=float(chi2[k]),
+                            chi2r=float(chi2r[k]))
+                    r.future.set_result(res)
         except Exception as e:
             for r in grp:
                 if not r.future.done():
@@ -258,34 +346,6 @@ class ServeEngine:
         if isinstance(r, PhasePredictRequest):
             return len(r.mjds)
         return r.problem.M.shape[0]
-
-    def _dispatch_gls(self, key, full_key, grp, Pb):
-        _, nb, pb, qb = key
-        problems = [r.problem for r in grp]
-        with annotate("serve.dispatch"):
-            dparams, cov, chi2, chi2r = self.cache.gls(
-                full_key, problems, shape=(Pb, nb, pb, qb))
-        for k, r in enumerate(grp):
-            pr = r.problem
-            p = pr.M.shape[1]
-            if isinstance(r, ResidualsRequest):
-                res = ResidualsResult(time_resids=pr.r,
-                                      chi2=float(chi2r[k]))
-            else:
-                res = FitStepResult(
-                    names=pr.names, dparams=dparams[k][:p],
-                    cov=cov[k][:p, :p], chi2=float(chi2[k]),
-                    chi2r=float(chi2r[k]))
-            r.future.set_result(res)
-
-    def _dispatch_phase(self, key, full_key, grp, Pb):
-        _, nb, kb = key
-        with annotate("serve.dispatch"):
-            pi, pf = self.cache.phase(full_key, grp, nb, kb, Pb)
-        for k, r in enumerate(grp):
-            n = len(r.mjds)
-            r.future.set_result(PhasePredictResult(
-                phase_int=pi[k][:n], phase_frac=pf[k][:n]))
 
     # -- threaded serving loop ----------------------------------------
 

@@ -411,6 +411,91 @@ def test_predict_profile_matches_registry_flags():
     assert not p64["dd32_split"]["active"]
 
 
+# ----------------------------------------------------------------- G11
+
+def test_g11_flags_read_after_donated_dispatch():
+    """The core hazard: a buffer passed at a donated position is
+    consumed by the dispatch; reading it afterwards is a
+    deleted-array error (or, pipelined, a race)."""
+    v, _ = _flow("""
+        import jax
+        def drive(f, x, b):
+            j = jax.jit(f, donate_argnums=(0,))
+            y = j(x, b)
+            return x + y
+    """)
+    flagged = [x for x in v if x.rule == "G11"]
+    assert flagged and "`x`" in flagged[0].msg
+    # the non-donated operand is untouched
+    assert not any("`b`" in x.msg for x in flagged)
+
+
+def test_g11_rebinding_sanctions_the_idiom():
+    """``x = j(x)`` rebinds the name from the call's result — the
+    sanctioned donation idiom — and a fresh-temporary argument
+    (jnp.asarray(x)) never involves a donatable name at all."""
+    v, _ = _flow("""
+        import jax
+        import jax.numpy as jnp
+        def drive(f, x, b):
+            j = jax.jit(f, donate_argnums=(0, 1))
+            x, b = j(x, b)
+            out = j(jnp.asarray(x), jnp.asarray(b))
+            return x + b + out[0]
+    """)
+    assert "G11" not in _rules(v)
+
+
+def test_g11_attribute_products_and_nonliteral_donation():
+    """self.x = jax.jit(..., donate_argnums=...) products are
+    tracked by attribute name; a NON-literal donate_argnums donates
+    conservatively at every position."""
+    v, _ = _flow("""
+        import jax
+        class Cache:
+            def __init__(self, f, pos):
+                self._k = jax.jit(f, donate_argnums=pos)
+            def solve(self, m, r):
+                out = self._k(m, r)
+                return r, out
+    """)
+    flagged = [x for x in v if x.rule == "G11"]
+    assert flagged and any("`r`" in x.msg for x in flagged)
+
+
+def test_g11_pragma_suppression():
+    src = ("import jax\n"
+           "def drive(f, x, b):\n"
+           "    j = jax.jit(f, donate_argnums=(0,))\n"
+           "    y = j(x, b)\n"
+           "    return x + y  # graftlint: allow G11 -- fixture\n")
+    m = gl.ModuleInfo("pint_tpu/models/_fixture.py", src)
+    gl.mark_jit_regions(m, gl.collect_jit_seed_names([m])[m.relpath])
+    violations, _ = gf.run_flow_checks([m], registry=[],
+                                       verify_probe_sites=False)
+    report = gl.LintReport(violations=violations)
+    gl.apply_suppressions(report, [],
+                          {"pint_tpu/models/_fixture.py": src})
+    assert not [x for x in report.violations if x.rule == "G11"]
+    assert report.suppressed
+
+
+def test_g11_donation_is_live_on_cpu():
+    """The runtime fact the rule guards: donation really consumes
+    the buffer on this jax/CPU build — a read after the dispatch
+    raises, it does not silently succeed."""
+    import jax
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    j = jax.jit(lambda a: a * 2.0, donate_argnums=(0,))
+    x = jnp.arange(4.0)
+    y = j(x)
+    assert float(y[0]) == 0.0
+    with _pytest.raises(RuntimeError, match="deleted"):
+        np_x = x + 1  # noqa: F841 — the read G11 statically forbids
+
+
 # ------------------------------------------------------ cfg engine
 
 def test_cfg_joins_branches_and_loops():

@@ -17,8 +17,10 @@ tools/tpu_watcher.sh drives this: bounded probe every ~9 min, then
 one stage at a time under its own timeout, git-committing the ledger
 after each stage so a tunnel death mid-capture loses at most the
 in-flight stage. Stage priority mirrors VERDICT.md round-4 item 1:
-the production (hybrid-Jacobian) north star first, then the N-scan,
-variant attribution, configs 2-5, and the PTA scaling sweep.
+the production (hybrid-Jacobian) north star first — the re-measure
+pending since PR 6 — then the ISSUE-7 async_fit pair (whole-fit
+dispatch overhead + pipelined serve), the N-scan, variant
+attribution, configs 2-5, and the PTA scaling sweep.
 """
 
 from __future__ import annotations
@@ -36,6 +38,7 @@ import bench  # noqa: E402  (lazy: imports jax only inside functions)
 # stage -> headline metric
 STAGES = {
     "north_star": "gls_fit_iteration_throughput_10k_toas_40p",
+    "async_fit": "whole_fit_dispatch_overhead",
     "scan": "gls_step_nscaling",
     "attr": "step_variant_attribution",
     "config2": "config2_b1855like_gls_ecorr_5k",
@@ -127,6 +130,39 @@ def stage_north_star(backend):
     rec["step_ms"] = round(per_iter * 1e3, 2)
     rec["value"] = round(toas.ntoas / per_iter, 1)
     rec.update(bench.roofline_fields(jitted, args, per_iter, backend))
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def stage_async_fit(backend):
+    """Whole-fit-on-device + pipelined serve (ISSUE 7): the dispatch
+    tax measured ON CHIP — the entire downhill fit as one donated
+    lax.while_loop dispatch (the <10% overhead target), plus a small
+    pipelined-vs-sync serve run. Queued right after the north star
+    so a short tunnel window still captures the headline pair."""
+    model, toas = bench.build_problem()
+    t, chi2, jitted, args, step_fn = bench.measure_step(model, toas)
+    per = t
+    try:
+        tc = bench.measure_step_chained((step_fn, args), k=8)
+        per = min(per, tc)
+    except Exception as e:
+        bench.log(f"  chained failed: {e!r}")
+    rec = {"metric": STAGES["async_fit"], "backend": backend,
+           "dispatch_ms": round(t * 1e3, 2),
+           "step_ms": round(per * 1e3, 2)}
+    rec.update(bench.measure_whole_fit(model, toas, per_step_s=per))
+    del jitted, args, step_fn, model, toas
+    try:
+        import bench_serve
+
+        srec = bench_serve.run(nreq=32, repeats=2)
+        rec["serve_pipelined_vs_sync"] = (
+            srec.get("dispatch_overhead") or {}).get(
+            "pipelined_vs_sync")
+        rec["serve_speedup"] = srec.get("value")
+    except Exception as e:  # the whole-fit number must survive a
+        rec["serve_error"] = repr(e)  # serve-half failure
     bench.tpu_record_append(rec)
     print(json.dumps(rec), flush=True)
 
@@ -268,6 +304,8 @@ def run_stage(name, backend):
     t0 = time.perf_counter()
     if name == "north_star":
         stage_north_star(backend)
+    elif name == "async_fit":
+        stage_async_fit(backend)
     elif name == "scan":
         stage_scan(backend)
     elif name == "attr":
