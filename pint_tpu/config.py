@@ -26,7 +26,9 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "breaker_cooldown_s", "breaker_probe_timeout_s",
            "donation_enabled", "whole_fit_enabled",
            "serve_bucket_edges", "serve_window_s", "serve_max_batch",
-           "serve_queue_cap", "serve_pipeline_depth"]
+           "serve_queue_cap", "serve_pipeline_depth",
+           "tenant_qps", "tenant_burst", "shed_policy", "aot_dir",
+           "journal_path", "serve_drain_timeout_s"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -437,6 +439,89 @@ def serve_queue_cap() -> int:
     ServeOverload (backpressure). $PINT_TPU_SERVE_QUEUE_CAP."""
     return max(1, int(_env_number("PINT_TPU_SERVE_QUEUE_CAP", 4096,
                                   cast=int)))
+
+
+def tenant_qps() -> float:
+    """Per-tenant admission rate for the serve layer's token-bucket
+    quotas [requests/s] ($PINT_TPU_TENANT_QPS). 0 (the default)
+    disables quota enforcement entirely — a single-tenant deployment
+    pays no bookkeeping. Each tenant's bucket refills at this rate up
+    to ``tenant_burst()`` tokens; a drained bucket sheds the submit
+    with ``TenantOverQuota`` (labeled in the admission counters,
+    never a silent drop)."""
+    return max(0.0, float(_env_number("PINT_TPU_TENANT_QPS", 0.0)))
+
+
+def tenant_burst() -> float:
+    """Token-bucket capacity per tenant ($PINT_TPU_TENANT_BURST):
+    how large a burst a tenant may land instantaneously before the
+    refill rate (``tenant_qps``) gates it. Default: 2x the rate
+    (>= 1), the classic burst allowance."""
+    qps = tenant_qps()
+    return max(1.0, float(_env_number("PINT_TPU_TENANT_BURST",
+                                      max(1.0, 2.0 * qps))))
+
+
+def shed_policy() -> str:
+    """Load-shedding policy when the admission queue is at capacity
+    ($PINT_TPU_SHED_POLICY):
+
+    - "deadline" (default): deadline-aware — shed a QUEUED request
+      that will miss its deadline anyway (its remaining budget is
+      smaller than the router-predicted wait), admitting the
+      newcomer in its place; a newcomer that cannot make its own
+      deadline is shed instead; only when nobody is provably doomed
+      does the submit fall back to plain backpressure rejection.
+      Never sheds a request that can still make it.
+    - "reject": classic backpressure — the newcomer is rejected with
+      ServeOverload, queued requests are never touched.
+    """
+    v = os.environ.get("PINT_TPU_SHED_POLICY", "deadline").lower()
+    if v not in ("deadline", "reject"):
+        if ("PINT_TPU_SHED_POLICY", v) not in _WARNED_ENV:
+            _WARNED_ENV.add(("PINT_TPU_SHED_POLICY", v))
+            from pint_tpu.logging import log
+
+            log.warning("unknown $PINT_TPU_SHED_POLICY=%r; using "
+                        "'deadline'", v)
+        return "deadline"
+    return v
+
+
+def aot_dir():
+    """Directory for AOT-exported serve bucket executables
+    ($PINT_TPU_AOT_DIR; None = disabled). A ServeEngine given this
+    dir exports every shape class it compiles (jax.export StableHLO
+    artifacts + a manifest) and a fresh engine restores them at
+    construction, so a process restart serves its first bucketed
+    request without re-tracing or re-compiling the serve kernels
+    (the XLA binary compile of a restored module is paid at RESTORE
+    time, seeded by the feature-keyed persistent jit cache — never
+    on the first request)."""
+    d = os.environ.get("PINT_TPU_AOT_DIR")
+    return d if d else None
+
+
+def journal_path():
+    """Append-only serve request journal ($PINT_TPU_JOURNAL; None =
+    disabled): every journalable admission is recorded before
+    dispatch and acknowledged on completion, so a cold restart can
+    replay exactly the unacknowledged entries
+    (``ServeEngine.replay``). The daemon (scripts/pint_serve) records
+    its raw JSONL request lines through the same machinery."""
+    p = os.environ.get("PINT_TPU_JOURNAL")
+    return p if p else None
+
+
+def serve_drain_timeout_s() -> float:
+    """Bound on the graceful-shutdown drain
+    ($PINT_TPU_SERVE_DRAIN_TIMEOUT_S, default 30 s): on SIGTERM the
+    engine keeps dispatching queued work until this deadline, then
+    sheds the remainder with an explicit labeled response per
+    request — a shutdown must never silently drop accepted work, and
+    must never hang forever either."""
+    return max(0.0, float(_env_number(
+        "PINT_TPU_SERVE_DRAIN_TIMEOUT_S", 30.0)))
 
 
 def serve_pipeline_depth() -> int:

@@ -37,7 +37,19 @@ from typing import List, Optional
 __all__ = ["Fault", "FaultPlan", "active_plan", "TransientFault",
            "FatalFault"]
 
-KINDS = ("hang", "error", "nan", "rtt_drift")
+KINDS = ("hang", "error", "nan", "rtt_drift",
+         # serving-lifecycle kinds (ISSUE 8), consumed by the serve
+         # layer rather than the dispatch supervisor: "overload"
+         # makes the admission controller treat capacity as
+         # exhausted for matching submits (forces the shed-policy
+         # path without needing a real million-user burst),
+         # "tenant_burst" drains the matching tenant's token bucket
+         # (a quota-exceeding tenant on demand), and "kill_restart"
+         # kills the engine at the drain boundary mid-burst — a
+         # simulated SIGKILL: in-flight futures die with the engine,
+         # journal entries stay unacknowledged, and the restart path
+         # (AOT restore + journal replay) is what recovers them.
+         "overload", "tenant_burst", "kill_restart")
 
 
 class TransientFault(RuntimeError):
@@ -55,7 +67,11 @@ class Fault:
     """One injection rule.
 
     match      substring of the dispatch key ("" matches every key)
-    kind       "hang" | "error" | "nan" | "rtt_drift"
+    kind       "hang" | "error" | "nan" | "rtt_drift" — dispatch
+               kinds, consumed by DispatchSupervisor.dispatch — or
+               "overload" | "tenant_burst" | "kill_restart" —
+               serving-lifecycle kinds, consumed by the serve
+               admission controller / scheduler (see KINDS above)
     after      skip this many matching dispatches first
     count      apply to at most this many dispatches (None: forever)
     seconds    hang duration (must exceed the configured deadline to
@@ -111,10 +127,21 @@ class FaultPlan:
         self.applied: List[tuple] = []   # (key, kind) log for asserts
         self._lock = threading.Lock()
 
-    def faults_for(self, key: str) -> List[Fault]:
-        """The rules firing on this dispatch (counters advanced)."""
+    def faults_for(self, key: str,
+                   kinds: Optional[tuple] = None) -> List[Fault]:
+        """The rules firing on this dispatch (counters advanced).
+
+        ``kinds`` scopes the lookup: only rules of those kinds are
+        tested (and have their deterministic counters advanced).
+        The dispatch supervisor and the serve admission/drain layers
+        consume DIFFERENT kinds at DIFFERENT choke points — without
+        the scope, an admission check would advance a hang rule's
+        ``after`` counter and silently shift which dispatch it fires
+        on."""
         with self._lock:
-            hits = [f for f in self.rules if f.applies(key)]
+            rules = self.rules if kinds is None else \
+                [f for f in self.rules if f.kind in kinds]
+            hits = [f for f in rules if f.applies(key)]
             for f in hits:
                 self.applied.append((key, f.kind))
             return hits

@@ -84,6 +84,12 @@ _DEADLINE_FLOOR_MS = 1000.0
 # RTT guess when the backend is an accelerator and nothing has been
 # measured yet: the tunnel's measured ceiling (round 4)
 _RTT_FALLBACK_MS = 250.0
+# the fault kinds the SUPERVISOR consumes at the dispatch boundary —
+# serving-lifecycle kinds (overload/tenant_burst/kill_restart) are
+# consumed by the serve layer at its own choke points and must not
+# have their deterministic counters advanced by dispatch lookups
+_DISPATCH_FAULT_KINDS = ("hang", "error", "nan", "rtt_drift")
+
 # drift window: observed wall within [1/2x, 2x] of prediction is fine
 _DRIFT_FACTOR = 2.0
 # predictions below this are noise on any backend — no drift verdicts
@@ -287,7 +293,9 @@ class DispatchSupervisor:
             if _plan_hits is not None:
                 hits, _plan_hits = _plan_hits, None
             else:
-                hits = plan.faults_for(key) if plan is not None else []
+                hits = plan.faults_for(
+                    key, kinds=_DISPATCH_FAULT_KINDS) \
+                    if plan is not None else []
             pre_sleep = sum(f.seconds for f in hits
                             if f.kind == "hang")
             nan = any(f.kind == "nan" for f in hits)
@@ -350,8 +358,12 @@ class DispatchSupervisor:
             # no drift verdict on the first call per key: its wall
             # includes the compile the deadline logic itself budgets
             # a separate allowance for — it would read as "drift" on
-            # every cold executable
-            if not first_call:
+            # every cold executable. Pinned (host-CPU) walls carry no
+            # information about the ACCELERATOR backend's RTT either
+            # (the serve capacity router deliberately runs host-pool
+            # dispatches pinned): feeding them to the drift model
+            # would read every fast host solve as an under-run.
+            if not first_call and not pinned:
                 self._note_wall(key, steps, wall * drift, backend,
                                 depth=depth)
             return out
@@ -379,7 +391,8 @@ class DispatchSupervisor:
         caller thread, so deterministic injection follows issue
         order."""
         plan = faults.active_plan()
-        plan_hits = plan.faults_for(key) if plan is not None else []
+        plan_hits = plan.faults_for(key, kinds=_DISPATCH_FAULT_KINDS) \
+            if plan is not None else []
         with self._inflight_lock:
             self._inflight += 1
             depth = self._inflight
@@ -411,6 +424,26 @@ class DispatchSupervisor:
         """Async dispatches issued and not yet completed."""
         with self._inflight_lock:
             return self._inflight
+
+    def pool_health(self) -> dict:
+        """Capacity-pool health surface for the serve router (ISSUE
+        8): the device pool's breaker state + in-flight depth, and
+        the host pool (always available — the local host cannot
+        wedge like the tunnel; its 'breaker' is definitionally
+        closed). Read-only: consulting this never probes the
+        backend, so it is safe to call per routing decision."""
+        import jax
+
+        backend = jax.default_backend()
+        return {
+            "device": {
+                "backend": backend,
+                "breaker": breaker_for(backend).snapshot(),
+                "open": breaker_for(backend).is_open,
+                "inflight": self.inflight,
+            },
+            "host": {"backend": "cpu", "open": False},
+        }
 
     def note_failover(self, key: str, exc: BaseException):
         """Record a failover performed by the CALL SITE (the device

@@ -5,7 +5,8 @@ The demo surface of ``pint_tpu.serve``: each input line is one
 request; the threaded ServeEngine coalesces whatever arrives within
 the window into padded vmapped dispatches. Request forms:
 
-    {"kind": "fit_step",  "par": P, "tim": T, "id": ..., "deadline_ms": ...}
+    {"kind": "fit_step",  "par": P, "tim": T, "id": ..., "deadline_ms": ...,
+     "tenant": ...}
     {"kind": "residuals", "par": P, "tim": T, ...}
     {"kind": "phase", "par": P, "mjds": [...], "obs": "@",
      "seg_min": 60.0, ...}
@@ -17,19 +18,176 @@ covering the requested MJDs, then split the MJDs per segment into
 PhasePredictRequests. ``--demo N`` synthesizes an N-request
 mixed-shape workload instead of reading stdin.
 
+Lifecycle (ISSUE 8):
+
+- **graceful shutdown**: SIGTERM/SIGINT stops the stdin read, drains
+  the engine with a bounded timeout (``--drain-timeout-s`` /
+  ``$PINT_TPU_SERVE_DRAIN_TIMEOUT_S``), and every request still
+  queued at the bound gets an explicit
+  ``{"status": "shed", "reason": "shutdown"}`` result line — queued
+  work is never silently dropped on the floor;
+- **crash-safe journal** (``--journal`` / ``$PINT_TPU_JOURNAL``):
+  each input record is journaled at admission and acknowledged when
+  its last result line is emitted (graceful sheds ack terminally as
+  ``shed:shutdown`` — the client was told). On startup,
+  unacknowledged records from a previous crash are REPLAYED before
+  stdin is read;
+- **AOT warm restart** (``--aot-dir`` / ``$PINT_TPU_AOT_DIR``): the
+  engine exports each compiled shape class and a restarted daemon
+  restores+primes them, serving its first request without
+  recompiling the serve kernels.
+
 One JSON result line per request (input order NOT guaranteed — lines
 carry the request id); the final line is the engine metrics snapshot
-({"metric": "serve_session", ...}).
+({"metric": "serve_session", ...}) whose ``admission``/``router``/
+``restart`` blocks label every shed, reroute and replay.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import signal
 import sys
 import threading
+import uuid
 
 __all__ = ["main"]
+
+
+class _Shutdown(Exception):
+    """Raised into the main thread by the SIGTERM/SIGINT handler to
+    break the blocking stdin read."""
+
+
+def _install_signal_handlers():
+    """Route SIGTERM/SIGINT into the graceful-shutdown path. Returns
+    the previous handlers so an embedding process (or a test driving
+    main() directly) can restore them."""
+    def handler(signum, frame):
+        raise _Shutdown(signal.Signals(signum).name)
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig, handler)
+        except (ValueError, OSError):
+            pass  # not the main thread (tests drive main() directly)
+    return prev
+
+
+def _restore_signal_handlers(prev):
+    for sig, h in (prev or {}).items():
+        try:
+            signal.signal(sig, h)
+        except (ValueError, OSError):
+            pass
+
+
+def _ignore_signals():
+    """Once the graceful shutdown has begun, further SIGTERM/SIGINT
+    must not abort the bounded drain mid-way — the shed lines and
+    the final session snapshot are the shutdown contract."""
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, signal.SIG_IGN)
+        except (ValueError, OSError):
+            pass
+
+
+def _shed_pending_stdin(stream=None) -> int:
+    """Shed input lines already written when shutdown arrives DURING
+    STARTUP (no engine yet): each pending JSONL record gets the same
+    explicit ``{"status": "shed", "reason": "shutdown"}`` line the
+    bounded drain emits — an early signal must not silently drop a
+    client's work either. Bounded by construction: only what is
+    already buffered on the pipe is drained (select with a 50 ms
+    grace per read, EOF stops)."""
+    import select
+
+    shed = 0
+
+    def shed_line(line):
+        nonlocal shed
+        line = line.strip()
+        if not line or line.startswith("#"):
+            return
+        try:
+            rid = json.loads(line).get("id")
+        except Exception:
+            rid = None
+        obj = {"status": "shed", "reason": "shutdown"}
+        if rid is not None:
+            obj["id"] = rid
+        print(json.dumps(obj), flush=True)
+        shed += 1
+
+    if stream is not None:          # tests drive main(stdin=[...])
+        for line in stream:
+            shed_line(line)
+        return shed
+    try:
+        while select.select([sys.stdin], [], [], 0.05)[0]:
+            line = sys.stdin.readline()
+            if not line:
+                break
+            shed_line(line)
+    except (OSError, ValueError):
+        pass                        # stdin closed / not selectable
+    return shed
+
+
+class _LineAck:
+    """Journal acknowledgement for ONE input record: a record may fan
+    out into several engine requests (phase segments); the terminal
+    ack is written when the LAST of them has emitted its result
+    line. Thread-safe — emissions arrive from the drain thread while
+    the expected count is still being established on the reader
+    thread."""
+
+    def __init__(self, journal, rid):
+        self.journal = journal
+        self.rid = rid
+        self._lock = threading.Lock()
+        self._expected = None
+        self._emitted = 0
+        self._acked = False
+        self._worst = "served"
+
+    def emitted(self, status: str = "served"):
+        with self._lock:
+            self._emitted += 1
+            if status != "served":
+                self._worst = status
+            self._maybe_ack()
+
+    def expect(self, n: int):
+        with self._lock:
+            self._expected = n
+            self._maybe_ack()
+
+    def _maybe_ack(self):
+        if self._acked or self.journal is None:
+            return
+        if self._expected is not None and \
+                self._emitted >= self._expected:
+            self._acked = True
+            # zero submissions = nothing was served (the error went
+            # through the uncounted report path): terminal "failed",
+            # never a fabricated "served"
+            self.journal.ack(self.rid, self._worst
+                             if self._expected > 0 else "failed")
+
+    def fail(self):
+        """Terminal "failed" ack for a record whose submission path
+        raised — without this a journaled record that can never
+        submit (a deleted par file, say) would be REPLAYED on every
+        restart forever."""
+        with self._lock:
+            if self._acked or self.journal is None:
+                return
+            self._acked = True
+            self.journal.ack(self.rid, "failed")
 
 
 def _load_pair(cache, par, tim):
@@ -62,7 +220,7 @@ def _polycos_for(cache, par, obs, mjd_lo, mjd_hi, seg_min):
     return cache[key]
 
 
-def _submit_line(engine, cache, rec, emit, report):
+def _submit_line(engine, cache, rec, emit, report, ack=None):
     """Parse one request record and submit it; wire result emission
     through the future's done-callback so the daemon never blocks on
     a single request. Returns the number of requests actually
@@ -75,10 +233,12 @@ def _submit_line(engine, cache, rec, emit, report):
         FitStepRequest,
         PhasePredictRequest,
         ResidualsRequest,
+        ShutdownShed,
     )
 
     rid = rec.get("id")
     kind = rec.get("kind", "fit_step")
+    tenant = rec.get("tenant")
     deadline_s = rec["deadline_ms"] / 1e3 \
         if rec.get("deadline_ms") is not None else None
 
@@ -87,9 +247,16 @@ def _submit_line(engine, cache, rec, emit, report):
             out = {"id": rid, "kind": kind}
             try:
                 res = fut.result(timeout=0)
+            except ShutdownShed:
+                # the graceful-shutdown contract: an explicit shed
+                # line per unserved request, never a silent drop
+                out.update(ok=False, status="shed",
+                           reason="shutdown")
+                emit(out, status="shed:shutdown")
+                return
             except Exception as e:
                 out.update(ok=False, error=f"{type(e).__name__}: {e}")
-                emit(out)
+                emit(out, status="failed")
                 return
             out["ok"] = True
             if kind == "fit_step":
@@ -111,8 +278,11 @@ def _submit_line(engine, cache, rec, emit, report):
     if kind in ("fit_step", "residuals"):
         model, toas = _load_pair(cache, rec["par"], rec["tim"])
         cls = FitStepRequest if kind == "fit_step" else ResidualsRequest
-        fut = engine.submit(cls(toas, model, deadline_s=deadline_s))
+        fut = engine.submit(cls(toas, model, deadline_s=deadline_s,
+                                tenant=tenant))
         fut.add_done_callback(finish(kind))
+        if ack is not None:
+            ack.expect(1)
         return 1
     if kind == "phase":
         mjds = np.atleast_1d(np.asarray(rec["mjds"], np.float64))
@@ -128,7 +298,7 @@ def _submit_line(engine, cache, rec, emit, report):
             try:
                 fut = engine.submit(PhasePredictRequest(
                     pcs.entries[int(s)], mjds[idx == s],
-                    deadline_s=deadline_s))
+                    deadline_s=deadline_s, tenant=tenant))
             except Exception as e:
                 # PARTIAL submit (PR-3 review bug): the segments
                 # already admitted WILL emit and release the pending
@@ -146,6 +316,8 @@ def _submit_line(engine, cache, rec, emit, report):
                 break
             fut.add_done_callback(finish("phase"))
             nsub += 1
+        if ack is not None:
+            ack.expect(nsub)
         return nsub
     raise ValueError(f"unknown request kind {kind!r}")
 
@@ -163,11 +335,11 @@ def _demo_requests(n: int):
                           entry_name="DEMO")()
 
 
-def main(argv=None) -> int:
+def main(argv=None, stdin=None) -> int:
     p = argparse.ArgumentParser(
         prog="pint_serve",
-        description="JSONL serving daemon over the coalescing "
-                    "batch scheduler (pint_tpu.serve)")
+        description="JSONL serving daemon over the continuous-"
+                    "batching scheduler (pint_tpu.serve)")
     p.add_argument("--window-ms", type=float, default=None,
                    help="coalescing window (default "
                         "$PINT_TPU_SERVE_WINDOW_MS or 5)")
@@ -176,24 +348,54 @@ def main(argv=None) -> int:
     p.add_argument("--demo", type=int, default=None, metavar="N",
                    help="serve N synthesized mixed requests instead "
                         "of reading stdin")
+    p.add_argument("--journal", default=None,
+                   help="append-only request journal (crash replay; "
+                        "default $PINT_TPU_JOURNAL)")
+    p.add_argument("--aot-dir", default=None,
+                   help="AOT executable dir for warm restart "
+                        "(default $PINT_TPU_AOT_DIR)")
+    p.add_argument("--drain-timeout-s", type=float, default=None,
+                   help="graceful-shutdown drain bound (default "
+                        "$PINT_TPU_SERVE_DRAIN_TIMEOUT_S or 30)")
     args = p.parse_args(argv)
 
-    from pint_tpu.config import enable_user_compile_cache
+    # handlers BEFORE the pint_tpu/jax import: startup takes seconds
+    # (jax init, AOT restore), and a signal landing in that window
+    # used to hit the default handler — process killed, lines already
+    # written to stdin silently dropped
+    prev_handlers = _install_signal_handlers()
+    try:
+        from pint_tpu.config import (
+            enable_user_compile_cache,
+            serve_drain_timeout_s,
+        )
 
-    enable_user_compile_cache()
+        enable_user_compile_cache()
+        drain_timeout = serve_drain_timeout_s() \
+            if args.drain_timeout_s is None else args.drain_timeout_s
 
-    from pint_tpu.serve import ServeEngine
+        from pint_tpu.serve import ServeEngine
 
-    engine = ServeEngine(
-        window_s=None if args.window_ms is None
-        else args.window_ms / 1e3,
-        max_batch=args.max_batch, queue_cap=args.queue_cap)
+        engine = ServeEngine(
+            window_s=None if args.window_ms is None
+            else args.window_ms / 1e3,
+            max_batch=args.max_batch, queue_cap=args.queue_cap,
+            aot_dir=args.aot_dir, journal=args.journal)
+    except _Shutdown as sig:
+        _ignore_signals()
+        shed = 0 if args.demo is not None else \
+            _shed_pending_stdin(stdin)
+        print(json.dumps({"event": "shutdown", "signal": str(sig),
+                          "during": "startup", "shed": shed}),
+              flush=True)
+        _restore_signal_handlers(prev_handlers)
+        return 0
 
     out_lock = threading.Lock()
     pending = threading.Semaphore(0)
     nsub = 0
 
-    def emit(obj):
+    def raw_emit(obj):
         with out_lock:
             print(json.dumps(obj), flush=True)
         pending.release()
@@ -205,54 +407,143 @@ def main(argv=None) -> int:
         with out_lock:
             print(json.dumps(obj), flush=True)
 
+    shutdown_reason = None
     if args.demo is not None:
         from pint_tpu.serve import ServeOverload
 
         reqs = _demo_requests(args.demo)
         engine.start()
-        for kind, rq in reqs:
-            try:
-                fut = engine.submit(rq)
-            except ServeOverload as e:
-                # PR-3 review bug: backpressure during the demo burst
-                # crashed the daemon instead of shedding the request
-                report({"kind": kind, "ok": False, "error": repr(e)})
-                continue
-
-            def cb(fut, kind=kind):
+        try:
+            for kind, rq in reqs:
                 try:
-                    fut.result(timeout=0)
-                    emit({"kind": kind, "ok": True})
-                except Exception as e:
-                    emit({"kind": kind, "ok": False, "error": repr(e)})
-            fut.add_done_callback(cb)
-            nsub += 1
+                    fut = engine.submit(rq)
+                except ServeOverload as e:
+                    # PR-3 review bug: backpressure during the demo
+                    # burst crashed the daemon instead of shedding
+                    report({"kind": kind, "ok": False,
+                            "error": repr(e)})
+                    continue
+
+                def cb(fut, kind=kind):
+                    try:
+                        fut.result(timeout=0)
+                        raw_emit({"kind": kind, "ok": True})
+                    except Exception as e:
+                        raw_emit({"kind": kind, "ok": False,
+                                  "error": repr(e)})
+                fut.add_done_callback(cb)
+                nsub += 1
+        except _Shutdown as sig:
+            shutdown_reason = str(sig)
+            _ignore_signals()
+            report({"event": "shutdown", "signal": shutdown_reason,
+                    "drain_timeout_s": drain_timeout})
     else:
         engine.start()
         cache: dict = {}
-        for line in sys.stdin:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            try:
-                rec = json.loads(line)
-                nsub += _submit_line(engine, cache, rec, emit,
-                                     report)
-            except Exception as e:
-                # malformed line (or a zero-submission overload):
-                # report through the uncounted path
-                report({"ok": False,
-                        "error": f"{type(e).__name__}: {e}",
-                        "line": line[:200]})
 
-    engine.stop(drain=True)
+        def handle(rec):
+            nonlocal nsub
+            rid = rec.get("id") or uuid.uuid4().hex
+            ack = _LineAck(engine.journal, rid)
+            if engine.journal is not None:
+                engine.journal.admit(rid, rec,
+                                     tenant=rec.get("tenant"))
+
+            def emit(obj, status="served", _ack=ack):
+                raw_emit(obj)
+                _ack.emitted(status)
+
+            try:
+                nsub += _submit_line(engine, cache, rec, emit,
+                                     report, ack=ack)
+            except _Shutdown:
+                # NOT the record's fault: leave it UNACKED so the
+                # journal replays it on restart (a terminal 'failed'
+                # ack here would silently drop it — the record was
+                # mid-submit when the signal landed). Without a
+                # journal nothing will replay it, so the client gets
+                # an explicit shed line instead.
+                if engine.journal is None:
+                    report({"id": rid, "status": "shed",
+                            "reason": "shutdown"})
+                raise
+            except BaseException:
+                ack.fail()  # terminal: never replay a poison record
+                raise
+
+        def replay_journal():
+            """Re-admit the records a previous process died holding
+            (no terminal ack in the journal). Runs BEFORE stdin so
+            recovered work is first in line."""
+            nonlocal nsub
+            if engine.journal is None:
+                return
+            for jrec in engine.journal.unacknowledged():
+                rec = jrec.get("payload") or {}
+                engine.journal.ack(jrec["rid"], "replayed")
+                ack = _LineAck(engine.journal, jrec["rid"])
+
+                def emit(obj, status="served", _ack=ack):
+                    raw_emit(obj)
+                    _ack.emitted(status)
+
+                try:
+                    n = _submit_line(engine, cache, rec, emit,
+                                     report, ack=ack)
+                    nsub += n
+                    engine.metrics.restart_info["replayed"] = \
+                        engine.metrics.restart_info.get(
+                            "replayed", 0) + n
+                except _Shutdown:
+                    raise  # leave unacked: replayable next start
+                except Exception as e:
+                    ack.fail()  # terminal: no infinite replay loop
+                    report({"id": jrec.get("rid"), "ok": False,
+                            "error": f"replay: "
+                                     f"{type(e).__name__}: {e}"})
+
+        try:
+            replay_journal()
+            for line in (sys.stdin if stdin is None else stdin):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    rec = json.loads(line)
+                    handle(rec)
+                except _Shutdown:
+                    raise
+                except Exception as e:
+                    # malformed line (or a zero-submission overload):
+                    # report through the uncounted path
+                    report({"ok": False,
+                            "error": f"{type(e).__name__}: {e}",
+                            "line": line[:200]})
+        except _Shutdown as sig:
+            shutdown_reason = str(sig)
+            # a SECOND signal must not abort the bounded drain —
+            # the shed lines + snapshot below are the contract
+            _ignore_signals()
+            report({"event": "shutdown", "signal": shutdown_reason,
+                    "drain_timeout_s": drain_timeout})
+
+    # graceful stop: bounded drain, then every still-queued request
+    # is shed with a labeled ShutdownShed (emitted above as
+    # {"status": "shed", "reason": "shutdown"}); unbounded only when
+    # no signal asked us to leave
+    engine.stop(drain=True,
+                timeout=drain_timeout if shutdown_reason else None)
     for _ in range(nsub):
         pending.acquire()
     snap = engine.metrics.snapshot()
     snap["metric"] = "serve_session"
+    if shutdown_reason:
+        snap["shutdown_signal"] = shutdown_reason
     with out_lock:
         print(json.dumps(snap), flush=True)
     print(engine.metrics.report(), file=sys.stderr)
+    _restore_signal_handlers(prev_handlers)
     return 0
 
 

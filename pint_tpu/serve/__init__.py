@@ -15,7 +15,18 @@ half of that is here. The moving parts (one module each):
   engine's runtime dispatch-supervisor counters — timeouts,
   failovers, breaker state — so degraded serving is labeled);
 - ``serve.workload``: the ONE synthetic mixed-shape workload
-  builder shared by bench_serve.py and the demo daemon.
+  builder shared by bench_serve.py and the demo daemon;
+- ``serve.admission`` (ISSUE 8): per-tenant token-bucket quotas,
+  deadline-aware load shedding, in-queue deadline expiry — every
+  shed labeled;
+- ``serve.router`` (ISSUE 8): breaker-aware capacity routing over
+  host CPU + accelerator as CONCURRENT pools with learned service
+  rates (an open breaker demotes the device pool, it does not stop
+  the world);
+- ``serve.journal`` (ISSUE 8): crash-safe restart — append-only
+  request journal with replay, jax.export AOT bucket executables
+  (warm restart serves its first request with zero new compiles),
+  serve-state snapshot.
 
 Every device dispatch routes through the engine's
 ``pint_tpu.runtime.DispatchSupervisor`` (watchdog deadline, circuit
@@ -28,6 +39,7 @@ Entry points: ``scripts/pint_serve.py`` (stdin JSONL daemon) and
 
 from pint_tpu.serve.request import (  # noqa: F401
     DeadlineExceeded,
+    EngineKilled,
     FitStepRequest,
     FitStepResult,
     PhasePredictRequest,
@@ -36,6 +48,8 @@ from pint_tpu.serve.request import (  # noqa: F401
     ResidualsResult,
     ServeFuture,
     ServeOverload,
+    ShutdownShed,
+    TenantOverQuota,
 )
 from pint_tpu.serve.scheduler import (  # noqa: F401
     ServeEngine,
@@ -46,4 +60,13 @@ from pint_tpu.serve.bucket import (  # noqa: F401
     ExecutableCache,
     bucket_for,
     pow2_ceil,
+)
+from pint_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    TokenBucket,
+)
+from pint_tpu.serve.router import CapacityRouter  # noqa: F401
+from pint_tpu.serve.journal import (  # noqa: F401
+    AotStore,
+    RequestJournal,
 )

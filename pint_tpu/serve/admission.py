@@ -1,0 +1,215 @@
+"""Admission control: per-tenant token-bucket quotas + deadline-aware
+load shedding + in-queue deadline expiry.
+
+The serve layer's overload story before ISSUE 8 was binary: the queue
+cap rejected everything past capacity and deadlines were only checked
+at drain time — a million-user burst either hard-failed or silently
+aged requests past their deadlines while they sat queued. This module
+makes every shed decision EXPLICIT, LABELED, and policy-driven:
+
+- **per-tenant token buckets** (``config.tenant_qps`` /
+  ``$PINT_TPU_TENANT_QPS``, burst ``$PINT_TPU_TENANT_BURST``): each
+  tenant refills at the configured rate; a drained bucket sheds with
+  ``TenantOverQuota`` without touching shared capacity — one bursting
+  tenant cannot starve the rest. Rate 0 (default) disables the
+  bookkeeping entirely.
+- **deadline-aware shedding** (``config.shed_policy``,
+  ``$PINT_TPU_SHED_POLICY``): at capacity, shed the request that will
+  miss its deadline ANYWAY — a queued request whose remaining budget
+  is smaller than the router-predicted wait (or the newcomer itself,
+  by the same test) — and never one that can still make it. Only when
+  nobody is provably doomed does the submit degrade to plain
+  backpressure rejection ("reject" restores the pre-ISSUE-8
+  behavior unconditionally).
+- **in-queue expiry** (the ``shed_expired`` counter): requests whose
+  deadline passes while still queued are failed with
+  ``DeadlineExceeded`` at the next admission or drain touch, not
+  discovered dispatch-time after the batch already padded around
+  them.
+
+Fault hooks (``runtime.faults``, new kinds): an active plan's
+``overload`` rule makes matching admissions see exhausted capacity
+(exercising the shed policy without a real burst); ``tenant_burst``
+drains the matching tenant's bucket on demand. Both are consumed
+HERE, at admission — the dispatch supervisor never sees them.
+
+Counters live on the controller and are embedded in
+``ServeMetrics.snapshot()`` as the ``admission`` block — a shed
+request is always visible in the artifact, never a silent drop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from pint_tpu.runtime import faults
+
+__all__ = ["TokenBucket", "AdmissionController"]
+
+
+class TokenBucket:
+    """The classic token bucket: ``rate`` tokens/s refill up to
+    ``burst`` capacity; ``take`` consumes one if available. Time is
+    injected (monotonic seconds) so tests are deterministic."""
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self._last = None  # first take() anchors the clock
+
+    def take(self, now: float) -> bool:
+        if self._last is None:
+            self._last = now
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
+
+    def drain(self):
+        """Empty the bucket (the ``tenant_burst`` fault hook)."""
+        self.tokens = 0.0
+
+
+class AdmissionController:
+    """Admission policy + shed accounting for one ServeEngine.
+
+    The engine calls ``check_quota`` before classifying (a
+    quota-shed request must not pay GLS assembly), and
+    ``shed_decision`` when the queue is at capacity. Thread-safe: the
+    engine may call from its submit path and its drain loop
+    concurrently."""
+
+    def __init__(self, tenant_qps: Optional[float] = None,
+                 tenant_burst: Optional[float] = None,
+                 policy: Optional[str] = None):
+        from pint_tpu import config
+
+        self.tenant_qps = config.tenant_qps() \
+            if tenant_qps is None else max(0.0, float(tenant_qps))
+        self.tenant_burst = (config.tenant_burst()
+                             if tenant_burst is None
+                             else max(1.0, float(tenant_burst)))
+        self.policy = config.shed_policy() if policy is None \
+            else str(policy)
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        # shed accounting (the admission block of the metrics
+        # snapshot): every decision that drops a request lands in
+        # exactly one of these
+        self.shed_expired = 0    # deadline passed while queued
+        self.shed_deadline = 0   # deadline-aware policy shed (doomed)
+        self.shed_quota = 0      # tenant token bucket drained
+        self.shed_overload = 0   # plain backpressure rejection
+        self.shed_shutdown = 0   # bounded drain timeout at shutdown
+        self.injected_overload = 0  # fault-plan overload rules fired
+        self.tenants: Dict[str, dict] = {}
+
+    # -- per-tenant quotas ---------------------------------------------
+
+    def _tenant(self, name: Optional[str]) -> dict:
+        t = self.tenants.setdefault(name or "default",
+                                    {"admitted": 0, "shed": 0})
+        return t
+
+    def check_quota(self, tenant: Optional[str],
+                    now: Optional[float] = None) -> bool:
+        """True = within quota (token consumed). Also consumes the
+        fault plan's ``tenant_burst`` rules: a matching rule drains
+        the tenant's bucket first, so the NEXT take fails
+        deterministically."""
+        name = tenant or "default"
+        plan = faults.active_plan()
+        burst_hit = False
+        if plan is not None:
+            burst_hit = bool(plan.faults_for(
+                f"serve.admit/{name}", kinds=("tenant_burst",)))
+        if self.tenant_qps <= 0.0 and not burst_hit:
+            return True
+        with self._lock:
+            b = self._buckets.get(name)
+            if b is None:
+                b = self._buckets[name] = TokenBucket(
+                    max(self.tenant_qps, 0.0), self.tenant_burst)
+            if burst_hit:
+                b.drain()
+            ok = b.take(time.monotonic() if now is None else now)
+            t = self._tenant(name)
+            if ok:
+                t["admitted"] += 1
+            else:
+                t["shed"] += 1
+                self.shed_quota += 1
+            return ok
+
+    # -- capacity / shedding -------------------------------------------
+
+    def capacity_exhausted(self, queued: int, cap: int) -> bool:
+        """Queue-full test, including the fault plan's ``overload``
+        rules (an injected overload makes THIS admission see a full
+        queue regardless of the real depth)."""
+        plan = faults.active_plan()
+        if plan is not None and plan.faults_for(
+                "serve.admit/capacity", kinds=("overload",)):
+            self.injected_overload += 1
+            return True
+        return queued >= cap
+
+    def shed_decision(self, newcomer, queued_waits,
+                      newcomer_wait_s: float, now: float):
+        """At-capacity policy decision. Returns one of
+
+        - ``("victim", req)``: shed the queued ``req`` — it cannot
+          make its deadline anyway — and admit the newcomer;
+        - ``("newcomer", None)``: the newcomer itself cannot make its
+          deadline; shed it (its future is failed, nothing raised);
+        - ``("reject", None)``: nobody is provably doomed —
+          backpressure-reject the newcomer (``ServeOverload``).
+
+        ``queued_waits`` is ``[(req, predicted_wait_s)]`` with each
+        wait computed POSITION-AWARE by the engine (only rows ahead
+        of the candidate count — one prefix-sum pass, so the
+        at-capacity decision stays O(n) under the engine lock);
+        ``newcomer_wait_s`` is the same estimate for the newcomer.
+        "Doomed" = remaining deadline budget < predicted wait. The
+        policy NEVER sheds a request that can still make its
+        deadline."""
+        if self.policy == "reject":
+            return ("reject", None)
+        for r, wait in queued_waits:
+            if r.expires_at is None:
+                continue
+            if r.expires_at - now < wait:
+                return ("victim", r)
+        if newcomer.deadline_s is not None and \
+                float(newcomer.deadline_s) < newcomer_wait_s:
+            return ("newcomer", None)
+        return ("reject", None)
+
+    # -- reporting -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "tenant_qps": self.tenant_qps,
+                "shed_expired": self.shed_expired,
+                "shed_deadline": self.shed_deadline,
+                "shed_quota": self.shed_quota,
+                "shed_overload": self.shed_overload,
+                "shed_shutdown": self.shed_shutdown,
+                "injected_overload": self.injected_overload,
+                "tenants": {k: dict(v)
+                            for k, v in sorted(self.tenants.items())},
+            }
+
+    @property
+    def total_shed(self) -> int:
+        return (self.shed_expired + self.shed_deadline +
+                self.shed_quota + self.shed_overload +
+                self.shed_shutdown)

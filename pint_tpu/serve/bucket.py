@@ -118,7 +118,7 @@ class ExecutableCache:
     which is why each engine owns its wrappers)."""
 
     def __init__(self, mesh=None, axis: str = "pulsar",
-                 supervisor=None):
+                 supervisor=None, aot_dir=None):
         import jax
 
         from pint_tpu.config import donation_enabled
@@ -163,6 +163,19 @@ class ExecutableCache:
         # never hang a serve batch — only slow it down, labeled
         self.supervisor = supervisor or get_supervisor()
         self.keys: set = set()
+        # AOT warm restart (ISSUE 8): with an aot_dir, every shape
+        # class exports a jax.export artifact right after its first
+        # successful device dispatch, and a fresh engine restores +
+        # primes them at construction so its first request compiles
+        # nothing. Disabled under a mesh: exported modules carry no
+        # sharding annotations, and restoring one against sharded
+        # inputs would silently gather.
+        self.aot = None
+        if aot_dir and mesh is None:
+            from pint_tpu.serve.journal import AotStore
+
+            self.aot = AotStore(aot_dir, donation=self.donation)
+            self.aot.restore_all(supervisor=self.supervisor)
 
     @property
     def compile_count(self) -> int:
@@ -196,7 +209,9 @@ class ExecutableCache:
             out[k] = jax.device_put(v, sh)
         return out
 
-    def _issue(self, run, host, dispatch_key, class_key, sync: bool):
+    def _issue(self, run, host, dispatch_key, class_key, sync: bool,
+               pool: str = "device", info: Optional[dict] = None,
+               export_cb=None, restored: bool = False):
         """Shared issue/collect plumbing: ``sync`` runs the
         supervised dispatch inline (the classic drain); otherwise the
         dispatch is ISSUED on the supervisor's pipeline mode
@@ -204,12 +219,52 @@ class ExecutableCache:
         blocks on its DispatchFuture — batch k+1's device work then
         overlaps batch k's result read. The class key is recorded at
         collect time, only on a real (non-failed-over) device
-        dispatch."""
+        dispatch; ``export_cb`` (the AOT export of a freshly
+        compiled class) fires on the same condition.
+
+        ``pool`` is the capacity router's verdict: "host" runs the
+        numpy mirror as a PINNED supervised dispatch — hang-free by
+        construction, bypassing the device breaker entirely (a
+        routed host solve is planned capacity, not a failover).
+        ``info`` (when given) is filled with the pool that actually
+        produced the result, for the router's rate learning."""
+        if info is None:
+            info = {}
+        info.setdefault("pool", pool)
+
+        if pool == "host":
+            if sync:
+                def collect():
+                    out = self.supervisor.dispatch(
+                        host, key=dispatch_key, pinned=True)
+                    info["used_pool"] = "host"
+                    return out
+            else:
+                fut = self.supervisor.dispatch_async(
+                    host, key=dispatch_key, pinned=True)
+
+                def collect():
+                    out = fut.result()
+                    info["used_pool"] = "host"
+                    return out
+
+            return collect
+
         fell_over = []
 
         def host_counted():
             fell_over.append(True)
             return host()
+
+        def _record():
+            if fell_over:
+                info["used_pool"] = "host-failover"
+                return
+            info["used_pool"] = "device"
+            if not restored:
+                self.keys.add(class_key)
+                if export_cb is not None:
+                    export_cb()
 
         if sync:
             # LAZY: the dispatch runs inside collect, so the
@@ -219,8 +274,7 @@ class ExecutableCache:
             def collect():
                 out = self.supervisor.dispatch(
                     run, key=dispatch_key, fallback=host_counted)
-                if not fell_over:
-                    self.keys.add(class_key)
+                _record()
                 return out
         else:
             fut = self.supervisor.dispatch_async(
@@ -228,13 +282,13 @@ class ExecutableCache:
 
             def collect():
                 out = fut.result()
-                if not fell_over:
-                    self.keys.add(class_key)
+                _record()
                 return out
 
         return collect
 
-    def gls_begin(self, key, problems, shape, sync: bool = False):
+    def gls_begin(self, key, problems, shape, sync: bool = False,
+                  pool: str = "device", info: Optional[dict] = None):
         """Pad ``problems`` to the class shape (``parallel.pta``
         masking) and issue the batch as one SUPERVISED dispatch
         (runtime watchdog; host ``pta_solve_np`` failover). Returns a
@@ -243,16 +297,25 @@ class ExecutableCache:
         only on success, so a failed dispatch cannot inflate
         ``compile_count`` past the classes actually built — and a
         failed-over (host-solved) dispatch does not record one
-        either: no executable was built for it."""
+        either: no executable was built for it. ``pool="host"``
+        (the capacity router's demotion/steering verdict) runs the
+        numpy mirror as planned capacity instead."""
         stacked = stack_problems(problems, shape=shape)
+        restored = None
+        if pool == "device" and self.aot is not None:
+            restored = self.aot.get("gls", key)
 
         def run():
             # place + dispatch + host read on the guarded worker so
             # the deadline covers completion, not just enqueue; the
             # placed arrays are fresh per call, so the donated
-            # pvalid buffer is never observable afterwards
+            # pvalid buffer is never observable afterwards. A
+            # restored (AOT) class calls its deserialized executable
+            # instead of the jit wrapper — same program, zero
+            # in-process trace/compile.
             st = self._place(stacked)
-            out = self._gls(st["M"], st["F"], st["phi"], st["r"], st["nvec"], st["valid"], st["pvalid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            fn = restored if restored is not None else self._gls
+            out = fn(st["M"], st["F"], st["phi"], st["r"], st["nvec"], st["valid"], st["pvalid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
             hs = tuple(np.asarray(o) for o in out)
             if self.donation:
                 # OWNED arrays: dparams aliases the donated pvalid
@@ -264,9 +327,23 @@ class ExecutableCache:
                            for h in hs)
             return hs
 
+        export_cb = None
+        if self.aot is not None and restored is None and \
+                pool == "device" and not self.aot.has("gls", key):
+            import jax
+
+            avals = tuple(jax.ShapeDtypeStruct(stacked[n].shape,
+                                               stacked[n].dtype)
+                          for n in ("M", "F", "phi", "r", "nvec",
+                                    "valid", "pvalid"))
+            export_cb = lambda: self.aot.save(  # noqa: E731
+                "gls", key, self._gls, avals)
+
         return self._issue(
             run, lambda: pta_solve_np(stacked),
-            f"serve.gls/{'/'.join(str(x) for x in key)}", key, sync)
+            f"serve.gls/{'/'.join(str(x) for x in key)}", key, sync,
+            pool=pool, info=info, export_cb=export_cb,
+            restored=restored is not None)
 
     def gls(self, key, problems, shape):
         """Synchronous ``gls_begin`` + collect (the non-pipelined
@@ -274,12 +351,14 @@ class ExecutableCache:
         return self.gls_begin(key, problems, shape, sync=True)()
 
     def phase_begin(self, key, requests, nb: int, kb: int, Pb: int,
-                    sync: bool = False):
+                    sync: bool = False, pool: str = "device",
+                    info: Optional[dict] = None):
         """Pad phase requests to (Pb, nb) MJDs x kb coefficients and
         issue the batch as one supervised dispatch (host failover:
         per-entry ``PolycoEntry.abs_phase``; key recorded on a real
         device dispatch only, as in ``gls_begin``). Returns the
-        zero-arg ``collect``."""
+        zero-arg ``collect``. ``pool``/``info`` as in ``gls_begin``.
+        """
         coeffs = np.zeros((Pb, kb))
         tmid = np.zeros(Pb)
         rpi = np.zeros(Pb)
@@ -300,13 +379,18 @@ class ExecutableCache:
             mjds[k, len(m):] = e.tmid  # dt = 0 on padded slots
             valid[k, :len(m)] = 1.0
 
+        restored = None
+        if pool == "device" and self.aot is not None:
+            restored = self.aot.get("phase", key)
+
         def run():
             # placed arrays are fresh per call: the donated
             # mjds/valid buffers are never observable afterwards
             arrs = self._place({"coeffs": coeffs, "tmid": tmid,
                                 "rpi": rpi, "rpf": rpf, "f0": f0,
                                 "mjds": mjds, "valid": valid})
-            pi, pf = self._phase(arrs["coeffs"], arrs["tmid"], arrs["rpi"], arrs["rpf"], arrs["f0"], arrs["mjds"], arrs["valid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            fn = restored if restored is not None else self._phase
+            pi, pf = fn(arrs["coeffs"], arrs["tmid"], arrs["rpi"], arrs["rpf"], arrs["f0"], arrs["mjds"], arrs["valid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
             hi, hf = np.asarray(pi), np.asarray(pf)
             if self.donation:
                 # owned arrays: (pi, pf) alias the donated
@@ -325,9 +409,22 @@ class ExecutableCache:
                 pf[k, :n] = hf
             return pi, pf
 
+        export_cb = None
+        if self.aot is not None and restored is None and \
+                pool == "device" and not self.aot.has("phase", key):
+            import jax
+
+            avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                          for a in (coeffs, tmid, rpi, rpf, f0,
+                                    mjds, valid))
+            export_cb = lambda: self.aot.save(  # noqa: E731
+                "phase", key, self._phase, avals)
+
         return self._issue(
             run, host,
-            f"serve.phase/{'/'.join(str(x) for x in key)}", key, sync)
+            f"serve.phase/{'/'.join(str(x) for x in key)}", key, sync,
+            pool=pool, info=info, export_cb=export_cb,
+            restored=restored is not None)
 
     def phase(self, key, requests, nb: int, kb: int, Pb: int):
         """Synchronous ``phase_begin`` + collect."""

@@ -1,0 +1,398 @@
+"""Crash-safe restart: append-only request journal, AOT-exported
+bucket executables, serve-state snapshot.
+
+A process restart used to cost the full trace+compile+first-run of
+every shape class (~32 s measured for the bench mix) AND silently
+forgot every queued request. This module makes restart a first-class
+serving event:
+
+- ``RequestJournal``: an append-only JSONL journal. Every journalable
+  admission (a request carrying a ``payload`` — an opaque JSON-able
+  description the caller's replay factory can rebuild from) is
+  recorded BEFORE dispatch and acknowledged with a status label
+  (served / shed:* / failed) on completion; each line is flushed and
+  fsynced so a SIGKILL loses at most the line being written. A cold
+  restart reads the journal and replays exactly the entries without
+  an ack (``ServeEngine.replay``).
+- ``AotStore``: ``jax.export`` StableHLO artifacts of the engine's
+  bucket executables, one file per (kind, shape-class) keyed by a
+  manifest that records platform / jax version / x64 / donation —
+  artifacts from a foreign configuration are skipped, never
+  mis-served. Export happens right after a class's first successful
+  device dispatch (crash-safe: the artifact exists as soon as the
+  compile it replaces does); restore deserializes and PRIMES each
+  artifact at engine construction — the XLA binary compile of the
+  restored module (seeded by the feature-keyed persistent jit cache)
+  is paid at restore time, so the first served request compiles
+  NOTHING (Sanitizer ``_cache_size``-asserted in
+  tests/test_serve_restart.py). Priming runs through the dispatch
+  supervisor: restoring against a wedged backend degrades to a cold
+  engine instead of hanging init.
+- ``save_state``/``load_state``: the serve-state snapshot
+  (``state.json`` in the AOT dir): metrics snapshot + shape-class
+  manifest + shutdown reason, written on ``ServeEngine.stop`` so the
+  restarted process can label itself warm/cold honestly in the
+  ``restart`` block of its artifacts.
+
+The LAPACK note: on this jax/CPU build a deserialized module whose
+program carries LAPACK custom calls (the GLS solve's cholesky)
+SEGFAULTS if invoked before the in-process FFI handlers are
+registered; ``AotStore.restore_all`` therefore runs a tiny
+registration warmup through a throwaway jit before the first
+restored call. The warmup is supervised like any other dispatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["RequestJournal", "AotStore", "save_state", "load_state"]
+
+
+# ------------------------------------------------------------------
+# request journal
+# ------------------------------------------------------------------
+
+
+class RequestJournal:
+    """Append-only JSONL request journal.
+
+    Line forms::
+
+        {"op": "admit", "rid": ..., "payload": {...}, "tenant": ...}
+        {"op": "ack",   "rid": ..., "status": "served" | "shed:..." |
+                                              "failed" | "replayed"}
+
+    ``unacknowledged()`` returns admit records with no terminal ack,
+    in admit order — the replay set. "replayed" is a progress marker
+    (the restarted engine re-admitted the entry), not a terminal
+    status; a crash DURING replay leaves the entry replayable again.
+    """
+
+    _TERMINAL = ("served", "failed", "shed")
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = None
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # a crash mid-write leaves a torn tail line WITHOUT a
+        # newline; appending straight onto it would concatenate the
+        # next record into the unparseable tail and lose BOTH
+        torn = False
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() > 0:
+                    fh.seek(-1, os.SEEK_END)
+                    torn = fh.read(1) != b"\n"
+        except OSError:
+            pass
+        self._fh = open(path, "a", encoding="utf-8")
+        if torn:
+            self._fh.write("\n")
+            self._fh.flush()
+
+    # -- writes --------------------------------------------------------
+
+    def _append(self, rec: dict):
+        line = json.dumps(rec, sort_keys=True)
+        with self._lock:
+            if self._fh is None or self._fh.closed:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+    def admit(self, rid: str, payload: dict,
+              tenant: Optional[str] = None,
+              deadline_s: Optional[float] = None):
+        rec = {"op": "admit", "rid": rid, "payload": payload}
+        if tenant is not None:
+            rec["tenant"] = tenant
+        if deadline_s is not None:
+            rec["deadline_s"] = deadline_s
+        self._append(rec)
+
+    def ack(self, rid: str, status: str):
+        self._append({"op": "ack", "rid": rid, "status": status})
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None and not self._fh.closed:
+                self._fh.close()
+
+    # -- reads ---------------------------------------------------------
+
+    def _scan(self) -> Tuple[List[dict], Dict[str, str]]:
+        admits: List[dict] = []
+        acks: Dict[str, str] = {}
+        try:
+            with open(self.path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail line from a crash
+                    if rec.get("op") == "admit":
+                        admits.append(rec)
+                    elif rec.get("op") == "ack":
+                        st = str(rec.get("status", ""))
+                        if st.split(":", 1)[0] in self._TERMINAL:
+                            acks[rec.get("rid")] = st
+        except OSError:
+            pass
+        return admits, acks
+
+    def unacknowledged(self) -> List[dict]:
+        admits, acks = self._scan()
+        seen = set()
+        out = []
+        for rec in admits:
+            rid = rec.get("rid")
+            if rid in acks or rid in seen:
+                continue
+            seen.add(rid)
+            out.append(rec)
+        return out
+
+    def counts(self) -> dict:
+        admits, acks = self._scan()
+        return {"admitted": len(admits), "acked": len(acks),
+                "unacknowledged": len(self.unacknowledged())}
+
+
+# ------------------------------------------------------------------
+# AOT executable store
+# ------------------------------------------------------------------
+
+
+def _fingerprint() -> dict:
+    """The configuration an artifact is only valid under."""
+    import jax
+
+    return {"jax": jax.__version__,
+            "platform": jax.default_backend(),
+            "x64": bool(jax.config.jax_enable_x64)}
+
+
+def _key_str(kind: str, full_key: tuple) -> str:
+    return kind + "/" + "/".join(str(x) for x in full_key)
+
+
+class AotStore:
+    """Serialized-executable store for one engine's bucket kernels.
+
+    ``save(kind, full_key, jit_fn, avals)`` exports the jitted kernel
+    at the class's exact avals and writes artifact + manifest
+    atomically; ``restore_all(supervisor)`` deserializes every
+    manifest entry matching the current configuration, wraps each in
+    a fresh ``jax.jit`` (so repeat dispatches reuse one compiled
+    module) and primes it with masking-safe zero batches so no
+    compile is left for the first real request. Restored callables
+    are fetched with ``get``."""
+
+    def __init__(self, dirpath: str, donation: bool = False):
+        self.dir = dirpath
+        self.donation = bool(donation)
+        os.makedirs(dirpath, exist_ok=True)
+        self._manifest_path = os.path.join(dirpath, "manifest.json")
+        self._restored: Dict[str, Callable] = {}
+        self._saved: set = set()
+        self._lock = threading.Lock()
+        self.exported = 0
+        self.restored = 0
+        self.export_errors = 0
+        self.restore_errors = 0
+
+    # -- manifest ------------------------------------------------------
+
+    def _read_manifest(self) -> dict:
+        try:
+            with open(self._manifest_path, encoding="utf-8") as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def _write_manifest(self, manifest: dict):
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, indent=1, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._manifest_path)
+
+    # -- export --------------------------------------------------------
+
+    def has(self, kind: str, full_key: tuple) -> bool:
+        ks = _key_str(kind, full_key)
+        with self._lock:
+            return ks in self._saved or ks in self._restored
+
+    def save(self, kind: str, full_key: tuple, jit_fn, avals):
+        """Export one compiled class (trace at ``avals`` — abstract
+        ShapeDtypeStructs, no device work) and persist it. Failures
+        are counted, never raised: AOT is an optimization, losing an
+        artifact must not fail the dispatch that just succeeded."""
+        ks = _key_str(kind, full_key)
+        with self._lock:
+            if ks in self._saved or ks in self._restored:
+                return
+            self._saved.add(ks)  # one attempt per key, even on error
+        try:
+            from jax import export as jexport
+
+            exp = jexport.export(jit_fn)(*avals)
+            blob = exp.serialize()
+            fname = hashlib.sha256(
+                (ks + json.dumps(_fingerprint(), sort_keys=True)
+                 ).encode()).hexdigest()[:16] + ".bin"
+            tmp = os.path.join(self.dir, fname + ".tmp")
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, os.path.join(self.dir, fname))
+            with self._lock:
+                manifest = self._read_manifest()
+                manifest[ks] = {
+                    "kind": kind,
+                    "key": list(full_key),
+                    "file": fname,
+                    "avals": [[list(a.shape), str(a.dtype)]
+                              for a in avals],
+                    "donation": self.donation,
+                    **_fingerprint(),
+                }
+                self._write_manifest(manifest)
+            self.exported += 1
+        except Exception as e:
+            self.export_errors += 1
+            _log().warning("AOT export of %s failed: %r", ks, e)
+
+    # -- restore -------------------------------------------------------
+
+    def restore_all(self, supervisor=None) -> int:
+        """Deserialize + prime every compatible artifact. Returns the
+        number restored. Priming (and the LAPACK FFI registration
+        warmup) runs through ``supervisor.dispatch`` so a wedged
+        backend degrades to a cold engine rather than hanging
+        construction; any per-artifact failure skips that artifact.
+        """
+        import numpy as np
+
+        manifest = self._read_manifest()
+        if not manifest:
+            return 0
+        fp = _fingerprint()
+        compatible = {
+            ks: ent for ks, ent in manifest.items()
+            if all(ent.get(k) == v for k, v in fp.items())
+            and bool(ent.get("donation", False)) == self.donation}
+        if not compatible:
+            return 0
+        import jax
+        import jax.numpy as jnp
+        from jax import export as jexport
+
+        def _primed():
+            # LAPACK FFI registration warmup: a restored module's
+            # custom calls (the GLS cholesky) segfault on this build
+            # unless the in-process handlers registered first — one
+            # tiny host cholesky does that. Then prime each restored
+            # module with a masking-safe zero batch (valid/pvalid all
+            # zero = the padded-slot path the kernels are built for)
+            # so its XLA binary compile happens NOW, not on the first
+            # served request.
+            np.asarray(jax.jit(jnp.linalg.cholesky)(jnp.eye(2)))  # graftlint: allow G6 -- registration warmup inside the supervised restore dispatch
+            restored = {}
+            for ks, ent in compatible.items():
+                try:
+                    with open(os.path.join(self.dir, ent["file"]),
+                              "rb") as fh:
+                        exp = jexport.deserialize(fh.read())
+                    fn = jax.jit(exp.call)
+                    zeros = tuple(
+                        jnp.zeros(tuple(shape), dtype=dtype)
+                        for shape, dtype in ent["avals"])
+                    out = fn(*zeros)  # graftlint: allow G6 -- priming inside the supervised restore dispatch
+                    jax.tree_util.tree_map(np.asarray, out)
+                    restored[ks] = fn
+                except Exception as e:
+                    self.restore_errors += 1
+                    _log().warning("AOT restore of %s failed: %r",
+                                   ks, e)
+            return restored
+
+        try:
+            if supervisor is not None:
+                restored = supervisor.dispatch(
+                    _primed, key="serve.aot_restore",
+                    fallback=lambda: {})
+            else:
+                restored = _primed()
+        except Exception as e:
+            self.restore_errors += 1
+            _log().warning("AOT restore pass failed: %r", e)
+            restored = {}
+        with self._lock:
+            self._restored.update(restored)
+            self.restored = len(self._restored)
+        return self.restored
+
+    def get(self, kind: str, full_key: tuple) -> Optional[Callable]:
+        with self._lock:
+            return self._restored.get(_key_str(kind, full_key))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"dir": self.dir,
+                    "restored": self.restored,
+                    "exported": self.exported,
+                    "export_errors": self.export_errors,
+                    "restore_errors": self.restore_errors}
+
+
+# ------------------------------------------------------------------
+# serve-state snapshot
+# ------------------------------------------------------------------
+
+
+def save_state(dirpath: str, snapshot: dict,
+               reason: str = "shutdown"):
+    """Write the serve-state snapshot (``state.json`` in the AOT
+    dir): the engine metrics snapshot + shutdown reason. Atomic, so
+    a crash mid-write leaves the previous snapshot intact."""
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, "state.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"reason": reason, "metrics": snapshot}, fh,
+                  indent=1, sort_keys=True)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def load_state(dirpath: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(dirpath, "state.json"),
+                  encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _log():
+    from pint_tpu.logging import log
+
+    return log

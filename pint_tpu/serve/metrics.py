@@ -97,11 +97,20 @@ class ServeMetrics:
     thing that actually compiled."""
 
     def __init__(self, cache=None, supervisor=None,
-                 pipeline_depth: int = 1, donation: bool = False):
+                 pipeline_depth: int = 1, donation: bool = False,
+                 admission=None, router=None):
         self.cache = cache
         self.supervisor = supervisor
         self.pipeline_depth = pipeline_depth   # configured in-flight cap
         self.donation = donation               # buffer donation on?
+        # ISSUE 8 observability: the admission controller's shed
+        # counters, the capacity router's per-pool shares, and the
+        # engine's restart provenance ride every snapshot — a shed,
+        # rerouted or replayed request is always visible in the
+        # artifact, never a silent drop
+        self.admission = admission
+        self.router = router
+        self.restart_info: dict = {}
         self.submitted = 0
         self.completed = 0
         self.rejected = 0           # backpressure (queue cap) drops
@@ -170,6 +179,16 @@ class ServeMetrics:
         # dispatch_overhead observability contract, ISSUE 7)
         out["pipeline_depth"] = self.pipeline_depth
         out["donation"] = bool(self.donation)
+        if self.admission is not None:
+            out["admission"] = self.admission.snapshot()
+        if self.router is not None:
+            out["router"] = self.router.snapshot()
+        if self.restart_info:
+            rs = dict(self.restart_info)
+            aot = getattr(self.cache, "aot", None)
+            if aot is not None:
+                rs["aot"] = aot.snapshot()  # live, not ctor-time
+            out["restart"] = rs
         if self.supervisor is not None:
             # the dispatch-supervisor counters (timeouts, retries,
             # breaker state, failovers; max_inflight = the pipelining
@@ -198,6 +217,30 @@ class ServeMetrics:
             f"{'bucket':<28} {'reqs':>6} {'batch':>6} {'occ':>6} "
             f"{'waste':>6} {'p50ms':>8} {'p99ms':>8}",
         ]
+        adm = s.get("admission")
+        if adm and (adm.get("shed_expired") or adm.get("shed_quota")
+                    or adm.get("shed_deadline")
+                    or adm.get("shed_shutdown")):
+            lines.insert(1, (
+                f"SHED: {adm['shed_expired']} expired in queue, "
+                f"{adm['shed_deadline']} deadline-doomed, "
+                f"{adm['shed_quota']} over tenant quota, "
+                f"{adm['shed_shutdown']} at shutdown "
+                f"(policy {adm['policy']})"))
+        rt = s.get("router")
+        if rt and rt.get("host", {}).get("dispatches"):
+            lines.insert(1, (
+                f"pools: device {rt['device']['dispatches']} "
+                f"dispatches ({rt['device']['share']:.0%}), host "
+                f"{rt['host']['dispatches']} "
+                f"({rt['host']['share']:.0%}, "
+                f"{rt['host']['demotions']} breaker demotions)"))
+        rs = s.get("restart")
+        if rs and (rs.get("warm") or rs.get("replayed")):
+            lines.insert(1, (
+                f"restart: warm={rs.get('warm')} "
+                f"aot_restored={rs.get('aot', {}).get('restored', 0)} "
+                f"replayed={rs.get('replayed', 0)}"))
         disp = s.get("dispatch")
         if disp and (disp.get("timeouts") or disp.get("failovers")
                      or disp.get("retries")

@@ -30,18 +30,42 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["ServeFuture", "DeadlineExceeded", "ServeOverload",
+           "TenantOverQuota", "ShutdownShed", "EngineKilled",
            "FitStepRequest", "ResidualsRequest", "PhasePredictRequest",
            "FitStepResult", "ResidualsResult", "PhasePredictResult"]
 
 
 class DeadlineExceeded(TimeoutError):
-    """The request's deadline passed before its batch dispatched."""
+    """The request's deadline passed before its batch dispatched
+    (expired in queue, shed by the deadline-aware admission policy,
+    or dead on arrival at dispatch time)."""
 
 
 class ServeOverload(RuntimeError):
     """Admission queue at capacity — backpressure signal to the
     caller (shed load or retry later; the queue cap is
     ``config.serve_queue_cap``)."""
+
+
+class TenantOverQuota(ServeOverload):
+    """The submitting tenant's token bucket is drained
+    (``config.tenant_qps`` / ``$PINT_TPU_TENANT_QPS``): this tenant
+    is bursting past its quota and is shed WITHOUT touching shared
+    capacity — other tenants keep being admitted."""
+
+
+class ShutdownShed(ServeOverload):
+    """The engine is draining for shutdown and the bounded drain
+    timeout elapsed before this request dispatched — shed with an
+    explicit label instead of dying silently with the process."""
+
+
+class EngineKilled(RuntimeError):
+    """The engine was killed (injected ``kill_restart`` fault — the
+    simulated SIGKILL of the restart-recovery harness): in-flight
+    futures die unresolved exactly as a real process death would
+    leave them; the journal's unacknowledged entries are what a
+    restarted engine replays."""
 
 
 class ServeFuture(concurrent.futures.Future):
@@ -64,12 +88,26 @@ class Request:
 
     ``deadline_s`` is RELATIVE (seconds from submission); the engine
     stamps the absolute expiry at admission. ``None`` = no deadline.
+
+    ``tenant`` feeds the admission controller's per-tenant token
+    buckets (None = the anonymous default tenant). ``rid`` +
+    ``payload`` make a request journalable: ``payload`` is an opaque
+    JSON-able description sufficient for the CALLER's replay factory
+    to rebuild the request after a crash (the journal stores it
+    verbatim; requests without one are served but never journaled —
+    an in-memory object cannot be replayed into a fresh process).
     """
 
     kind = "?"
 
-    def __init__(self, deadline_s: Optional[float] = None):
+    def __init__(self, deadline_s: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 rid: Optional[str] = None,
+                 payload: Optional[dict] = None):
         self.deadline_s = deadline_s
+        self.tenant = tenant
+        self.rid = rid
+        self.payload = payload
         self.future = ServeFuture()
         self.admitted_at: Optional[float] = None  # time.monotonic()
         self.expires_at: Optional[float] = None
@@ -126,8 +164,9 @@ class _GLSRequest(Request):
     re-solves on every poll, so admission stays O(1))."""
 
     def __init__(self, toas=None, model=None, problem=None,
-                 track_mode=None, deadline_s: Optional[float] = None):
-        super().__init__(deadline_s=deadline_s)
+                 track_mode=None, deadline_s: Optional[float] = None,
+                 **kw):
+        super().__init__(deadline_s=deadline_s, **kw)
         if problem is None and (toas is None or model is None):
             raise ValueError(
                 f"{type(self).__name__} needs (toas, model) or a "
@@ -173,8 +212,9 @@ class PhasePredictRequest(Request):
 
     kind = "phase"
 
-    def __init__(self, entry, mjds, deadline_s: Optional[float] = None):
-        super().__init__(deadline_s=deadline_s)
+    def __init__(self, entry, mjds, deadline_s: Optional[float] = None,
+                 **kw):
+        super().__init__(deadline_s=deadline_s, **kw)
         self.entry = entry
         self.mjds = np.atleast_1d(np.asarray(mjds, np.float64))
 

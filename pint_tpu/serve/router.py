@@ -1,0 +1,186 @@
+"""Breaker-aware capacity routing: host CPU and accelerator as
+CONCURRENT pools with learned service rates.
+
+Before ISSUE 8 the host path existed only as the dispatch
+supervisor's failover target: every batch first tried the device,
+and a dead backend cost each dispatch a watchdog deadline before the
+host solved it. This module promotes the host to a first-class
+capacity pool:
+
+- **two pools**: "device" (the engine's jitted / AOT-restored bucket
+  executables on the default backend) and "host" (the numpy mirrors
+  — ``pta_solve_np`` / ``PolycoEntry.abs_phase`` — running pinned,
+  hang-free, on the caller's CPU). In a pipelined drain, units routed
+  to different pools genuinely execute concurrently.
+- **learned service rates**: every completed dispatch feeds an EWMA
+  of rows/s per (pool, kind). Routing predicts each pool's
+  completion time as (in-flight backlog + this batch) / rate and
+  picks the cheaper pool. Cold start is deliberately conservative:
+  until the HOST rate has been observed (a breaker demotion served
+  there, or ``seed_rate`` taught it explicitly), everything routes
+  to the device — the router never guesses the host faster on no
+  evidence, so a fault-free deployment behaves exactly like the
+  pre-router engine.
+- **breaker-aware demotion**: an OPEN device breaker
+  (``runtime.breaker``, consulted through the supervisor's
+  ``pool_health`` surface) demotes the device pool instead of
+  stopping the world — batches route straight to the host pool,
+  counted as ``demotions``, without each paying the watchdog-timeout
+  + failover dance first. When the breaker closes (half-open probe
+  recovery), the device pool rejoins automatically.
+
+Every decision is visible: ``snapshot()`` is the ``router`` block of
+``ServeMetrics.snapshot()`` (per-pool dispatch/request/row shares,
+learned rates, demotion count).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["CapacityRouter"]
+
+# EWMA smoothing for learned rates: ~5-dispatch memory — fast enough
+# to track a warming cache, slow enough not to thrash on one outlier
+_EWMA_ALPHA = 0.3
+# rows/s assumed for a pool that has never been observed; the device
+# prior is high on purpose (routing away from the device requires
+# EVIDENCE, not a guess)
+_DEVICE_PRIOR = 1e9
+
+
+class _Pool:
+    __slots__ = ("name", "dispatches", "requests", "rows",
+                 "rates", "inflight_rows", "demotions")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.dispatches = 0
+        self.requests = 0
+        self.rows = 0
+        self.rates: Dict[str, float] = {}   # kind -> EWMA rows/s
+        self.inflight_rows = 0
+        self.demotions = 0
+
+    def rate(self, kind: str) -> Optional[float]:
+        return self.rates.get(kind)
+
+    def observe(self, kind: str, rows: int, wall_s: float):
+        if wall_s <= 0.0:
+            return
+        r = max(1.0, rows) / wall_s
+        prev = self.rates.get(kind)
+        self.rates[kind] = r if prev is None else \
+            (1.0 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * r
+
+    def snapshot(self) -> dict:
+        return {
+            "dispatches": self.dispatches,
+            "requests": self.requests,
+            "rows": self.rows,
+            "inflight_rows": self.inflight_rows,
+            "demotions": self.demotions,
+            "rows_per_s": {k: round(v, 1)
+                           for k, v in sorted(self.rates.items())},
+        }
+
+
+class CapacityRouter:
+    """Routes sealed shape-class units to a capacity pool.
+
+    ``supervisor`` provides the ``pool_health`` surface (breaker
+    state). One router per engine — its shares are that deployment's
+    accounting, like the engine's compile counts."""
+
+    def __init__(self, supervisor=None):
+        self.supervisor = supervisor
+        self.pools = {"device": _Pool("device"), "host": _Pool("host")}
+        self._lock = threading.Lock()
+
+    # -- routing -------------------------------------------------------
+
+    def _device_open(self) -> bool:
+        if self.supervisor is None:
+            return False
+        try:
+            return bool(self.supervisor.pool_health()["device"]["open"])
+        except Exception:
+            return False
+
+    def pick(self, kind: str, rows: int) -> str:
+        """Choose the pool for one sealed unit of ``rows`` padded
+        rows. Breaker-open demotes the device outright; otherwise
+        the pool with the smaller predicted completion time wins,
+        with the device preferred until the host has a LEARNED
+        rate."""
+        with self._lock:
+            dev, host = self.pools["device"], self.pools["host"]
+            if self._device_open():
+                host.demotions += 1
+                return "host"
+            hr = host.rate(kind)
+            if hr is None:
+                return "device"
+            dr = dev.rate(kind) or _DEVICE_PRIOR
+            t_dev = (dev.inflight_rows + rows) / dr
+            t_host = (host.inflight_rows + rows) / hr
+            return "device" if t_dev <= t_host else "host"
+
+    def predicted_wait_s(self, rows: int, kind: str = "gls") -> float:
+        """Admission-policy estimate: how long ``rows`` padded rows
+        would wait for results given current backlog and the best
+        learned rate (0 when nothing has been learned — the shed
+        policy then never declares anyone doomed on no evidence)."""
+        with self._lock:
+            rates = [p.rate(kind) for p in self.pools.values()]
+            rates = [r for r in rates if r]
+            if not rates:
+                return 0.0
+            backlog = sum(p.inflight_rows for p in self.pools.values())
+            return (backlog + rows) / max(rates)
+
+    # -- accounting ----------------------------------------------------
+
+    def issued(self, pool: str, nreq: int, rows: int):
+        with self._lock:
+            p = self.pools[pool]
+            p.dispatches += 1
+            p.requests += nreq
+            p.rows += rows
+            p.inflight_rows += rows
+
+    def finished(self, pool: str, kind: str, rows: int,
+                 wall_s: float, used_pool: Optional[str] = None):
+        """Complete one dispatch issued to ``pool``. ``used_pool``
+        names the pool that ACTUALLY produced the result; a rate is
+        observed only when the result came from the pool it was
+        issued to. A device-issued dispatch that failed over to the
+        host ("host-failover") teaches NOBODY: its wall includes the
+        watchdog deadline it first burned, a corrupt sample for
+        either pool — the failover stays visible in the supervisor
+        counters, and repeated failures trip the breaker whose OPEN
+        state is what routes (and teaches) the host pool."""
+        with self._lock:
+            self.pools[pool].inflight_rows = max(
+                0, self.pools[pool].inflight_rows - rows)
+            if used_pool is None:
+                used_pool = pool
+            if used_pool == pool:
+                self.pools[pool].observe(kind, rows, wall_s)
+
+    def seed_rate(self, pool: str, kind: str, rows_per_s: float):
+        """Directly set a pool's learned rate (tests, and the bench's
+        host-probe warmup)."""
+        with self._lock:
+            self.pools[pool].rates[kind] = float(rows_per_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {name: p.snapshot()
+                   for name, p in self.pools.items()}
+        total = sum(p["dispatches"] for p in out.values())
+        for p in out.values():
+            p["share"] = round(p["dispatches"] / total, 4) \
+                if total else 0.0
+        return out

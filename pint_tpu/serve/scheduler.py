@@ -1,51 +1,57 @@
-"""Coalescing request scheduler: admission queue -> shape-class
-groups -> one padded vmapped dispatch per group.
+"""Continuous-batching request scheduler: admission pipeline ->
+open shape-class buckets -> routed, padded, vmapped dispatches.
 
-The serving loop of an inference stack, applied to timing: requests
-admitted within a coalescing window (``config.serve_window_s``) are
-grouped by compatible shape class (``serve.bucket``) and solved in
-ONE device call per group via the ``parallel.pta`` batch kernel, so a
-burst of K compatible requests pays one dispatch RTT instead of K
-(over the axon tunnel that is 0.1-0.25 s EACH — see
-``config.dispatch_rtt_ms``). Compiles are bounded by the shape-class
+The serving loop of an inference stack, applied to timing — rebuilt
+(ISSUE 8) from drain-the-queue rounds into CONTINUOUS BATCHING:
+requests are admitted in-flight into *open* shape-class buckets
+between drain windows. A bucket seals (becomes a dispatch unit) when
+it fills to ``max_batch`` or its coalescing window expires; sealed
+units dispatch while new arrivals keep landing in freshly opened
+buckets — admission never stops for a drain. A burst of K compatible
+requests still pays one dispatch RTT instead of K (0.1-0.25 s each
+over the axon tunnel); compiles stay bounded by the shape-class
 count, never the request count.
 
-Operation modes:
+Admission pipeline (``serve.admission``), in order:
 
-- synchronous (default): ``submit()`` queues; ``flush()`` — called
-  explicitly, or implicitly by ``ServeFuture.result()`` — drains
-  everything pending. Deterministic; what the tests and bench drive.
-- threaded: ``start()`` runs a daemon loop that waits for the first
-  request, sleeps out the coalescing window to let a batch
-  accumulate, then drains. The stdin daemon
-  (``scripts/pint_serve.py``) uses this.
+1. **tenant quota**: per-tenant token buckets
+   (``$PINT_TPU_TENANT_QPS`` / ``_BURST``) shed a bursting tenant
+   with ``TenantOverQuota`` before any assembly work is spent;
+2. **classification**: the request is assembled and assigned its
+   shape class (unchanged from the coalescing engine);
+3. **in-queue expiry**: requests whose deadline passed while queued
+   are failed with ``DeadlineExceeded`` NOW (the ``shed_expired``
+   counter), not discovered at dispatch;
+4. **capacity + shed policy** (``$PINT_TPU_SHED_POLICY``): at
+   capacity, the deadline-aware policy sheds the request that will
+   miss its deadline anyway — a doomed queued victim, or the doomed
+   newcomer itself — and never one that can still make it; with no
+   provably-doomed request the submit is backpressure-rejected
+   (``ServeOverload``), exactly the pre-ISSUE-8 behavior.
 
-Backpressure: the admission queue is capped
-(``config.serve_queue_cap``); a full queue rejects the submit with
-``ServeOverload`` — shedding at admission is the only honest
-overload response when every accepted request carries a deadline.
-Expired requests are failed with ``DeadlineExceeded`` at drain time,
-before any device work is spent on them. A request whose shape fits
-no configured bucket is NOT rejected: it falls back to the next
-power-of-two shape class (counted in ``metrics.fallback_single`` —
-graceful, still shape-quantized), and fallback requests landing on
-the SAME class coalesce into one shared padded dispatch.
+Dispatch routing (``serve.router``): every sealed unit is placed by
+the breaker-aware capacity router — host CPU and the accelerator are
+CONCURRENT pools with learned per-pool service rates; an OPEN device
+breaker demotes the device pool (units route straight to the host
+mirrors as planned capacity, pinned and hang-free) instead of every
+dispatch paying the watchdog-timeout-then-failover dance.
 
-Every device dispatch routes through the engine's
+Crash-safe restart (``serve.journal``): with a journal, every
+payload-carrying admission is recorded before dispatch and
+acknowledged on completion; with an AOT dir, each shape class is
+exported after its first compile and restored+primed at engine
+construction, so a restarted engine serves its first request with
+zero new serve-kernel compiles and ``replay()`` re-submits exactly
+the unacknowledged journal entries. ``stop(timeout=...)`` drains
+gracefully: queued work keeps dispatching until the bound, the
+remainder is shed with an explicit ``ShutdownShed`` per request, and
+the serve-state snapshot is written.
+
+Every device dispatch still routes through the engine's
 ``runtime.DispatchSupervisor`` (watchdog deadline, circuit breaker,
-host numpy/polyco failover): a wedged backend degrades a batch to
-the host path — counted, never hung — so every admitted future
-always completes.
-
-Pipelined drain (ISSUE 7): with ``pipeline_depth`` > 1 (default 2,
-``$PINT_TPU_SERVE_PIPELINE``) a drain pass keeps that many
-shape-class dispatches in flight at once — batch k+1 is issued on
-the supervisor's async pipeline (``dispatch_async``) while batch k
-executes, with explicit result collection only at scatter time
-(double-buffering on jax's async dispatch). Each in-flight dispatch
-carries its own depth-scaled watchdog deadline and host fallback, so
-a mid-pipeline backend death still drains every admitted future to
-labeled host failover — zero hung futures.
+host failover), and every shed/quota/reroute/replay decision is
+LABELED in the metrics snapshot (``admission``/``router``/``restart``
+blocks) — degraded serving is visible, never silent.
 """
 
 from __future__ import annotations
@@ -53,12 +59,15 @@ from __future__ import annotations
 import collections
 import threading
 import time
+import uuid
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from pint_tpu.fitter import Fitter
 from pint_tpu.profiling import annotate
+from pint_tpu.runtime import faults
+from pint_tpu.serve.admission import AdmissionController
 from pint_tpu.serve.bucket import (
     ExecutableCache,
     gls_shape_class,
@@ -69,6 +78,7 @@ from pint_tpu.serve.bucket import (
 from pint_tpu.serve.metrics import ServeMetrics
 from pint_tpu.serve.request import (
     DeadlineExceeded,
+    EngineKilled,
     FitStepRequest,
     FitStepResult,
     PhasePredictRequest,
@@ -76,27 +86,51 @@ from pint_tpu.serve.request import (
     ResidualsRequest,
     ResidualsResult,
     ServeOverload,
+    ShutdownShed,
+    TenantOverQuota,
 )
+from pint_tpu.serve.router import CapacityRouter
 
 __all__ = ["ServeEngine", "ServeGLSFitter"]
 
 
+class _OpenBucket:
+    """One open shape-class bucket: requests accumulate here between
+    seal events (full batch / window expiry / explicit flush)."""
+
+    __slots__ = ("key", "reqs", "opened_at", "fallback")
+
+    def __init__(self, key, opened_at: float, fallback: bool):
+        self.key = key
+        self.reqs: List = []
+        self.opened_at = opened_at
+        self.fallback = fallback
+
+
 class ServeEngine:
-    """The serving engine: queue, coalescer, executable cache,
-    metrics. One engine per served deployment; its compile accounting
+    """The serving engine: admission pipeline, open buckets,
+    capacity router, executable cache, journal, metrics. One engine
+    per served deployment; its compile accounting
     (``metrics.compile_count``) is self-contained.
 
     ``mesh`` optionally shards every dispatch's batch axis over the
     named mesh ``axis`` (the ``parallel.pta`` pulsar axis): batch
     slots then pad to a mesh multiple so XLA GSPMD never sees a
-    ragged shard."""
+    ragged shard. ``aot_dir``/``journal`` arm the crash-safe restart
+    path (defaults from ``$PINT_TPU_AOT_DIR`` / ``$PINT_TPU_JOURNAL``).
+    """
 
     def __init__(self, window_s: Optional[float] = None,
                  max_batch: Optional[int] = None,
                  queue_cap: Optional[int] = None,
                  bucket_edges: Optional[Tuple[int, ...]] = None,
                  mesh=None, axis: str = "pulsar",
-                 pipeline_depth: Optional[int] = None):
+                 pipeline_depth: Optional[int] = None,
+                 tenant_qps: Optional[float] = None,
+                 tenant_burst: Optional[float] = None,
+                 shed_policy: Optional[str] = None,
+                 aot_dir: Optional[str] = None,
+                 journal=None):
         from pint_tpu import config
         from pint_tpu.runtime import DispatchSupervisor
 
@@ -111,10 +145,9 @@ class ServeEngine:
             else bucket_edges))
         self.mesh = mesh
         self.axis = axis
-        # pipelined drain (ISSUE 7): keep up to this many shape-class
-        # dispatches IN FLIGHT during one drain pass — batch k+1 is
-        # issued on the supervisor's async pipeline while batch k
-        # executes, and results are collected in issue order. 1 = the
+        # pipelined drain (ISSUE 7): keep up to this many sealed
+        # units IN FLIGHT while draining — unit k+1 is issued on the
+        # supervisor's async pipeline while unit k executes. 1 = the
         # classic synchronous drain.
         self.pipeline_depth = max(1, config.serve_pipeline_depth()
                                   if pipeline_depth is None
@@ -124,118 +157,405 @@ class ServeEngine:
         # like the compile accounting — while breaker state stays
         # process-global (backend health is a process fact)
         self.supervisor = DispatchSupervisor()
+        self.admission = AdmissionController(
+            tenant_qps=tenant_qps, tenant_burst=tenant_burst,
+            policy=shed_policy)
+        self.router = CapacityRouter(supervisor=self.supervisor)
+        if aot_dir is None:
+            aot_dir = config.aot_dir()
         self.cache = ExecutableCache(mesh=mesh, axis=axis,
-                                     supervisor=self.supervisor)
+                                     supervisor=self.supervisor,
+                                     aot_dir=aot_dir)
+        # journal: a path (str), a prebuilt RequestJournal, or None
+        # (default $PINT_TPU_JOURNAL)
+        if journal is None:
+            journal = config.journal_path()
+        if isinstance(journal, str):
+            from pint_tpu.serve.journal import RequestJournal
+
+            journal = RequestJournal(journal)
+        self.journal = journal
         self.metrics = ServeMetrics(self.cache,
                                     supervisor=self.supervisor,
                                     pipeline_depth=self.pipeline_depth,
-                                    donation=self.cache.donation)
-        self._queue: collections.deque = collections.deque()
+                                    donation=self.cache.donation,
+                                    admission=self.admission,
+                                    router=self.router)
+        self.metrics.restart_info = self._restart_info(aot_dir)
+        self._open: dict = {}                  # key -> _OpenBucket
+        self._ready: collections.deque = collections.deque()
+        self._pool_last_collect: dict = {}     # pool -> last collect t
+        self._nqueued = 0
+        self._earliest_expiry: Optional[float] = None
+        self._dead = False
+        self._drain_stop_at: Optional[float] = None  # shutdown bound
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
         self._dispatch_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def _restart_info(self, aot_dir) -> dict:
+        info = {"warm": False, "replayed": 0}
+        if self.cache.aot is not None:
+            info["aot"] = self.cache.aot.snapshot()
+            info["warm"] = self.cache.aot.restored > 0
+            from pint_tpu.serve.journal import load_state
+
+            prior = load_state(aot_dir)
+            if prior is not None:
+                info["prior_shutdown"] = prior.get("reason")
+        if self.journal is not None:
+            info["journal"] = self.journal.counts()
+        return info
+
     # -- admission -----------------------------------------------------
 
     def submit(self, req):
-        """Admit a request; returns its ServeFuture. Raises
-        ServeOverload when the queue is at capacity (backpressure —
-        nothing is partially accepted)."""
+        """Run one request through the admission pipeline; returns
+        its ServeFuture. Raises ``TenantOverQuota`` when the tenant's
+        token bucket is drained and ``ServeOverload`` when capacity
+        is exhausted and the shed policy found nobody provably doomed
+        (backpressure — nothing is partially accepted). A
+        deadline-doomed newcomer is NOT raised: its future is failed
+        with ``DeadlineExceeded`` and returned (a labeled shed
+        response, not a transport error)."""
+        if self._dead:
+            raise EngineKilled(
+                "engine was killed (kill_restart); restart and "
+                "replay the journal")
+        now = time.monotonic()
+        # 1. tenant quota — before classification, so a shed tenant
+        # never costs GLS assembly work
+        if not self.admission.check_quota(req.tenant, now=now):
+            raise TenantOverQuota(
+                f"tenant {req.tenant or 'default'!r} is over its "
+                f"{self.admission.tenant_qps}/s quota; shed")
+        # 2. classification (assembles GLS problems — outside any
+        # lock; the request object is single-submitter by contract)
+        try:
+            key, fb = self._class_of(req)
+        except Exception as e:
+            self.metrics.submitted += 1
+            self.metrics.failed += 1
+            req.future.set_exception(e)
+            return req.future
         with self._cv:
-            if len(self._queue) >= self.queue_cap:
-                self.metrics.rejected += 1
-                raise ServeOverload(
-                    f"admission queue full ({self.queue_cap}); "
-                    f"shed load or raise PINT_TPU_SERVE_QUEUE_CAP")
             now = time.monotonic()
+            # 3. in-queue expiry sweep (amortized: no-op until the
+            # earliest queued deadline has actually passed)
+            self._expire_locked(now)
+            # 4. capacity + shed policy
+            if self.admission.capacity_exhausted(self._nqueued,
+                                                 self.queue_cap):
+                verdict, victim = self.admission.shed_decision(
+                    req, self._queued_waits_locked(),
+                    self._predicted_wait_locked(req), now)
+                if verdict == "victim":
+                    self._remove_queued_locked(victim)
+                    self.admission.shed_deadline += 1
+                    victim.future.set_exception(DeadlineExceeded(
+                        f"{victim.kind} request shed at admission: "
+                        f"predicted wait exceeds its remaining "
+                        f"{victim.deadline_s}s deadline (doomed "
+                        f"anyway; capacity given to a request that "
+                        f"can still make it)"))
+                elif verdict == "newcomer":
+                    self.admission.shed_deadline += 1
+                    self.metrics.submitted += 1
+                    req.future.set_exception(DeadlineExceeded(
+                        f"{req.kind} request shed at admission: "
+                        f"predicted wait exceeds its {req.deadline_s}"
+                        f"s deadline (would miss anyway)"))
+                    return req.future
+                else:
+                    self.metrics.rejected += 1
+                    self.admission.shed_overload += 1
+                    raise ServeOverload(
+                        f"admission queue full ({self.queue_cap}); "
+                        f"shed load or raise "
+                        f"PINT_TPU_SERVE_QUEUE_CAP")
+            # admitted: stamp, journal, place into its open bucket
             req.admitted_at = now
             if req.deadline_s is not None:
                 req.expires_at = now + float(req.deadline_s)
+                if self._earliest_expiry is None or \
+                        req.expires_at < self._earliest_expiry:
+                    self._earliest_expiry = req.expires_at
             if self._thread is None:
                 # synchronous mode: result() pumps the queue itself
                 req.future._sync_engine = self
-            self._queue.append(req)
+            b = self._open.get(key)
+            if b is None:
+                b = self._open[key] = _OpenBucket(key, now, fb)
+            b.reqs.append(req)
+            self._nqueued += 1
+            if len(b.reqs) >= self.max_batch:
+                self._seal_locked(key)
             self.metrics.submitted += 1
-            self.metrics.queue_depth(len(self._queue))
+            self.metrics.queue_depth(self._nqueued)
             self._cv.notify()
+        # journal OUTSIDE the engine lock: the per-admit fsync must
+        # not serialize other submitters or the drain loop's seal/
+        # expire work behind disk latency. The request may even
+        # complete before the admit line lands (threaded drain) —
+        # the ack callback fires immediately on a done future and
+        # the journal scan matches admit/ack lines in any order.
+        self._journal_admit(req)
         return req.future
+
+    def _journal_admit(self, req):
+        if self.journal is None or req.payload is None:
+            return
+        if req.rid is None:
+            req.rid = uuid.uuid4().hex
+        # a replayed entry already HAS its admit line (plus the
+        # "replayed" progress mark) — writing another would grow the
+        # journal by the full payload and double-count `admitted`
+        # on every restart; only its terminal ack below is owed
+        if not getattr(req, "_journal_replayed", False):
+            self.journal.admit(req.rid, req.payload,
+                               tenant=req.tenant,
+                               deadline_s=req.deadline_s)
+        journal = self.journal
+
+        def _ack(fut, rid=req.rid):
+            try:
+                fut.result(timeout=0)
+                st = "served"
+            except DeadlineExceeded:
+                st = "shed:deadline"
+            except ShutdownShed:
+                st = "shed:shutdown"
+            except ServeOverload:
+                st = "shed:overload"
+            except Exception:
+                st = "failed"
+            journal.ack(rid, st)
+
+        req.future.add_done_callback(_ack)
+
+    def replay(self, factory) -> List:
+        """Re-submit every unacknowledged journal entry (crash
+        recovery): ``factory(payload)`` rebuilds the request from
+        the journaled payload. Returns the new futures, in journal
+        order. Each entry gets a non-terminal "replayed" progress
+        mark; its terminal ack lands when the replayed future
+        resolves — a crash DURING replay leaves it replayable."""
+        if self.journal is None:
+            return []
+        futs = []
+        for rec in self.journal.unacknowledged():
+            req = factory(rec["payload"])
+            req.rid = rec["rid"]
+            if req.payload is None:
+                req.payload = rec["payload"]
+            req._journal_replayed = True
+            self.journal.ack(rec["rid"], "replayed")
+            futs.append(self.submit(req))
+        self.metrics.restart_info["replayed"] = len(futs)
+        return futs
+
+    # -- queue bookkeeping (all under self._lock) ----------------------
+
+    def _queued_requests_locked(self):
+        for b in self._open.values():
+            yield from b.reqs
+        for _, grp in self._ready:
+            yield from grp
+
+    def _remove_queued_locked(self, req):
+        for key, b in list(self._open.items()):
+            if req in b.reqs:
+                b.reqs.remove(req)
+                self._nqueued -= 1
+                if not b.reqs:
+                    del self._open[key]
+                return
+        for unit in self._ready:
+            if req in unit[1]:
+                unit[1].remove(req)
+                self._nqueued -= 1
+                return
+
+    @staticmethod
+    def _kind_of(req) -> str:
+        return "phase" if isinstance(req, PhasePredictRequest) \
+            else "gls"
+
+    def _predicted_wait_locked(self, req) -> float:
+        """Admission-policy wait estimate for a NEWCOMER: every
+        already-sealed unit dispatches before it, plus the router's
+        in-flight backlog, over the best learned service rate (0.0 —
+        never doomed — until a rate has actually been observed).
+        Open-bucket rows are excluded: their seal order vs the
+        newcomer's own bucket is not knowable, and overestimating
+        the wait would shed a request that could still make its
+        deadline."""
+        ahead = sum(self._rows_of(r)
+                    for _, grp in self._ready for r in grp)
+        return self.router.predicted_wait_s(
+            ahead + self._rows_of(req), kind=self._kind_of(req))
+
+    def _queued_waits_locked(self):
+        """``[(req, predicted_wait_s)]`` for every queued request,
+        ONE O(n) prefix-sum pass in dispatch order. A queued
+        candidate's wait counts only rows AHEAD of it — sealed units
+        dispatch in deque order, batch-mates ride the same vmapped
+        dispatch, and rows queued BEHIND a candidate must not count
+        (the inflated wait would shed a head-of-queue request that
+        was about to be served on time). Open-bucket requests
+        dispatch after every sealed unit; other open buckets are
+        excluded, same never-overestimate rule as above."""
+        out = []
+        ahead = 0
+        for _, grp in self._ready:
+            for r in grp:
+                out.append((r, self.router.predicted_wait_s(
+                    ahead + self._rows_of(r), kind=self._kind_of(r))))
+            ahead += sum(self._rows_of(r) for r in grp)
+        for b in self._open.values():
+            for r in b.reqs:
+                out.append((r, self.router.predicted_wait_s(
+                    ahead + self._rows_of(r), kind=self._kind_of(r))))
+        return out
+
+    def _expire_locked(self, now: float):
+        """Fail every queued request whose deadline has passed
+        (satellite: deadlines used to be checked only at
+        drain/dispatch time — a doomed request could sit in the queue
+        consuming capacity long after its caller gave up). Amortized:
+        skips entirely until the earliest queued expiry is due."""
+        if self._earliest_expiry is None or now < self._earliest_expiry:
+            return
+        earliest = None
+
+        def sweep(reqs: List) -> List:
+            nonlocal earliest
+            live = []
+            for r in reqs:
+                if r.expired(now):
+                    self._nqueued -= 1
+                    self.metrics.deadline_missed += 1
+                    self.admission.shed_expired += 1
+                    r.future.set_exception(DeadlineExceeded(
+                        f"{r.kind} request missed its "
+                        f"{r.deadline_s}s deadline in queue"))
+                else:
+                    if r.expires_at is not None and \
+                            (earliest is None
+                             or r.expires_at < earliest):
+                        earliest = r.expires_at
+                    live.append(r)
+            return live
+
+        for key, b in list(self._open.items()):
+            b.reqs[:] = sweep(b.reqs)
+            if not b.reqs:
+                del self._open[key]
+        for unit in list(self._ready):
+            unit[1][:] = sweep(unit[1])
+            if not unit[1]:
+                self._ready.remove(unit)
+        self._earliest_expiry = earliest
+        self.metrics.queue_depth(self._nqueued)
+
+    def _seal_locked(self, key):
+        """Seal one open bucket into a ready dispatch unit."""
+        b = self._open.pop(key)
+        if not b.reqs:
+            return
+        if b.fallback:
+            self.metrics.fallback_single += len(b.reqs)
+        self._ready.append((key, b.reqs))
+        self._cv.notify_all()
 
     # -- draining ------------------------------------------------------
 
     def flush(self):
-        """Drain every currently-queued request (grouping, batching
-        and dispatching as one coalesced pass). Safe from any thread;
-        dispatches are serialized."""
+        """Seal every open bucket and drain every sealed unit (new
+        requests admitted DURING the drain are drained too). Safe
+        from any thread; dispatches are serialized."""
         while True:
             with self._cv:
-                if not self._queue:
+                if self._dead:
+                    raise EngineKilled(
+                        "engine was killed (kill_restart); restart "
+                        "and replay the journal")
+                self._expire_locked(time.monotonic())
+                for key in list(self._open):
+                    self._seal_locked(key)
+                if not self._ready:
                     return
-                batch = list(self._queue)
-                self._queue.clear()
-                self.metrics.queue_depth(0)
-            with self._dispatch_lock:
-                self._process(batch)
+            self._drain_ready()
 
-    def _process(self, reqs: List):
-        now = time.monotonic()
-        live = []
-        for r in reqs:
-            if r.expired(now):
-                self.metrics.deadline_missed += 1
-                r.future.set_exception(DeadlineExceeded(
-                    f"{r.kind} request missed its "
-                    f"{r.deadline_s}s deadline in queue"))
-            else:
-                live.append(r)
-        groups: dict = {}
-        fallbacks = []
-        for r in live:
-            try:
-                key, fb = self._class_of(r)
-            except Exception as e:
-                self.metrics.failed += 1
-                r.future.set_exception(e)
-                continue
-            if fb:
-                fallbacks.append((key, r))
-            else:
-                groups.setdefault(key, []).append(r)
-        units: List[Tuple] = []
-        for key, grp in groups.items():
-            for i in range(0, len(grp), self.max_batch):
-                units.append((key, grp[i:i + self.max_batch]))
-        # oversize requests (no configured bucket) still coalesce:
-        # the fallback shape class IS a shape class, so requests that
-        # land on the same power-of-two dims share one padded
-        # dispatch instead of going one-at-a-time (compile count
-        # stays <= bucket count + oversize classes either way)
-        fb_groups: dict = {}
-        for key, r in fallbacks:
-            fb_groups.setdefault(key, []).append(r)
-        for key, grp in fb_groups.items():
-            self.metrics.fallback_single += len(grp)
-            for i in range(0, len(grp), self.max_batch):
-                units.append((key, grp[i:i + self.max_batch]))
-        if self.pipeline_depth <= 1 or len(units) <= 1:
-            for key, grp in units:
-                self._dispatch(key, grp)
-            return
-        # pipelined drain: a sliding window of pipeline_depth
-        # in-flight dispatches; collection stays in issue order so
-        # result scattering (and the per-bucket metrics) are
-        # deterministic. A mid-pipeline backend death drains cleanly:
-        # every issued dispatch carries its own depth-scaled watchdog
-        # deadline and host fallback, so collecting the window always
-        # terminates — zero hung futures (tests/test_runtime_faults).
+    def _drain_ready(self, stop_at: Optional[float] = None):
+        """Dispatch sealed units with a sliding window of
+        ``pipeline_depth`` in flight; collection stays in issue order
+        so result scattering (and the per-bucket metrics) are
+        deterministic. A mid-pipeline backend death drains cleanly:
+        every issued dispatch carries its own depth-scaled watchdog
+        deadline and host fallback, so collecting the window always
+        terminates — zero hung futures (tests/test_runtime_faults).
+        ``stop_at`` bounds a shutdown drain (units are not popped
+        past it). An injected ``kill_restart`` fault aborts the drain
+        like a SIGKILL: already-issued work is abandoned, futures die
+        unresolved, journal entries stay unacknowledged."""
+        sync = self.pipeline_depth <= 1
         pending: collections.deque = collections.deque()
-        for key, grp in units:
-            pending.append(self._dispatch_begin(key, grp))
-            if len(pending) >= self.pipeline_depth:
+        with self._dispatch_lock:
+            while True:
+                with self._cv:
+                    if not self._ready:
+                        break
+                    # re-read the shutdown bound every iteration: a
+                    # stop(timeout=...) that lands while this drain
+                    # is already running must still bound it — the
+                    # call-time stop_at alone would let a large
+                    # backlog drain unboundedly past the contract
+                    bound = stop_at
+                    live = self._drain_stop_at
+                    if live is not None and \
+                            (bound is None or live < bound):
+                        bound = live
+                    if bound is not None and \
+                            time.monotonic() > bound:
+                        break
+                    key, grp = self._ready.popleft()
+                    self._nqueued -= len(grp)
+                    self.metrics.queue_depth(self._nqueued)
+                plan = faults.active_plan()
+                if plan is not None and plan.faults_for(
+                        "serve.drain", kinds=("kill_restart",)):
+                    self._dead = True
+                    raise EngineKilled(
+                        "injected kill_restart: engine died "
+                        "mid-drain (simulated SIGKILL — journal "
+                        "entries stay unacknowledged)")
+                # dispatch-time expiry: a unit may have aged between
+                # seal and pop (the legacy drain-time deadline check)
+                now = time.monotonic()
+                live = []
+                for r in grp:
+                    if r.expired(now):
+                        self.metrics.deadline_missed += 1
+                        self.admission.shed_expired += 1
+                        r.future.set_exception(DeadlineExceeded(
+                            f"{r.kind} request missed its "
+                            f"{r.deadline_s}s deadline in queue"))
+                    else:
+                        live.append(r)
+                if not live:
+                    continue
+                state = self._dispatch_begin(key, live, sync=sync)
+                if sync:
+                    self._dispatch_finish(*state)
+                    continue
+                pending.append(state)
+                if len(pending) >= self.pipeline_depth:
+                    self._dispatch_finish(*pending.popleft())
+            while pending:
                 self._dispatch_finish(*pending.popleft())
-        while pending:
-            self._dispatch_finish(*pending.popleft())
 
     def _class_of(self, r):
         """(shape-class key, is_fallback). GLS requests are assembled
@@ -267,41 +587,45 @@ class ServeEngine:
             Pb = m * pow2_ceil(-(-P // m))
         return Pb
 
-    def _dispatch(self, key, grp: List):
-        """One synchronous device call for one shape-class group;
-        scatter results to the group's futures. A dispatch failure
-        fails exactly this group's futures — the engine keeps
-        serving."""
-        self._dispatch_finish(*self._dispatch_begin(key, grp,
-                                                    sync=True))
-
     def _dispatch_begin(self, key, grp: List, sync: bool = False):
-        """Issue one shape-class group's device call (async on the
-        supervisor's pipeline mode unless ``sync``). Returns the
-        state tuple ``_dispatch_finish`` consumes; an assembly/issue
-        failure rides along as the collect slot and fails the group
-        at finish time, so begin never throws into the drain loop."""
+        """Route one sealed unit to a capacity pool and issue its
+        call (async on the supervisor's pipeline mode unless
+        ``sync``). Returns the state tuple ``_dispatch_finish``
+        consumes; an assembly/issue failure rides along as the
+        collect slot and fails the group at finish time, so begin
+        never throws into the drain loop."""
         Pb = self._batch_pad(len(grp))
         full_key = key + (Pb,)
         t0 = time.monotonic()
+        kind = "phase" if key[0] == "phase" else "gls"
+        rows = Pb * key[1]
+        pool = self.router.pick(kind, rows)
+        self.router.issued(pool, len(grp), rows)
+        info: dict = {}
         try:
             if key[0] == "phase":
                 _, nb, kb = key
                 collect = self.cache.phase_begin(
-                    full_key, grp, nb, kb, Pb, sync=sync)
+                    full_key, grp, nb, kb, Pb, sync=sync, pool=pool,
+                    info=info)
             else:
                 _, nb, pb, qb = key
                 collect = self.cache.gls_begin(
                     full_key, [r.problem for r in grp],
-                    shape=(Pb, nb, pb, qb), sync=sync)
+                    shape=(Pb, nb, pb, qb), sync=sync, pool=pool,
+                    info=info)
         except Exception as e:
             collect = e
-        return key, full_key, grp, Pb, t0, collect
+        return key, full_key, grp, Pb, t0, collect, pool, info
 
-    def _dispatch_finish(self, key, full_key, grp, Pb, t0, collect):
+    def _dispatch_finish(self, key, full_key, grp, Pb, t0, collect,
+                         pool, info):
         """Collect one issued dispatch and scatter results to the
         group's futures (the wait rides the supervisor's depth-scaled
-        watchdog, so this always terminates)."""
+        watchdog, so this always terminates). Feeds the router's
+        rate learning with the pool that ACTUALLY served."""
+        kind = "phase" if key[0] == "phase" else "gls"
+        rows = Pb * key[1]
         try:
             if isinstance(collect, Exception):
                 raise collect
@@ -328,12 +652,28 @@ class ServeEngine:
                             chi2r=float(chi2r[k]))
                     r.future.set_result(res)
         except Exception as e:
+            self.router.finished(pool, kind, rows, 0.0,
+                                 used_pool="error")
             for r in grp:
                 if not r.future.done():
                     self.metrics.failed += 1
                     r.future.set_exception(e)
             return
         done = time.monotonic()
+        # rate-learning wall: a pipelined collect's issue-to-collect
+        # span includes time spent queued behind other in-flight
+        # dispatches (up to pipeline_depth x the true service time —
+        # the same corruption the supervisor excludes from RTT
+        # drift). The inter-completion gap since the pool's previous
+        # collect is the honest throughput sample under pipelining;
+        # a collect after idle (gap would span the idle period)
+        # falls back to its own issue-to-collect wall.
+        last = self._pool_last_collect.get(pool)
+        wall = done - t0 if last is None or last <= t0 \
+            else done - last
+        self._pool_last_collect[pool] = done
+        self.router.finished(pool, kind, rows, wall,
+                             used_pool=info.get("used_pool", pool))
         lats = [done - (r.admitted_at or t0) for r in grp]
         nb = key[1]
         rows_real = sum(self._rows_of(r) for r in grp)
@@ -350,9 +690,9 @@ class ServeEngine:
     # -- threaded serving loop ----------------------------------------
 
     def start(self):
-        """Run the coalescing loop in a daemon thread. Futures then
-        resolve asynchronously; ``ServeFuture.result(timeout)`` is
-        the blocking wait."""
+        """Run the continuous-batching loop in a daemon thread.
+        Futures then resolve asynchronously;
+        ``ServeFuture.result(timeout)`` is the blocking wait."""
         if self._thread is not None:
             return self
         self._stop.clear()
@@ -361,9 +701,21 @@ class ServeEngine:
         self._thread.start()
         return self
 
-    def stop(self, drain: bool = True):
-        """Stop the loop; by default drain what is still queued so no
-        accepted request is silently dropped."""
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None):
+        """Stop the loop. ``drain=True`` (default) keeps dispatching
+        what is queued so no accepted request is silently dropped;
+        ``timeout`` bounds that drain — work still queued at the
+        deadline is shed with an explicit ``ShutdownShed`` per
+        request (the graceful-shutdown contract: labeled, never
+        silent, never unbounded). Writes the serve-state snapshot
+        and closes the journal."""
+        stop_at = None if timeout is None \
+            else time.monotonic() + max(0.0, timeout)
+        # the loop's own final drain (it seals + drains on stop)
+        # must honor the same bound, or it drains unboundedly before
+        # this thread ever reaches the shed step
+        self._drain_stop_at = stop_at
         self._stop.set()
         with self._cv:
             self._cv.notify_all()
@@ -371,27 +723,95 @@ class ServeEngine:
         if t is not None:
             t.join(timeout=60.0)
             self._thread = None
-        if drain:
-            self.flush()
+        try:
+            if drain and not self._dead:
+                if stop_at is None:
+                    self.flush()
+                else:
+                    while time.monotonic() <= stop_at:
+                        with self._cv:
+                            for key in list(self._open):
+                                self._seal_locked(key)
+                            if not self._ready:
+                                break
+                        self._drain_ready(stop_at=stop_at)
+                    self._shed_remaining()
+        finally:
+            self._persist_state("shutdown")
+
+    def _shed_remaining(self):
+        """Fail everything still queued after a bounded shutdown
+        drain — each future gets a labeled ShutdownShed (the daemon
+        turns these into explicit shed response lines)."""
+        with self._cv:
+            reqs = list(self._queued_requests_locked())
+            self._open.clear()
+            self._ready.clear()
+            self._nqueued = 0
+            self.metrics.queue_depth(0)
+        for r in reqs:
+            self.admission.shed_shutdown += 1
+            if not r.future.done():
+                r.future.set_exception(ShutdownShed(
+                    f"{r.kind} request shed: engine shut down "
+                    f"before it dispatched (bounded drain timeout)"))
+
+    def _persist_state(self, reason: str):
+        if self.cache.aot is not None:
+            from pint_tpu.serve.journal import save_state
+
+            try:
+                save_state(self.cache.aot.dir,
+                           self.metrics.snapshot(), reason=reason)
+            except Exception:
+                pass
+        if self.journal is not None:
+            self.journal.close()
 
     def _loop(self):
         while True:
             with self._cv:
-                while not self._queue and not self._stop.is_set():
+                while not self._open and not self._ready and \
+                        not self._stop.is_set():
                     self._cv.wait(timeout=0.25)
-                if self._stop.is_set() and not self._queue:
-                    return
-            # first request seen: sleep out the coalescing window so
-            # a burst lands in one batch, but dispatch immediately
-            # once a full batch is waiting
-            deadline = time.monotonic() + self.window_s
-            while time.monotonic() < deadline:
-                with self._lock:
-                    if len(self._queue) >= self.max_batch or \
-                            self._stop.is_set():
+                if self._stop.is_set():
+                    stop_at = self._drain_stop_at
+                    if (not self._open and not self._ready) or \
+                            (stop_at is not None
+                             and time.monotonic() > stop_at):
+                        # drained clean, or the bounded shutdown
+                        # window is spent — stop() owns the labeled
+                        # shed of whatever remains; spinning here
+                        # would just burn the join timeout
+                        return
+            # continuous batching: hold open buckets for their
+            # coalescing window (a full bucket seals itself at
+            # admission), then seal and dispatch — new requests keep
+            # being admitted into fresh open buckets while sealed
+            # units are in flight
+            while not self._stop.is_set():
+                with self._cv:
+                    self._expire_locked(time.monotonic())
+                    if self._ready:
+                        break
+                    if not self._open:
+                        break
+                    now = time.monotonic()
+                    due = [key for key, b in self._open.items()
+                           if now >= b.opened_at + self.window_s]
+                    if due:
+                        for key in due:
+                            self._seal_locked(key)
                         break
                 time.sleep(min(1e-3, max(self.window_s, 1e-4)))
-            self.flush()
+            if self._stop.is_set():
+                with self._cv:
+                    for key in list(self._open):
+                        self._seal_locked(key)
+            try:
+                self._drain_ready(stop_at=self._drain_stop_at)
+            except EngineKilled:
+                return
 
 
 class ServeGLSFitter(Fitter):

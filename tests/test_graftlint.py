@@ -303,6 +303,23 @@ def _lint_dispatch(src, relpath="pint_tpu/serve/_fixture.py"):
     return gl.check_g6_dispatch(m, per[relpath] | priv)
 
 
+def test_g6_covers_new_serve_modules():
+    """ISSUE-8 satellite: the dispatch half of G6 applies to the new
+    serve modules (admission/router/journal) — a direct jit-product
+    call there is a lint error, same as the rest of the serve layer.
+    """
+    for mod in ("admission", "router", "journal"):
+        rel = f"pint_tpu/serve/{mod}.py"
+        assert gl._g6_dispatch_applies(rel), rel
+        v = _lint_dispatch("""
+            import jax
+            primer = jax.jit(lambda x: x + 1)
+            def prime(x):
+                return primer(x)
+        """, relpath=rel)
+        assert [x.rule for x in v] == ["G6"], rel
+
+
 def test_g6_dispatch_flags_direct_jit_product_call():
     v = _lint_dispatch("""
         import jax

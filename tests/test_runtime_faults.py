@@ -395,6 +395,92 @@ def test_serve_drain_completes_every_future_under_backend_death(
     assert "DEGRADED" in eng.metrics.report()
 
 
+# ------------------------------------------------ chaos (ISSUE 8)
+
+
+def test_chaos_overload_tenant_burst_backend_death(monkeypatch):
+    """ISSUE-8 chaos oracle: injected backend death MID-BURST + a
+    quota-exceeding tenant + injected admission overload, all at
+    once. Required outcome: zero hung futures, every request
+    accounted served / shed / failover in the metrics (nothing
+    silently dropped), results for served requests still correct,
+    counters honest."""
+    from pint_tpu.serve import ServeEngine, ServeOverload
+    from pint_tpu.serve.request import TenantOverQuota
+    from pint_tpu.serve.workload import build_workload
+
+    fresh = build_workload(12, sizes=(40, 90), base=2700,
+                           prebuild=True, entry_name="CHAOS")
+    # reference pass (no faults): warms compiles AND gives the oracle
+    ref_eng = ServeEngine()
+    ref_futs = [ref_eng.submit(r) for r in fresh()]
+    ref_eng.flush()
+    ref_res = [f.result(timeout=0) for f in ref_futs]
+
+    monkeypatch.setenv("PINT_TPU_DISPATCH_DEADLINE_MS", "250")
+    eng = ServeEngine()
+    plan = FaultPlan([
+        # the GLS backend dies after its first dispatch of the burst
+        Fault(match="serve.gls", kind="hang", seconds=5.0, after=1),
+        # tenant "noisy" is bursting past quota the whole time
+        Fault(match="serve.admit/noisy", kind="tenant_burst"),
+        # and two admissions see injected capacity exhaustion
+        Fault(match="serve.admit/capacity", kind="overload",
+              after=6, count=2),
+    ])
+    reqs = fresh()
+    for i, r in enumerate(reqs):
+        if i % 6 == 5:
+            r.tenant = "noisy"
+    shed_quota = shed_overload = 0
+    futs, labels = [], []
+    t0 = time.monotonic()
+    with plan.active():
+        for r in reqs:
+            try:
+                futs.append((r, eng.submit(r)))
+            except TenantOverQuota:
+                shed_quota += 1
+                labels.append("shed")
+            except ServeOverload:
+                shed_overload += 1
+                labels.append("shed")
+        eng.flush()
+    wall = time.monotonic() - t0
+    assert wall < 5.0 - 1.0  # bounded by failover, not the hang
+    # ZERO hung futures: every admitted request resolved
+    assert all(f.done() for _, f in futs)
+    served = 0
+    ref_by_idx = {id(r): res for r, res in zip(reqs, ref_res)}
+    for r, f in futs:
+        res = f.result(timeout=0)  # labeled failover, never raises
+        served += 1
+        labels.append("served")
+        ref = ref_by_idx[id(r)]
+        if hasattr(res, "phase_int"):
+            tot = (np.asarray(res.phase_int) - np.asarray(ref.phase_int)
+                   + np.asarray(res.phase_frac)
+                   - np.asarray(ref.phase_frac))
+            assert np.all(np.abs(tot) < 1e-9)
+        else:
+            assert res.chi2 == pytest.approx(ref.chi2, rel=1e-8)
+    # conservation: every request accounted, nothing silent
+    assert served + shed_quota + shed_overload == len(reqs)
+    assert shed_quota >= 1       # the noisy tenant really shed
+    assert shed_overload >= 1    # the injected overload really shed
+    snap = eng.metrics.snapshot()
+    assert snap["completed"] == served
+    adm = snap["admission"]
+    assert adm["shed_quota"] == shed_quota
+    assert adm["injected_overload"] == 2
+    assert adm["tenants"]["noisy"]["shed"] == shed_quota
+    disp = snap["dispatch"]
+    assert disp["failovers"] >= 1  # the dead backend was failed over
+    assert disp["timeouts"] >= 1
+    assert "DEGRADED" in eng.metrics.report()
+    assert "SHED" in eng.metrics.report()
+
+
 # ------------------------------------------------- pipelined drain
 
 

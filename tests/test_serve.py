@@ -524,6 +524,269 @@ def test_workload_builder_shared_by_bench_and_demo():
     assert all(getattr(r, "problem", None) is None for _, r in reqs)
 
 
+# ------------------------------------------- admission (ISSUE 8)
+
+
+def test_expired_request_shed_while_queued(zoo):
+    """ISSUE-8 satellite regression: a deadline-dead request is
+    expired IN QUEUE (at the next admission touch) with the
+    shed_expired counter — not discovered at drain time after
+    consuming queue capacity the whole while."""
+    m, t = zoo[0]
+    eng = ServeEngine()
+    doomed = eng.submit(FitStepRequest(t, m, deadline_s=0.01))
+    time.sleep(0.03)
+    live = eng.submit(ResidualsRequest(t, m))  # sweep fires here
+    assert doomed.done()  # failed BEFORE any flush/dispatch
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=0)
+    snap = eng.metrics.snapshot()
+    assert snap["admission"]["shed_expired"] == 1
+    assert snap["deadline_missed"] == 1
+    eng.flush()
+    assert live.result(timeout=0).chi2 > 0
+
+
+def test_tenant_quota_sheds_bursting_tenant(zoo):
+    """Per-tenant token buckets: a bursting tenant is shed with
+    TenantOverQuota while other tenants keep being admitted — one
+    noisy tenant cannot starve the deployment."""
+    from pint_tpu.serve import TenantOverQuota
+
+    m, t = zoo[0]
+    eng = ServeEngine(tenant_qps=0.001, tenant_burst=2)
+    ok_a = eng.submit(FitStepRequest(t, m, tenant="noisy"))
+    ok_b = eng.submit(ResidualsRequest(t, m, tenant="noisy"))
+    with pytest.raises(TenantOverQuota):
+        eng.submit(FitStepRequest(t, m, tenant="noisy"))
+    ok_c = eng.submit(FitStepRequest(t, m, tenant="quiet"))
+    eng.flush()
+    for f in (ok_a, ok_b, ok_c):
+        assert f.result(timeout=0).chi2 > 0
+    adm = eng.metrics.snapshot()["admission"]
+    assert adm["shed_quota"] == 1
+    assert adm["tenants"]["noisy"] == {"admitted": 2, "shed": 1}
+    assert adm["tenants"]["quiet"] == {"admitted": 1, "shed": 0}
+
+
+def test_deadline_aware_shed_policy(zoo):
+    """The shed policy: at capacity, shed the request that will miss
+    its deadline ANYWAY (a doomed queued victim, or the doomed
+    newcomer itself) — and NEVER one that can still make it; with
+    nobody provably doomed, plain backpressure."""
+    m, t = zoo[0]
+    eng = ServeEngine(queue_cap=2, shed_policy="deadline")
+    # teach the router a glacial service rate so predicted waits
+    # dwarf any deadline below
+    eng.router.seed_rate("device", "gls", 1.0)
+    doomed = eng.submit(FitStepRequest(t, m, deadline_s=5.0))
+    live = eng.submit(ResidualsRequest(t, m))  # no deadline: safe
+    # at capacity: the doomed queued request is shed, the newcomer
+    # (no deadline — can always "make it") is admitted in its place
+    new = eng.submit(FitStepRequest(t, m))
+    assert doomed.done()
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=0)
+    assert eng.admission.shed_deadline == 1
+    # at capacity again: nobody queued is doomed (no deadlines), but
+    # the NEWCOMER cannot make its own deadline — shed it (a labeled
+    # failed future, not a transport error)
+    doomed2 = eng.submit(FitStepRequest(t, m, deadline_s=0.5))
+    assert doomed2.done()
+    with pytest.raises(DeadlineExceeded):
+        doomed2.result(timeout=0)
+    assert eng.admission.shed_deadline == 2
+    # nobody doomed anywhere: honest backpressure
+    with pytest.raises(ServeOverload):
+        eng.submit(FitStepRequest(t, m))
+    eng.flush()
+    assert live.result(timeout=0).chi2 > 0
+    assert new.result(timeout=0).chi2 > 0
+
+
+def test_shed_policy_wait_is_position_aware(zoo):
+    """Review fix: a queued candidate's predicted wait counted EVERY
+    other queued request's rows — batch-mates and requests queued
+    BEHIND it included — so a head-of-queue request that was about
+    to be served on time could be declared doomed and shed,
+    violating the never-shed-a-survivor invariant. Waits are now
+    prefix sums in dispatch order (only rows AHEAD count)."""
+    m, t = zoo[0]
+    eng = ServeEngine(queue_cap=3, shed_policy="deadline")
+    head_req = FitStepRequest(t, m, deadline_s=2.0)
+    head = eng.submit(head_req)
+    eng.submit(FitStepRequest(t, m))       # behind: no deadline
+    eng.submit(ResidualsRequest(t, m))     # behind: no deadline
+    rows = head_req.problem.M.shape[0]
+    # one request's rows per second: head's own wait ~1 s, within
+    # its 2 s budget — but the OLD all-queued-rows estimate (~3 s)
+    # declared it doomed
+    eng.router.seed_rate("device", "gls", float(rows))
+    with pytest.raises(ServeOverload):
+        eng.submit(FitStepRequest(t, m))   # at capacity, no deadline
+    assert not head.done()                 # head was NOT shed
+    assert eng.admission.shed_deadline == 0
+    eng.flush()
+
+
+def test_reject_policy_restores_plain_backpressure(zoo):
+    """shed_policy="reject": queued requests are never touched, the
+    newcomer is rejected — the pre-ISSUE-8 behavior, pinnable."""
+    m, t = zoo[0]
+    eng = ServeEngine(queue_cap=1, shed_policy="reject")
+    eng.router.seed_rate("device", "gls", 1.0)
+    # 60 s deadline: provably doomed under the 1-row/s seeded rate
+    # (the deadline policy WOULD shed it), but nowhere near expiring
+    # in queue during the test
+    queued = eng.submit(FitStepRequest(t, m, deadline_s=60.0))
+    with pytest.raises(ServeOverload):
+        eng.submit(FitStepRequest(t, m))
+    assert not queued.done()  # the doomed one was NOT shed
+    assert eng.admission.shed_deadline == 0
+
+
+# ---------------------------------------------- router (ISSUE 8)
+
+
+def test_breaker_demotion_routes_to_host_pool(zoo):
+    """An OPEN device breaker demotes the pool: units route straight
+    to the host mirrors as PLANNED capacity (no per-dispatch
+    watchdog-timeout-then-failover dance), labeled in the router
+    block, and results match the device path."""
+    from pint_tpu.runtime import OPEN, breaker_for, reset_runtime
+
+    reset_runtime()
+    try:
+        m, t = zoo[2]
+        ref = ServeEngine().submit(FitStepRequest(t, m)).result()
+        eng = ServeEngine()
+        br = breaker_for("cpu")
+        for _ in range(br.threshold):
+            br.on_result(False)
+        assert br.state == OPEN
+        futs = [eng.submit(FitStepRequest(t, m)),
+                eng.submit(ResidualsRequest(t, m))]
+        eng.flush()
+        res = [f.result(timeout=0) for f in futs]
+        np.testing.assert_allclose(res[0].dparams, ref.dparams,
+                                   rtol=1e-8, atol=1e-15)
+        assert res[0].chi2 == pytest.approx(ref.chi2, rel=1e-8)
+        snap = eng.metrics.snapshot()
+        rt = snap["router"]
+        assert rt["host"]["dispatches"] >= 1
+        assert rt["host"]["demotions"] >= 1
+        assert rt["device"]["dispatches"] == 0
+        # routed, not failed over: the supervisor never even saw the
+        # broken backend
+        assert snap["dispatch"]["failovers"] == 0
+        assert snap["dispatch"]["breaker_rejections"] == 0
+        assert "pools:" in eng.metrics.report()
+    finally:
+        reset_runtime()
+
+
+def test_router_steers_by_learned_rates(zoo):
+    """With BOTH pools' rates learned, the router sends a unit to the
+    predicted-faster pool — host CPU as concurrent capacity, not just
+    a failover target."""
+    m, t = zoo[0]
+    eng = ServeEngine()
+    eng.router.seed_rate("host", "gls", 1e12)
+    eng.router.seed_rate("device", "gls", 1e-3)
+    fut = eng.submit(FitStepRequest(t, m))
+    eng.flush()
+    assert fut.result(timeout=0).chi2 > 0
+    rt = eng.metrics.snapshot()["router"]
+    assert rt["host"]["dispatches"] == 1
+    assert rt["device"]["dispatches"] == 0
+    # host never learned = device preferred (no guessing on no
+    # evidence): a fresh engine routes everything to the device
+    eng2 = ServeEngine()
+    fut = eng2.submit(FitStepRequest(t, m))
+    eng2.flush()
+    fut.result(timeout=0)
+    assert eng2.metrics.snapshot()["router"]["host"]["dispatches"] == 0
+
+
+# ------------------------------------- daemon lifecycle (ISSUE 8)
+
+
+def test_daemon_graceful_shutdown_sheds_queued(capsys, tmp_path):
+    """ISSUE-8 satellite: SIGTERM/SIGINT used to drop queued JSONL
+    requests on the floor. Now the bounded drain sheds them with an
+    explicit {"status": "shed", "reason": "shutdown"} line each, the
+    journal acks them terminally, and the session snapshot still
+    prints LAST."""
+    import json
+    import os
+
+    from pint_tpu.scripts.pint_serve import _Shutdown, main
+
+    datadir = os.path.join(os.path.dirname(__file__), "datafile")
+    par = os.path.join(datadir, "NGC6440E.par")
+    tim = os.path.join(datadir, "NGC6440E.tim")
+    jpath = str(tmp_path / "journal.jsonl")
+
+    def feed():
+        yield json.dumps({"kind": "fit_step", "par": par,
+                          "tim": tim, "id": "a"}) + "\n"
+        yield json.dumps({"kind": "residuals", "par": par,
+                          "tim": tim, "id": "b"}) + "\n"
+        raise _Shutdown("SIGTERM")  # the signal handler's raise
+
+    # a huge window keeps both requests queued when the signal lands;
+    # drain timeout 0 = shed everything still queued
+    assert main(["--window-ms", "60000", "--drain-timeout-s", "0",
+                 "--journal", jpath], stdin=feed()) == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    snap = lines[-1]
+    assert snap["metric"] == "serve_session"
+    assert snap["shutdown_signal"] == "SIGTERM"
+    shed = [x for x in lines if x.get("status") == "shed"]
+    assert sorted(x["id"] for x in shed) == ["a", "b"]
+    assert all(x["reason"] == "shutdown" for x in shed)
+    assert snap["admission"]["shed_shutdown"] == 2
+    # terminal journal acks: the client was told, no replay owed
+    acks = [json.loads(x)["status"] for x in open(jpath)
+            if json.loads(x)["op"] == "ack"]
+    assert acks == ["shed:shutdown", "shed:shutdown"]
+
+
+def test_daemon_startup_shutdown_sheds_pending_stdin(capsys,
+                                                     monkeypatch):
+    """Verification finding on the ISSUE-8 graceful-shutdown
+    satellite: the handlers were installed AFTER the multi-second
+    pint_tpu/jax import, so a SIGTERM during startup hit the default
+    handler — process killed, lines already written to stdin
+    silently dropped (observed live: exit -15, 60 lines, zero shed
+    lines). Handlers now install before the heavy imports and a
+    startup-window shutdown sheds every pending line explicitly."""
+    import json
+
+    import pint_tpu.serve as serve_mod
+    from pint_tpu.scripts.pint_serve import _Shutdown, main
+
+    def dies_in_ctor(*a, **k):
+        raise _Shutdown("SIGTERM")  # the handler's raise, mid-ctor
+
+    monkeypatch.setattr(serve_mod, "ServeEngine", dies_in_ctor)
+    feed = [json.dumps({"kind": "fit_step", "par": "x.par",
+                        "tim": "x.tim", "id": "a"}) + "\n",
+            json.dumps({"kind": "phase", "entry": "DEMO",
+                        "mjds": [55000.0], "id": "b"}) + "\n",
+            "# comment\n", "\n"]
+    assert main([], stdin=feed) == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    shed = [x for x in lines if x.get("status") == "shed"]
+    assert sorted(x["id"] for x in shed) == ["a", "b"]
+    assert all(x["reason"] == "shutdown" for x in shed)
+    ev = [x for x in lines if x.get("event") == "shutdown"]
+    assert ev and ev[-1]["during"] == "startup" and \
+        ev[-1]["shed"] == 2
+
+
 # ---------------------------------------------------------- config
 
 
@@ -537,6 +800,32 @@ def test_serve_bucket_env_knob(monkeypatch):
     monkeypatch.delenv("PINT_TPU_SERVE_BUCKETS")
     edges = config.serve_bucket_edges()
     assert edges[0] == 64 and edges[-1] == 16384
+
+
+def test_issue8_env_knobs(monkeypatch):
+    from pint_tpu import config
+
+    monkeypatch.setenv("PINT_TPU_TENANT_QPS", "12.5")
+    assert config.tenant_qps() == 12.5
+    assert config.tenant_burst() == 25.0  # default 2x, >= 1
+    monkeypatch.setenv("PINT_TPU_TENANT_BURST", "4")
+    assert config.tenant_burst() == 4.0
+    monkeypatch.delenv("PINT_TPU_TENANT_QPS")
+    assert config.tenant_qps() == 0.0  # disabled by default
+    monkeypatch.setenv("PINT_TPU_SHED_POLICY", "reject")
+    assert config.shed_policy() == "reject"
+    monkeypatch.setenv("PINT_TPU_SHED_POLICY", "banana")
+    assert config.shed_policy() == "deadline"  # warned, defaulted
+    monkeypatch.delenv("PINT_TPU_SHED_POLICY")
+    assert config.shed_policy() == "deadline"
+    assert config.aot_dir() is None
+    monkeypatch.setenv("PINT_TPU_AOT_DIR", "/tmp/x")
+    assert config.aot_dir() == "/tmp/x"
+    assert config.journal_path() is None
+    monkeypatch.setenv("PINT_TPU_JOURNAL", "/tmp/j.jsonl")
+    assert config.journal_path() == "/tmp/j.jsonl"
+    monkeypatch.setenv("PINT_TPU_SERVE_DRAIN_TIMEOUT_S", "7")
+    assert config.serve_drain_timeout_s() == 7.0
 
 
 def test_rtt_env_read_before_cache(monkeypatch):

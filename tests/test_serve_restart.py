@@ -1,0 +1,282 @@
+"""Crash-safe restart acceptance (ISSUE 8).
+
+The restart oracle: a killed-and-restarted engine must produce
+BIT-IDENTICAL responses for replayed journal entries vs an
+uninterrupted run, and a warm restart from the AOT store must serve
+its first bucketed request with ZERO new serve-kernel compiles
+(Sanitizer ``_cache_size``-asserted). The kill is the injected
+``kill_restart`` fault — a simulated SIGKILL at the drain boundary:
+in-flight futures die unresolved exactly as a process death would
+leave them, and the journal's unacknowledged entries are the replay
+set.
+
+Bitwise equivalence holds because (a) the replay factory rebuilds the
+identical requests in journal order, so the restarted engine seals
+identical buckets (same shape class, same batch pad), and (b) a
+restored jax.export artifact is the SAME lowered program XLA compiled
+for the uninterrupted engine — deterministic compilation on one
+machine gives bit-equal outputs per batch slot.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pint_tpu.runtime import Fault, FaultPlan, reset_runtime
+from pint_tpu.serve import (
+    EngineKilled,
+    FitStepRequest,
+    PhasePredictRequest,
+    ServeEngine,
+)
+from pint_tpu.serve.journal import AotStore, RequestJournal
+from pint_tpu.serve.workload import demo_polyco_entry, synth_pulsar
+
+
+@pytest.fixture(autouse=True)
+def clean_runtime():
+    reset_runtime()
+    yield
+    reset_runtime()
+
+
+@pytest.fixture(scope="module")
+def stock():
+    """Two small pulsars (prebuilt problems) + one polyco entry —
+    enough for two shape classes, deterministic by construction
+    (synth_pulsar is seeded)."""
+    from pint_tpu.parallel.pta import build_problem
+
+    pulsars = {k: synth_pulsar(k, 40, base=3100) for k in (0, 1)}
+    problems = {k: build_problem(t, m)
+                for k, (m, t) in pulsars.items()}
+    return {"entry": demo_polyco_entry("RESTART"),
+            "problems": problems}
+
+
+def _mk_batch(stock):
+    """One mixed batch with journalable payloads; composition is
+    FIXED so every run seals identical buckets (same Pb — the
+    bitwise-equality precondition)."""
+    mjds = (55000.0 + np.linspace(-0.01, 0.01, 24)).tolist()
+    return [
+        PhasePredictRequest(stock["entry"], np.asarray(mjds),
+                            payload={"kind": "phase", "mjds": mjds}),
+        FitStepRequest(problem=stock["problems"][0],
+                       payload={"kind": "fit", "k": 0}),
+        FitStepRequest(problem=stock["problems"][1],
+                       payload={"kind": "fit", "k": 1}),
+    ]
+
+
+def _factory(stock):
+    def factory(payload):
+        if payload["kind"] == "phase":
+            return PhasePredictRequest(
+                stock["entry"], np.asarray(payload["mjds"]),
+                payload=payload)
+        return FitStepRequest(
+            problem=stock["problems"][payload["k"]], payload=payload)
+
+    return factory
+
+
+def _assert_bitwise(a, b):
+    if hasattr(a, "phase_int"):
+        np.testing.assert_array_equal(np.asarray(a.phase_int),
+                                      np.asarray(b.phase_int))
+        np.testing.assert_array_equal(np.asarray(a.phase_frac),
+                                      np.asarray(b.phase_frac))
+    else:
+        np.testing.assert_array_equal(np.asarray(a.dparams),
+                                      np.asarray(b.dparams))
+        np.testing.assert_array_equal(np.asarray(a.cov),
+                                      np.asarray(b.cov))
+        assert a.chi2 == b.chi2 and a.chi2r == b.chi2r
+
+
+def test_kill_restart_replay_bit_identical_and_warm(tmp_path, stock):
+    """THE restart oracle: kill mid-burst -> restart -> replay ->
+    bit-identical responses, zero new compiles on the warm engine."""
+    from pint_tpu.analysis import Sanitizer
+
+    aot = str(tmp_path / "aot")
+    jpath = str(tmp_path / "journal.jsonl")
+
+    # --- engine B: serves batch 1 (compiles + AOT-exports its
+    # classes), then dies mid-drain holding batch 2
+    eng_b = ServeEngine(aot_dir=aot, journal=jpath)
+    b1 = [eng_b.submit(r) for r in _mk_batch(stock)]
+    eng_b.flush()
+    for f in b1:
+        f.result(timeout=0)
+    assert eng_b.cache.aot.exported == 2  # phase + gls classes
+    b2 = [eng_b.submit(r) for r in _mk_batch(stock)]
+    plan = FaultPlan([Fault(match="serve.drain",
+                            kind="kill_restart")])
+    with plan.active():
+        with pytest.raises(EngineKilled):
+            eng_b.flush()
+    # a SIGKILL leaves futures unresolved and journal entries
+    # unacknowledged — that is the replay contract
+    assert all(not f.done() for f in b2)
+    assert eng_b.journal.counts()["unacknowledged"] == 3
+    with pytest.raises(EngineKilled):
+        eng_b.submit(_mk_batch(stock)[0])
+
+    # --- reference: an UNINTERRUPTED engine serving batch 1 then
+    # batch 2 (same compositions, fresh jit compiles)
+    eng_r = ServeEngine()
+    r1 = [eng_r.submit(r) for r in _mk_batch(stock)]
+    eng_r.flush()
+    for f in r1:
+        f.result(timeout=0)
+    r2 = [eng_r.submit(r) for r in _mk_batch(stock)]
+    eng_r.flush()
+    ref = [f.result(timeout=0) for f in r2]
+
+    # --- engine C: warm restart — restores+primes the AOT classes,
+    # replays the unacknowledged journal entries
+    eng_c = ServeEngine(aot_dir=aot, journal=jpath)
+    assert eng_c.metrics.restart_info["warm"] is True
+    assert eng_c.cache.aot.restored == 2
+    with Sanitizer() as san:
+        san.watch(eng_c.cache._gls, "gls")
+        san.watch(eng_c.cache._phase, "phase")
+        futs = eng_c.replay(_factory(stock))
+        assert len(futs) == 3
+        eng_c.flush()
+        res = [f.result(timeout=0) for f in futs]
+        growth = san.executable_growth()
+    # zero new compiles: the serve kernels' executable caches did not
+    # grow — the restored artifacts served the first requests
+    assert all(g in (0, None) for g in growth.values()), growth
+    assert eng_c.cache.jit_cache_size() in (0, None)
+    assert san.compiles() == 0
+    # bit-identical to the uninterrupted run, slot by slot
+    for a, b in zip(res, ref):
+        _assert_bitwise(a, b)
+    # the journal is fully acknowledged now; the restart block labels
+    # what happened
+    assert eng_c.journal.counts()["unacknowledged"] == 0
+    snap = eng_c.metrics.snapshot()
+    assert snap["restart"]["replayed"] == 3
+    assert snap["restart"]["aot"]["restored"] == 2
+    assert "restart: warm=True" in eng_c.metrics.report()
+
+
+def test_state_snapshot_written_on_stop(tmp_path, stock):
+    from pint_tpu.serve.journal import load_state
+
+    aot = str(tmp_path / "aot")
+    eng = ServeEngine(aot_dir=aot)
+    fut = eng.submit(FitStepRequest(problem=stock["problems"][0]))
+    eng.flush()
+    fut.result(timeout=0)
+    eng.stop()
+    state = load_state(aot)
+    assert state is not None
+    assert state["reason"] == "shutdown"
+    assert state["metrics"]["completed"] == 1
+    # the restarted engine reads the prior shutdown reason
+    eng2 = ServeEngine(aot_dir=aot)
+    assert eng2.metrics.restart_info["prior_shutdown"] == "shutdown"
+    assert eng2.metrics.restart_info["warm"] is True
+
+
+def test_aot_store_skips_foreign_configuration(tmp_path):
+    """Artifacts from another platform / jax version / precision mode
+    must be SKIPPED, never mis-served."""
+    d = str(tmp_path / "aot")
+    store = AotStore(d, donation=False)
+    store._write_manifest({"gls/64/8/0/1": {
+        "kind": "gls", "key": [64, 8, 0, 1], "file": "missing.bin",
+        "avals": [[[1, 4], "float64"]], "donation": False,
+        "jax": "0.0.1", "platform": "tpu", "x64": True}})
+    fresh = AotStore(d, donation=False)
+    assert fresh.restore_all() == 0
+    assert fresh.get("gls", (64, 8, 0, 1)) is None
+
+
+def test_journal_replay_set_and_torn_tail(tmp_path):
+    """Unacknowledged = admits with no terminal ack ("replayed" is a
+    progress marker, not terminal); a torn tail line from a crash
+    mid-write is skipped, not fatal."""
+    jpath = str(tmp_path / "j.jsonl")
+    j = RequestJournal(jpath)
+    j.admit("r1", {"kind": "x"})
+    j.admit("r2", {"kind": "y"})
+    j.ack("r1", "served")
+    j.admit("r3", {"kind": "z"})
+    j.ack("r3", "replayed")  # non-terminal: still owed
+    j.close()
+    with open(jpath, "a") as fh:
+        fh.write('{"op": "admit", "rid": "torn')  # crash mid-write
+    j2 = RequestJournal(jpath)
+    un = j2.unacknowledged()
+    assert [r["rid"] for r in un] == ["r2", "r3"]
+    assert j2.counts() == {"admitted": 3, "acked": 1,
+                           "unacknowledged": 2}
+    j2.ack("r2", "shed:shutdown")  # shed is terminal: client told
+    j2.ack("r3", "served")
+    assert j2.unacknowledged() == []
+    j2.close()
+
+
+def test_replay_does_not_duplicate_admit_records(tmp_path, stock):
+    """Review fix: replay() re-submits through submit(), whose
+    journal hook wrote a SECOND admit line (full payload, same rid)
+    per replayed entry — the journal grew by the payload volume and
+    ``admitted`` double-counted on every restart cycle. A replayed
+    entry owes only its terminal ack."""
+    jpath = str(tmp_path / "journal.jsonl")
+    eng_a = ServeEngine(journal=jpath)
+    for r in _mk_batch(stock):
+        eng_a.submit(r)
+    del eng_a  # simulated SIGKILL: admitted, never flushed or acked
+
+    eng_b = ServeEngine(journal=jpath)
+    futs = eng_b.replay(_factory(stock))
+    assert len(futs) == 3
+    eng_b.flush()
+    for f in futs:
+        f.result(timeout=0)
+    ops = [json.loads(x) for x in open(jpath)]
+    admits = [o for o in ops if o["op"] == "admit"]
+    assert len(admits) == 3  # one per original submit, none added
+    j = RequestJournal(jpath)
+    assert j.counts() == {"admitted": 3, "acked": 3,
+                          "unacknowledged": 0}
+    eng_b.stop()
+
+
+def test_daemon_replays_unacked_journal(tmp_path, capsys):
+    """The daemon's startup replay: a journal left by a killed
+    process (admit, no ack) is re-served before stdin, and the
+    session snapshot labels the replay."""
+    import os
+
+    from pint_tpu.scripts.pint_serve import main
+
+    datadir = os.path.join(os.path.dirname(__file__), "datafile")
+    rec = {"kind": "fit_step", "id": "r1",
+           "par": os.path.join(datadir, "NGC6440E.par"),
+           "tim": os.path.join(datadir, "NGC6440E.tim")}
+    jpath = str(tmp_path / "j.jsonl")
+    with open(jpath, "w") as fh:
+        fh.write(json.dumps({"op": "admit", "rid": "r1",
+                             "payload": rec}) + "\n")
+    assert main(["--window-ms", "2", "--journal", jpath],
+                stdin=iter(())) == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    snap = lines[-1]
+    assert snap["metric"] == "serve_session"
+    res = [x for x in lines if x.get("id") == "r1"]
+    assert len(res) == 1 and res[0]["ok"] and "chi2" in res[0]
+    assert snap["restart"]["replayed"] == 1
+    # fully acknowledged: a second restart owes nothing
+    j = RequestJournal(jpath)
+    assert j.unacknowledged() == []
+    j.close()

@@ -49,6 +49,7 @@ STAGES = {
     "stress": "stress_nanograv_like_10k_fit",
     "stress_wideband": "stress_nanograv_like_10k_fit_wideband",
     "serve": "serve_coalesced_vs_sequential_64req",
+    "serve_degraded": "serve_degraded_overload",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
 ATTR_VARIANTS = ("production", "no_hybrid_jac", "jac_f64",
@@ -299,6 +300,23 @@ def stage_serve(backend):
     print(json.dumps(rec), flush=True)
 
 
+def stage_serve_degraded(backend):
+    """Coalesced-vs-shed throughput under injected overload (ISSUE
+    8): the admission controller's shed policy exercised ON CHIP —
+    what the service actually delivers when a burst exceeds
+    capacity, with every shed labeled in the record."""
+    import bench_serve
+
+    rec = bench_serve.run_degraded(nreq=64)
+    if rec.get("backend") != backend:
+        raise RuntimeError(
+            f"bench_serve.run_degraded ran on {rec.get('backend')!r}"
+            f", not {backend!r} (tunnel died?); stage stays on the "
+            f"to-do list")
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
 def run_stage(name, backend):
     bench.log(f"=== stage {name} ===")
     t0 = time.perf_counter()
@@ -326,6 +344,8 @@ def run_stage(name, backend):
         stage_stress(backend, wideband=True)
     elif name == "serve":
         stage_serve(backend)
+    elif name == "serve_degraded":
+        stage_serve_degraded(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "
