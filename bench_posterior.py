@@ -1,0 +1,315 @@
+"""Posterior-sampling benchmark (ISSUE 9): whole-chain-on-device
+MCMC vs the per-step dispatch baseline.
+
+The pre-ISSUE-9 ensemble loop paid two supervised dispatches PER MCMC
+step (the exact dispatch-tax shape ISSUE 7 eliminated for fitting);
+``pint_tpu.sampling`` collapses an entire ensemble run into one
+deadline-supervised ``lax.scan`` dispatch per chain chunk. This bench
+measures both modes ON THE SAME KERNEL — ``mode="host_loop"`` is the
+chunk program compiled at K=1, consuming the identical positional
+PRNG stream, so the speedup is pure dispatch-tax amortization. On the
+CPU (IEEE) backend the two chains are asserted BIT-IDENTICAL before
+any number is reported; on an accelerator the flag is recorded
+honestly in the artifact (K=1 and K=256 are different XLA programs —
+under the TPU's non-correctly-rounded emulated f64 they may round
+differently without either being wrong).
+
+Run:  python bench_posterior.py [--nsteps 512] [--nwalkers 32]
+                                [--repeats 3] [--serve]
+Prints one JSON line per mode; the LAST line is the artifact
+(steps/s per mode, speedup, dispatch_overhead block with the
+<10%-target overhead_frac, dispatch_supervisor counters, lint state).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+PAR = """
+PSR J0005+0005
+RAJ 08:00:00.0
+DECJ 25:00:00.0
+F0 180.0 1
+F1 -2.5e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 12.0
+DMEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+
+def build_posterior(ntoa: int = 120):
+    """One simulated pulsar's DevicePosterior (fixed noise — the
+    bench target is the CHAIN dispatch shape, not the likelihood's
+    internals) with proper Gaussian priors so every walker starts
+    finite."""
+    import io
+    import warnings
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.models.priors import GaussianPrior
+    from pint_tpu.sampling import DevicePosterior
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(PAR))
+        toas = make_fake_toas_uniform(
+            54000, 56000, ntoa, model, freq_mhz=1400.0,
+            add_noise=True, rng=np.random.default_rng(42))
+    for name in ("F0", "F1"):
+        p = model.get_param(name)
+        p.prior = GaussianPrior(p.value,
+                                max(abs(p.value) * 1e-9, 1e-18))
+    return DevicePosterior(model, toas)
+
+
+def _run_mode(post, mode: str, nwalkers: int, nsteps: int,
+              repeats: int, seed: int = 7):
+    """Best-of-``repeats`` wall for one mode; returns (wall_s,
+    sampler) of the best run (compiles paid by a warmup run)."""
+    import numpy as np
+
+    from pint_tpu.sampling import DeviceEnsembleSampler
+
+    p0 = post.init_walkers(nwalkers, rng=np.random.default_rng(3))
+    walls = []
+    # ONE sampler across warmup + repeats: its jitted chunk program
+    # compiles on the warmup run, so the timed runs measure dispatch
+    # + chain math, not retracing (run_mcmc overwrites chain state;
+    # identical seed -> identical chain every run)
+    samp = DeviceEnsembleSampler(nwalkers, post.nparams,
+                                 post.lnpost_batch)
+    for r in range(repeats + 1):  # +1 warmup
+        samp.dispatches = 0
+        t0 = time.perf_counter()
+        samp.run_mcmc(p0, nsteps, seed=seed, mode=mode)
+        w = time.perf_counter() - t0
+        if r > 0:
+            walls.append(w)
+    return min(walls), samp
+
+
+def measure_overhead(post, nwalkers: int, nsteps: int,
+                     wall_scan: float, seed: int = 7) -> dict:
+    """Dispatch-overhead split for the whole-chain mode: the marginal
+    per-step cost comes from the SAME compiled executable via budget
+    variation (a full-budget vs half-budget run of one chunk class),
+    so ``pure_step_ms`` is what the chain math itself costs and
+    ``overhead_frac`` is everything else — dispatch, PRNG host prep,
+    D2H readback (<10% target, same contract as bench.py's fit
+    artifact)."""
+    import numpy as np
+
+    from pint_tpu import config
+    from pint_tpu.sampling import DeviceEnsembleSampler
+
+    p0 = post.init_walkers(nwalkers, rng=np.random.default_rng(3))
+    # one sampler reused warm->timed, and both step counts chosen to
+    # quantize to the SAME chunk class K (nsteps is a runtime budget
+    # inside one executable), so the wall difference isolates the
+    # marginal in-kernel step cost with zero retracing between runs
+    s = DeviceEnsembleSampler(nwalkers, post.nparams,
+                              post.lnpost_batch)
+    K = config.chain_chunk_steps(nsteps)
+    full, half = K, K // 2 + 1   # both -> chunk class K
+
+    def wall_of(n):
+        s.run_mcmc(p0, n, seed=seed, mode="scan")  # warm
+        t0 = time.perf_counter()
+        s.run_mcmc(p0, n, seed=seed, mode="scan")
+        return time.perf_counter() - t0
+
+    w_full, w_half = wall_of(full), wall_of(half)
+    per_step_ms = max(0.0, (w_full - w_half) / (full - half)) * 1e3
+    pure_ms = per_step_ms * nsteps
+    wall_ms = wall_scan * 1e3
+    return {
+        "per_step_ms": round(per_step_ms, 4),
+        "pure_step_ms": round(pure_ms, 2),
+        "chain_wall_ms": round(wall_ms, 2),
+        "overhead_frac": round(
+            max(0.0, (wall_ms - pure_ms) / wall_ms), 4)
+        if wall_ms > 0 else None,
+    }
+
+
+def measure_serve(nwalkers: int, nsteps: int) -> dict:
+    """Coalesced PosteriorRequest serving: a 4-pulsar bucket runs as
+    ONE vmapped chunked dispatch sequence; reported against serving
+    the same requests one flush at a time."""
+    import copy
+    import io
+    import warnings
+
+    import numpy as np
+
+    from pint_tpu.models import get_model
+    from pint_tpu.parallel.pta import build_problem
+    from pint_tpu.serve import PosteriorRequest, ServeEngine
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    problems = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for k in range(4):
+            par = PAR.replace("F0 180.0", f"F0 {180.0 + 40 * k}")
+            m = get_model(io.StringIO(par))
+            toas = make_fake_toas_uniform(
+                54000, 56000, 100 + 10 * k, m, freq_mhz=1400.0,
+                add_noise=True, rng=np.random.default_rng(k))
+            problems.append(build_problem(toas, m))
+
+    def reqs():
+        return [PosteriorRequest(problem=copy.copy(pr),
+                                 nwalkers=nwalkers, nsteps=nsteps,
+                                 seed=11 + k)
+                for k, pr in enumerate(problems)]
+
+    def drive(eng, coalesced: bool):
+        futs = []
+        for r in reqs():
+            futs.append(eng.submit(r))
+            if not coalesced:
+                eng.flush()
+        eng.flush()
+        for f in futs:
+            f.result(timeout=0)
+
+    seq_eng, co_eng = ServeEngine(), ServeEngine()
+    drive(seq_eng, False)   # warmup + sequential compile
+    drive(co_eng, True)
+    t0 = time.perf_counter()
+    drive(seq_eng, False)
+    seq_w = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    drive(co_eng, True)
+    co_w = time.perf_counter() - t0
+    snap = co_eng.metrics.snapshot()
+    return {
+        "nreq": 4,
+        "sequential_wall_ms": round(seq_w * 1e3, 2),
+        "coalesced_wall_ms": round(co_w * 1e3, 2),
+        "coalesced_speedup": round(seq_w / co_w, 2),
+        "compile_count": snap["compile_count"],
+        "router": snap.get("router"),
+        "admission": snap.get("admission"),
+    }
+
+
+def run(nwalkers: int = 32, nsteps: int = 512, repeats: int = 3,
+        serve: bool = True) -> dict:
+    import jax
+    import numpy as np
+
+    from pint_tpu import config
+    from pint_tpu.runtime import get_supervisor
+
+    backend = jax.default_backend()
+    log(f"backend: {backend}")
+    post = build_posterior()
+    K = config.chain_chunk_steps(nsteps)
+    log(f"chain chunk K={K} for nsteps={nsteps}")
+
+    w_host, s_host = _run_mode(post, "host_loop", nwalkers, nsteps,
+                               repeats)
+    print(json.dumps({
+        "metric": "posterior_host_loop_steps_per_s",
+        "backend": backend, "unit": "steps/s",
+        "value": round(nsteps / w_host, 1),
+        "nsteps": nsteps, "nwalkers": nwalkers,
+        "dispatches": s_host.dispatches,
+        "wall_ms": round(w_host * 1e3, 2)}), flush=True)
+
+    w_scan, s_scan = _run_mode(post, "scan", nwalkers, nsteps,
+                               repeats)
+    bit_identical = bool(
+        np.array_equal(s_host.chain, s_scan.chain)
+        and np.array_equal(s_host.lnprob, s_scan.lnprob))
+    log(f"scan-vs-host_loop bit-identical: {bit_identical}")
+    if backend == "cpu" and not bit_identical:
+        # on IEEE hardware the two modes are the SAME kernel on the
+        # same stream — divergence is a regression, never a headline
+        raise RuntimeError(
+            "scan vs host_loop diverged on the CPU oracle backend")
+
+    rec = {
+        "metric": "posterior_whole_chain_vs_per_step",
+        "backend": backend, "unit": "x",
+        "value": round(w_host / w_scan, 2),
+        "nsteps": nsteps, "nwalkers": nwalkers,
+        "ndim": post.nparams,
+        "chunk_steps": K,
+        "host_loop_steps_per_s": round(nsteps / w_host, 1),
+        "whole_chain_steps_per_s": round(nsteps / w_scan, 1),
+        "whole_chain_dispatches": s_scan.dispatches,
+        "host_loop_dispatches": s_host.dispatches,
+        "acceptance": round(s_scan.acceptance_fraction, 3),
+        "bit_identical": bit_identical,
+        "dispatch_overhead": measure_overhead(post, nwalkers,
+                                              nsteps, w_scan),
+        "dispatch_supervisor": get_supervisor().snapshot(),
+        "lint": _lint_block(),
+    }
+    if serve:
+        rec["serve"] = measure_serve(nwalkers, max(64, nsteps // 4))
+    return rec
+
+
+def _lint_block():
+    try:
+        from pint_tpu.analysis import lint_state_safe
+
+        return lint_state_safe()
+    except Exception as e:  # analyzer package unimportable
+        return {"clean": None, "error": repr(e)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nsteps", type=int, default=512)
+    ap.add_argument("--nwalkers", type=int, default=32)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--no-serve", action="store_true",
+                    help="skip the coalesced-serving section")
+    args = ap.parse_args()
+
+    import os
+
+    if not os.environ.get("PINT_TPU_BENCH_FALLBACK") and \
+            os.environ.get("PALLAS_AXON_POOL_IPS"):
+        from bench import accelerator_responsive, cpu_fallback_env
+
+        if not accelerator_responsive():
+            log("accelerator backend unresponsive; re-running on CPU")
+            os.execvpe(sys.executable,
+                       [sys.executable, __file__] + sys.argv[1:],
+                       cpu_fallback_env())
+
+    import jax
+
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    rec = run(nwalkers=args.nwalkers, nsteps=args.nsteps,
+              repeats=args.repeats, serve=not args.no_serve)
+    print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -84,6 +84,14 @@ ALLOWLIST = [
              "precision) — same reviewed convention as frac_fn's "
              "baked reference point, rebuilt per BayesianTiming "
              "construction"),
+    dict(rule="G10", file="pint_tpu/sampling/likelihood.py",
+         match="def lnlike_core(tl_eff, eta):",
+         why="the noise-sampled lnlike_core bakes `f0` (reference "
+             "F0) as the turns->seconds scale of the whitened "
+             "residuals — the identical reviewed convention as "
+             "bayesian.py's fixed-noise lnlike_core (second-order "
+             "error in the sampled delta, delta_F0/F0 ~ 1e-12), "
+             "rebuilt per SampledNoiseLikelihood construction"),
     dict(rule="G10", file="pint_tpu/gridutils.py",
          match="def eval_node(gvals):", max_hits=2,
          why="the grid evaluator captures the frozen baseline pairs "

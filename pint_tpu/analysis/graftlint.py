@@ -666,7 +666,8 @@ def _g6_applies(relpath: str) -> bool:
 G6_DISPATCH_FILES = {"pint_tpu/fitter.py", "pint_tpu/gls.py",
                      "pint_tpu/wideband_fitter.py",
                      "pint_tpu/config.py"}
-G6_DISPATCH_DIRS = ("pint_tpu/serve/", "pint_tpu/parallel/")
+G6_DISPATCH_DIRS = ("pint_tpu/serve/", "pint_tpu/parallel/",
+                    "pint_tpu/sampling/")
 
 
 def _g6_dispatch_applies(relpath: str) -> bool:

@@ -76,8 +76,12 @@ class BayesianTiming:
                 "free_params / packed-parameter mismatch: "
                 f"{sorted(set(free) ^ set(self.param_labels))}")
         f0 = float(model.F0.value)
-        self.theta0, self._tl0, frac_fn = build_batched_phase_eval(
+        self.theta0, self._tl0, self._frac_fn = build_batched_phase_eval(
             model, toas)
+        # local alias for the traced closures below; the attribute is
+        # the shareable surface (sampling.SampledNoiseLikelihood
+        # reuses it instead of re-running the phase-eval build)
+        frac_fn = self._frac_fn
 
         nvec = jnp.asarray(model.scaled_toa_uncertainty(toas) ** 2)
         w = 1.0 / nvec
@@ -168,6 +172,11 @@ class BayesianTiming:
                 rCr = rCr - bF @ jax.scipy.linalg.cho_solve(Lf, bF)
             return -0.5 * rCr + lnnorm
 
+        # the raw (un-jitted) closure is the reusable traced surface:
+        # pint_tpu.sampling composes it into the whole-chain-on-device
+        # kernel, where it runs inside a lax.scan rather than as its
+        # own dispatch
+        self._lnlike_core_raw = lnlike_core
         self._lnlike_core = jax.jit(lnlike_core)
         self._lnlike_core_batch = jax.jit(jax.vmap(lnlike_core))
 

@@ -28,7 +28,8 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "serve_bucket_edges", "serve_window_s", "serve_max_batch",
            "serve_queue_cap", "serve_pipeline_depth",
            "tenant_qps", "tenant_burst", "shed_policy", "aot_dir",
-           "journal_path", "serve_drain_timeout_s"]
+           "journal_path", "serve_drain_timeout_s",
+           "chain_chunk_steps", "journal_compact_bytes"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -522,6 +523,42 @@ def serve_drain_timeout_s() -> float:
     must never hang forever either."""
     return max(0.0, float(_env_number(
         "PINT_TPU_SERVE_DRAIN_TIMEOUT_S", 30.0)))
+
+
+def chain_chunk_steps(nsteps: int, thin: int = 1) -> int:
+    """MCMC steps chained inside ONE whole-chain-on-device dispatch
+    (pint_tpu.sampling): the smallest power of two covering
+    ``nsteps``, clamped to [16, 256] and rounded up to a multiple of
+    ``thin`` — the chain analog of the whole-fit K quantization
+    (auto_steps_per_dispatch): K is part of the scan program's
+    compile key, so a raw nsteps would compile one executable per
+    distinct chain length, while the quantized set bounds it to 5
+    entries and the per-chunk RUNTIME budget argument keeps extra
+    compiled steps from executing. Longer chains run as chunked
+    multi-dispatch (each chunk its own supervised deadline, so serve
+    drains and SIGTERM stay bounded). $PINT_TPU_CHAIN_CHUNK pins the
+    chunk size (still rounded to a thin multiple)."""
+    env = _env_number("PINT_TPU_CHAIN_CHUNK", None, cast=int)
+    if env is not None:
+        k = max(1, int(env))
+    else:
+        k = 16
+        while k < int(nsteps) and k < 256:
+            k *= 2
+    thin = max(1, int(thin))
+    return ((k + thin - 1) // thin) * thin
+
+
+def journal_compact_bytes() -> int:
+    """Journal size past which ``RequestJournal`` auto-compacts
+    (rewrites itself to just the unacknowledged admit records;
+    $PINT_TPU_JOURNAL_COMPACT_BYTES, default 16 MiB, 0 disables): a
+    long-lived deployment's append-only journal otherwise grows
+    without bound even though the replay set stays tiny. Compaction
+    is atomic (tmp + rename, fsynced) so a crash mid-compaction
+    leaves the previous journal intact."""
+    return max(0, int(_env_number("PINT_TPU_JOURNAL_COMPACT_BYTES",
+                                  16 * 1024 * 1024, cast=int)))
 
 
 def serve_pipeline_depth() -> int:

@@ -1,9 +1,18 @@
 """MCMC fitting of timing models (and photon-template likelihoods).
 
 Reference: src/pint/mcmc_fitter.py (MCMCFitter,
-MCMCFitterAnalyticTemplate) + event_optimize's likelihood. Posterior
-machinery comes from BayesianTiming (one vmapped device call per
-walker batch); sampling from the in-repo EnsembleSampler.
+MCMCFitterAnalyticTemplate) + event_optimize's likelihood.
+
+Since ISSUE 9 the fitters here are THIN CONSUMERS of the
+``pint_tpu.sampling`` subsystem: the default ``mode="scan"`` runs the
+whole ensemble chain on-device as chunked supervised ``lax.scan``
+dispatches (``sampling.DeviceEnsembleSampler`` over a
+``sampling.DevicePosterior``), and ``sample_noise=True`` lifts the GP
+noise hyperparameters (PLRedNoise log10_A/gamma, ECORR weights) into
+the sampled dimensions. ``mode="host"`` keeps the original host-loop
+``EnsembleSampler`` (two vmapped dispatches per step) — the path
+host-side posterior callables (CompositeMCMCFitter's mixed
+radio+photon sum) still require.
 
 MCMCFitter samples TOA-likelihood posteriors; PhotonMCMCFitter samples
 the unbinned photon-template likelihood sum_i log(w_i f(phi_i(theta)) +
@@ -24,24 +33,77 @@ from pint_tpu.sampler import EnsembleSampler
 __all__ = ["MCMCFitter", "PhotonMCMCFitter", "CompositeMCMCFitter"]
 
 
+def _run_sampler(fitter, p0, nsteps: int, progress: bool):
+    """Run the fitter's sampler, host or device: the device sampler's
+    positional PRNG stream is anchored by a seed drawn from the
+    fitter's numpy generator, so a seeded fitter stays reproducible
+    in every mode."""
+    if isinstance(fitter.sampler, EnsembleSampler):
+        fitter.sampler.run_mcmc(p0, nsteps, progress=progress)
+    else:
+        seed = int(fitter.rng.integers(0, 2 ** 31 - 1))
+        fitter.sampler.run_mcmc(p0, nsteps, seed=seed,
+                                mode=fitter.mode, progress=progress)
+
+
 class MCMCFitter(Fitter):
     """Posterior sampling over the model's free parameters (reference:
     MCMCFitter). fit_toas runs the ensemble and sets parameter values
-    to posterior medians with std-dev uncertainties."""
+    to posterior medians with std-dev uncertainties.
+
+    ``mode``: "scan" (default — whole-chain-on-device, one supervised
+    dispatch per chain chunk), "host_loop" (the same device kernel
+    driven one step per dispatch: the bit-equality oracle), or "host"
+    (the pre-ISSUE-9 host ensemble over
+    ``BayesianTiming.lnposterior_batch``). ``sample_noise=True``
+    (device modes only) appends the model's GP noise hyperparameters
+    to the sampled dimensions; their posterior medians land in
+    ``self.noise_estimates`` rather than in the timing model."""
 
     def __init__(self, toas, model, nwalkers: int = 32,
-                 rng: Optional[np.random.Generator] = None):
+                 rng: Optional[np.random.Generator] = None,
+                 mode: str = "scan", sample_noise: bool = False):
         super().__init__(toas, model)
-        self.bt = BayesianTiming(model, toas)
-        self.nwalkers = max(nwalkers, 2 * self.bt.nparams + 2)
+        self.mode = mode
+        self.rng = rng or np.random.default_rng()
+        self.noise_estimates: dict = {}
+        if mode == "host":
+            if sample_noise:
+                raise ValueError(
+                    "sample_noise requires a device mode (the host "
+                    "sampler consumes the fixed-noise posterior)")
+            self.post = None
+            self.bt = BayesianTiming(model, toas)
+            ndim = self.bt.nparams
+            self.param_labels = list(self.bt.param_labels)
+            self.ntiming = ndim
+        else:
+            from pint_tpu.sampling import DevicePosterior
+
+            self.post = DevicePosterior(model, toas,
+                                        sample_noise=sample_noise)
+            self.bt = self.post.bt
+            ndim = self.post.nparams
+            self.param_labels = list(self.post.param_labels)
+            self.ntiming = self.post.ntiming
+        self.nwalkers = max(nwalkers, 2 * ndim + 2)
         if self.nwalkers % 2:
             self.nwalkers += 1
-        self.rng = rng or np.random.default_rng()
-        self.sampler = EnsembleSampler(
-            self.nwalkers, self.bt.nparams,
-            self.bt.lnposterior_batch, rng=self.rng)
+        if mode == "host":
+            self.sampler = EnsembleSampler(
+                self.nwalkers, ndim,
+                self.bt.lnposterior_batch, rng=self.rng)
+        else:
+            from pint_tpu.sampling import DeviceEnsembleSampler
+
+            self.sampler = DeviceEnsembleSampler(
+                self.nwalkers, ndim, self.post.lnpost_batch)
 
     def _init_walkers(self, scatter):
+        if self.post is not None:
+            return self.post.init_walkers(self.nwalkers,
+                                          rng=self.rng,
+                                          scatter=scatter)
         th0 = self.bt.theta0
         scales = np.empty(self.bt.nparams)
         for k, name in enumerate(self.bt.param_labels):
@@ -57,12 +119,18 @@ class MCMCFitter(Fitter):
 
         t0 = _time.perf_counter()
         p0 = self._init_walkers(scatter)
-        self.sampler.run_mcmc(p0, nsteps, progress=progress)
+        _run_sampler(self, p0, nsteps, progress)
         burn = nsteps // 3 if burn is None else burn
         flat = self.sampler.get_chain(discard=burn, flat=True)
         med = np.median(flat, axis=0)
         std = np.std(flat, axis=0)
-        for k, name in enumerate(self.bt.param_labels):
+        for k, name in enumerate(self.param_labels):
+            if k >= self.ntiming:
+                # sampled noise hyperparameters: reported, never
+                # written into the timing model's parameter values
+                self.noise_estimates[name] = {
+                    "median": float(med[k]), "std": float(std[k])}
+                continue
             p = self.model.get_param(name)
             p.set_dd((float(med[k]), 0.0))
             p.uncertainty = float(std[k])
@@ -86,13 +154,15 @@ class PhotonMCMCFitter:
 
     def __init__(self, toas, model, template, weights=None,
                  nwalkers: int = 32,
-                 rng: Optional[np.random.Generator] = None):
+                 rng: Optional[np.random.Generator] = None,
+                 mode: str = "scan"):
         import jax
         import jax.numpy as jnp
 
         self.toas = toas
         self.model = model
         self.template = template
+        self.mode = mode
         self.param_labels = list(model.free_params)
         self.nparams = len(self.param_labels)
         self.nwalkers = max(nwalkers, 2 * self.nparams + 2)
@@ -115,8 +185,25 @@ class PhotonMCMCFitter:
             return jnp.sum(jnp.log(w * dens + (1.0 - w)))
 
         self._core_batch = jax.jit(jax.vmap(lnlike_core))
-        self.sampler = EnsembleSampler(self.nwalkers, self.nparams,
-                                       self._lp_batch, rng=self.rng)
+        if mode == "host":
+            self.sampler = EnsembleSampler(
+                self.nwalkers, self.nparams, self._lp_batch,
+                rng=self.rng)
+        else:
+            # whole-chain-on-device (ISSUE 9): the photon likelihood
+            # is already a traced core, so it composes directly into
+            # the chain kernel's lax.scan — the dd low-word offset
+            # mapping rides inside the trace
+            from pint_tpu.sampling import DeviceEnsembleSampler
+
+            th0_j = jnp.asarray(self.theta0)
+            tl0_j = jnp.asarray(self._tl0)
+
+            def lnpost_one(theta):
+                return lnlike_core(tl0_j + (theta - th0_j))
+
+            self.sampler = DeviceEnsembleSampler(
+                self.nwalkers, self.nparams, jax.vmap(lnpost_one))
 
     def _photon_lnlike_batch(self, thetas: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
@@ -135,7 +222,7 @@ class PhotonMCMCFitter:
         scales = np.maximum(np.abs(self.theta0) * scatter, 1e-16)
         p0 = self.theta0[None, :] + scales[None, :] \
             * self.rng.standard_normal((self.nwalkers, self.nparams))
-        self.sampler.run_mcmc(p0, nsteps, progress=progress)
+        _run_sampler(self, p0, nsteps, progress)
         burn = nsteps // 3 if burn is None else burn
         flat = self.sampler.get_chain(discard=burn, flat=True)
         med = np.median(flat, axis=0)
@@ -164,8 +251,13 @@ class CompositeMCMCFitter(PhotonMCMCFitter):
     def __init__(self, toas_radio, toas_events, model, template,
                  weights=None, nwalkers: int = 32,
                  rng: Optional[np.random.Generator] = None):
+        # mode="host": the composite posterior mixes two device
+        # evaluations with a host-side finite-mask combine, so it is
+        # a host CALLABLE, not a traced core — the one fitter shape
+        # the whole-chain kernel cannot absorb
         super().__init__(toas_events, model, template,
-                         weights=weights, nwalkers=nwalkers, rng=rng)
+                         weights=weights, nwalkers=nwalkers, rng=rng,
+                         mode="host")
         self.toas = toas_radio
         self.toas_events = toas_events
         self.bt = BayesianTiming(model, toas_radio)

@@ -13,7 +13,9 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["Prior", "UniformPrior", "UniformUnboundedPrior",
-           "GaussianPrior"]
+           "GaussianPrior", "Log10TransformedPrior"]
+
+_LN10 = float(np.log(10.0))
 
 
 class Prior:
@@ -58,6 +60,29 @@ class UniformPrior(Prior):
 
     def __repr__(self):
         return f"UniformPrior({self.lower}, {self.upper})"
+
+
+class Log10TransformedPrior(Prior):
+    """Change-of-variables adapter for a dimension SAMPLED as
+    eta = log10(v) whose declared prior is over the linear value v
+    (the ECORR convention in ``sampling.likelihood``: the parameter's
+    prior is in microseconds, the sampled dimension is log10(us)):
+    p_eta(eta) = p_v(10**eta) * 10**eta * ln(10). The base prior must
+    have positive support for ``ppf`` to be meaningful."""
+
+    def __init__(self, base: Prior):
+        self.base = base
+
+    def logpdf(self, eta):
+        eta = jnp.asarray(eta, dtype=jnp.float64)
+        return (self.base.logpdf(10.0 ** eta) + eta * _LN10
+                + np.log(_LN10))
+
+    def ppf(self, q):
+        return jnp.log10(self.base.ppf(q))
+
+    def __repr__(self):
+        return f"Log10TransformedPrior({self.base!r})"
 
 
 class GaussianPrior(Prior):

@@ -108,15 +108,15 @@ def stack_problems(problems: Sequence[PulsarProblem],
             "valid": valid, "pvalid": pvalid}
 
 
-def _solve_one(M, F, phi, r, nvec, valid, pvalid):
-    """Masked, preconditioned basis-Woodbury solve for one pulsar
-    (same algebra as pint_tpu.gls._gls_kernel with padding guards).
-
-    Returns (dparams, cov, chi2, chi2r): ``chi2`` is the linearized
-    post-fit chi2 (parameters AND bases marginalized); ``chi2r`` is
-    the chi2 of the residuals at the CURRENT point with only the
-    noise bases marginalized — the quantity Residuals.chi2 reports
-    (r^T C^-1 r), which the serve layer's residual requests return."""
+def _assemble_normal(M, F, phi, r, nvec, valid, pvalid):
+    """Masked, column-scaled JOINT (params + bases) normal system —
+    the one assembly shared by ``_solve_one`` below and the posterior
+    slot kernel (``pint_tpu.sampling.serve_kernel`` builds its
+    marginal precision by Schur-complementing the basis block of
+    exactly this system), so a masking/scaling/pinning fix here
+    reaches both consumers. Returns (Sigma, b, w, colmax, norm) with
+    padded parameter columns pinned to identity so Cholesky stays
+    PD."""
     p = M.shape[1]
     w = valid / nvec
     M = M * pvalid[None, :]
@@ -131,11 +131,25 @@ def _solve_one(M, F, phi, r, nvec, valid, pvalid):
     Sigma = big.T @ bigw
     prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi])
     Sigma = Sigma + jnp.diag(prior)
-    # pin padded parameter columns to identity so Cholesky stays PD
     colvalid = jnp.concatenate([pvalid, jnp.ones(F.shape[1])])
     Sigma = Sigma * jnp.outer(colvalid, colvalid) + \
         jnp.diag(1.0 - colvalid)
     b = bigw.T @ r * colvalid
+    return Sigma, b, w, colmax, norm
+
+
+def _solve_one(M, F, phi, r, nvec, valid, pvalid):
+    """Masked, preconditioned basis-Woodbury solve for one pulsar
+    (same algebra as pint_tpu.gls._gls_kernel with padding guards).
+
+    Returns (dparams, cov, chi2, chi2r): ``chi2`` is the linearized
+    post-fit chi2 (parameters AND bases marginalized); ``chi2r`` is
+    the chi2 of the residuals at the CURRENT point with only the
+    noise bases marginalized — the quantity Residuals.chi2 reports
+    (r^T C^-1 r), which the serve layer's residual requests return."""
+    p = M.shape[1]
+    Sigma, b, w, colmax, norm = _assemble_normal(
+        M, F, phi, r, nvec, valid, pvalid)
     d = jnp.sqrt(jnp.diagonal(Sigma))
     d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
     cf = jax.scipy.linalg.cho_factor(Sigma / jnp.outer(d, d), lower=True)

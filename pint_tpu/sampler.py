@@ -8,6 +8,11 @@ around BATCHED posterior evaluation: each half-ensemble's proposals are
 scored in ONE vectorized call (BayesianTiming.lnposterior_batch runs
 them as a single vmapped device program), so a 64-walker ensemble costs
 two device calls per step rather than 64 python evaluations.
+
+The whole-chain-on-device variant (two dispatches per step collapsed
+to one per chain chunk) lives in ``pint_tpu.sampling``; the chain
+diagnostics shared by both samplers are the ``ChainStats`` mixin
+below.
 """
 
 from __future__ import annotations
@@ -16,77 +21,20 @@ from typing import Callable, Optional
 
 import numpy as np
 
-__all__ = ["EnsembleSampler"]
+__all__ = ["EnsembleSampler", "ChainStats"]
 
 
-class EnsembleSampler:
-    """Affine-invariant ensemble sampler with batched posterior calls.
+class ChainStats:
+    """Chain bookkeeping + convergence diagnostics shared by the
+    host ``EnsembleSampler`` and the device
+    ``sampling.DeviceEnsembleSampler`` (emcee-compatible surface:
+    ``chain``/``lnprob``/``get_chain``/``get_autocorr_time``/
+    ``converged``)."""
 
-    ``log_prob_batch`` maps an (S, ndim) array to (S,) log posteriors.
-    """
-
-    def __init__(self, nwalkers: int, ndim: int,
-                 log_prob_batch: Callable[[np.ndarray], np.ndarray],
-                 a: float = 2.0,
-                 rng: Optional[np.random.Generator] = None):
-        if nwalkers < 2 * ndim or nwalkers % 2:
-            raise ValueError(
-                "need an even nwalkers >= 2*ndim for ensemble moves")
-        self.nwalkers = nwalkers
-        self.ndim = ndim
-        self.log_prob_batch = log_prob_batch
-        self.a = float(a)
-        self.rng = rng or np.random.default_rng()
-        self.chain: Optional[np.ndarray] = None   # (nsteps, W, ndim)
-        self.lnprob: Optional[np.ndarray] = None  # (nsteps, W)
-        self.naccepted = 0
-        self.niterations = 0
-
-    def _stretch_half(self, pos, lp, move, other):
-        """One stretch-move update of walkers ``move`` against the
-        complementary set ``other``; returns accepted count."""
-        n = len(move)
-        # z ~ g(z) prop. 1/sqrt(z) on [1/a, a]
-        z = ((self.a - 1.0) * self.rng.uniform(size=n) + 1.0) ** 2 \
-            / self.a
-        partners = other[self.rng.integers(0, len(other), size=n)]
-        prop = pos[partners] + z[:, None] * (pos[move] - pos[partners])
-        lp_prop = np.asarray(self.log_prob_batch(prop))
-        logq = (self.ndim - 1.0) * np.log(z) + lp_prop - lp[move]
-        accept = np.log(self.rng.uniform(size=n)) < logq
-        pos[move[accept]] = prop[accept]
-        lp[move[accept]] = lp_prop[accept]
-        return int(accept.sum())
-
-    def run_mcmc(self, p0: np.ndarray, nsteps: int,
-                 progress: bool = False) -> np.ndarray:
-        """Run the ensemble; returns the final (W, ndim) positions and
-        stores the full chain in ``self.chain``."""
-        pos = np.array(p0, dtype=np.float64)
-        if pos.shape != (self.nwalkers, self.ndim):
-            raise ValueError(f"p0 must be {(self.nwalkers, self.ndim)}")
-        # np.array (copy): log_prob_batch may hand back a read-only
-        # view of a jax device buffer
-        lp = np.array(self.log_prob_batch(pos), dtype=np.float64)
-        if not np.any(np.isfinite(lp)):
-            raise ValueError("no walker starts at finite posterior")
-        chain = np.empty((nsteps, self.nwalkers, self.ndim))
-        lnprob = np.empty((nsteps, self.nwalkers))
-        half = self.nwalkers // 2
-        first = np.arange(half)
-        second = np.arange(half, self.nwalkers)
-        for step in range(nsteps):
-            self.naccepted += self._stretch_half(pos, lp, first, second)
-            self.naccepted += self._stretch_half(pos, lp, second, first)
-            self.niterations += self.nwalkers
-            chain[step] = pos
-            lnprob[step] = lp
-            if progress and (step + 1) % max(1, nsteps // 10) == 0:
-                print(f"  step {step + 1}/{nsteps} "
-                      f"acc={self.acceptance_fraction:.2f}")
-        self.chain = chain
-        self.lnprob = lnprob
-        return pos
+    chain: Optional[np.ndarray] = None    # (nsteps, W, ndim)
+    lnprob: Optional[np.ndarray] = None   # (nsteps, W)
+    naccepted = 0
+    niterations = 0
 
     @property
     def acceptance_fraction(self) -> float:
@@ -136,3 +84,79 @@ class EnsembleSampler:
         if not np.all(np.isfinite(tau)):
             return False
         return self.chain.shape[0] > factor * float(np.max(tau))
+
+
+class EnsembleSampler(ChainStats):
+    """Affine-invariant ensemble sampler with batched posterior calls.
+
+    ``log_prob_batch`` maps an (S, ndim) array to (S,) log posteriors.
+    """
+
+    def __init__(self, nwalkers: int, ndim: int,
+                 log_prob_batch: Callable[[np.ndarray], np.ndarray],
+                 a: float = 2.0,
+                 rng: Optional[np.random.Generator] = None):
+        if nwalkers < 2 * ndim or nwalkers % 2:
+            raise ValueError(
+                "need an even nwalkers >= 2*ndim for ensemble moves")
+        self.nwalkers = nwalkers
+        self.ndim = ndim
+        self.log_prob_batch = log_prob_batch
+        self.a = float(a)
+        self.rng = rng or np.random.default_rng()
+        self.chain: Optional[np.ndarray] = None   # (nsteps, W, ndim)
+        self.lnprob: Optional[np.ndarray] = None  # (nsteps, W)
+        self.naccepted = 0
+        self.niterations = 0
+
+    def _stretch_half(self, pos, lp, move, other):
+        """One stretch-move update of walkers ``move`` against the
+        complementary set ``other``; returns accepted count."""
+        n = len(move)
+        # z ~ g(z) prop. 1/sqrt(z) on [1/a, a]
+        z = ((self.a - 1.0) * self.rng.uniform(size=n) + 1.0) ** 2 \
+            / self.a
+        partners = other[self.rng.integers(0, len(other), size=n)]
+        prop = pos[partners] + z[:, None] * (pos[move] - pos[partners])
+        # np.array (OWNED copy, not np.asarray): log_prob_batch may
+        # hand back a zero-copy view of a jax device buffer, and with
+        # buffer donation enabled that memory can be reused by the
+        # NEXT dispatch while these values are still referenced — the
+        # runtime counterpart of graftlint G11, copy at the boundary
+        lp_prop = np.array(self.log_prob_batch(prop),
+                           dtype=np.float64)
+        logq = (self.ndim - 1.0) * np.log(z) + lp_prop - lp[move]
+        accept = np.log(self.rng.uniform(size=n)) < logq
+        pos[move[accept]] = prop[accept]
+        lp[move[accept]] = lp_prop[accept]
+        return int(accept.sum())
+
+    def run_mcmc(self, p0: np.ndarray, nsteps: int,
+                 progress: bool = False) -> np.ndarray:
+        """Run the ensemble; returns the final (W, ndim) positions and
+        stores the full chain in ``self.chain``."""
+        pos = np.array(p0, dtype=np.float64)
+        if pos.shape != (self.nwalkers, self.ndim):
+            raise ValueError(f"p0 must be {(self.nwalkers, self.ndim)}")
+        # np.array (copy): log_prob_batch may hand back a read-only
+        # view of a jax device buffer
+        lp = np.array(self.log_prob_batch(pos), dtype=np.float64)
+        if not np.any(np.isfinite(lp)):
+            raise ValueError("no walker starts at finite posterior")
+        chain = np.empty((nsteps, self.nwalkers, self.ndim))
+        lnprob = np.empty((nsteps, self.nwalkers))
+        half = self.nwalkers // 2
+        first = np.arange(half)
+        second = np.arange(half, self.nwalkers)
+        for step in range(nsteps):
+            self.naccepted += self._stretch_half(pos, lp, first, second)
+            self.naccepted += self._stretch_half(pos, lp, second, first)
+            self.niterations += self.nwalkers
+            chain[step] = pos
+            lnprob[step] = lp
+            if progress and (step + 1) % max(1, nsteps // 10) == 0:
+                print(f"  step {step + 1}/{nsteps} "
+                      f"acc={self.acceptance_fraction:.2f}")
+        self.chain = chain
+        self.lnprob = lnprob
+        return pos

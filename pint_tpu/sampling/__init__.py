@@ -1,0 +1,46 @@
+"""Device-native posterior sampling subsystem (ISSUE 9 / ROADMAP
+item 5).
+
+The Bayesian surfaces (``bayesian.py`` / ``sampler.py`` /
+``mcmc_fitter.py``) had a vmapped batched posterior but a host-side
+Python ensemble loop — two supervised dispatches per MCMC step, the
+exact dispatch-tax shape PR 7 eliminated for fitting. This package is
+the whole-fit pattern applied to sampling, one module each:
+
+- ``sampling.kernel``: the affine-invariant stretch move (both
+  half-ensemble updates, accept/reject, positional jax.random PRNG)
+  inside one ``lax.scan`` — a whole ensemble chunk is ONE
+  deadline-supervised dispatch, nsteps a RUNTIME budget in quantized
+  compile keys (``config.chain_chunk_steps``);
+- ``sampling.likelihood``: GP noise-hyperparameter sampling —
+  PLRedNoise log10_A/gamma and ECORR weights lifted into the traced
+  likelihood (phi, per-epoch variances, Sff Cholesky and logdet
+  recomputed in-trace per walker under vmap; PAPERS.md 1202.5932 via
+  the 1407.6710 low-rank Woodbury split);
+- ``sampling.posterior``: ``DevicePosterior`` — traced priors +
+  likelihood as one (W, ndim) -> (W,) batch function, fixed-noise or
+  noise-sampled;
+- ``sampling.chain``: ``DeviceEnsembleSampler`` — chunked supervised
+  whole-chain runs, with a ``host_loop`` mode on the identical
+  split-PRNG stream as the CPU bit-equality oracle (and the
+  per-step-dispatch baseline ``bench_posterior.py`` measures
+  against);
+- ``sampling.serve_kernel``: the padded, vmap-across-pulsars batch
+  kernel behind the serve layer's ``PosteriorRequest`` path
+  (walker/step shape classes, chunked multi-dispatch for long
+  chains).
+
+``MCMCFitter``/``PhotonMCMCFitter`` are thin consumers of this
+package; graftlint G6 is pinned over it (every device call routes
+through ``runtime.DispatchSupervisor``).
+"""
+
+from pint_tpu.sampling.chain import DeviceEnsembleSampler  # noqa: F401
+from pint_tpu.sampling.kernel import build_stretch_chunk  # noqa: F401
+from pint_tpu.sampling.likelihood import (  # noqa: F401
+    SampledNoiseLikelihood,
+)
+from pint_tpu.sampling.posterior import DevicePosterior  # noqa: F401
+from pint_tpu.sampling.serve_kernel import (  # noqa: F401
+    sample_problems,
+)

@@ -1,0 +1,138 @@
+"""Whole-chain-on-device affine-invariant ensemble kernel.
+
+Reference: src/pint/sampler.py (EmceeSampler) / Goodman & Weare 2010
+— the same stretch move ``pint_tpu.sampler.EnsembleSampler`` runs on
+the host, rebuilt as ONE ``lax.scan`` program so an entire ensemble
+run is a single deadline-supervised dispatch (the whole-fit pattern
+of ISSUE 7, applied to MCMC per ROADMAP item 5): both half-ensemble
+updates, the accept/reject, and the ``jax.random`` PRNG threading
+all execute in-kernel, with the thinned chain and acceptance counter
+as carried outputs.
+
+Design contracts (mirrors ``parallel.build_fit_loop``):
+
+- **quantized compile keys**: the compiled scan length K
+  (``config.chain_chunk_steps``) comes from a small power-of-two
+  set; the ACTUAL step count rides along as a runtime ``budget``
+  argument, so distinct chain lengths never mean distinct
+  executables and steps past the budget are skipped by a scalar
+  ``lax.cond`` (a true branch skip outside vmap; a masked select
+  under the serve layer's batch vmap).
+- **positional PRNG**: step i draws all six of its streams from
+  ``fold_in(key, offset + i)`` — no carried key state — so a chunked
+  chain (offset advancing per chunk) and a host-loop chain (one
+  dispatch per step, the dispatch-tax baseline) consume THE
+  IDENTICAL stream. The host-loop mode is built from this same
+  function at K=1, which is what makes it the bit-equality oracle on
+  the CPU mesh (tests/test_sampling.py).
+- **thinning**: the emitted chain keeps every ``thin``-th state
+  (outer scan of K//thin slots, inner ``fori_loop`` of ``thin``
+  steps), bounding the D2H readback for long chains.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["build_stretch_chunk"]
+
+
+def build_stretch_chunk(logp_batch, nwalkers: int, ndim: int,
+                        nsteps: int, thin: int = 1, a: float = 2.0):
+    """Build the traced chunk function for one ensemble.
+
+    ``logp_batch``: traceable (half, ndim) -> (half,) log-posterior
+    (non-finite values are never accepted — the same -inf prior
+    convention as the host sampler). Returns
+
+        chunk(pos, lp, key, budget, offset)
+            -> (pos', lp', naccept, chain, lnprob)
+
+    with ``pos`` (W, ndim) f64, ``lp`` (W,), ``key`` a jax PRNG key,
+    ``budget``/``offset`` int32 scalars (steps to actually run in
+    this chunk / global step index of its first step), ``chain``
+    (K//thin, W, ndim) and ``lnprob`` (K//thin, W) — rows past the
+    budget repeat the final state and are sliced off by the caller.
+    ``naccept`` counts accepted walker moves (budgeted steps only).
+    """
+    # ndim may be a TRACED scalar (the serve kernel's padded batch:
+    # each slot's real dimension count is sum(pvalid), and the
+    # Hastings factor z^(d-1) must use the REAL d — padded pinned
+    # dims contribute no volume); the walker-count check then falls
+    # to the caller, which knows the real dimensions at class time
+    if isinstance(ndim, int) and \
+            (nwalkers < 2 * ndim or nwalkers % 2):
+        raise ValueError(
+            "need an even nwalkers >= 2*ndim for ensemble moves")
+    if nwalkers % 2:
+        raise ValueError("need an even nwalkers")
+    if thin < 1 or nsteps % thin:
+        raise ValueError("thin must be >= 1 and divide the chunk size")
+    half = nwalkers // 2
+    nslots = nsteps // thin
+    a = float(a)
+
+    def half_move(pos, lp, kz, kp, ku, lo, olo):
+        """One stretch-move update of walkers [lo:lo+half] against
+        the complementary set [olo:olo+half] (static slices — W and
+        the half split are compile-time)."""
+        mv = pos[lo:lo + half]
+        ot = pos[olo:olo + half]
+        # z ~ g(z) prop. 1/sqrt(z) on [1/a, a]
+        z = ((a - 1.0) * jax.random.uniform(kz, (half,)) + 1.0) ** 2 \
+            / a
+        idx = jax.random.randint(kp, (half,), 0, half)
+        partners = ot[idx]
+        prop = partners + z[:, None] * (mv - partners)
+        lp_prop = logp_batch(prop)
+        logq = (ndim - 1.0) * jnp.log(z) + lp_prop - lp[lo:lo + half]
+        # NaN logq (wild proposal) compares False: never accepted
+        accept = jnp.log(jax.random.uniform(ku, (half,))) < logq
+        pos = pos.at[lo:lo + half].set(
+            jnp.where(accept[:, None], prop, mv))
+        lp = lp.at[lo:lo + half].set(
+            jnp.where(accept, lp_prop, lp[lo:lo + half]))
+        return pos, lp, jnp.sum(accept).astype(jnp.int32)
+
+    def one_step(pos, lp, acc, key, i):
+        """Both half-ensemble updates of global step ``i`` — all six
+        PRNG streams derive positionally from fold_in(key, i)."""
+        k = jax.random.fold_in(key, i)
+        kz1, kp1, ku1, kz2, kp2, ku2 = jax.random.split(k, 6)
+        pos, lp, n1 = half_move(pos, lp, kz1, kp1, ku1, 0, half)
+        pos, lp, n2 = half_move(pos, lp, kz2, kp2, ku2, half, 0)
+        return pos, lp, acc + n1 + n2
+
+    def chunk(pos, lp, key, budget, offset):
+        pos = jnp.asarray(pos, jnp.float64)
+        lp = jnp.asarray(lp, jnp.float64)
+        budget = jnp.asarray(budget, jnp.int32)
+        offset = jnp.asarray(offset, jnp.int32)
+
+        def outer(carry, o):
+            def inner(j, c):
+                pos_, lp_, acc_ = c
+                local = o * thin + j
+
+                def live(c_):
+                    p_, l_, a_ = c_
+                    return one_step(p_, l_, a_, key,
+                                    offset + local)
+
+                # scalar-pred cond: steps past the runtime budget are
+                # SKIPPED (no wasted logp evals for an oversized
+                # quantized K); under the serve batch vmap this
+                # lowers to a select, which is still correct
+                return lax.cond(local < budget, live,
+                                lambda c_: c_, c)
+
+            carry = lax.fori_loop(0, thin, inner, carry)
+            return carry, (carry[0], carry[1])
+
+        (pos, lp, acc), (chain, lnprob) = lax.scan(
+            outer, (pos, lp, jnp.int32(0)), jnp.arange(nslots))
+        return pos, lp, acc, chain, lnprob
+
+    return chunk

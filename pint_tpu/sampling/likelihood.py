@@ -1,0 +1,300 @@
+"""GP noise-hyperparameter sampled likelihood.
+
+Reference: src/pint/bayesian.py (BayesianTiming) + the standard
+red-noise analysis of PAPERS.md 1202.5932 (van Haasteren et al.) with
+the low-rank Woodbury evaluation of 1407.6710: the fixed-noise
+``BayesianTiming`` freezes ``phi`` and the Woodbury Cholesky at
+construction (hyperparameters only move under MCMC there by
+re-CONSTRUCTING); here the pieces that depend on the sampled
+hyperparameters — the power-law ``phi`` of each PLRedNoise basis, the
+per-epoch ECORR variances, the Sff Cholesky and the log-determinant —
+are lifted INTO the traced likelihood, so log10_A/gamma and the ECORR
+weights become sampled dimensions evaluated per walker under ``vmap``
+(the whole ensemble still costs one device program).
+
+What stays static (hyperparameters not sampled here, exactly the
+split the Woodbury algebra allows): the white-noise vector ``nvec``
+(EFAC/EQUAD), the Fourier/quantization BASES (they depend on the TOA
+grid, not on amplitudes), the data-side normal block F^T N^-1 F, and
+the per-epoch weight sums the Sherman-Morrison ECORR downdate
+consumes. The per-sample recompute is therefore one q x q Cholesky
+plus O(q^2) assembly — cheap next to the phase evaluation
+(1407.6710's point).
+
+CPU equality oracle: at hyperparameters pinned to the model's current
+values, ``lnlike_core(tl_eff, eta0)`` equals the fixed-noise
+``BayesianTiming.lnlikelihood`` (tests/test_sampling.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.models.noise import (
+    FYR,
+    _tdb_seconds,
+    create_fourier_design_matrix,
+    quantization_buckets,
+)
+from pint_tpu.models.priors import Log10TransformedPrior
+
+__all__ = ["SampledNoiseLikelihood"]
+
+LN2PI = float(np.log(2.0 * np.pi))
+
+
+def _powerlaw_traced(f, lgA, gamma):
+    """Traced power-law PSD (mirror of models.noise.powerlaw with
+    log10-amplitude input): P(f) = A^2/(12 pi^2) f_yr^(gamma-3)
+    f^(-gamma)."""
+    A2 = 10.0 ** (2.0 * lgA)
+    return A2 / (12.0 * jnp.pi ** 2) * FYR ** (gamma - 3.0) \
+        * f ** (-gamma)
+
+
+class SampledNoiseLikelihood:
+    """Traced likelihood with PLRedNoise (log10_A, gamma) and ECORR
+    (log10 weight) as sampled dimensions.
+
+    ``lnlike_core(tl_eff, eta)`` is the traceable surface: ``tl_eff``
+    the dd low-word parameter point (see
+    ``bayesian.build_batched_phase_eval``), ``eta`` the noise vector
+    laid out as ``labels`` reports — per PLRedNoise component
+    ``<comp>.log10_A`` / ``<comp>.gamma``, then one
+    ``<ECORR param>.log10`` per active ECORR mask parameter (the
+    weight sampled as log10 of the microsecond amplitude). ``eta0``
+    holds the model's current values, the pinned-hyperparameter
+    oracle point."""
+
+    def __init__(self, model, toas, bt=None):
+        from pint_tpu.bayesian import build_batched_phase_eval
+
+        self.model = model
+        self.toas = toas
+        if bt is not None:
+            # reuse the caller's BayesianTiming phase-eval surface
+            # (DevicePosterior already built one — rebuilding would
+            # double the design-matrix construction AND silently
+            # couple two theta0/tl0 copies that must stay identical)
+            self.theta0, self.tl0, frac_fn = \
+                bt.theta0, bt._tl0, bt._frac_fn
+        else:
+            self.theta0, self.tl0, frac_fn = build_batched_phase_eval(
+                model, toas)
+
+        nvec = jnp.asarray(model.scaled_toa_uncertainty(toas) ** 2)
+        w = 1.0 / nvec
+        n = toas.ntoas
+        logdet_white = float(jnp.sum(jnp.log(nvec)))
+        f0 = float(model.F0.value)
+
+        # -- ECORR: segment path with per-epoch variances traced ----
+        seg = model.noise_model_ecorr_segments(toas)
+        labels: List[str] = []
+        eta0: List[float] = []
+        priors: List = []
+        if seg is not None:
+            eid_np, jvar_np, exclude = seg
+            nseg = len(jvar_np)          # K + 1 (last slot: no epoch)
+            eid = jnp.asarray(eid_np)
+            s_seg = jax.ops.segment_sum(w, eid, num_segments=nseg)
+            # per-epoch -> ECORR-parameter map, replayed in exactly
+            # the enumeration order noise_epoch_segments uses
+            # (components in model order, params in ecorrs order,
+            # quantization buckets per mask) and VERIFIED against the
+            # returned jvar so any future reordering fails loudly
+            # instead of silently sampling the wrong epoch's weight
+            mjd = toas.get_mjds()
+            ep_param: List[int] = []
+            ec_params = []
+            for c in model.noise_components:
+                if not hasattr(c, "noise_epoch_segments"):
+                    continue
+                for name in getattr(c, "ecorrs", ()):
+                    p = c.params[name]
+                    if p.value is None:
+                        continue
+                    idx = np.flatnonzero(p.select_mask(toas))
+                    if len(idx) == 0:
+                        continue
+                    nb = len(quantization_buckets(mjd[idx]))
+                    if nb == 0:
+                        continue
+                    ep_param.extend([len(ec_params)] * nb)
+                    ec_params.append(p)
+            if len(ep_param) != nseg - 1:
+                raise RuntimeError(
+                    "ECORR epoch enumeration drifted from "
+                    "noise_model_ecorr_segments "
+                    f"({len(ep_param)} vs {nseg - 1} epochs)")
+            for e, pi in enumerate(ep_param):
+                expect = (ec_params[pi].value * 1e-6) ** 2
+                if not np.isclose(jvar_np[e], expect, rtol=1e-12):
+                    raise RuntimeError(
+                        "ECORR epoch->parameter map mismatch at "
+                        f"epoch {e}")
+            ec_off = len(labels)
+            for p in ec_params:
+                labels.append(f"{p.name}.log10")
+                eta0.append(float(np.log10(p.value)))
+                # the parameter's prior is declared over the LINEAR
+                # ECORR value (microseconds); the sampled dimension
+                # is log10(us), so a set prior needs the
+                # change-of-variables Jacobian. None stays the
+                # improper flat — flat in log10 is the standard
+                # log-uniform choice for a scale hyperparameter.
+                pb = getattr(p, "prior", None)
+                priors.append(None if pb is None
+                              else Log10TransformedPrior(pb))
+            ep_param_j = jnp.asarray(np.asarray(ep_param,
+                                                dtype=np.int32))
+            self._n_ecorr = len(ec_params)
+        else:
+            eid = s_seg = ep_param_j = None
+            nseg = 1
+            exclude = ()
+            ec_off = 0
+            self._n_ecorr = 0
+
+        # -- basis components: static F, phi traced for PLRedNoise --
+        pairs = model.noise_model_basis_weight_pairs(toas,
+                                                     exclude=exclude)
+        if not pairs and seg is None:
+            raise ValueError(
+                "model has no sampled noise dimensions (no basis "
+                "noise component and no ECORR segments)")
+        phi_static = []
+        rn_slices = []   # (col offset, ncols, freqs, df, eta offset)
+        off = 0
+        for name, F, phi in pairs:
+            comp = {type(c).__name__: c
+                    for c in model.noise_components}[name]
+            A_g = getattr(comp, "amplitude_gamma", None)
+            if A_g is not None and A_g()[0] is not None:
+                A, gamma = A_g()
+                nmodes = int(comp.TNREDC.value or 30)
+                Fc, freqs = create_fourier_design_matrix(
+                    _tdb_seconds(toas), nmodes)
+                if not np.allclose(Fc, np.asarray(F)):
+                    raise RuntimeError(
+                        f"{name}: recomputed Fourier basis drifted "
+                        f"from noise_basis_weight")
+                rn_slices.append((off, F.shape[1],
+                                  jnp.asarray(freqs),
+                                  float(freqs[0]), len(labels)))
+                labels.append(f"{name}.log10_A")
+                eta0.append(float(np.log10(A)))
+                priors.append(getattr(comp.TNREDAMP, "prior", None)
+                              if comp.TNREDAMP.value is not None
+                              else None)
+                labels.append(f"{name}.gamma")
+                eta0.append(float(gamma))
+                priors.append(getattr(comp.TNREDGAM, "prior", None)
+                              if comp.TNREDGAM.value is not None
+                              else None)
+            phi_static.append(np.asarray(phi, dtype=np.float64))
+            off += F.shape[1]
+        if not labels:
+            raise ValueError(
+                "model has no sampled noise dimensions (no "
+                "PLRedNoise amplitude and no ECORR weights)")
+        self.labels = labels
+        self.eta0 = np.asarray(eta0, dtype=np.float64)
+        self.priors = priors
+        self.nnoise = len(labels)
+
+        if pairs:
+            F_all = jnp.asarray(np.concatenate(
+                [np.asarray(F) for _, F, _ in pairs], axis=1))
+            Fw = F_all * w[:, None]
+            A0 = F_all.T @ Fw           # data block: static
+            if eid is not None:
+                EF = jax.ops.segment_sum(Fw, eid, num_segments=nseg)
+            else:
+                EF = None
+            phi_static_j = jnp.asarray(np.concatenate(phi_static))
+        else:
+            F_all = Fw = A0 = EF = phi_static_j = None
+
+        demean = "PhaseOffset" not in model.components
+        ec_off_j = ec_off
+
+        def lnlike_core(tl_eff, eta):
+            """Traced noise-sampled log-likelihood (see class
+            docstring). Mirrors BayesianTiming's fixed-noise core
+            with phi / ECORR variances / Sff / logdet recomputed from
+            ``eta`` in-trace."""
+            eta = jnp.asarray(eta, jnp.float64)
+            # per-epoch ECORR variances + Sherman-Morrison terms
+            if eid is not None:
+                jv_ep = (10.0 ** eta[ec_off_j:ec_off_j
+                                     + self._n_ecorr] * 1e-6) ** 2
+                jv = jnp.concatenate(
+                    [jv_ep[ep_param_j], jnp.zeros(1)])
+                g = jv / (1.0 + jv * s_seg)
+                logdet_ecorr = jnp.sum(jnp.log1p(jv * s_seg))
+            else:
+                g = None
+                logdet_ecorr = 0.0
+            # phi with the sampled power-law slices overwritten
+            if phi_static_j is not None:
+                phi = phi_static_j
+                for coff, ncol, freqs, df, eoff in rn_slices:
+                    phi = phi.at[coff:coff + ncol].set(
+                        _powerlaw_traced(freqs, eta[eoff],
+                                         eta[eoff + 1]) * df)
+                # Sff = F^T N_eff^-1 F + phi^-1 (ECORR downdated),
+                # Jacobi-preconditioned exactly like the fixed path
+                Sff = A0 + jnp.diag(1.0 / phi)
+                if EF is not None:
+                    Sff = Sff - EF.T @ (g[:, None] * EF)
+                dS = jnp.sqrt(jnp.diagonal(Sff))
+                Lf = jax.scipy.linalg.cho_factor(
+                    Sff / jnp.outer(dS, dS), lower=True)
+                logdet = (logdet_white + logdet_ecorr
+                          + jnp.sum(jnp.log(phi))
+                          + 2.0 * jnp.sum(jnp.log(
+                              jnp.diagonal(Lf[0])))
+                          + 2.0 * jnp.sum(jnp.log(dS)))
+            else:
+                dS = Lf = None
+                logdet = logdet_white + logdet_ecorr
+            lnnorm = -0.5 * logdet - 0.5 * n * LN2PI
+            frac = frac_fn(tl_eff)
+            if demean:
+                wmean = jnp.sum(frac * w) / jnp.sum(w)
+                frac = frac - wmean
+            r = frac / f0
+            rCr = jnp.sum(r * r * w)
+            if eid is not None:
+                wr_seg = jax.ops.segment_sum(w * r, eid,
+                                             num_segments=nseg)
+                rCr = rCr - jnp.sum(g * wr_seg ** 2)
+            if Fw is not None:
+                bF = Fw.T @ r
+                if EF is not None:
+                    bF = bF - EF.T @ (g * wr_seg)
+                bF = bF / dS
+                rCr = rCr - bF @ jax.scipy.linalg.cho_solve(Lf, bF)
+            return -0.5 * rCr + lnnorm
+
+        self.lnlike_core = lnlike_core
+        self._lnlike_jit = jax.jit(lnlike_core)
+
+    def lnlikelihood(self, theta, eta) -> float:
+        """Host convenience (oracle surface): evaluate one point,
+        supervised like every other device touch in this package."""
+        from pint_tpu.runtime import get_supervisor
+
+        tl_eff = self.tl0 + (np.asarray(theta, dtype=np.float64)
+                             - self.theta0)
+        eta = np.asarray(eta, dtype=np.float64)
+
+        def run():
+            return float(self._lnlike_jit(jnp.asarray(tl_eff), jnp.asarray(eta)))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+        return get_supervisor().dispatch(run, key="sampling.lnlike")

@@ -1,0 +1,120 @@
+"""Traced posterior surfaces for the device-native sampler.
+
+Reference: src/pint/bayesian.py + src/pint/mcmc_fitter.py — the host
+fitters evaluate lnposterior on the host per batch; here the WHOLE
+lnposterior (jnp-traceable priors from ``models.priors`` + the
+noise-marginalized likelihood core) is a traced function the chain
+kernel calls inside its ``lax.scan``, so an entire ensemble run is
+one dispatch (ROADMAP item 5).
+
+Two modes:
+
+- fixed noise (default): wraps ``BayesianTiming``'s traced likelihood
+  closure — hyperparameters frozen at construction, exactly the
+  reference's sampling mode;
+- ``sample_noise=True``: appends the GP noise hyperparameters
+  (PLRedNoise log10_A/gamma, ECORR weights) as sampled dimensions via
+  ``SampledNoiseLikelihood`` — phi, the per-epoch variances, the Sff
+  Cholesky and the log-determinant recomputed in-trace per walker.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DevicePosterior"]
+
+
+class DevicePosterior:
+    """lnposterior as a traceable batch function (W, ndim) -> (W,).
+
+    ``param_labels`` orders theta: the model's free timing parameters
+    (BayesianTiming validates the packed order), then — with
+    ``sample_noise`` — the noise labels of
+    ``SampledNoiseLikelihood``. ``theta0`` is the current point.
+    """
+
+    def __init__(self, model, toas, sample_noise: bool = False):
+        from pint_tpu.bayesian import BayesianTiming
+
+        self.model = model
+        self.toas = toas
+        self.bt = BayesianTiming(model, toas)
+        self.sample_noise = bool(sample_noise)
+        ntim = self.bt.nparams
+        self.ntiming = ntim
+        th0_j = jnp.asarray(self.bt.theta0)
+        tl0_j = jnp.asarray(self.bt._tl0)
+        priors: List = list(self.bt._priors)
+        labels = list(self.bt.param_labels)
+        theta0 = np.asarray(self.bt.theta0, dtype=np.float64)
+
+        if sample_noise:
+            from pint_tpu.sampling.likelihood import (
+                SampledNoiseLikelihood,
+            )
+
+            self.noise = SampledNoiseLikelihood(model, toas,
+                                                bt=self.bt)
+            labels += self.noise.labels
+            theta0 = np.concatenate([theta0, self.noise.eta0])
+            priors += self.noise.priors
+            core = self.noise.lnlike_core
+
+            def lnpost_one(theta):
+                lp = _prior_sum(priors, theta)
+                tl_eff = tl0_j + (theta[:ntim] - th0_j)
+                ll = core(tl_eff, theta[ntim:])
+                return jnp.where(jnp.isfinite(lp), lp + ll, -jnp.inf)
+        else:
+            self.noise = None
+            core = self.bt._lnlike_core_raw
+
+            def lnpost_one(theta):
+                lp = _prior_sum(priors, theta)
+                ll = core(tl0_j + (theta - th0_j))
+                return jnp.where(jnp.isfinite(lp), lp + ll, -jnp.inf)
+
+        self.param_labels = labels
+        self.nparams = len(labels)
+        self.theta0 = theta0
+        self._priors = priors
+        self.lnpost_one = lnpost_one
+        self.lnpost_batch = jax.vmap(lnpost_one)
+
+    def init_scales(self) -> np.ndarray:
+        """Per-dimension walker-scatter scales: the parameter's
+        quoted uncertainty when it has one, a relative floor
+        otherwise; noise dimensions (log10/spectral-index units, all
+        O(1)) default to 0.1."""
+        scales = np.empty(self.nparams)
+        for k, name in enumerate(self.param_labels):
+            if k < self.ntiming:
+                p = self.model.get_param(name)
+                scales[k] = p.uncertainty if p.uncertainty else \
+                    max(abs(self.theta0[k]) * 1e-10, 1e-14)
+            else:
+                scales[k] = 0.1
+        return scales
+
+    def init_walkers(self, nwalkers: int,
+                     rng: Optional[np.random.Generator] = None,
+                     scatter: float = 0.5) -> np.ndarray:
+        rng = rng or np.random.default_rng()
+        return self.theta0[None, :] + scatter \
+            * self.init_scales()[None, :] \
+            * rng.standard_normal((nwalkers, self.nparams))
+
+
+def _prior_sum(priors, theta):
+    """Traced sum of per-parameter prior log-densities (None =
+    improper flat = exactly 0, the BayesianTiming convention)."""
+    lp = jnp.asarray(0.0, jnp.float64)
+    for k, p in enumerate(priors):
+        if p is not None:
+            lp = lp + p.logpdf(theta[k])
+    return lp

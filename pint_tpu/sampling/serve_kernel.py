@@ -1,0 +1,369 @@
+"""Batched posterior-chain kernel for the serve layer.
+
+A served posterior request samples the LINEARIZED timing posterior of
+one pulsar's ``parallel.pta.PulsarProblem`` — the exact Gaussian whose
+mean/covariance the GLS solve reports (bases marginalized via the same
+masked Woodbury algebra as ``pta._solve_one``), explored by the
+stretch-move chain kernel. Because the likelihood consumes the same
+padded (M, F, phi, r, nvec, valid, pvalid) arrays the GLS buckets
+consume, a bucket of posterior requests for DIFFERENT pulsars
+coalesces into one vmapped dispatch exactly like GLS batches do
+(walker/step shape classes bound the executables; ISSUE 9 tentpole).
+
+Per slot the kernel:
+
+1. builds the marginal precision A and rhs b of the scaled parameter
+   block by Schur-complementing the noise-basis block out of the
+   masked normal matrix (identical scaling/pinning to ``_solve_one``,
+   so padded rows/columns are inert and A is well-conditioned);
+2. initializes W walkers around the GLS solution, overdispersed by
+   2 marginal sigmas (padded parameter dims pinned to exactly 0 —
+   stretch moves between zeros stay zero, and the Hastings factor
+   uses the REAL dimension count sum(pvalid));
+3. runs the shared ``build_stretch_chunk`` scan with a per-slot
+   runtime step budget and per-slot PRNG key (a request's stream
+   depends only on its own seed, never on its batch position);
+4. emits the thinned chain mapped back to physical parameter units
+   (the ``dparams`` convention of ``_solve_one``: the correction to
+   ADD, sign included).
+
+Oracle: the chain's sample mean/covariance converge on the GLS
+``dparams``/``cov`` (tests/test_sampling.py), and a single request
+through the ServeEngine is bit-identical to ``sample_problems`` at
+the same shape class and seed.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from pint_tpu.sampling.kernel import build_stretch_chunk
+
+__all__ = ["make_posterior_slot", "posterior_chunk_driver",
+           "sample_problems"]
+
+
+def make_posterior_slot(W: int, K: int, thin: int = 1,
+                        a: float = 2.0, scatter: float = 2.0):
+    """Traced one-slot chunk function (vmap it over the batch axis).
+
+    Signature: (M, F, phi, r, nvec, valid, pvalid, key, budget,
+    pos_in, lp_in, init, offset) -> (pos, lp, naccept, chain_phys,
+    lnprob) with ``init`` a traced bool selecting in-kernel walker
+    initialization (chunk 0) over the carried (pos_in, lp_in)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(M, F, phi, r, nvec, valid, pvalid, key, budget,
+            pos_in, lp_in, init, offset):
+        from pint_tpu.parallel.pta import _assemble_normal
+
+        p = M.shape[1]
+        # the EXACT joint normal system the GLS solve assembles
+        # (shared helper — identical scaling/pinning by construction,
+        # not by parallel copies)
+        Sigma, b, _, colmax, norm = _assemble_normal(
+            M, F, phi, r, nvec, valid, pvalid)
+        # Schur-complement the basis block out: A = Spp - SpF Sff^-1
+        # SFp is the marginal precision of the scaled parameter block
+        # (the same marginalization _solve_one's joint solve encodes)
+        q = F.shape[1]
+        Spp = Sigma[:p, :p]
+        if q:
+            SpF = Sigma[:p, p:]
+            SFF = Sigma[p:, p:]
+            dF = jnp.sqrt(jnp.diagonal(SFF))
+            dF = jnp.where((dF == 0) | ~jnp.isfinite(dF), 1.0, dF)
+            cfF = jax.scipy.linalg.cho_factor(
+                SFF / jnp.outer(dF, dF), lower=True)
+            X = jax.scipy.linalg.cho_solve(
+                cfF, SpF.T / dF[:, None]) / dF[:, None]   # (q, p)
+            A = Spp - SpF @ X
+            bn = b[:p] - X.T @ b[p:]
+        else:
+            A = Spp
+            bn = b[:p]
+        # re-pin padded dims (the Schur step preserves the pinning,
+        # this just keeps it exact against rounding)
+        A = A * jnp.outer(pvalid, pvalid) + jnp.diag(1.0 - pvalid)
+        bn = bn * pvalid
+        d = jnp.sqrt(jnp.diagonal(A))
+        d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+        cf = jax.scipy.linalg.cho_factor(A / jnp.outer(d, d),
+                                         lower=True)
+        xhat = jax.scipy.linalg.cho_solve(cf, bn / d) / d
+        inv = jax.scipy.linalg.cho_solve(
+            cf, jnp.eye(p)) / jnp.outer(d, d)
+        sig = jnp.sqrt(jnp.abs(jnp.diagonal(inv)))
+
+        def logp_batch(x):
+            # exact Gaussian log-density of the linearized posterior
+            # (constant dropped: MH only consumes differences)
+            return -0.5 * jnp.einsum("si,ij,sj->s", x, A, x) \
+                + x @ bn
+
+        ndim_real = jnp.sum(pvalid)
+        chunk = build_stretch_chunk(logp_batch, W, ndim_real, K,
+                                    thin=thin, a=a)
+        # init stream at the top of the uint32 fold_in range: step
+        # streams use fold_in(key, offset+i) with i < 2^31, no overlap
+        kinit = jax.random.fold_in(key, 0xFFFFFFFF)
+        z = jax.random.normal(kinit, (W, p))
+        pos0 = (xhat[None, :] + scatter * sig[None, :] * z) \
+            * pvalid[None, :]
+        lp0 = logp_batch(pos0)
+        pos = jnp.where(init, pos0, pos_in)
+        lp = jnp.where(init, lp0, lp_in)
+        pos, lp, nacc, chain, lnp = chunk(pos, lp, key, budget,
+                                          offset)
+        # physical units, dparams sign convention (correction to ADD)
+        scale = -pvalid / (colmax * norm)
+        return pos, lp, nacc, chain * scale[None, None, :], lnp
+
+    return one
+
+
+def posterior_chunk_driver(fnv, stacked: dict, seeds, nsteps,
+                           W: int, K: int, thin: int,
+                           supervisor, key_tag: str,
+                           pool: str = "device",
+                           sync: bool = True, info: Optional[dict] = None,
+                           progress=None):
+    """Drive one padded batch through its chunked supervised
+    dispatches and return per-slot results.
+
+    ``fnv`` is the jitted vmapped slot kernel; ``seeds``/``nsteps``
+    are per-slot. Each chunk is its OWN supervised dispatch (bounded
+    watchdog deadline — a long chain can never turn one deadline
+    window into an unbounded hang, and a shutdown drain is bounded by
+    the in-flight chunk, not the whole chain). ``progress`` (steps
+    completed per slot) fires after every chunk — the serve layer
+    journals it as a non-terminal progress ack. Returns a zero-arg
+    ``collect``; its call yields (chain (P, S_total, W, p), lnprob,
+    naccept (P,), rows_done (P,)) host arrays.
+
+    ``pool="host"`` runs every chunk pinned to the host CPU device
+    (the capacity router's planned-host-capacity verdict);
+    ``pool="device"`` chunks carry a pinned-CPU failover, so a
+    backend death mid-chain degrades to a labeled host continuation
+    instead of a hung future (the chaos oracle's requirement)."""
+    import jax
+    import jax.numpy as jnp
+
+    if info is None:
+        info = {}
+    info.setdefault("pool", pool)
+    P = stacked["M"].shape[0]
+    seeds = np.asarray(seeds, dtype=np.int64)
+    nsteps = np.asarray(nsteps, dtype=np.int64)
+    kmax = int(nsteps.max()) if len(nsteps) else 0
+    nchunks = max(1, -(-kmax // K))
+    pb = stacked["M"].shape[2]
+    fell_over = []
+    # the read-only problem batch + PRNG key batch are placed on
+    # device ONCE per driver, not once per chunk: over the tunnel the
+    # repeated H2D of identical (P, N, p) inputs would dominate a
+    # deep chain's wall. The pinned-host fallback never reads this
+    # cache (its buffers may live on a dead backend) — it rebuilds
+    # from the numpy copies, and clears the cache so a later chunk
+    # re-places fresh if the device recovers.
+    placed: dict = {}
+
+    def _key_batch():
+        return np.stack([np.asarray(jax.random.PRNGKey(int(s)))
+                         for s in seeds])
+
+    def _chunk_closures(c, pos_h, lp_h):
+        """(run, run_pinned, budgets) for chunk ``c`` — the ONE
+        dispatch body both the sync loop and the async chunk-0 issue
+        path feed to the supervisor."""
+        budgets = np.clip(nsteps - c * K, 0, K).astype(np.int32)
+        first = c == 0
+
+        def call(st, keys):
+            if first:
+                pos_in = jnp.zeros((P, W, pb))
+                lp_in = jnp.zeros((P, W))
+            else:
+                pos_in = jnp.asarray(pos_h)
+                lp_in = jnp.asarray(lp_h)
+            out = fnv(st["M"], st["F"], st["phi"], st["r"], st["nvec"], st["valid"], st["pvalid"], keys, jnp.asarray(budgets), pos_in, lp_in, jnp.asarray(first), jnp.asarray(c * K, jnp.int32))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            hs = [np.asarray(o) for o in out]
+            return [h if h.flags.owndata else h.copy() for h in hs]
+
+        def run():
+            if not placed:
+                placed["st"] = {kk: jnp.asarray(v)
+                                for kk, v in stacked.items()}
+                placed["keys"] = jnp.asarray(_key_batch())
+            return call(placed["st"], placed["keys"])
+
+        def run_pinned():
+            placed.clear()
+            with jax.default_device(jax.devices("cpu")[0]):
+                st = {kk: jnp.asarray(v)
+                      for kk, v in stacked.items()}
+                return call(st, jnp.asarray(_key_batch()))
+
+        return run, run_pinned, budgets
+
+    def chunk_run(c, pos_h, lp_h):
+        run, run_pinned, budgets = _chunk_closures(c, pos_h, lp_h)
+        if pool == "host":
+            out = supervisor.dispatch(
+                run_pinned, key=f"{key_tag}/chunk{c}", steps=K,
+                pinned=True)
+            info["used_pool"] = "host"
+        else:
+            def host_counted():
+                fell_over.append(True)
+                return run_pinned()
+
+            out = supervisor.dispatch(
+                run, key=f"{key_tag}/chunk{c}", steps=K,
+                fallback=host_counted)
+        return out, budgets
+
+    def run_chunks():
+        pos_h = lp_h = None
+        acc = np.zeros(P, np.int64)
+        chains: List[np.ndarray] = []
+        lnps: List[np.ndarray] = []
+        rows_done = np.zeros(P, np.int64)
+        for c in range(nchunks):
+            out, budgets = chunk_run(c, pos_h, lp_h)
+            pos_h = np.asarray(out[0], np.float64)
+            lp_h = np.asarray(out[1], np.float64)
+            acc += np.asarray(out[2], np.int64)
+            chains.append(np.asarray(out[3]))
+            lnps.append(np.asarray(out[4]))
+            rows_done += budgets // thin
+            if progress is not None:
+                progress(np.minimum(nsteps, (c + 1) * K))
+        if pool != "host":
+            info["used_pool"] = "host-failover" if fell_over \
+                else "device"
+        return _gather(chains, lnps, acc, rows_done)
+
+    def _gather(chains, lnps, acc, rows_done):
+        """Per-slot row gather: chunk c's valid rows for slot k are
+        its first budget_ck//thin emitted slots (later rows repeat
+        the final state under the in-kernel budget mask)."""
+        S = K // thin
+        chain = np.concatenate(chains, axis=1)
+        lnp = np.concatenate(lnps, axis=1)
+        rows_total = int(rows_done.max()) if P else 0
+        chain_out = np.zeros((P, rows_total, W, pb))
+        lnp_out = np.zeros((P, rows_total, W))
+        for k in range(P):
+            got = 0
+            for c in range(len(chains)):
+                nkeep = int(np.clip(nsteps[k] - c * K, 0, K)) // thin
+                if nkeep == 0:
+                    break
+                sl = slice(c * S, c * S + nkeep)
+                chain_out[k, got:got + nkeep] = chain[k, sl]
+                lnp_out[k, got:got + nkeep] = lnp[k, sl]
+                got += nkeep
+        return chain_out, lnp_out, acc, rows_done
+
+    if sync:
+        return run_chunks
+    # pipelined drain: chunk 0 of this unit is issued on the
+    # supervisor's async pipeline so it overlaps the previous unit's
+    # collect; remaining chunks (sequential by construction — each
+    # consumes the carried ensemble state) run at collect time
+    first_fut = None
+    if nchunks >= 1 and pool != "host":
+        run0, run0_pinned, _ = _chunk_closures(0, None, None)
+
+        def host_counted0():
+            fell_over.append(True)
+            return run0_pinned()
+
+        first_fut = supervisor.dispatch_async(
+            run0, key=f"{key_tag}/chunk0", steps=K,
+            fallback=host_counted0)
+
+    def collect():
+        nonlocal first_fut
+        if first_fut is None:
+            return run_chunks()
+        out0 = first_fut.result()
+        first_fut = None
+        pos_h = np.asarray(out0[0], np.float64)
+        lp_h = np.asarray(out0[1], np.float64)
+        acc = np.asarray(out0[2], np.int64).copy()
+        chains = [np.asarray(out0[3])]
+        lnps = [np.asarray(out0[4])]
+        rows_done = (np.clip(nsteps, 0, K) // thin).astype(np.int64)
+        if progress is not None:
+            progress(np.minimum(nsteps, K))
+        for c in range(1, nchunks):
+            out, budgets = chunk_run(c, pos_h, lp_h)
+            pos_h = np.asarray(out[0], np.float64)
+            lp_h = np.asarray(out[1], np.float64)
+            acc += np.asarray(out[2], np.int64)
+            chains.append(np.asarray(out[3]))
+            lnps.append(np.asarray(out[4]))
+            rows_done += budgets // thin
+            if progress is not None:
+                progress(np.minimum(nsteps, (c + 1) * K))
+        info["used_pool"] = "host-failover" if fell_over \
+            else "device"
+        return _gather(chains, lnps, acc, rows_done)
+
+    return collect
+
+
+def sample_problems(problems: Sequence, nwalkers: int, nsteps: int,
+                    seeds: Sequence[int], thin: int = 1,
+                    shape=None, chunk: Optional[int] = None):
+    """Direct (engine-less) batched posterior sampling — the oracle
+    surface for the serve path: pad ``problems`` to ``shape``
+    ((P, N, p, q), defaults to the batch maxima), run the SAME slot
+    kernel at the same (W, K, thin) class, and return per-problem
+    (chain (S, W, p_real), lnprob, acceptance_fraction). A
+    PosteriorRequest served at the same shape class and seed is
+    bit-identical."""
+    import jax
+
+    from pint_tpu import config
+    from pint_tpu.parallel.pta import stack_problems
+    from pint_tpu.runtime import get_supervisor
+
+    problems = list(problems)
+    W = int(nwalkers)
+    for pr in problems:
+        # the slot kernel traces ndim, so build_stretch_chunk cannot
+        # check this — an under-walkered stretch ensemble silently
+        # never leaves the affine hull of its start positions
+        if W % 2 or W < 2 * pr.M.shape[1]:
+            raise ValueError(
+                f"nwalkers={W} too small for a {pr.M.shape[1]}-dim "
+                "problem: need an even nwalkers >= 2*ndim")
+    stacked = stack_problems(problems, shape=shape)
+    P = stacked["M"].shape[0]
+    K = int(chunk) if chunk else config.chain_chunk_steps(
+        nsteps, thin=thin)
+    fnv = jax.jit(jax.vmap(
+        make_posterior_slot(W, K, thin=thin),
+        in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, None, None)))
+    seeds = list(seeds) + [0] * (P - len(problems))
+    nsteps_arr = [nsteps] * len(problems) + [0] * (P - len(problems))
+    collect = posterior_chunk_driver(
+        fnv, stacked, seeds, nsteps_arr, W, K, thin,
+        get_supervisor(), "sampling.post_direct", sync=True)
+    chain, lnp, acc, rows = collect()
+    out = []
+    for k, pr in enumerate(problems):
+        p = pr.M.shape[1]
+        nrows = int(rows[k])
+        # owned copies — a view would pin the whole padded batch
+        # buffer (same contract as the served PosteriorResult)
+        out.append((np.ascontiguousarray(chain[k, :nrows, :, :p]),
+                    lnp[k, :nrows].copy(),
+                    float(acc[k]) / max(1, int(nsteps) * W)))
+    return out

@@ -10,6 +10,8 @@ the window into padded vmapped dispatches. Request forms:
     {"kind": "residuals", "par": P, "tim": T, ...}
     {"kind": "phase", "par": P, "mjds": [...], "obs": "@",
      "seg_min": 60.0, ...}
+    {"kind": "posterior", "par": P, "tim": T, "nwalkers": 32,
+     "nsteps": 500, "seed": 0, "thin": 1, ...}
 
 (par, tim) pairs are loaded once and cached — repeated requests
 against the same pulsar are the serving-state hot path, paying only
@@ -232,6 +234,7 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
     from pint_tpu.serve import (
         FitStepRequest,
         PhasePredictRequest,
+        PosteriorRequest,
         ResidualsRequest,
         ShutdownShed,
     )
@@ -269,6 +272,10 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
                 out["chi2"] = res.chi2
                 out["rms_us"] = res.rms_us
                 out["n"] = len(res.time_resids)
+            elif kind == "posterior":
+                out["acceptance"] = res.acceptance_fraction
+                out["nsteps"] = res.nsteps
+                out["posterior"] = res.summary()
             else:
                 out["phase_int"] = np.asarray(res.phase_int).tolist()
                 out["phase_frac"] = np.asarray(res.phase_frac).tolist()
@@ -280,6 +287,38 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
         cls = FitStepRequest if kind == "fit_step" else ResidualsRequest
         fut = engine.submit(cls(toas, model, deadline_s=deadline_s,
                                 tenant=tenant))
+        fut.add_done_callback(finish(kind))
+        if ack is not None:
+            ack.expect(1)
+        return 1
+    if kind == "posterior":
+        from pint_tpu.parallel.pta import build_problem
+        from pint_tpu.serve.bucket import pow2_ceil
+
+        model, toas = _load_pair(cache, rec["par"], rec["tim"])
+        problem = build_problem(toas, model)
+        # client-facing quantization: nwalkers/thin ride EXACTLY in
+        # the posterior compile key (they are compile-time constants
+        # of the scan program), so arbitrary client values would mean
+        # one multi-second XLA compile per distinct request shape.
+        # Pow2-quantize both (more walkers is strictly better
+        # sampling; nsteps rounds up to stay a thin multiple) so
+        # compiles stay bounded by class count, not traffic. The
+        # walker FLOOR comes from the problem's real dimension count
+        # (the 2*ndim ensemble guard), so a default request never
+        # hard-fails on a wide model; nsteps is capped so one
+        # request cannot monopolize a pool with an unbounded
+        # sequential chunk loop.
+        p = problem.M.shape[1]
+        W = max(int(rec.get("nwalkers", 32)), 2 * p + 2)
+        W = min(1024, max(8, pow2_ceil(W)))
+        thin = min(16, max(1, pow2_ceil(int(rec.get("thin", 1)))))
+        nsteps = min(int(rec.get("nsteps", 500)), 1_000_000)
+        nsteps = ((nsteps + thin - 1) // thin) * thin
+        fut = engine.submit(PosteriorRequest(
+            problem=problem, nwalkers=W, nsteps=nsteps,
+            seed=int(rec.get("seed", 0)), thin=thin,
+            deadline_s=deadline_s, tenant=tenant))
         fut.add_done_callback(finish(kind))
         if ack is not None:
             ack.expect(1)

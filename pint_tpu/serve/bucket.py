@@ -31,7 +31,8 @@ from pint_tpu.parallel.pta import _solve_one, pta_solve_np, \
     stack_problems
 
 __all__ = ["bucket_for", "pad_dim", "pow2_ceil", "ExecutableCache",
-           "gls_shape_class", "phase_shape_class"]
+           "gls_shape_class", "phase_shape_class",
+           "posterior_shape_class"]
 
 
 def pow2_ceil(n: int) -> int:
@@ -78,6 +79,25 @@ def phase_shape_class(nmjd: int, ncoeff: int, edges: Tuple[int, ...]):
     if nb is None:
         return None
     return ("phase", nb, pad_dim(ncoeff, 4))
+
+
+def posterior_shape_class(n: int, p: int, q: int, W: int, K: int,
+                          thin: int, edges: Tuple[int, ...]):
+    """(kind, N_bucket, p_pad, q_pad, W, K, thin) for a posterior
+    request — or None when the TOA count exceeds every bucket edge.
+    The problem axes bucket like GLS classes (same padded masking);
+    the WALKER count and the chunked-scan length K ride in the key
+    EXACTLY (not padded): both are compile-time constants of the
+    chain program, W is pinned by the request (padding it would
+    change the PRNG stream and break bit-equality with the direct
+    ``sample_problems`` path), and K is already quantized by
+    ``config.chain_chunk_steps`` — the actual per-request ``nsteps``
+    is a runtime budget, so distinct chain lengths share a class."""
+    nb = bucket_for(n, edges)
+    if nb is None:
+        return None
+    return ("posterior", nb, pad_dim(p), pad_dim(q), int(W), int(K),
+            int(thin))
 
 
 def _phase_eval_one(coeffs, tmid, rphase_int, rphase_frac, f0, mjds,
@@ -157,6 +177,15 @@ class ExecutableCache:
         else:
             self._gls = jax.jit(jax.vmap(_solve_one))
             self._phase = jax.jit(jax.vmap(_phase_eval_one))
+        # posterior chain kernels (ISSUE 9): one jitted vmapped slot
+        # kernel per (W, K, thin) walker/step class — W and K are
+        # compile-time constants of the scan program, so unlike the
+        # structure-agnostic GLS kernel the wrapper itself is
+        # class-keyed. NOT donated: each chunk re-feeds the carried
+        # (pos, lp) pair it just read back for the host-side chunk
+        # loop and journaled progress, so no argument position is
+        # safely alias-exact across the whole chunked run.
+        self._posterior: dict = {}
         # every dispatch routes through the runtime supervisor:
         # watchdog deadline + host failover (numpy mirror for GLS,
         # PolycoEntry.abs_phase for phase) so a wedged backend can
@@ -189,7 +218,9 @@ class ExecutableCache:
         running jax exposes it (None otherwise)."""
         try:
             return int(self._gls._cache_size()) + \
-                int(self._phase._cache_size())
+                int(self._phase._cache_size()) + \
+                sum(int(fn._cache_size())
+                    for fn in self._posterior.values())
         except AttributeError:
             return None
 
@@ -430,3 +461,75 @@ class ExecutableCache:
         """Synchronous ``phase_begin`` + collect."""
         return self.phase_begin(key, requests, nb, kb, Pb,
                                 sync=True)()
+
+    def _posterior_kernel(self, W: int, K: int, thin: int):
+        import jax
+
+        from pint_tpu.sampling.serve_kernel import make_posterior_slot
+
+        ck = (W, K, thin)
+        if ck not in self._posterior:
+            self._posterior[ck] = jax.jit(jax.vmap(
+                make_posterior_slot(W, K, thin=thin),
+                in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                         None, None)))
+        return self._posterior[ck]
+
+    def posterior_begin(self, key, requests, shape,
+                        sync: bool = False, pool: str = "device",
+                        info: Optional[dict] = None, progress=None):
+        """Pad the requests' problems to the class shape and run the
+        whole-chain posterior kernel as CHUNKED supervised dispatches
+        (``sampling.posterior_chunk_driver``): each chunk of K scan
+        steps is its own deadline-bounded dispatch with a pinned-host
+        failover, so long chains never turn one watchdog window into
+        an unbounded hang and shutdown drains stay bounded by a
+        chunk. ``progress`` (per-slot steps completed) fires after
+        every chunk — the scheduler journals it as non-terminal
+        progress acks. Returns the zero-arg ``collect`` yielding
+        (chain, lnprob, naccept, rows_done) host arrays.
+
+        Not AOT-exported: the chain program embeds the request
+        class's (W, K, thin) and recompiles in seconds from the
+        feature-keyed persistent jit cache — unlike the GLS/phase
+        kernels there is no LAPACK-heavy multi-second retrace to
+        amortize at restart, and a restored chain could not resume
+        mid-run anyway (chunk state is not persisted; replay restarts
+        the chain, which the journal's progress marks label
+        honestly). The batch axis is likewise not mesh-sharded:
+        posterior batches are small (few pulsars) while the per-slot
+        scan is deep — the parallelism is inside the slot, not across
+        it."""
+        _, nb, pb, qb, W, K, thin = key[:7]
+        stacked = stack_problems([r.problem for r in requests],
+                                 shape=shape)
+        # padded batch slots run a zero-step budget (their chunk
+        # work is masked off in-kernel, same convention as the
+        # all-padded GLS slot solving the identity system)
+        npad = shape[0] - len(requests)
+        seeds = [r.seed for r in requests] + [0] * npad
+        nsteps = [r.nsteps for r in requests] + [0] * npad
+        fnv = self._posterior_kernel(W, K, thin)
+        if info is None:
+            info = {}
+
+        from pint_tpu.sampling.serve_kernel import (
+            posterior_chunk_driver,
+        )
+
+        inner = posterior_chunk_driver(
+            fnv, stacked, seeds, nsteps, W, K, thin,
+            self.supervisor,
+            "serve.posterior/" + "/".join(str(x) for x in key),
+            pool=pool, sync=sync, info=info, progress=progress)
+
+        def collect():
+            out = inner()
+            if info.get("used_pool") == "device":
+                # compile accounting parity with gls/phase: the class
+                # is recorded only when a real device dispatch built
+                # (or reused) its executable
+                self.keys.add(key)
+            return out
+
+        return collect

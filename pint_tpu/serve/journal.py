@@ -71,14 +71,39 @@ class RequestJournal:
     in admit order — the replay set. "replayed" is a progress marker
     (the restarted engine re-admitted the entry), not a terminal
     status; a crash DURING replay leaves the entry replayable again.
+
+    Long-running chunked work (a posterior chain) additionally writes
+    ``progress`` lines between its chunk dispatches — non-terminal
+    marks recording how far a request got before a crash. They are
+    informational (replay restarts the chain from scratch — chunk
+    results are not persisted) and are dropped by compaction.
+
+    **Compaction** (ISSUE 9 satellite): an append-only journal on a
+    long-lived deployment grows without bound even though the replay
+    set stays tiny. ``compact()`` rewrites the file to exactly the
+    unacknowledged admit records (original lines verbatim, admit
+    order preserved) via atomic tmp + fsync + rename — a crash
+    mid-compaction leaves the previous journal intact, and replay
+    after compaction is bit-identical to replay before it
+    (tests/test_serve_restart.py). Auto-triggered after an append
+    pushes the file past ``config.journal_compact_bytes()``
+    ($PINT_TPU_JOURNAL_COMPACT_BYTES, 0 disables).
     """
 
     _TERMINAL = ("served", "failed", "shed")
 
-    def __init__(self, path: str):
+    def __init__(self, path: str,
+                 compact_bytes: Optional[int] = None):
         self.path = path
         self._lock = threading.Lock()
         self._fh = None
+        self.compactions = 0
+        if compact_bytes is None:
+            from pint_tpu import config
+
+            compact_bytes = config.journal_compact_bytes()
+        self._compact_bytes = max(0, int(compact_bytes))
+        self._next_compact = self._compact_bytes
         d = os.path.dirname(os.path.abspath(path))
         if d:
             os.makedirs(d, exist_ok=True)
@@ -98,6 +123,7 @@ class RequestJournal:
         if torn:
             self._fh.write("\n")
             self._fh.flush()
+        self._bytes = self._fh.tell()
 
     # -- writes --------------------------------------------------------
 
@@ -109,6 +135,9 @@ class RequestJournal:
             self._fh.write(line + "\n")
             self._fh.flush()
             os.fsync(self._fh.fileno())
+            self._bytes += len(line) + 1
+            if self._compact_bytes and self._bytes > self._next_compact:
+                self._compact_locked()
 
     def admit(self, rid: str, payload: dict,
               tenant: Optional[str] = None,
@@ -122,6 +151,52 @@ class RequestJournal:
 
     def ack(self, rid: str, status: str):
         self._append({"op": "ack", "rid": rid, "status": status})
+
+    def progress(self, rid: str, steps: int):
+        """Non-terminal progress mark for chunked work (a posterior
+        chain records steps completed after every chunk dispatch):
+        visible in a post-crash journal scan, dropped by compaction,
+        ignored by the replay-set computation."""
+        self._append({"op": "progress", "rid": rid,
+                      "steps": int(steps)})
+
+    # -- compaction ----------------------------------------------------
+
+    def compact(self):
+        """Rewrite the journal to exactly its unacknowledged admit
+        records (atomic tmp + fsync + rename; original admit lines
+        preserved verbatim and in order, so replay after compaction
+        is bit-identical to replay before it)."""
+        with self._lock:
+            self._compact_locked()
+
+    def _compact_locked(self):
+        keep = self.unacknowledged_unlocked()
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            for rec in keep:
+                fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        reopen = self._fh is not None and not self._fh.closed
+        if reopen:
+            self._fh.close()
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._bytes = self._fh.tell()
+        if not reopen:
+            # compacting a closed journal leaves it closed
+            self._fh.close()
+        self.compactions += 1
+        # hysteresis: when the LIVE unacknowledged set itself exceeds
+        # the threshold, compaction cannot shrink below it — without
+        # a backoff every subsequent append would re-scan and rewrite
+        # the whole file under the lock (O(file) per append during
+        # exactly the backed-up outage this journal exists for). The
+        # next auto-trigger waits for the file to double instead.
+        if self._compact_bytes:
+            self._next_compact = max(self._compact_bytes,
+                                     2 * self._bytes)
 
     def close(self):
         with self._lock:
@@ -153,7 +228,7 @@ class RequestJournal:
             pass
         return admits, acks
 
-    def unacknowledged(self) -> List[dict]:
+    def unacknowledged_unlocked(self) -> List[dict]:
         admits, acks = self._scan()
         seen = set()
         out = []
@@ -165,10 +240,20 @@ class RequestJournal:
             out.append(rec)
         return out
 
+    def unacknowledged(self) -> List[dict]:
+        # under the lock so a concurrent auto-compaction's
+        # rewrite+rename never races the scan
+        with self._lock:
+            return self.unacknowledged_unlocked()
+
     def counts(self) -> dict:
-        admits, acks = self._scan()
-        return {"admitted": len(admits), "acked": len(acks),
-                "unacknowledged": len(self.unacknowledged())}
+        with self._lock:
+            admits, acks = self._scan()
+            unacked = len(self.unacknowledged_unlocked())
+            return {"admitted": len(admits), "acked": len(acks),
+                    "unacknowledged": unacked,
+                    "compactions": self.compactions,
+                    "bytes": self._bytes}
 
 
 # ------------------------------------------------------------------

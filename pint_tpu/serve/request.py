@@ -13,7 +13,13 @@ hot read paths of a timing service:
   quantity ``Residuals.chi2`` reports);
 - ``PhasePredictRequest``: absolute-phase prediction from a polyco
   segment (``polycos.PolycoEntry``) at arbitrary MJDs — the
-  phase-ephemeris read path (fold-mode observing, online dedispersion).
+  phase-ephemeris read path (fold-mode observing, online dedispersion);
+- ``PosteriorRequest`` (ISSUE 9): a posterior-sampling run over the
+  pulsar's linearized GLS posterior — the whole-chain-on-device
+  stretch-move kernel of ``pint_tpu.sampling.serve_kernel``, batched
+  across pulsars by walker/step shape class, dispatched as chunked
+  supervised ``lax.scan`` programs with journalable per-chunk
+  progress.
 
 Every request carries an optional relative deadline and owns a
 ``ServeFuture``; the scheduler resolves the future when the request's
@@ -32,7 +38,8 @@ import numpy as np
 __all__ = ["ServeFuture", "DeadlineExceeded", "ServeOverload",
            "TenantOverQuota", "ShutdownShed", "EngineKilled",
            "FitStepRequest", "ResidualsRequest", "PhasePredictRequest",
-           "FitStepResult", "ResidualsResult", "PhasePredictResult"]
+           "PosteriorRequest", "FitStepResult", "ResidualsResult",
+           "PhasePredictResult", "PosteriorResult"]
 
 
 class DeadlineExceeded(TimeoutError):
@@ -148,6 +155,32 @@ class ResidualsResult:
 
 
 @dataclass
+class PosteriorResult:
+    """One pulsar's sampled linearized posterior: the thinned chain
+    in PHYSICAL parameter units using the ``dparams`` convention of
+    ``parallel.pta._solve_one`` (each sample is the correction to ADD
+    to the current parameter values), aligned with ``names``."""
+
+    names: List[str]
+    chain: np.ndarray            # (S, W, p) thinned samples
+    lnprob: np.ndarray           # (S, W)
+    acceptance_fraction: float
+    nsteps: int                  # un-thinned chain length actually run
+
+    def flat(self, discard: int = 0) -> np.ndarray:
+        """(S*W, p) flattened post-burn samples."""
+        return self.chain[discard:].reshape(-1, self.chain.shape[-1])
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-parameter posterior median/std of the correction."""
+        flat = self.flat(discard=self.chain.shape[0] // 3)
+        med = np.median(flat, axis=0)
+        std = np.std(flat, axis=0)
+        return {n: {"median": float(m), "std": float(s)}
+                for n, m, s in zip(self.names, med, std)}
+
+
+@dataclass
 class PhasePredictResult:
     """Absolute phase split (int turns, frac turns) at the request's
     MJDs — same split as ``PolycoEntry.abs_phase``."""
@@ -201,6 +234,62 @@ class FitStepRequest(_GLSRequest):
 
 class ResidualsRequest(_GLSRequest):
     kind = "residuals"
+
+
+class PosteriorRequest(_GLSRequest):
+    """Sample the pulsar's linearized timing posterior (ISSUE 9).
+
+    Rides the same assembled ``PulsarProblem`` as the GLS kinds; the
+    served work is a whole-chain-on-device stretch-move ensemble run
+    (``sampling.serve_kernel``). ``seed`` anchors the positional PRNG
+    stream — a request's chain depends only on its own seed, never on
+    its batch position, so a coalesced batch slot is bit-identical to
+    the direct ``sample_problems`` path at the same shape class.
+    ``nsteps`` is a RUNTIME budget (requests with different chain
+    lengths share one compiled shape class); ``nwalkers``/``thin``
+    are part of the shape class."""
+
+    kind = "posterior"
+
+    def __init__(self, toas=None, model=None, problem=None,
+                 nwalkers: int = 32, nsteps: int = 500,
+                 seed: int = 0, thin: int = 1, **kw):
+        super().__init__(toas=toas, model=model, problem=problem,
+                         **kw)
+        self.nwalkers = int(nwalkers)
+        self.nsteps = int(nsteps)
+        self.seed = int(seed)
+        self.thin = max(1, int(thin))
+        if self.nwalkers < 2 or self.nwalkers % 2:
+            raise ValueError("nwalkers must be even and >= 2")
+        if self.nsteps < 1 or self.nsteps >= 2 ** 31:
+            # upper bound: the kernel's positional PRNG offset is an
+            # int32 — past 2^31 fold_in streams would wrap and repeat
+            raise ValueError("nsteps must be in [1, 2^31)")
+        if self.nsteps % self.thin:
+            raise ValueError("nsteps must be a multiple of thin")
+
+    def ensure_problem(self):
+        """The walker-count guard lives here, not in the kernel: the
+        serve kernel's padded batch traces ndim, so
+        ``build_stretch_chunk`` cannot check it — and an
+        under-walkered stretch-move ensemble is confined to the
+        affine hull of its start positions (dimensions beyond
+        nwalkers-1 are silently never explored)."""
+        pr = super().ensure_problem()
+        if self.nwalkers < 2 * pr.M.shape[1]:
+            raise ValueError(
+                f"nwalkers={self.nwalkers} < 2*ndim"
+                f"={2 * pr.M.shape[1]}: need an even nwalkers >= "
+                "2*ndim for ensemble moves")
+        return pr
+
+    @property
+    def walker_steps(self) -> int:
+        """Total walker-updates this chain costs — the kind-local
+        'rows' unit the capacity router learns posterior service
+        rates in."""
+        return self.nsteps * self.nwalkers
 
 
 class PhasePredictRequest(Request):

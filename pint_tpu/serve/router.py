@@ -13,9 +13,13 @@ capacity pool:
   hang-free, on the caller's CPU). In a pipelined drain, units routed
   to different pools genuinely execute concurrently.
 - **learned service rates**: every completed dispatch feeds an EWMA
-  of rows/s per (pool, kind). Routing predicts each pool's
-  completion time as (in-flight backlog + this batch) / rate and
-  picks the cheaper pool. Cold start is deliberately conservative:
+  of rows/s per (pool, kind). Rows are KIND-LOCAL units (padded
+  TOA/MJD rows for gls/phase, walker-steps for posterior chains), so
+  backlogs are tracked and costed per kind — a queued posterior unit
+  is priced at the posterior rate in every completion-time and
+  admission-wait estimate (ISSUE 9 satellite), never at the GLS
+  rate. Routing predicts each pool's completion time as the per-kind
+  backlog cost + this batch / rate and picks the cheaper pool. Cold start is deliberately conservative:
   until the HOST rate has been observed (a breaker demotion served
   there, or ``seed_rate`` taught it explicitly), everything routes
   to the device — the router never guesses the host faster on no
@@ -52,7 +56,8 @@ _DEVICE_PRIOR = 1e9
 
 class _Pool:
     __slots__ = ("name", "dispatches", "requests", "rows",
-                 "rates", "inflight_rows", "demotions")
+                 "rates", "inflight_rows", "inflight_kind",
+                 "demotions")
 
     def __init__(self, name: str):
         self.name = name
@@ -61,6 +66,7 @@ class _Pool:
         self.rows = 0
         self.rates: Dict[str, float] = {}   # kind -> EWMA rows/s
         self.inflight_rows = 0
+        self.inflight_kind: Dict[str, int] = {}  # kind -> rows
         self.demotions = 0
 
     def rate(self, kind: str) -> Optional[float]:
@@ -84,6 +90,16 @@ class _Pool:
             "rows_per_s": {k: round(v, 1)
                            for k, v in sorted(self.rates.items())},
         }
+
+    def add_inflight(self, kind: str, rows: int):
+        self.inflight_rows += rows
+        self.inflight_kind[kind] = \
+            self.inflight_kind.get(kind, 0) + rows
+
+    def sub_inflight(self, kind: str, rows: int):
+        self.inflight_rows = max(0, self.inflight_rows - rows)
+        self.inflight_kind[kind] = max(
+            0, self.inflight_kind.get(kind, 0) - rows)
 
 
 class CapacityRouter:
@@ -123,32 +139,70 @@ class CapacityRouter:
             if hr is None:
                 return "device"
             dr = dev.rate(kind) or _DEVICE_PRIOR
-            t_dev = (dev.inflight_rows + rows) / dr
-            t_host = (host.inflight_rows + rows) / hr
+
+            def backlog_s(p, r_kind):
+                # per-kind backlog costing (each kind at its own
+                # learned rate; unlearned kinds free — consistent
+                # with predicted_wait_s)
+                t = 0.0
+                for k, v in p.inflight_kind.items():
+                    r = r_kind if k == kind else p.rate(k)
+                    if r:
+                        t += v / r
+                return t
+
+            t_dev = backlog_s(dev, dr) + rows / dr
+            t_host = backlog_s(host, hr) + rows / hr
             return "device" if t_dev <= t_host else "host"
 
-    def predicted_wait_s(self, rows: int, kind: str = "gls") -> float:
-        """Admission-policy estimate: how long ``rows`` padded rows
-        would wait for results given current backlog and the best
-        learned rate (0 when nothing has been learned — the shed
-        policy then never declares anyone doomed on no evidence)."""
+    def _best_rate(self, kind: str) -> Optional[float]:
+        rates = [p.rate(kind) for p in self.pools.values()]
+        rates = [r for r in rates if r]
+        return max(rates) if rates else None
+
+    def predicted_wait_s(self, rows: int, kind: str = "gls",
+                         ahead_by_kind: Optional[Dict[str, int]]
+                         = None) -> float:
+        """Admission-policy estimate: how long ``rows`` rows of
+        ``kind`` would wait given the current backlog, PER-KIND
+        (ISSUE 9 satellite): each kind's backlog — in-flight plus the
+        caller-supplied queued-ahead ``ahead_by_kind`` — is costed at
+        ITS OWN best learned (pool, kind) rate, so a posterior chain
+        queued ahead is priced at the posterior rate, never the
+        ~1000x faster GLS rate (which would admit a doomed long chain
+        against a deadline it provably cannot make). Rows are
+        kind-local units (padded TOA/MJD rows for gls/phase,
+        walker-steps for posterior) — which is exactly why rates and
+        backlogs must never mix across kinds. A kind with no learned
+        rate contributes 0 (never doomed on no evidence); if the
+        NEWCOMER's own kind is unlearned the whole estimate is 0."""
         with self._lock:
-            rates = [p.rate(kind) for p in self.pools.values()]
-            rates = [r for r in rates if r]
-            if not rates:
+            own = self._best_rate(kind)
+            if own is None:
                 return 0.0
-            backlog = sum(p.inflight_rows for p in self.pools.values())
-            return (backlog + rows) / max(rates)
+            backlog: Dict[str, int] = {}
+            for p in self.pools.values():
+                for k, v in p.inflight_kind.items():
+                    backlog[k] = backlog.get(k, 0) + v
+            for k, v in (ahead_by_kind or {}).items():
+                backlog[k] = backlog.get(k, 0) + v
+            t = rows / own
+            for k, v in backlog.items():
+                r = self._best_rate(k)
+                if r:
+                    t += v / r
+            return t
 
     # -- accounting ----------------------------------------------------
 
-    def issued(self, pool: str, nreq: int, rows: int):
+    def issued(self, pool: str, nreq: int, rows: int,
+               kind: str = "gls"):
         with self._lock:
             p = self.pools[pool]
             p.dispatches += 1
             p.requests += nreq
             p.rows += rows
-            p.inflight_rows += rows
+            p.add_inflight(kind, rows)
 
     def finished(self, pool: str, kind: str, rows: int,
                  wall_s: float, used_pool: Optional[str] = None):
@@ -162,8 +216,7 @@ class CapacityRouter:
         counters, and repeated failures trip the breaker whose OPEN
         state is what routes (and teaches) the host pool."""
         with self._lock:
-            self.pools[pool].inflight_rows = max(
-                0, self.pools[pool].inflight_rows - rows)
+            self.pools[pool].sub_inflight(kind, rows)
             if used_pool is None:
                 used_pool = pool
             if used_pool == pool:

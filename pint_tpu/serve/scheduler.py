@@ -73,6 +73,7 @@ from pint_tpu.serve.bucket import (
     gls_shape_class,
     pad_dim,
     phase_shape_class,
+    posterior_shape_class,
     pow2_ceil,
 )
 from pint_tpu.serve.metrics import ServeMetrics
@@ -83,6 +84,8 @@ from pint_tpu.serve.request import (
     FitStepResult,
     PhasePredictRequest,
     PhasePredictResult,
+    PosteriorRequest,
+    PosteriorResult,
     ResidualsRequest,
     ResidualsResult,
     ServeOverload,
@@ -380,22 +383,33 @@ class ServeEngine:
 
     @staticmethod
     def _kind_of(req) -> str:
-        return "phase" if isinstance(req, PhasePredictRequest) \
-            else "gls"
+        if isinstance(req, PhasePredictRequest):
+            return "phase"
+        if isinstance(req, PosteriorRequest):
+            return "posterior"
+        return "gls"
 
     def _predicted_wait_locked(self, req) -> float:
         """Admission-policy wait estimate for a NEWCOMER: every
         already-sealed unit dispatches before it, plus the router's
-        in-flight backlog, over the best learned service rate (0.0 —
-        never doomed — until a rate has actually been observed).
-        Open-bucket rows are excluded: their seal order vs the
-        newcomer's own bucket is not knowable, and overestimating
-        the wait would shed a request that could still make its
-        deadline."""
-        ahead = sum(self._rows_of(r)
-                    for _, grp in self._ready for r in grp)
+        in-flight backlog, each KIND costed at its own learned
+        (pool, kind) rate (0.0 — never doomed — until the newcomer's
+        own kind has an observed rate; ISSUE 9 satellite: a queued
+        posterior chain is priced at the posterior rate, so a heavy
+        chain ahead dooms a tight-deadline newcomer honestly, and a
+        GLS-speed estimate never admits a long chain against a
+        deadline it cannot make). Open-bucket rows are excluded:
+        their seal order vs the newcomer's own bucket is not
+        knowable, and overestimating the wait would shed a request
+        that could still make its deadline."""
+        ahead: dict = {}
+        for _, grp in self._ready:
+            for r in grp:
+                k = self._kind_of(r)
+                ahead[k] = ahead.get(k, 0) + self._rows_of(r)
         return self.router.predicted_wait_s(
-            ahead + self._rows_of(req), kind=self._kind_of(req))
+            self._rows_of(req), kind=self._kind_of(req),
+            ahead_by_kind=ahead)
 
     def _queued_waits_locked(self):
         """``[(req, predicted_wait_s)]`` for every queued request,
@@ -404,20 +418,27 @@ class ServeEngine:
         dispatch in deque order, batch-mates ride the same vmapped
         dispatch, and rows queued BEHIND a candidate must not count
         (the inflated wait would shed a head-of-queue request that
-        was about to be served on time). Open-bucket requests
-        dispatch after every sealed unit; other open buckets are
-        excluded, same never-overestimate rule as above."""
+        was about to be served on time). The prefix sum is PER KIND
+        (rows are kind-local units — walker-steps for posterior —
+        and the router costs each kind at its own rate). Open-bucket
+        requests dispatch after every sealed unit; other open
+        buckets are excluded, same never-overestimate rule as
+        above."""
         out = []
-        ahead = 0
+        ahead: dict = {}
         for _, grp in self._ready:
             for r in grp:
                 out.append((r, self.router.predicted_wait_s(
-                    ahead + self._rows_of(r), kind=self._kind_of(r))))
-            ahead += sum(self._rows_of(r) for r in grp)
+                    self._rows_of(r), kind=self._kind_of(r),
+                    ahead_by_kind=dict(ahead))))
+            for r in grp:
+                k = self._kind_of(r)
+                ahead[k] = ahead.get(k, 0) + self._rows_of(r)
         for b in self._open.values():
             for r in b.reqs:
                 out.append((r, self.router.predicted_wait_s(
-                    ahead + self._rows_of(r), kind=self._kind_of(r))))
+                    self._rows_of(r), kind=self._kind_of(r),
+                    ahead_by_kind=dict(ahead))))
         return out
 
     def _expire_locked(self, now: float):
@@ -572,6 +593,16 @@ class ServeEngine:
             pr = r.ensure_problem()
         n, p = pr.M.shape
         q = pr.F.shape[1]
+        if isinstance(r, PosteriorRequest):
+            from pint_tpu import config
+
+            K = config.chain_chunk_steps(r.nsteps, thin=r.thin)
+            key = posterior_shape_class(n, p, q, r.nwalkers, K,
+                                        r.thin, self.bucket_edges)
+            if key is None:
+                return ("posterior", pow2_ceil(n), pad_dim(p),
+                        pad_dim(q), r.nwalkers, K, r.thin), True
+            return key, False
         key = gls_shape_class(n, p, q, self.bucket_edges)
         if key is None:
             return ("gls", pow2_ceil(n), pad_dim(p), pad_dim(q)), True
@@ -597,10 +628,10 @@ class ServeEngine:
         Pb = self._batch_pad(len(grp))
         full_key = key + (Pb,)
         t0 = time.monotonic()
-        kind = "phase" if key[0] == "phase" else "gls"
-        rows = Pb * key[1]
+        kind = key[0] if key[0] in ("phase", "posterior") else "gls"
+        rows = self._unit_rows(key, grp, Pb)
         pool = self.router.pick(kind, rows)
-        self.router.issued(pool, len(grp), rows)
+        self.router.issued(pool, len(grp), rows, kind=kind)
         info: dict = {}
         try:
             if key[0] == "phase":
@@ -608,6 +639,12 @@ class ServeEngine:
                 collect = self.cache.phase_begin(
                     full_key, grp, nb, kb, Pb, sync=sync, pool=pool,
                     info=info)
+            elif key[0] == "posterior":
+                _, nb, pb, qb = key[:4]
+                collect = self.cache.posterior_begin(
+                    full_key, grp, shape=(Pb, nb, pb, qb),
+                    sync=sync, pool=pool, info=info,
+                    progress=self._posterior_progress(grp))
             else:
                 _, nb, pb, qb = key
                 collect = self.cache.gls_begin(
@@ -618,14 +655,43 @@ class ServeEngine:
             collect = e
         return key, full_key, grp, Pb, t0, collect, pool, info
 
+    def _unit_rows(self, key, grp: List, Pb: int) -> int:
+        """Kind-local work units one sealed unit dispatches (feeds
+        the router's per-kind rate learning, so it must count the
+        PADDED work the device really executes — under the batch
+        vmap the budget mask lowers to a select, so every slot runs
+        every chunk's K steps)."""
+        if key[0] == "posterior":
+            W, K = key[4], key[5]
+            kmax = max((r.nsteps for r in grp), default=0)
+            return Pb * W * max(1, -(-kmax // K)) * K
+        return Pb * key[1]
+
+    def _posterior_progress(self, grp: List):
+        """Per-chunk progress hook for a posterior unit: journals a
+        non-terminal progress ack per journalable request after
+        every chunk dispatch, so a crash mid-chain is visible in the
+        journal (the replay restarts the chain; the marks label how
+        far the dead run got)."""
+        if self.journal is None:
+            return None
+        journal = self.journal
+
+        def progress(done_steps):
+            for k, r in enumerate(grp):
+                if r.rid is not None and r.payload is not None:
+                    journal.progress(r.rid, int(done_steps[k]))
+
+        return progress
+
     def _dispatch_finish(self, key, full_key, grp, Pb, t0, collect,
                          pool, info):
         """Collect one issued dispatch and scatter results to the
         group's futures (the wait rides the supervisor's depth-scaled
         watchdog, so this always terminates). Feeds the router's
         rate learning with the pool that ACTUALLY served."""
-        kind = "phase" if key[0] == "phase" else "gls"
-        rows = Pb * key[1]
+        kind = key[0] if key[0] in ("phase", "posterior") else "gls"
+        rows = self._unit_rows(key, grp, Pb)
         try:
             if isinstance(collect, Exception):
                 raise collect
@@ -637,6 +703,23 @@ class ServeEngine:
                     n = len(r.mjds)
                     r.future.set_result(PhasePredictResult(
                         phase_int=pi[k][:n], phase_frac=pf[k][:n]))
+            elif key[0] == "posterior":
+                chain, lnp, acc, rows_done = out
+                for k, r in enumerate(grp):
+                    pr = r.problem
+                    p = pr.M.shape[1]
+                    nrows = int(rows_done[k])
+                    # OWNED copies: a view slice would pin the whole
+                    # padded (Pb, S, W, pb) batch buffer for as long
+                    # as any client holds its result
+                    r.future.set_result(PosteriorResult(
+                        names=pr.names,
+                        chain=np.ascontiguousarray(
+                            chain[k, :nrows, :, :p]),
+                        lnprob=lnp[k, :nrows].copy(),
+                        acceptance_fraction=float(acc[k])
+                        / max(1, r.walker_steps),
+                        nsteps=r.nsteps))
             else:
                 dparams, cov, chi2, chi2r = out
                 for k, r in enumerate(grp):
@@ -683,8 +766,13 @@ class ServeEngine:
 
     @staticmethod
     def _rows_of(r) -> int:
+        """KIND-LOCAL work units (must match what the router's rate
+        for that kind was learned in): TOA/MJD rows for gls/phase,
+        total walker-steps for a posterior chain."""
         if isinstance(r, PhasePredictRequest):
             return len(r.mjds)
+        if isinstance(r, PosteriorRequest):
+            return r.walker_steps
         return r.problem.M.shape[0]
 
     # -- threaded serving loop ----------------------------------------

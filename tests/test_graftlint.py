@@ -320,6 +320,23 @@ def test_g6_covers_new_serve_modules():
         assert [x.rule for x in v] == ["G6"], rel
 
 
+def test_g6_covers_sampling_package():
+    """ISSUE-9 satellite: the dispatch half of G6 is pinned over the
+    posterior-sampling package — a direct jit-product call there must
+    lint (every chain dispatch routes through the supervisor)."""
+    for mod in ("kernel", "chain", "likelihood", "posterior",
+                "serve_kernel"):
+        rel = f"pint_tpu/sampling/{mod}.py"
+        assert gl._g6_dispatch_applies(rel), rel
+        v = _lint_dispatch("""
+            import jax
+            chunk = jax.jit(lambda x: x + 1)
+            def run_chain(x):
+                return chunk(x)
+        """, relpath=rel)
+        assert [x.rule for x in v] == ["G6"], rel
+
+
 def test_g6_dispatch_flags_direct_jit_product_call():
     v = _lint_dispatch("""
         import jax

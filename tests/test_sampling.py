@@ -1,0 +1,664 @@
+"""Device-native posterior sampling acceptance suite (ISSUE 9).
+
+The subsystem's load-bearing invariants, all on the CPU mesh:
+
+- the whole-chain-on-device ``lax.scan`` kernel is BIT-IDENTICAL to
+  the host-loop sampler (the same chunk program compiled at K=1)
+  because the PRNG streams are positional — chunked multi-dispatch
+  included;
+- the GP noise-sampled likelihood equals the fixed-noise
+  ``BayesianTiming`` at pinned hyperparameters, and equals a
+  re-CONSTRUCTED fixed-noise likelihood at moved hyperparameters
+  (the in-trace phi/Cholesky/logdet recompute is exactly the
+  reference's re-construction);
+- a ``PosteriorRequest`` through the ServeEngine is bit-identical to
+  the direct ``sample_problems`` path at the same shape class and
+  seed, and the sampled linearized posterior converges on the GLS
+  solution it linearizes;
+- chaos: backend death mid-chain degrades to a LABELED host failover
+  with zero hung futures (the chunk boundary is the failover
+  boundary);
+- admission (ISSUE-9 satellite): predicted waits price each kind at
+  its own learned rate, so a doomed posterior chain is shed while a
+  fit step with the same deadline is served.
+"""
+
+import copy
+import io
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.models.priors import GaussianPrior
+from pint_tpu.runtime import Fault, FaultPlan, reset_runtime
+from pint_tpu.simulation import (make_fake_toas_fromMJDs,
+                                 make_fake_toas_uniform)
+
+
+@pytest.fixture(autouse=True)
+def clean_runtime():
+    reset_runtime()
+    yield
+    reset_runtime()
+
+
+PAR = """
+PSR J0006+0006
+RAJ 06:00:00.0
+DECJ 20:00:00.0
+F0 220.0 1
+F1 -1.5e-15 1
+PEPOCH 55000
+POSEPOCH 55000
+DM 15.0
+DMEPOCH 55000
+TZRMJD 55000.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+"""
+
+NOISE_EXTRA = """EFAC -be X 1.1
+ECORR -be X 0.8
+TNREDAMP -13.5
+TNREDGAM 3.0
+TNREDC 5
+"""
+
+
+def _mk(ntoa=60, noise=False, seed=11):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        par = PAR + (NOISE_EXTRA if noise else "")
+        model = get_model(io.StringIO(par))
+        rng = np.random.default_rng(seed)
+        if noise:
+            # pairs of TOAs 0.01 d apart -> real ECORR epochs
+            # (quantization_buckets drops singleton buckets, nmin=2)
+            centers = np.linspace(54001, 55999, ntoa // 2)
+            mjds = (centers[:, None]
+                    + np.array([0.0, 0.01])[None, :]).ravel()
+            toas = make_fake_toas_fromMJDs(
+                mjds, model, error_us=1.0, freq_mhz=1400.0,
+                add_noise=True, rng=rng)
+            for f in toas.flags:
+                f["be"] = "X"
+        else:
+            toas = make_fake_toas_uniform(
+                54000, 56000, ntoa, model, error_us=1.0,
+                freq_mhz=1400.0, add_noise=True, rng=rng)
+    return model, toas
+
+
+@pytest.fixture(scope="module")
+def posterior():
+    """A fixed-noise DevicePosterior with proper Gaussian priors so
+    every overdispersed walker starts finite."""
+    from pint_tpu.sampling import DevicePosterior
+
+    model, toas = _mk()
+    for name in ("F0", "F1"):
+        p = model.get_param(name)
+        p.prior = GaussianPrior(p.value,
+                                max(abs(p.value) * 1e-9, 1e-18))
+    return DevicePosterior(model, toas)
+
+
+@pytest.fixture(scope="module")
+def noise_pair():
+    """(model, toas) with EFAC + ECORR + power-law red noise — the
+    sampled-hyperparameter surfaces."""
+    return _mk(ntoa=50, noise=True, seed=23)
+
+
+# ---------------------------------------------------- kernel contract
+
+
+def test_kernel_validates():
+    from pint_tpu.sampling import build_stretch_chunk
+
+    lp = lambda x: -0.5 * (x ** 2).sum(axis=-1)  # noqa: E731
+    with pytest.raises(ValueError):
+        build_stretch_chunk(lp, 7, 2, 16)     # odd walkers
+    with pytest.raises(ValueError):
+        build_stretch_chunk(lp, 2, 2, 16)     # < 2*ndim
+    with pytest.raises(ValueError):
+        build_stretch_chunk(lp, 8, 2, 16, thin=5)  # 5 !| 16
+
+
+def test_sampler_validates(posterior):
+    from pint_tpu.sampling import DeviceEnsembleSampler
+
+    with pytest.raises(ValueError):
+        DeviceEnsembleSampler(3, 2, posterior.lnpost_batch)
+    s = DeviceEnsembleSampler(8, posterior.nparams,
+                              posterior.lnpost_batch)
+    with pytest.raises(ValueError):
+        s.run_mcmc(np.zeros((4, 2)), 8)       # wrong p0 shape
+    with pytest.raises(ValueError):
+        s.run_mcmc(posterior.init_walkers(8), 8, mode="bogus")
+
+
+# ------------------------------------ scan == host_loop (THE oracle)
+
+
+def _fresh_sampler(posterior, nwalkers=8, thin=1):
+    from pint_tpu.sampling import DeviceEnsembleSampler
+
+    return DeviceEnsembleSampler(nwalkers, posterior.nparams,
+                                 posterior.lnpost_batch, thin=thin)
+
+
+def test_scan_bit_identical_to_host_loop(posterior):
+    """The tentpole oracle: one whole-chain ``lax.scan`` dispatch vs
+    one dispatch PER STEP, identical positional PRNG stream →
+    bitwise-equal chains, lnprob, acceptance and final ensemble."""
+    p0 = posterior.init_walkers(8, rng=np.random.default_rng(5))
+    host = _fresh_sampler(posterior)
+    pos_h = host.run_mcmc(p0, 48, seed=7, mode="host_loop")
+    scan = _fresh_sampler(posterior)
+    pos_s = scan.run_mcmc(p0, 48, seed=7, mode="scan")
+    assert host.dispatches == 48
+    assert scan.dispatches == 1           # whole chain, one dispatch
+    np.testing.assert_array_equal(pos_h, pos_s)
+    np.testing.assert_array_equal(host.chain, scan.chain)
+    np.testing.assert_array_equal(host.lnprob, scan.lnprob)
+    assert host.naccepted == scan.naccepted
+    assert 0 < scan.acceptance_fraction <= 1.0
+
+
+def test_chunked_multi_dispatch_bit_identical(posterior, monkeypatch):
+    """A long chain split across chunks (offset-advanced positional
+    PRNG) is bitwise the single-chunk/host-loop chain — the serve
+    layer's bounded-deadline chunking changes nothing numerically."""
+    monkeypatch.setenv("PINT_TPU_CHAIN_CHUNK", "16")
+    p0 = posterior.init_walkers(8, rng=np.random.default_rng(5))
+    chunked = _fresh_sampler(posterior)
+    chunked.run_mcmc(p0, 48, seed=7, mode="scan")
+    assert chunked.dispatches == 3
+    monkeypatch.delenv("PINT_TPU_CHAIN_CHUNK")
+    host = _fresh_sampler(posterior)
+    host.run_mcmc(p0, 48, seed=7, mode="host_loop")
+    np.testing.assert_array_equal(chunked.chain, host.chain)
+    np.testing.assert_array_equal(chunked.lnprob, host.lnprob)
+    assert chunked.naccepted == host.naccepted
+
+
+def test_thinned_chain_matches_strided_full(posterior):
+    """thin=4 emits exactly every 4th state of the thin=1 chain
+    (same PRNG stream — thinning only bounds the D2H readback)."""
+    p0 = posterior.init_walkers(8, rng=np.random.default_rng(2))
+    full = _fresh_sampler(posterior)
+    full.run_mcmc(p0, 32, seed=3, mode="scan")
+    thin = _fresh_sampler(posterior, thin=4)
+    thin.run_mcmc(p0, 32, seed=3, mode="scan")
+    assert thin.chain.shape[0] == 8
+    np.testing.assert_array_equal(thin.chain, full.chain[3::4])
+    np.testing.assert_array_equal(thin.lnprob, full.lnprob[3::4])
+    # host_loop honors thin too (review fix: it used to emit the
+    # un-thinned chain, a different SHAPE than its scan counterpart)
+    hthin = _fresh_sampler(posterior, thin=4)
+    hthin.run_mcmc(p0, 32, seed=3, mode="host_loop")
+    np.testing.assert_array_equal(hthin.chain, thin.chain)
+    np.testing.assert_array_equal(hthin.lnprob, thin.lnprob)
+    with pytest.raises(ValueError):
+        thin.run_mcmc(p0, 30, seed=3)     # 4 does not divide 30
+
+
+def test_device_sampler_moments_match_wls(posterior):
+    """Statistical sanity on top of the bitwise oracles: the sampled
+    posterior's center stays on the injected model truth within the
+    posterior scatter (prior sigma ~1e-9 relative)."""
+    s = _fresh_sampler(posterior, nwalkers=16)
+    p0 = posterior.init_walkers(16, rng=np.random.default_rng(8))
+    s.run_mcmc(p0, 300, seed=1, mode="scan")
+    flat = s.get_chain(discard=100, flat=True)
+    for k in range(posterior.nparams):
+        sig = np.std(flat[:, k])
+        assert sig > 0
+        assert abs(np.mean(flat[:, k]) - posterior.theta0[k]) \
+            < 5 * sig
+
+
+# ----------------------------------------- noise-sampled likelihood
+
+
+def test_sampled_noise_matches_fixed_at_pinned(noise_pair):
+    """CPU oracle: at hyperparameters pinned to the model's current
+    values the traced noise-sampled likelihood IS the fixed-noise
+    ``BayesianTiming`` likelihood."""
+    from pint_tpu.bayesian import BayesianTiming
+    from pint_tpu.sampling import SampledNoiseLikelihood
+
+    model, toas = noise_pair
+    bt = BayesianTiming(model, toas)
+    sn = SampledNoiseLikelihood(model, toas)
+    assert sn.labels == ["ECORR1.log10", "PLRedNoise.log10_A",
+                         "PLRedNoise.gamma"]
+    np.testing.assert_allclose(
+        sn.eta0, [np.log10(0.8), -13.5, 3.0], rtol=1e-12)
+    rng = np.random.default_rng(3)
+    th0 = bt.theta0.copy()
+    for _ in range(3):
+        th = th0 + 1e-10 * rng.standard_normal(len(th0)) * th0
+        assert sn.lnlikelihood(th, sn.eta0) == pytest.approx(
+            bt.lnlikelihood(th), rel=1e-9)
+
+
+def test_sampled_noise_matches_reconstruction(noise_pair):
+    """The strong oracle: moving (log10_A, gamma, ECORR) in eta
+    equals RE-CONSTRUCTING the fixed-noise likelihood at the moved
+    hyperparameters — the in-trace phi / per-epoch variance / Sff
+    Cholesky / logdet recompute is exactly the reference's
+    construction-time computation."""
+    from pint_tpu.bayesian import BayesianTiming
+    from pint_tpu.sampling import SampledNoiseLikelihood
+
+    model, toas = noise_pair
+    sn = SampledNoiseLikelihood(model, toas)
+    eta1 = sn.eta0 + np.array([0.1, 0.3, -0.4])
+    m2 = copy.deepcopy(model)
+    m2.get_param("ECORR1").value = 10.0 ** eta1[0]
+    m2.get_param("TNREDAMP").value = eta1[1]
+    m2.get_param("TNREDGAM").value = eta1[2]
+    m2.invalidate_cache()
+    bt2 = BayesianTiming(m2, toas)
+    th0 = bt2.theta0.copy()
+    th1 = th0.copy()
+    th1[0] += 2e-10
+    for th in (th0, th1):
+        assert sn.lnlikelihood(th, eta1) == pytest.approx(
+            bt2.lnlikelihood(th), rel=1e-9)
+    # and the hyperparameters genuinely move the likelihood
+    assert sn.lnlikelihood(th0, eta1) != \
+        pytest.approx(sn.lnlikelihood(th0, sn.eta0), rel=1e-12)
+
+
+def test_noise_sampled_posterior_chain(noise_pair):
+    """End-to-end: a DevicePosterior with sample_noise=True runs the
+    whole-chain kernel over timing + noise dimensions, scan ==
+    host_loop bitwise, and the noise dimensions actually mix."""
+    from pint_tpu.sampling import (
+        DeviceEnsembleSampler,
+        DevicePosterior,
+    )
+
+    model, toas = noise_pair
+    post = DevicePosterior(model, toas, sample_noise=True)
+    assert post.param_labels[post.ntiming:] == [
+        "ECORR1.log10", "PLRedNoise.log10_A", "PLRedNoise.gamma"]
+    W = 2 * post.nparams + 2
+    p0 = post.init_walkers(W, rng=np.random.default_rng(4),
+                           scatter=0.2)
+    scan = DeviceEnsembleSampler(W, post.nparams, post.lnpost_batch)
+    scan.run_mcmc(p0, 24, seed=9, mode="scan")
+    host = DeviceEnsembleSampler(W, post.nparams, post.lnpost_batch)
+    host.run_mcmc(p0, 24, seed=9, mode="host_loop")
+    np.testing.assert_array_equal(scan.chain, host.chain)
+    assert np.all(np.isfinite(scan.lnprob))
+    assert scan.naccepted > 0
+    # the sampled red-noise amplitude dimension moved off its start
+    lgA = scan.chain[:, :, post.ntiming + 1]
+    assert np.ptp(lgA) > 0
+
+
+def test_mcmc_fitter_sample_noise(noise_pair):
+    """MCMCFitter as a thin consumer: sample_noise=True reports the
+    hyperparameter posterior in ``noise_estimates`` and never writes
+    it into the timing model; mode='host' refuses sample_noise."""
+    from pint_tpu.mcmc_fitter import MCMCFitter
+
+    model, toas = noise_pair
+    m = copy.deepcopy(model)
+    mc = MCMCFitter(toas, m, nwalkers=4, sample_noise=True,
+                    rng=np.random.default_rng(6))
+    chi2 = mc.fit_toas(nsteps=30)
+    assert np.isfinite(chi2)
+    assert set(mc.noise_estimates) == {
+        "ECORR1.log10", "PLRedNoise.log10_A", "PLRedNoise.gamma"}
+    for v in mc.noise_estimates.values():
+        assert np.isfinite(v["median"]) and v["std"] >= 0
+    # the timing model's noise parameters are untouched
+    assert m.get_param("TNREDAMP").value == -13.5
+    with pytest.raises(ValueError):
+        MCMCFitter(toas, m, mode="host", sample_noise=True)
+
+
+# ------------------------------------------------- serve integration
+
+
+def _problems(nreq=2):
+    from pint_tpu.parallel.pta import build_problem
+
+    out = []
+    for k in range(nreq):
+        par = PAR.replace("F0 220.0", f"F0 {220.0 + 30 * k}")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(io.StringIO(par))
+            t = make_fake_toas_uniform(
+                54000, 56000, 40 + 10 * k, m, error_us=1.0,
+                freq_mhz=1400.0, add_noise=True,
+                rng=np.random.default_rng(30 + k))
+        out.append(build_problem(t, m))
+    return out
+
+
+def test_served_posterior_bit_identical_to_direct():
+    """A coalesced PosteriorRequest bucket == the direct
+    ``sample_problems`` path at the same shape class and seeds (a
+    request's PRNG stream depends only on its own seed, never on its
+    batch position)."""
+    from pint_tpu import config
+    from pint_tpu.sampling import sample_problems
+    from pint_tpu.serve import PosteriorRequest, ServeEngine
+    from pint_tpu.serve.bucket import posterior_shape_class
+
+    problems = _problems(2)
+    W, nsteps, thin = 8, 40, 1
+    eng = ServeEngine()
+    futs = [eng.submit(PosteriorRequest(
+        problem=copy.copy(pr), nwalkers=W, nsteps=nsteps,
+        seed=100 + k, thin=thin))
+        for k, pr in enumerate(problems)]
+    eng.flush()
+    served = [f.result(timeout=0) for f in futs]
+
+    K = config.chain_chunk_steps(nsteps, thin=thin)
+    keys = {posterior_shape_class(
+        pr.M.shape[0], pr.M.shape[1], pr.F.shape[1], W, K, thin,
+        eng.bucket_edges) for pr in problems}
+    assert len(keys) == 1                   # one class: coalesced
+    (_, nb, pb, qb, _, _, _), = keys
+    direct = sample_problems(
+        problems, W, nsteps, seeds=[100, 101], thin=thin,
+        shape=(eng._batch_pad(2), nb, pb, qb))
+    for res, (chain, lnp, acc) in zip(served, direct):
+        np.testing.assert_array_equal(res.chain, chain)
+        np.testing.assert_array_equal(res.lnprob, lnp)
+        assert res.acceptance_fraction == pytest.approx(acc)
+    snap = eng.metrics.snapshot()
+    assert snap["completed"] == 2
+    assert snap["router"]["device"]["rows_per_s"].get("posterior")
+
+
+def test_sampled_linearized_posterior_matches_gls():
+    """The serve kernel's statistical oracle: the chain's sample
+    moments converge on the GLS ``dparams``/``cov`` of the SAME
+    linearized problem (the chain explores the exact Gaussian the
+    solve reports)."""
+    from pint_tpu.parallel.pta import pta_solve_np, stack_problems
+    from pint_tpu.sampling import sample_problems
+
+    (pr,) = _problems(1)
+    chain, lnp, acc = sample_problems(
+        [pr], nwalkers=16, nsteps=600, seeds=[42])[0]
+    dparams, cov = pta_solve_np(stack_problems([pr]))[:2]
+    sig = np.sqrt(np.diagonal(cov[0]))
+    flat = chain[200:].reshape(-1, chain.shape[-1])
+    assert 0.1 < acc < 0.95
+    err = np.abs(flat.mean(axis=0) - dparams[0])
+    assert np.all(err < 0.5 * sig)
+    ratio = flat.std(axis=0) / sig
+    assert np.all((0.5 < ratio) & (ratio < 2.0))
+
+
+def test_posterior_request_validates():
+    from pint_tpu.serve import PosteriorRequest
+
+    (pr,) = _problems(1)
+    with pytest.raises(ValueError):
+        PosteriorRequest(problem=pr, nwalkers=7)   # odd
+    with pytest.raises(ValueError):
+        PosteriorRequest(problem=pr, nsteps=0)
+    with pytest.raises(ValueError):
+        PosteriorRequest(problem=pr, nsteps=10, thin=3)
+    # under-walkered ensemble: the serve kernel traces ndim, so the
+    # guard fires at problem assembly (review fix — a 4-walker chain
+    # over >2 dims silently never leaves its affine hull); the direct
+    # oracle surface guards identically
+    with pytest.raises(ValueError, match="2\\*ndim"):
+        PosteriorRequest(problem=pr, nwalkers=4).ensure_problem()
+    from pint_tpu.sampling import sample_problems
+    with pytest.raises(ValueError, match="2\\*ndim"):
+        sample_problems([pr], nwalkers=4, nsteps=8, seeds=[1])
+    r = PosteriorRequest(problem=pr, nwalkers=8, nsteps=100)
+    assert r.walker_steps == 800
+    assert r.kind == "posterior"
+
+
+def test_posterior_summary_convention():
+    """PosteriorResult.summary() reports per-parameter corrections in
+    the dparams convention, keyed by design-column names."""
+    from pint_tpu.serve import PosteriorRequest, ServeEngine
+
+    (pr,) = _problems(1)
+    eng = ServeEngine()
+    fut = eng.submit(PosteriorRequest(problem=copy.copy(pr),
+                                      nwalkers=8, nsteps=40, seed=5))
+    eng.flush()
+    res = fut.result(timeout=0)
+    s = res.summary()
+    assert set(s) == set(pr.names)
+    assert s["Offset"]["std"] >= 0
+    assert res.flat().shape == (40 * 8, pr.M.shape[1])
+
+
+# -------------------------------------------------- chaos + admission
+
+
+def test_posterior_chaos_mid_chain_backend_death(monkeypatch):
+    """ISSUE-9 chaos oracle: the backend dies between chain chunks —
+    every future completes via LABELED host failover (the chunk
+    boundary is the failover boundary; the chain continues from the
+    carried ensemble state), bit-identical on the CPU mesh, zero hung
+    futures, honest counters."""
+    from pint_tpu.serve import PosteriorRequest, ServeEngine
+
+    monkeypatch.setenv("PINT_TPU_CHAIN_CHUNK", "16")
+    problems = _problems(2)
+
+    def submit_all(eng):
+        return [eng.submit(PosteriorRequest(
+            problem=copy.copy(pr), nwalkers=8, nsteps=48,
+            seed=200 + k)) for k, pr in enumerate(problems)]
+
+    # reference pass (no faults): warms compiles AND gives the oracle
+    ref_eng = ServeEngine()
+    ref_futs = submit_all(ref_eng)
+    ref_eng.flush()
+    ref = [f.result(timeout=0) for f in ref_futs]
+
+    monkeypatch.setenv("PINT_TPU_DISPATCH_DEADLINE_MS", "300")
+    eng = ServeEngine()
+    # chunk 0 survives on the device; the backend wedges from chunk 1
+    plan = FaultPlan([Fault(match="serve.posterior", kind="hang",
+                            seconds=5.0, after=1)])
+    with plan.active():
+        futs = submit_all(eng)
+        eng.flush()
+    assert all(f.done() for f in futs)        # ZERO hung futures
+    for f, r in zip(futs, ref):
+        res = f.result(timeout=0)             # labeled, never raises
+        np.testing.assert_array_equal(res.chain, r.chain)
+        np.testing.assert_array_equal(res.lnprob, r.lnprob)
+        assert res.acceptance_fraction == r.acceptance_fraction
+    snap = eng.metrics.snapshot()
+    disp = snap["dispatch"]
+    assert disp["failovers"] >= 1 and disp["timeouts"] >= 1
+    assert "DEGRADED" in eng.metrics.report()
+
+
+def test_posterior_admission_priced_at_posterior_rate():
+    """ISSUE-9 satellite regression: the admission wait for a queued
+    posterior chain uses the POSTERIOR kind's learned rate — the
+    doomed chain is shed at admission while a fit step with the SAME
+    deadline is served. (Under the old single-rate estimate the
+    chain's walker-steps were priced at the ~free GLS rate: nobody
+    looked doomed and the fit step was backpressure-rejected.)"""
+    from pint_tpu.serve import (
+        DeadlineExceeded,
+        FitStepRequest,
+        PosteriorRequest,
+        ResidualsRequest,
+        ServeEngine,
+    )
+
+    (pr,) = _problems(1)
+    m, t = _mk(ntoa=50, seed=77)
+    eng = ServeEngine(queue_cap=2, shed_policy="deadline")
+    eng.router.seed_rate("device", "gls", 1e6)       # rows/s: fast
+    eng.router.seed_rate("device", "posterior", 10.0)  # glacial
+    # sanity: the same-size work is priced per kind
+    assert eng.router.predicted_wait_s(1600, kind="posterior") > \
+        eng.router.predicted_wait_s(1600, kind="gls")
+    filler = eng.submit(ResidualsRequest(t, m))      # no deadline
+    # 8*200 = 1600 walker-steps at 10/s = 160 s wait >> 30 s budget
+    post = eng.submit(PosteriorRequest(
+        problem=copy.copy(pr), nwalkers=8, nsteps=200,
+        deadline_s=30.0))
+    # at capacity: the doomed queued CHAIN is the shed victim, and
+    # the fit step with the identical deadline takes its place
+    fit = eng.submit(FitStepRequest(t, m, deadline_s=30.0))
+    assert post.done()
+    with pytest.raises(DeadlineExceeded):
+        post.result(timeout=0)
+    assert eng.admission.shed_deadline == 1
+    eng.flush()
+    assert fit.result(timeout=0).chi2 > 0            # SERVED
+    assert filler.result(timeout=0).chi2 > 0
+
+
+def test_ecorr_prior_log10_change_of_variables(noise_pair):
+    """Review fix: a prior declared over the LINEAR ECORR value
+    (microseconds) must be transformed to the sampled log10
+    coordinate with its Jacobian — p_eta(eta) = p_v(10^eta) 10^eta
+    ln10 — not evaluated raw at the log10 value."""
+    from pint_tpu.models.priors import (
+        GaussianPrior,
+        Log10TransformedPrior,
+    )
+    from pint_tpu.sampling import DevicePosterior, SampledNoiseLikelihood
+
+    base = GaussianPrior(0.8, 0.1)          # over ECORR in us
+    for eta in (-0.2, np.log10(0.8), 0.1):
+        v = 10.0 ** eta
+        expect = float(base.logpdf(v)) + np.log(v * np.log(10.0))
+        got = float(Log10TransformedPrior(base).logpdf(eta))
+        assert got == pytest.approx(expect, rel=1e-12)
+
+    model, toas = noise_pair
+    m = copy.deepcopy(model)
+    m.get_param("ECORR1").prior = GaussianPrior(0.8, 0.1)
+    sn = SampledNoiseLikelihood(m, toas)
+    assert isinstance(sn.priors[0], Log10TransformedPrior)
+    post = DevicePosterior(m, toas, sample_noise=True)
+    # the posterior's prior sum picks up the transformed density:
+    # moving eta by +0.1 in log10 changes lnpost by the transformed
+    # prior delta plus the likelihood delta, and the density peaks
+    # near log10(0.8), not at eta=0.8
+    i = post.ntiming                         # ECORR1.log10 slot
+    e0 = float(post.theta0[i])
+    assert e0 == pytest.approx(np.log10(0.8))
+    tp = Log10TransformedPrior(base)
+    assert float(tp.logpdf(np.log10(0.8))) > float(tp.logpdf(0.8))
+
+
+def test_daemon_posterior_quantizes_walkers(tmp_path, capsys):
+    """Review fix: nwalkers/thin ride EXACTLY in the posterior
+    compile key, so the daemon pow2-quantizes client values (a
+    client sweeping nwalkers 33,34,35... must not force one XLA
+    compile per request)."""
+    import json
+    import os
+
+    from pint_tpu.scripts.pint_serve import main
+
+    datadir = os.path.join(os.path.dirname(__file__), "datafile")
+    par = os.path.join(datadir, "NGC6440E.par")
+    tim = os.path.join(datadir, "NGC6440E.tim")
+    recs = [
+        {"kind": "posterior", "id": "q1", "par": par, "tim": tim,
+         "nwalkers": 18, "nsteps": 33, "thin": 3, "seed": 2},
+        # under-walkered ask: the daemon floors W at the problem's
+        # 2*ndim+2 (review fix — a default request must never
+        # hard-fail the ensemble guard on a wide model)
+        {"kind": "posterior", "id": "q2", "par": par, "tim": tim,
+         "nwalkers": 2, "nsteps": 16, "seed": 3},
+    ]
+    assert main(["--window-ms", "2"],
+                stdin=iter(json.dumps(r) for r in recs)) == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    res = [x for x in lines if x.get("id") == "q1"]
+    assert len(res) == 1 and res[0]["ok"]
+    # 18 walkers -> 32, thin 3 -> 4, nsteps 33 -> next multiple of 4
+    assert res[0]["nsteps"] == 36
+    assert "F0" in res[0]["posterior"]
+    res2 = [x for x in lines if x.get("id") == "q2"]
+    assert len(res2) == 1 and res2[0]["ok"]
+
+
+def test_posterior_progress_acks_journaled(tmp_path, monkeypatch):
+    """A journalable multi-chunk posterior request writes one
+    non-terminal ``progress`` mark per chunk dispatch between its
+    admit and its terminal ack — the post-crash journal scan shows
+    how far a dead chain got (replay restarts it from scratch)."""
+    import json
+
+    from pint_tpu.serve import PosteriorRequest, ServeEngine
+
+    monkeypatch.setenv("PINT_TPU_CHAIN_CHUNK", "16")
+    (pr,) = _problems(1)
+    jpath = str(tmp_path / "j.jsonl")
+    eng = ServeEngine(journal=jpath)
+    fut = eng.submit(PosteriorRequest(
+        problem=copy.copy(pr), nwalkers=8, nsteps=48, seed=1,
+        payload={"kind": "posterior"}))
+    eng.flush()
+    fut.result(timeout=0)
+    recs = [json.loads(x) for x in open(jpath)]
+    assert [r["op"] for r in recs] == \
+        ["admit", "progress", "progress", "progress", "ack"]
+    assert [r["steps"] for r in recs if r["op"] == "progress"] == \
+        [16, 32, 48]
+    assert recs[-1]["status"] == "served"
+    eng.stop()
+
+
+# ------------------------------------- host sampler boundary (G11)
+
+
+def test_host_sampler_copies_logp_at_boundary():
+    """ISSUE-9 small fix: ``EnsembleSampler`` must take an OWNED copy
+    of log_prob_batch's return — a zero-copy numpy view of a jax
+    device buffer dangles once donation reuses the memory. Simulated
+    here by a posterior callable that recycles ONE backing buffer
+    (what a donated device buffer looks like from numpy): the chain
+    must equal the fresh-array oracle bitwise."""
+    from pint_tpu.sampler import EnsembleSampler
+
+    icov = np.linalg.inv(np.array([[2.0, 0.6], [0.6, 1.0]]))
+
+    def fresh(x):
+        x = np.atleast_2d(x)
+        return -0.5 * np.einsum("si,ij,sj->s", x, icov, x)
+
+    buf = np.empty(64)
+
+    def recycled(x):
+        out = fresh(x)
+        view = buf[:len(out)]
+        view[:] = out
+        return view                       # same memory every call
+
+    p0 = np.random.default_rng(1).standard_normal((8, 2))
+    a = EnsembleSampler(8, 2, fresh, rng=np.random.default_rng(9))
+    a.run_mcmc(p0.copy(), 60)
+    b = EnsembleSampler(8, 2, recycled, rng=np.random.default_rng(9))
+    b.run_mcmc(p0.copy(), 60)
+    np.testing.assert_array_equal(a.chain, b.chain)
+    np.testing.assert_array_equal(a.lnprob, b.lnprob)
+    assert a.naccepted == b.naccepted

@@ -216,12 +216,99 @@ def test_journal_replay_set_and_torn_tail(tmp_path):
     j2 = RequestJournal(jpath)
     un = j2.unacknowledged()
     assert [r["rid"] for r in un] == ["r2", "r3"]
-    assert j2.counts() == {"admitted": 3, "acked": 1,
-                           "unacknowledged": 2}
+    counts = j2.counts()
+    assert {k: counts[k] for k in
+            ("admitted", "acked", "unacknowledged")} == \
+        {"admitted": 3, "acked": 1, "unacknowledged": 2}
+    assert counts["compactions"] == 0 and counts["bytes"] > 0
     j2.ack("r2", "shed:shutdown")  # shed is terminal: client told
     j2.ack("r3", "served")
     assert j2.unacknowledged() == []
     j2.close()
+
+
+def test_journal_compaction_replay_bit_identical(tmp_path, stock):
+    """ISSUE 9 satellite: ``compact()`` rewrites the journal to
+    exactly the unacknowledged admit records (atomic tmp+rename,
+    original lines verbatim, progress marks dropped) — and an engine
+    replaying the COMPACTED journal produces bit-identical responses
+    to one replaying the uncompacted copy."""
+    import shutil
+
+    jpath = str(tmp_path / "j.jsonl")
+    jcopy = str(tmp_path / "j_uncompacted.jsonl")
+    eng_a = ServeEngine(journal=jpath)
+    batch = _mk_batch(stock)
+    f0 = eng_a.submit(batch[0])
+    eng_a.flush()
+    f0.result(timeout=0)             # acked: compaction drops it
+    eng_a.submit(batch[1])
+    eng_a.submit(batch[2])
+    eng_a.journal.progress(batch[1].rid, 1)  # dropped by compaction
+    del eng_a                        # simulated SIGKILL: 2 unacked
+
+    shutil.copy(jpath, jcopy)
+    j = RequestJournal(jpath)
+    before = j.unacknowledged()
+    assert len(before) == 2
+    j.compact()
+    assert j.counts()["compactions"] == 1
+    assert j.unacknowledged() == before  # replay set bit-identical
+    j.close()
+    recs = [json.loads(x) for x in open(jpath)]
+    assert [r["op"] for r in recs] == ["admit", "admit"]
+    assert recs == before            # original lines verbatim
+    assert not (tmp_path / "j.jsonl.tmp").exists()
+
+    eng_b = ServeEngine(journal=jpath)
+    futs_b = eng_b.replay(_factory(stock))
+    eng_b.flush()
+    res_b = [f.result(timeout=0) for f in futs_b]
+    eng_c = ServeEngine(journal=jcopy)
+    futs_c = eng_c.replay(_factory(stock))
+    eng_c.flush()
+    res_c = [f.result(timeout=0) for f in futs_c]
+    assert len(res_b) == len(res_c) == 2
+    for a, b in zip(res_b, res_c):
+        _assert_bitwise(a, b)
+    eng_b.stop()
+    eng_c.stop()
+
+
+def test_journal_auto_compaction_past_threshold(tmp_path):
+    """Compaction auto-triggers when an append pushes the file past
+    the byte threshold ($PINT_TPU_JOURNAL_COMPACT_BYTES /
+    ``compact_bytes=``); a long-lived journal whose replay set stays
+    tiny stays tiny on disk too."""
+    import os
+
+    jpath = str(tmp_path / "j.jsonl")
+    j = RequestJournal(jpath, compact_bytes=512)
+    for i in range(64):
+        j.admit(f"r{i}", {"kind": "x", "pad": "y" * 32})
+        j.ack(f"r{i}", "served")
+    j.admit("tail", {"kind": "x"})   # the one live entry
+    assert j.compactions >= 1
+    assert [r["rid"] for r in j.unacknowledged()] == ["tail"]
+    j.close()
+    assert os.path.getsize(jpath) < 4 * 512
+    # disabled (0) never compacts
+    j2 = RequestJournal(str(tmp_path / "j2.jsonl"), compact_bytes=0)
+    for i in range(64):
+        j2.admit(f"r{i}", {"kind": "x", "pad": "y" * 32})
+        j2.ack(f"r{i}", "served")
+    assert j2.compactions == 0
+    j2.close()
+    # hysteresis (review fix): when the LIVE set itself exceeds the
+    # threshold compaction cannot shrink it — the trigger must back
+    # off (file doubles) instead of rewriting the whole journal on
+    # every append during a backed-up outage
+    j3 = RequestJournal(str(tmp_path / "j3.jsonl"), compact_bytes=256)
+    for i in range(64):
+        j3.admit(f"r{i}", {"kind": "x", "pad": "y" * 32})  # no acks
+    assert len(j3.unacknowledged()) == 64
+    assert j3.compactions <= 8          # ~log2, not one per append
+    j3.close()
 
 
 def test_replay_does_not_duplicate_admit_records(tmp_path, stock):
@@ -246,8 +333,10 @@ def test_replay_does_not_duplicate_admit_records(tmp_path, stock):
     admits = [o for o in ops if o["op"] == "admit"]
     assert len(admits) == 3  # one per original submit, none added
     j = RequestJournal(jpath)
-    assert j.counts() == {"admitted": 3, "acked": 3,
-                          "unacknowledged": 0}
+    counts = j.counts()
+    assert {k: counts[k] for k in
+            ("admitted", "acked", "unacknowledged")} == \
+        {"admitted": 3, "acked": 3, "unacknowledged": 0}
     eng_b.stop()
 
 

@@ -50,6 +50,7 @@ STAGES = {
     "stress_wideband": "stress_nanograv_like_10k_fit_wideband",
     "serve": "serve_coalesced_vs_sequential_64req",
     "serve_degraded": "serve_degraded_overload",
+    "posterior": "posterior_whole_chain_vs_per_step",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
 ATTR_VARIANTS = ("production", "no_hybrid_jac", "jac_f64",
@@ -317,6 +318,24 @@ def stage_serve_degraded(backend):
     print(json.dumps(rec), flush=True)
 
 
+def stage_posterior(backend):
+    """Whole-chain-on-device MCMC vs the per-step dispatch baseline
+    ON CHIP (ISSUE 9): over the axon tunnel the host-loop mode pays
+    the full RTT twice per step, so the whole-chain speedup here is
+    the subsystem's real win — the CPU-mesh 13.6x in the bench
+    artifact is the architectural floor."""
+    import bench_posterior
+
+    rec = bench_posterior.run(nwalkers=32, nsteps=512, repeats=3)
+    if rec.get("backend") != backend:
+        raise RuntimeError(
+            f"bench_posterior ran on {rec.get('backend')!r}, not "
+            f"{backend!r} (tunnel died?); stage stays on the "
+            f"to-do list")
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
 def run_stage(name, backend):
     bench.log(f"=== stage {name} ===")
     t0 = time.perf_counter()
@@ -346,6 +365,8 @@ def run_stage(name, backend):
         stage_serve(backend)
     elif name == "serve_degraded":
         stage_serve_degraded(backend)
+    elif name == "posterior":
+        stage_posterior(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "
