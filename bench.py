@@ -550,6 +550,113 @@ def measure_whole_fit(model, toas, per_step_s=None, reps=3,
     return block
 
 
+def measure_obs_overhead(step_call, reps=5):
+    """Tracing-overhead measurement (ISSUE 10 acceptance targets:
+    tracer OFF within noise of the uninstrumented wall, <1%; tracer
+    ON <5%). Two measurements, one conclusion:
+
+    1. **per-dispatch instrumentation cost**, resolved where it is
+       actually measurable: the full supervised-dispatch + span path
+       with a TRIVIAL payload, batched x200, tracer off vs on — the
+       off/on delta IS the instrumentation cost (a few µs on the CPU
+       mesh), independent of payload noise. ``overhead_frac`` is
+       that cost against the real step wall — the honest number a
+       ~µs effect on a ~50 ms step deserves.
+    2. **evidence walls**: the real north-star step through the same
+       path, tracer off vs on, ALTERNATING pairs with min-of-each
+       (cancels monotonic load drift). On a noisy container the
+       run-to-run spread of the step itself (tens of ms here —
+       watcher probes, suite runs) dwarfs the µs signal, so these
+       are reported as evidence, not divided against each other.
+
+    A fresh DispatchSupervisor keeps the measurement's counters and
+    latency histograms self-contained (returned as the artifact's
+    ``latency`` block); the global tracer is restored to its
+    env-driven state afterwards."""
+    from pint_tpu import obs
+    from pint_tpu.runtime import DispatchSupervisor
+
+    sup = DispatchSupervisor()
+
+    def once():
+        with obs.span("bench.step"):
+            sup.dispatch(step_call, key="bench.obs_step")
+
+    def tiny_batch(n=_TINY_N):
+        for _ in range(n):
+            with obs.span("bench.tiny"):
+                sup.dispatch(_noop_payload, key="bench.obs_tiny")
+
+    # force-off legs must be GENUINELY off: an armed env stream or
+    # flight dir would otherwise keep recording through the "off"
+    # configure (recording = enabled OR stream OR flight), measuring
+    # zero delta and vacuously "passing" the acceptance target
+    def cfg(on: bool):
+        obs.configure(enabled=on, stream=False, flight_dir=False)
+
+    try:
+        cfg(False)
+        once()                      # warm both dispatch keys
+        tiny_batch(2)
+        # events per tiny iteration (the instrumented-unit size the
+        # measured delta covers — dividing by it gives a per-EVENT
+        # cost that composes with any step's event count)
+        cfg(True)
+        ring0 = len(obs.get_tracer())
+        tiny_batch(1)
+        events_per_tiny = max(1, len(obs.get_tracer()) - ring0)
+        # 1. per-iteration instrumentation cost (trivial payload)
+        t_tiny_off = t_tiny_on = float("inf")
+        for _ in range(max(2, reps)):
+            cfg(False)
+            t_tiny_off = min(t_tiny_off, time_fn(tiny_batch, 1))
+            cfg(True)
+            t_tiny_on = min(t_tiny_on, time_fn(tiny_batch, 1))
+        per_iter_us = max(0.0, t_tiny_on - t_tiny_off) \
+            / _TINY_N * 1e6
+        per_event_us = per_iter_us / events_per_tiny
+        # 2. real-step evidence walls (alternating mins)
+        cfg(True)
+        ring0 = len(obs.get_tracer())
+        once()
+        events_per_step = len(obs.get_tracer()) - ring0
+        t_off = t_on = float("inf")
+        for _ in range(max(2, reps)):
+            cfg(False)
+            t_off = min(t_off, time_fn(once, 1))
+            cfg(True)
+            t_on = min(t_on, time_fn(once, 1))
+        status = obs.get_tracer().status()
+        block = {
+            # the headline: instrumentation cost of one span+dispatch
+            # unit, and the per-event cost scaled by the step's real
+            # event count against the step wall
+            "per_dispatch_overhead_us": round(per_iter_us, 2),
+            "overhead_frac": round(
+                per_event_us * 1e-6 * events_per_step / t_off, 6)
+            if t_off else None,
+            "events_per_step": events_per_step,
+            # evidence walls (min over alternating pairs; their raw
+            # difference is container noise, not tracer cost)
+            "trace_off_step_ms": round(t_off * 1e3, 3),
+            "trace_on_step_ms": round(t_on * 1e3, 3),
+            "ring_size": status["ring_size"],
+        }
+        return block, sup.metrics.latency.snapshot()
+    finally:
+        obs.reset()
+
+
+# tiny-payload iterations per timing sample in measure_obs_overhead
+# (the ONE constant both the batch default and the per-iteration
+# division use — tuning it in one place cannot skew the other)
+_TINY_N = 200
+
+
+def _noop_payload():
+    return None
+
+
 def measure_numpy_mirror(model, toas, reps=3):
     """The reference-algorithm CPU path: residuals + design matrix on
     the CPU backend, numpy/scipy basis-Woodbury solve (dense ECORR
@@ -997,6 +1104,22 @@ def main():
     except Exception as e:
         log(f"whole-fit measurement failed: {e!r}")
 
+    # tracing-overhead measurement (ISSUE 10): same step, production
+    # supervised path, tracer off vs on — the `obs` block's <1%/<5%
+    # acceptance targets, with the per-(pool,key) latency histograms
+    # of the measurement run as the `latency` block
+    obs_block = lat_block = None
+    try:
+        obs_block, lat_block = measure_obs_overhead(
+            lambda: jax.block_until_ready(jitted(*args)))
+        log(f"tracing overhead [{backend}]: off "
+            f"{obs_block['trace_off_step_ms']} ms, on "
+            f"{obs_block['trace_on_step_ms']} ms "
+            f"(frac={obs_block['overhead_frac']}, "
+            f"{obs_block['events_per_step']} events/step)")
+    except Exception as e:
+        log(f"tracing-overhead measurement failed: {e!r}")
+
     # transparency: the f32-Jacobian variant is auto-on only on TPU;
     # when we're on the CPU backend measure it too (it halves the CPU
     # step at <1e-2 sigma agreement — tests/test_jac32.py)
@@ -1087,6 +1210,10 @@ def main():
         north["step_ms_chained8"] = chained_ms
     if overhead_block is not None:
         north["dispatch_overhead"] = overhead_block
+    if obs_block is not None:
+        north["obs"] = obs_block
+    if lat_block is not None:
+        north["latency"] = lat_block
     north.update(roofline_fields(jitted, args, per_iter_t, backend))
 
     # provenance merge: carry the latest committed on-chip records

@@ -265,6 +265,13 @@ def run(nwalkers: int = 32, nsteps: int = 512, repeats: int = 3,
         "dispatch_supervisor": get_supervisor().snapshot(),
         "lint": _lint_block(),
     }
+    # ISSUE 10: the supervisor's per-(pool,key) dispatch-wall
+    # histograms as the top-level `latency` block + tracer/flight
+    # state — the same artifact shape as bench.py / bench_serve.py
+    rec["latency"] = get_supervisor().metrics.latency.snapshot()
+    from pint_tpu import obs
+
+    rec["obs"] = obs.status()
     if serve:
         rec["serve"] = measure_serve(nwalkers, max(64, nsteps // 4))
     return rec

@@ -184,6 +184,12 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
         "admission": co_snap.get("admission"),
         "router": co_snap.get("router"),
         "restart": measure_restart(),
+        # ISSUE 10 observability: log-bucketed latency histograms
+        # per (pool, kind, class) x (queue_wait/dispatch_wall/e2e)
+        # + tracer/flight-recorder state — the tail view the
+        # reservoir p50/p99 above cannot give
+        "latency": co_snap.get("latency"),
+        "obs": co_snap.get("obs"),
     }
     if "coalesced_mesh" in co_best:
         rec["mesh_sharded_wall_ms"] = round(

@@ -70,6 +70,18 @@ Rules (see ARCHITECTURE.md "Static analysis" for the table):
       G10's frozen-guard check); donated positions are read from the
       literal donate_argnums, a non-literal donates conservatively
       at every position (graftflow.check_g11_module)
+  G12 supervised-dispatch call sites in the dispatch layer (the G6
+      file set) must run under a tracer span context
+      (``pint_tpu.obs.span``/``attach``): the supervisor's own
+      dispatch span and its retry/timeout/breaker/failover children
+      parent from the ambient context, so a dispatch issued with no
+      span context is a causal orphan — its degradation events can
+      never be traced back to the request/fit that caused them.
+      Compliance is approximate like G10's frozen-guard check: the
+      call must be lexically under a ``with ...span(...)`` /
+      ``attach(...)``, or its enclosing function (or a lexical
+      ancestor) must be reachable from a span-bearing function via
+      same-module calls. Pragma/allowlist policy as G9.
 
 jit-reachability is inferred statically, seeded by project
 conventions: any function whose early positional parameters include
@@ -121,6 +133,9 @@ RULES = {
            "closure captures cross-checked against the compile key)",
     "G11": "no use-after-donate: a buffer passed in a donated "
            "argument position must not be read after the dispatch",
+    "G12": "supervised-dispatch call sites must run under a tracer "
+           "span context (obs.span/attach) so dispatch telemetry "
+           "has a causal parent",
 }
 
 # entry points allowed to mutate global jax config (G7): the package
@@ -748,6 +763,131 @@ def check_g6_dispatch(m: ModuleInfo,
     return out
 
 
+# G12 — span context at supervised-dispatch call sites ---------------
+
+# context managers that establish a span context (pint_tpu.obs):
+# span()/open_span() enter a new span, attach() re-enters a captured
+# one on a worker thread — all three parent subsequent dispatch spans
+SPAN_CONTEXT_CALLS = {"span", "attach"}
+DISPATCH_METHODS = {"dispatch", "dispatch_async"}
+# receiver-name markers identifying the callee as the runtime
+# supervisor (sup.dispatch / self.supervisor.dispatch /
+# get_supervisor().dispatch / supervisor.dispatch_async)
+SUPERVISOR_MARKERS = {"supervisor", "sup", "get_supervisor"}
+
+
+def _expr_names(node: ast.AST) -> Set[str]:
+    """Every Name id / Attribute attr / called tail in an expression
+    — how a dispatch call's receiver chain is matched against the
+    supervisor markers."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _with_establishes_span(node) -> bool:
+    return isinstance(node, (ast.With, ast.AsyncWith)) and any(
+        isinstance(it.context_expr, ast.Call)
+        and _tail_name(it.context_expr.func) in SPAN_CONTEXT_CALLS
+        for it in node.items)
+
+
+def _span_context_closure(m: ModuleInfo) -> Set[ast.FunctionDef]:
+    """Functions that (approximately) run under a span context:
+    seeds are functions whose body contains a with-span/with-attach
+    statement; the closure propagates along same-module calls (bare
+    name or self./cls. attribute) from a seed to its callees — the
+    fit_toas -> _fit_device pattern — with the same shadowed-local
+    filtering as the jit-reachability inference."""
+    seeds: Set[ast.FunctionDef] = set()
+    for f in m.functions:
+        for node in ast.walk(f):
+            if _with_establishes_span(node):
+                seeds.add(f)
+                break
+    ok = set(seeds)
+    changed = True
+    while changed:
+        changed = False
+        for f in list(ok):
+            local = _locally_bound_names(f)
+            for node in ast.walk(f):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = None
+                fn = node.func
+                if isinstance(fn, ast.Name):
+                    if fn.id in local:
+                        continue
+                    callee = fn.id
+                elif isinstance(fn, ast.Attribute) and \
+                        isinstance(fn.value, ast.Name) and \
+                        fn.value.id in ("self", "cls"):
+                    callee = fn.attr
+                if callee is None:
+                    continue
+                for g in m.by_name.get(callee, []):
+                    if g not in ok:
+                        ok.add(g)
+                        changed = True
+    return ok
+
+
+def check_g12(m: ModuleInfo) -> List[Violation]:
+    """Span context at supervised-dispatch call sites (module
+    docstring G12). Same file set as G6's dispatch half; runtime/
+    is exempt by construction (the supervisor IS the span emitter).
+    """
+    if not _g6_dispatch_applies(m.relpath):
+        return []
+    closure = None  # computed lazily — most modules have no dispatch
+    out = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and fn.attr in DISPATCH_METHODS):
+            continue
+        if not (_expr_names(fn.value) & SUPERVISOR_MARKERS):
+            continue
+        # (a) lexically under a with-span/with-attach
+        cur = m.parents.get(node)
+        enclosed = False
+        while cur is not None:
+            if _with_establishes_span(cur):
+                enclosed = True
+                break
+            cur = m.parents.get(cur)
+        if enclosed:
+            continue
+        # (b) enclosing function (or a lexical ancestor — closures
+        # the span-bearing function builds) in the span closure
+        if closure is None:
+            closure = _span_context_closure(m)
+        cur = m.enclosing_function(node)
+        in_closure = False
+        while cur is not None:
+            if cur in closure:
+                in_closure = True
+                break
+            cur = m.enclosing_function(cur)
+        if in_closure:
+            continue
+        out.append(Violation(
+            "G12", m.relpath, node.lineno,
+            f"supervised dispatch `{fn.attr}` with no span context: "
+            f"the dispatch span (and its retry/timeout/breaker/"
+            f"failover children) would be a causal orphan — wrap the "
+            f"call site in `with obs.span(...)` (or obs.attach on a "
+            f"worker thread)", m.line_text(node.lineno)))
+    return out
+
+
 def check_g6_python(m: ModuleInfo) -> List[Violation]:
     """Timeout bounds in tools//scripts Python. The bounded-probe
     requirement is module-wide and order-insensitive — a deliberate
@@ -1140,6 +1280,7 @@ def run_lint(root: str, dynamic: bool = True,
         report.violations += check_g6_python(m)
         report.violations += check_g6_dispatch(
             m, prod_per_module.get(m.relpath, set()) | prod_private)
+        report.violations += check_g12(m)
         report.violations += check_g7(m)
         report.violations += check_g8(m)
     for relpath, src in shell:

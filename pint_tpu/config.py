@@ -21,6 +21,7 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "obs_override", "enable_compile_cache", "solve_device",
            "solve_scope", "dispatch_rtt_ms", "auto_steps_per_dispatch",
            "remeasure_dispatch_rtt", "dispatch_deadline_ms",
+           "dispatch_rtt_override_ms",
            "dispatch_retries", "dispatch_backoff_ms",
            "dispatch_compile_allowance_ms", "breaker_threshold",
            "breaker_cooldown_s", "breaker_probe_timeout_s",
@@ -29,7 +30,9 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "serve_queue_cap", "serve_pipeline_depth",
            "tenant_qps", "tenant_burst", "shed_policy", "aot_dir",
            "journal_path", "serve_drain_timeout_s",
-           "chain_chunk_steps", "journal_compact_bytes"]
+           "chain_chunk_steps", "journal_compact_bytes",
+           "trace_enabled", "trace_stream_path", "trace_ring_size",
+           "flight_dir"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -54,6 +57,39 @@ def _env_number(name: str, default, cast=float):
         return default
 
 
+def dispatch_rtt_override_ms():
+    """The validated $PINT_TPU_DISPATCH_RTT_MS override, or None.
+
+    The ONE parser for the override (ISSUE 10 satellite, round-5
+    advisor finding): ``dispatch_rtt_ms`` and the supervisor's
+    deadline/drift logic both read the env through here, so the
+    validation — parse BEFORE any per-backend cache lookup, warn on a
+    bad value instead of silently ignoring it — can never diverge
+    between the two consumers. Beyond parseability, the value must be
+    a finite positive float: a zero/negative/NaN/inf RTT would poison
+    every watchdog-deadline prediction and the power-of-two K re-pick
+    downstream, so those warn (once per distinct bad value) and are
+    ignored like a typo."""
+    import math
+
+    val = _env_number("PINT_TPU_DISPATCH_RTT_MS", None)
+    if val is None:
+        return None
+    val = float(val)
+    if not math.isfinite(val) or val <= 0.0:
+        raw = os.environ.get("PINT_TPU_DISPATCH_RTT_MS")
+        key = ("PINT_TPU_DISPATCH_RTT_MS", f"range:{raw}")
+        if key not in _WARNED_ENV:
+            _WARNED_ENV.add(key)
+            from pint_tpu.logging import log
+
+            log.warning("$PINT_TPU_DISPATCH_RTT_MS=%r is not a "
+                        "finite positive RTT; ignoring the override",
+                        raw)
+        return None
+    return val
+
+
 def dispatch_rtt_ms() -> float:
     """Measured round-trip latency of ONE trivial dispatch on the
     default backend (ms), cached per backend per process. This is the
@@ -62,18 +98,19 @@ def dispatch_rtt_ms() -> float:
     tunnel (measured round 4). The device fitters size their
     steps-per-dispatch chaining from it instead of a hard-coded 8.
     Override with $PINT_TPU_DISPATCH_RTT_MS (a float) to skip the
-    measurement — read BEFORE the per-backend cache so a mid-process
-    override (or a changed one) takes effect immediately; an
-    unparsable value logs a warning instead of silently falling back
-    (ADVICE round 5)."""
+    measurement — VALIDATED and read BEFORE the per-backend cache
+    (dispatch_rtt_override_ms) so a mid-process override (or a
+    changed one) takes effect immediately; an unparsable or
+    out-of-range value logs a warning instead of silently falling
+    back (ADVICE round 5 / ISSUE 10 satellite)."""
     import time
 
     import jax
     import jax.numpy as jnp
 
-    env = _env_number("PINT_TPU_DISPATCH_RTT_MS", None)
+    env = dispatch_rtt_override_ms()
     if env is not None:
-        return float(env)
+        return env
     backend = jax.default_backend()
     if backend in _RTT_MS:
         return _RTT_MS[backend]
@@ -559,6 +596,54 @@ def journal_compact_bytes() -> int:
     leaves the previous journal intact."""
     return max(0, int(_env_number("PINT_TPU_JOURNAL_COMPACT_BYTES",
                                   16 * 1024 * 1024, cast=int)))
+
+
+# ---------------------------------------------------- observability
+
+
+def trace_enabled() -> bool:
+    """Structured span tracing ($PINT_TPU_TRACE, default OFF): when
+    on, every serve request / supervised dispatch / device fit emits
+    causally-linked spans into the process tracer's ring buffer
+    (``pint_tpu.obs``), exportable as Chrome trace-event JSON
+    (Perfetto / chrome://tracing). Off, the hot path pays a single
+    branch per instrumentation point — the <1% north-star contract
+    measured in bench.py's ``obs`` block."""
+    return os.environ.get("PINT_TPU_TRACE", "").lower() in (
+        "1", "on", "true", "yes")
+
+
+def trace_stream_path():
+    """JSONL span-stream path ($PINT_TPU_TRACE_STREAM; None =
+    disabled): completed spans/events are appended as one JSON object
+    per line AS THEY COMPLETE, in addition to the ring buffer — the
+    ``pint_serve`` daemon's live-tail mode (a crash loses at most the
+    line being written, unlike a ring that dies with the process).
+    Implies tracing even without $PINT_TPU_TRACE."""
+    p = os.environ.get("PINT_TPU_TRACE_STREAM")
+    return p if p else None
+
+
+def trace_ring_size() -> int:
+    """Span-ring capacity ($PINT_TPU_TRACE_RING, default 16384):
+    the most recent completed spans/events kept in memory for export
+    and for flight-recorder dumps. Bounded so a long-lived serving
+    process never grows; at serving rates (a few spans per BATCH,
+    not per TOA) the default covers minutes of history."""
+    return max(256, int(_env_number("PINT_TPU_TRACE_RING", 16384,
+                                    cast=int)))
+
+
+def flight_dir():
+    """Flight-recorder dump directory ($PINT_TPU_FLIGHT_DIR; None =
+    disabled): on breaker-open, shed-burst, shutdown drain, or an
+    unhandled serve-engine exception, the tracer's recent-span ring
+    is dumped to a timestamped JSON file there — pairing with the
+    request journal so a post-mortem has both *what was pending* and
+    *what the system was doing*. Arming the flight recorder turns on
+    span RECORDING (ring only) even when $PINT_TPU_TRACE is off."""
+    d = os.environ.get("PINT_TPU_FLIGHT_DIR")
+    return d if d else None
 
 
 def serve_pipeline_depth() -> int:

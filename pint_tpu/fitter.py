@@ -165,9 +165,13 @@ class Fitter:
             with self._solve_scope():
                 return _wls_solve(jnp.asarray(M_h), jnp.asarray(r_h), jnp.asarray(e_h), threshold_arg=threshold)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
 
-        return get_supervisor().dispatch(
-            run, key="wls.solve", pinned=self._solve_pinned(),
-            fallback=lambda: _wls_solve_np(M_h, r_h, e_h, threshold))
+        from pint_tpu import obs
+
+        with obs.span("wls.solve", ntoa=self.toas.ntoas):
+            return get_supervisor().dispatch(
+                run, key="wls.solve", pinned=self._solve_pinned(),
+                fallback=lambda: _wls_solve_np(M_h, r_h, e_h,
+                                               threshold))
 
     def _record_stats(self, chi2: float, iterations: int, t0: float,
                       dof=None):

@@ -196,10 +196,13 @@ def gls_chi2(model, toas, resids=None) -> float:
         with solve_scope(toas.ntoas):
             return _gls_chi2_kernel(jnp.asarray(F_h), jnp.asarray(phi_h), jnp.asarray(r_h), jnp.asarray(nvec_h))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
 
-    out = get_supervisor().dispatch(
-        run, key="gls.chi2",
-        pinned=solve_device(toas.ntoas) is not None,
-        fallback=lambda: _gls_chi2_np(F_h, phi_h, r_h, nvec_h))
+    from pint_tpu import obs
+
+    with obs.span("gls.chi2", ntoa=toas.ntoas):
+        out = get_supervisor().dispatch(
+            run, key="gls.chi2",
+            pinned=solve_device(toas.ntoas) is not None,
+            fallback=lambda: _gls_chi2_np(F_h, phi_h, r_h, nvec_h))
     return float(out)
 
 
@@ -388,29 +391,34 @@ class GLSFitter(Fitter):
             with self._solve_scope():
                 return _gls_kernel(*place(), f32mm=f32mm)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
 
-        if self.full_cov:
-            x, cov, chi2, noise = sup.dispatch(
-                run_fullcov, key="gls.fullcov", pinned=pinned)
-        elif threshold is not None:
-            x, cov, chi2, noise, _ = sup.dispatch(
-                run_svd, kw={"th": float(threshold)},
-                key="gls.svd", pinned=pinned)
-        else:
-            from pint_tpu.parallel.fit_step import _use_f32_matmul
+        from pint_tpu import obs
 
-            # when the solve is pinned to the host CPU the f32-MXU
-            # auto-on (keyed on the process backend) is moot: CPU
-            # f64 is native, so keep full precision there
-            f32mm = False if pinned else _use_f32_matmul(None)
-            x, cov, chi2, noise, _, ok = sup.dispatch(
-                run_chol, kw={"f32mm": f32mm}, key="gls.solve",
-                pinned=pinned)
-            if not bool(ok):
-                from pint_tpu.fitter import warn_degenerate
-
-                warn_degenerate()
+        with obs.span("gls.solve_once",
+                      fitter=type(self).__name__,
+                      ntoa=self.toas.ntoas):
+            if self.full_cov:
+                x, cov, chi2, noise = sup.dispatch(
+                    run_fullcov, key="gls.fullcov", pinned=pinned)
+            elif threshold is not None:
                 x, cov, chi2, noise, _ = sup.dispatch(
-                    run_svd, key="gls.svd", pinned=pinned)
+                    run_svd, kw={"th": float(threshold)},
+                    key="gls.svd", pinned=pinned)
+            else:
+                from pint_tpu.parallel.fit_step import _use_f32_matmul
+
+                # when the solve is pinned to the host CPU the
+                # f32-MXU auto-on (keyed on the process backend) is
+                # moot: CPU f64 is native, so keep full precision
+                f32mm = False if pinned else _use_f32_matmul(None)
+                x, cov, chi2, noise, _, ok = sup.dispatch(
+                    run_chol, kw={"f32mm": f32mm}, key="gls.solve",
+                    pinned=pinned)
+                if not bool(ok):
+                    from pint_tpu.fitter import warn_degenerate
+
+                    warn_degenerate()
+                    x, cov, chi2, noise, _ = sup.dispatch(
+                        run_svd, key="gls.svd", pinned=pinned)
         # r ≈ M (θ − θ_true): the correction is −x (see WLSFitter)
         return (-np.asarray(x), np.asarray(cov), float(chi2),
                 np.asarray(noise), names)
@@ -581,22 +589,34 @@ class DeviceDownhillGLSFitter(GLSFitter):
         after a completed dispatch loop, so the failover starts from
         the pre-fit state and its result is bit-identical to running
         the host fitter directly."""
+        from pint_tpu import obs
+
         t0 = time.perf_counter()
         # reset BEFORE the attempt: after a host failover the count
         # must read None (no device evals ran), not the previous
         # fit's number — unlabeled degradation is the failure mode
         # the runtime layer exists to prevent
         self.step_evals = None
+        # the whole fit is one trace (ISSUE 10): every chained-loop
+        # dispatch, pipelined chunk issue and supervisor child event
+        # (retry/timeout/failover) parents under this span, and a
+        # host failover is a labeled sibling — the causal story
+        # behind a DEGRADED artifact
         try:
-            return self._fit_device(maxiter, min_lambda,
-                                    required_chi2_decrease,
-                                    steps_per_dispatch, t0,
-                                    whole_fit=whole_fit,
-                                    pipeline=pipeline)
+            with obs.span("fit.device", fitter=type(self).__name__,
+                          ntoa=self.toas.ntoas, maxiter=maxiter):
+                return self._fit_device(maxiter, min_lambda,
+                                        required_chi2_decrease,
+                                        steps_per_dispatch, t0,
+                                        whole_fit=whole_fit,
+                                        pipeline=pipeline)
         except (DispatchError, NonFiniteStepError) as e:
             get_supervisor().note_failover("gls.device_fit", e)
-            return self._fit_host_failover(
-                maxiter, min_lambda, required_chi2_decrease, e, t0)
+            with obs.span("fit.host_failover",
+                          cause=f"{type(e).__name__}: {e}"):
+                return self._fit_host_failover(
+                    maxiter, min_lambda, required_chi2_decrease, e,
+                    t0)
 
     def _fit_host_failover(self, maxiter, min_lambda,
                            required_chi2_decrease, cause, t0):

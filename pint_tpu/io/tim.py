@@ -196,7 +196,22 @@ def _fresh_state() -> dict:
 
 def _parse_tim_stream(source, st: dict, _depth: int = 0):
     """parse_tim worker: one file/stream of the INCLUDE tree, sharing
-    the command state ``st`` (see _fresh_state)."""
+    the command state ``st`` (see _fresh_state).
+
+    **EMIN/EMAX cut ordering (intentional, ISSUE 10 satellite)**:
+    the error cuts are applied to the SCALED uncertainty — after the
+    scoped EFAC multiply and EQUAD quadrature add — not to the raw
+    column value. Rationale: the cut then sees exactly the
+    uncertainty the fit will see, so "drop TOAs worse than X" means
+    what it says under any in-file rescaling. TEMPO-parity caveat:
+    classic TEMPO applies EMIN/EMAX to the RAW quoted error before
+    its own scaling, so a .tim file combining EFAC/EQUAD with
+    EMIN/EMAX can select a (slightly) different TOA subset here than
+    under TEMPO — files that keep the cuts ahead of any EFAC/EQUAD
+    command in the stream are unaffected (the scale factors are
+    still 1 when the cut state is set, and both orderings see raw ==
+    scaled for TOAs parsed before the first scaling command).
+    FMIN/FMAX have no such subtlety (frequency is never rescaled)."""
     from pint_tpu.io.par import resolve_source
 
     lines, base_dir = resolve_source(source, kind="tim")

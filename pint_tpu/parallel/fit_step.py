@@ -910,10 +910,12 @@ def build_sharded_fit_step(model, toas, mesh, axis: str = "toa",
         callers here all read to host immediately anyway). The raw
         jit object stays reachable as ``supervised.jitted`` for
         introspection (``.lower()``/cost analysis)."""
+        from pint_tpu import obs
         from pint_tpu.runtime import get_supervisor
 
-        return get_supervisor().dispatch(
-            jitted, *step_args, key="fit_step.sharded")
+        with obs.span("fit_step.sharded"):
+            return get_supervisor().dispatch(
+                jitted, *step_args, key="fit_step.sharded")
 
     supervised.jitted = jitted
     return supervised, dev_args, names

@@ -275,9 +275,12 @@ def pta_solve(stacked: dict, mesh=None, axis: str = "pulsar"):
         out = _pta_kernel(arrs["M"], arrs["F"], arrs["phi"], arrs["r"], arrs["nvec"], arrs["valid"], arrs["pvalid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
         return tuple(np.asarray(o)[:P] for o in out)
 
-    return get_supervisor().dispatch(
-        run, key="pta.batch",
-        fallback=lambda: pta_solve_np(stacked))
+    from pint_tpu import obs
+
+    with obs.span("pta.solve", npulsars=P):
+        return get_supervisor().dispatch(
+            run, key="pta.batch",
+            fallback=lambda: pta_solve_np(stacked))
 
 
 def fit_pta(pairs: Sequence[Tuple], maxiter: int = 2, mesh=None,

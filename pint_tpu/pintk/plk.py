@@ -61,11 +61,17 @@ class PlkState:
             # Julian-epoch year (reference plk "year" axis)
             x = 2000.0 + (data["mjds"] - 51544.5) / 365.25
         elif self.xaxis == "day_of_year":
-            # days since the most recent Jan 1 (UTC, civil-year
-            # approximation adequate for a plot axis)
-            yr = np.floor((data["mjds"] - 51544.0) / 365.25)
-            jan1 = 51544.0 + yr * 365.25
-            x = data["mjds"] - np.floor(jan1)
+            # EXACT civil (UTC) day-of-year via the calendar
+            # conversion in pint_tpu.time.mjd (ISSUE 10 satellite:
+            # the old Julian-year 365.25 d approximation drifted up
+            # to ~0.75 d within a year and produced day-366
+            # artifacts at non-leap year boundaries). Jan 1 00:00 ->
+            # 1.0, fractional day rides the MJD fraction.
+            from pint_tpu.time.mjd import mjd_to_calendar
+
+            mjds = data["mjds"]
+            _, _, _, doy = mjd_to_calendar(mjds)
+            x = doy + (mjds - np.floor(mjds))
         elif self.xaxis == "orbital_phase":
             x = data.get("orbital_phase")
             if x is None:

@@ -101,9 +101,15 @@ def trace(logdir: Optional[str] = None):
 
 @contextlib.contextmanager
 def annotate(name: str):
-    """Named region: shows up inside device traces AND feeds the
-    scoreboard, so one instrumentation point serves both."""
+    """Named region: shows up inside device traces, feeds the
+    scoreboard, AND (ISSUE 10) opens a tracer span under the current
+    causal context — ONE instrumentation point serves jax.profiler,
+    the process scoreboard and the structured trace. With tracing
+    off the span is the shared no-op."""
     import jax
 
-    with jax.profiler.TraceAnnotation(name), scoreboard.phase(name):
+    from pint_tpu import obs
+
+    with jax.profiler.TraceAnnotation(name), scoreboard.phase(name), \
+            obs.span(name, kind="annotate"):
         yield

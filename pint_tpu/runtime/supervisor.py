@@ -122,12 +122,19 @@ class RuntimeMetrics:
                  "async_dispatches")
 
     def __init__(self):
+        from pint_tpu.obs import HistogramSet
+
         self._lock = threading.Lock()
         for name in self._COUNTERS:
             setattr(self, name, 0)
         self.last_rtt_ms: Optional[float] = None
         self.last_k: Optional[int] = None
         self.max_inflight = 0   # peak pipelined depth observed
+        # per-(pool, key) dispatch-wall histograms (ISSUE 10):
+        # log-bucketed, O(1) memory, embedded as the `latency` block
+        # of snapshot() — how bench artifacts judge tails without
+        # per-sample storage
+        self.latency = HistogramSet()
 
     def bump(self, name: str, n: int = 1):
         with self._lock:
@@ -148,6 +155,9 @@ class RuntimeMetrics:
             out["last_k"] = self.last_k
         out["breakers"] = {b: br.snapshot()
                            for b, br in _BREAKERS.items()}
+        lat = self.latency.snapshot()
+        if lat:
+            out["latency"] = lat
         return out
 
 
@@ -251,11 +261,30 @@ class DispatchSupervisor:
                   time by dispatch_async (keeps injection
                   deterministic in issue order); first attempt only,
                   retries re-fetch.
+
+        Every dispatch runs under a tracer span ("dispatch/<key>",
+        ISSUE 10) parented by the caller's context — retries,
+        timeouts, breaker transitions, failovers and RTT re-measures
+        are child events, so a DEGRADED artifact's counters have a
+        causal story behind them. With tracing off the span is the
+        shared no-op (one branch).
         """
         import jax
 
+        from pint_tpu import obs
+
         kw = kw or {}
         backend = jax.default_backend()
+        with obs.span(f"dispatch/{key}", kind="dispatch",
+                      backend=backend, steps=steps, depth=depth,
+                      pinned=pinned) as sp:
+            return self._dispatch_in_span(
+                sp, fn, args, kw, key, steps, fallback, guard,
+                pinned, depth, _plan_hits, backend)
+
+    def _dispatch_in_span(self, sp, fn, args, kw, key, steps,
+                          fallback, guard, pinned, depth, _plan_hits,
+                          backend):
         plan = faults.active_plan()
         if guard is None:
             # pinned solves stay inline even under a fault plan: the
@@ -278,9 +307,10 @@ class DispatchSupervisor:
         gate = "proceed" if br is None else br.allow()
         if gate == "reject":
             m.bump("breaker_rejections")
+            sp.event("breaker.reject", backend=backend)
             return self._failover(fallback, key, BackendUnavailable(
                 f"{backend} backend circuit breaker is open "
-                f"(dispatch {key!r} short-circuited to host)"))
+                f"(dispatch {key!r} short-circuited to host)"), sp)
         probing = gate == "probe"
 
         from pint_tpu import config
@@ -324,9 +354,10 @@ class DispatchSupervisor:
                 # attempt costs another full deadline against a
                 # backend that just proved unresponsive
                 m.bump("timeouts")
-                if br is not None:
-                    br.on_result(False)
-                return self._failover(fallback, key, e)
+                sp.event("dispatch.timeout",
+                         deadline_s=round(deadline_s, 3))
+                self._breaker_failure(br, sp, backend)
+                return self._failover(fallback, key, e, sp)
             except BaseException as e:
                 if not _is_transient(e):
                     # caller bug: no retry, no breaker verdict — but a
@@ -336,20 +367,23 @@ class DispatchSupervisor:
                         br.abort_trial()
                     raise
                 m.bump("transient_errors")
-                if br is not None:
-                    br.on_result(False)
+                sp.event("dispatch.transient_error", attempt=attempt,
+                         error=f"{type(e).__name__}: {e}")
+                self._breaker_failure(br, sp, backend)
                 if (br is None or not br.is_open) and \
                         attempt < retries:
                     m.bump("retries")
+                    sp.event("dispatch.retry", attempt=attempt + 1)
                     time.sleep(_backoff_s(attempt))
                     attempt += 1
                     continue
-                return self._failover(fallback, key, e)
+                return self._failover(fallback, key, e, sp)
             wall = time.perf_counter() - t0
             if br is not None:
                 br.on_result(True)
             if probing:
                 m.bump("breaker_recoveries")
+                sp.event("breaker.closed", backend=backend)
                 _log().warning(
                     "%s backend recovered; circuit breaker closed",
                     backend)
@@ -366,7 +400,29 @@ class DispatchSupervisor:
             if not first_call and not pinned:
                 self._note_wall(key, steps, wall * drift, backend,
                                 depth=depth)
+            self.metrics.latency.record(
+                ("host" if pinned else backend, key),
+                "dispatch_wall", wall)
             return out
+
+    @staticmethod
+    def _breaker_failure(br, sp, backend):
+        """Report a failure to the breaker and, when that TRIPS it
+        (CLOSED/HALF_OPEN -> OPEN), emit the breaker.open span event
+        and trigger a flight-recorder dump — the moment the pool
+        router starts demoting is exactly the moment a post-mortem
+        wants the black box written."""
+        if br is None:
+            return
+        was_open = br.is_open
+        br.on_result(False)
+        if br.is_open and not was_open:
+            from pint_tpu import obs
+
+            sp.event("breaker.open", backend=backend,
+                     trips=br.trips)
+            obs.flight_dump("breaker_open", backend=backend,
+                            breaker=br.snapshot())
 
     def dispatch_async(self, fn, *args, key: str = "dispatch",
                        steps: int = 1, kw: Optional[dict] = None,
@@ -390,6 +446,8 @@ class DispatchSupervisor:
         observations). Fault-plan rules are consumed HERE, on the
         caller thread, so deterministic injection follows issue
         order."""
+        from pint_tpu import obs
+
         plan = faults.active_plan()
         plan_hits = plan.faults_for(key, kinds=_DISPATCH_FAULT_KINDS) \
             if plan is not None else []
@@ -399,13 +457,22 @@ class DispatchSupervisor:
         self.metrics.bump("async_dispatches")
         self.metrics.note_inflight(depth)
         fut = DispatchFuture(key)
+        # span context captured at ISSUE time on the caller thread:
+        # the worker re-enters it so the dispatch span (and its
+        # retry/timeout/failover children) parent under the serve
+        # unit / fit that issued this pipeline slot — under
+        # pipelining, issue and collect are separate spans of the
+        # same causal story (ISSUE 10)
+        ctx = obs.current()
+        obs.event("dispatch.issue", key=key, depth=depth)
 
         def work():
             try:
-                fut._set_result(self.dispatch(
-                    fn, *args, key=key, steps=steps, kw=kw,
-                    fallback=fallback, guard=guard, pinned=pinned,
-                    depth=depth, _plan_hits=plan_hits))
+                with obs.attach(ctx):
+                    fut._set_result(self.dispatch(
+                        fn, *args, key=key, steps=steps, kw=kw,
+                        fallback=fallback, guard=guard, pinned=pinned,
+                        depth=depth, _plan_hits=plan_hits))
             except BaseException as e:
                 fut._set_exception(e)
             finally:
@@ -445,11 +512,20 @@ class DispatchSupervisor:
             "host": {"backend": "cpu", "open": False},
         }
 
-    def note_failover(self, key: str, exc: BaseException):
-        """Record a failover performed by the CALL SITE (the device
-        fitter swaps in the whole host fitter rather than a single
-        fallback solve)."""
+    def note_failover(self, key: str, exc: BaseException, sp=None):
+        """Record a failover — performed by the CALL SITE (the
+        device fitter swaps in the whole host fitter rather than a
+        single fallback solve) or by ``_failover`` below, which
+        passes its dispatch span so the event lands under it; call
+        sites emit at the ambient context."""
+        from pint_tpu import obs
+
         self.metrics.bump("failovers")
+        err = f"{type(exc).__name__}: {exc}"
+        if sp is not None:
+            sp.event("dispatch.failover", key=key, error=err)
+        else:
+            obs.event("dispatch.failover", key=key, error=err)
         _log().warning("dispatch %s degraded to the host path: %s",
                        key, exc)
 
@@ -458,10 +534,10 @@ class DispatchSupervisor:
 
     # -- internals -----------------------------------------------------
 
-    def _failover(self, fallback, key, exc):
+    def _failover(self, fallback, key, exc, sp=None):
         if fallback is None:
             raise exc
-        self.note_failover(key, exc)
+        self.note_failover(key, exc, sp=sp)
         return fallback()
 
     def _guarded_call(self, fn, args, kw, deadline_s, pre_sleep,
@@ -537,13 +613,13 @@ class DispatchSupervisor:
     @staticmethod
     def _peek_rtt_ms(backend) -> Optional[float]:
         """The RTT the deadline/drift logic may use WITHOUT triggering
-        a measurement (env override or the per-backend cache); None
-        when nothing is known yet."""
+        a measurement (the VALIDATED env override or the per-backend
+        cache); None when nothing is known yet."""
         from pint_tpu import config
 
-        env = config._env_number("PINT_TPU_DISPATCH_RTT_MS", None)
+        env = config.dispatch_rtt_override_ms()
         if env is not None:
-            return float(env)
+            return env
         if backend == "cpu" or backend in config._RTT_MS:
             return config.dispatch_rtt_ms()
         return None
@@ -600,8 +676,7 @@ class DispatchSupervisor:
 
         if depth > 1:
             return
-        if config._env_number("PINT_TPU_DISPATCH_RTT_MS",
-                              None) is not None:
+        if config.dispatch_rtt_override_ms() is not None:
             # operator-pinned RTT: a re-measure would only re-read the
             # env — drifting away from a pin is not possible, so a
             # verdict is pure warning churn (e.g. a CPU-fallback run
@@ -625,6 +700,13 @@ class DispatchSupervisor:
             return
         self.metrics.last_rtt_ms = new_rtt
         self.metrics.last_k = config.auto_steps_per_dispatch()
+        from pint_tpu import obs
+
+        obs.event("rtt.remeasure", key=key,
+                  wall_ms=round(wall_ms, 2),
+                  predicted_ms=round(predicted_ms, 2),
+                  new_rtt_ms=round(new_rtt, 2),
+                  new_k=self.metrics.last_k)
         _log().warning(
             "dispatch %s wall %.1f ms vs predicted %.1f ms (>%.0fx "
             "drift): re-measured RTT %.1f ms, steps-per-dispatch "

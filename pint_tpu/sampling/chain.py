@@ -94,8 +94,11 @@ class DeviceEnsembleSampler(ChainStats):
             with jax.default_device(jax.devices("cpu")[0]):
                 return run()
 
-        return get_supervisor().dispatch(
-            run, key="sampling.lnpost0", fallback=run_pinned)
+        from pint_tpu import obs
+
+        with obs.span("sampling.lnpost0"):
+            return get_supervisor().dispatch(
+                run, key="sampling.lnpost0", fallback=run_pinned)
 
     def run_mcmc(self, p0: np.ndarray, nsteps: int, seed: int = 0,
                  mode: str = "scan",
@@ -152,8 +155,12 @@ class DeviceEnsembleSampler(ChainStats):
                 with jax.default_device(jax.devices("cpu")[0]):
                     return run()
 
-            out = sup.dispatch(run, key="sampling.chain",
-                               steps=budget, fallback=run_pinned)
+            from pint_tpu import obs
+
+            with obs.span("sampling.chunk", steps=int(budget)):
+                out = sup.dispatch(run, key="sampling.chain",
+                                   steps=budget,
+                                   fallback=run_pinned)
             self.dispatches += 1
             pos = np.asarray(out[0], np.float64)
             lp = np.asarray(out[1], np.float64)

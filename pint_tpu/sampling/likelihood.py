@@ -297,4 +297,8 @@ class SampledNoiseLikelihood:
         def run():
             return float(self._lnlike_jit(jnp.asarray(tl_eff), jnp.asarray(eta)))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
 
-        return get_supervisor().dispatch(run, key="sampling.lnlike")
+        from pint_tpu import obs
+
+        with obs.span("sampling.lnlike"):
+            return get_supervisor().dispatch(
+                run, key="sampling.lnlike")

@@ -210,20 +210,24 @@ def posterior_chunk_driver(fnv, stacked: dict, seeds, nsteps,
         return run, run_pinned, budgets
 
     def chunk_run(c, pos_h, lp_h):
-        run, run_pinned, budgets = _chunk_closures(c, pos_h, lp_h)
-        if pool == "host":
-            out = supervisor.dispatch(
-                run_pinned, key=f"{key_tag}/chunk{c}", steps=K,
-                pinned=True)
-            info["used_pool"] = "host"
-        else:
-            def host_counted():
-                fell_over.append(True)
-                return run_pinned()
+        from pint_tpu import obs
 
-            out = supervisor.dispatch(
-                run, key=f"{key_tag}/chunk{c}", steps=K,
-                fallback=host_counted)
+        run, run_pinned, budgets = _chunk_closures(c, pos_h, lp_h)
+        with obs.span("posterior.chunk", chunk=c, steps=K,
+                      pool=pool):
+            if pool == "host":
+                out = supervisor.dispatch(
+                    run_pinned, key=f"{key_tag}/chunk{c}", steps=K,
+                    pinned=True)
+                info["used_pool"] = "host"
+            else:
+                def host_counted():
+                    fell_over.append(True)
+                    return run_pinned()
+
+                out = supervisor.dispatch(
+                    run, key=f"{key_tag}/chunk{c}", steps=K,
+                    fallback=host_counted)
         return out, budgets
 
     def run_chunks():
@@ -277,15 +281,18 @@ def posterior_chunk_driver(fnv, stacked: dict, seeds, nsteps,
     # consumes the carried ensemble state) run at collect time
     first_fut = None
     if nchunks >= 1 and pool != "host":
+        from pint_tpu import obs
+
         run0, run0_pinned, _ = _chunk_closures(0, None, None)
 
         def host_counted0():
             fell_over.append(True)
             return run0_pinned()
 
-        first_fut = supervisor.dispatch_async(
-            run0, key=f"{key_tag}/chunk0", steps=K,
-            fallback=host_counted0)
+        with obs.span("posterior.chunk.issue", chunk=0, steps=K):
+            first_fut = supervisor.dispatch_async(
+                run0, key=f"{key_tag}/chunk0", steps=K,
+                fallback=host_counted0)
 
     def collect():
         nonlocal first_fut

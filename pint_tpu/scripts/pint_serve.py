@@ -12,6 +12,7 @@ the window into padded vmapped dispatches. Request forms:
      "seg_min": 60.0, ...}
     {"kind": "posterior", "par": P, "tim": T, "nwalkers": 32,
      "nsteps": 500, "seed": 0, "thin": 1, ...}
+    {"kind": "stats", "id": ...}
 
 (par, tim) pairs are loaded once and cached — repeated requests
 against the same pulsar are the serving-state hot path, paying only
@@ -38,6 +39,15 @@ Lifecycle (ISSUE 8):
   engine exports each compiled shape class and a restarted daemon
   restores+primes them, serving its first request without
   recompiling the serve kernels.
+
+Observability (ISSUE 10): a ``{"kind": "stats"}`` line answers
+IMMEDIATELY on the reader thread with the latency-histogram
+quantiles, flight-recorder status and dispatch counters — it is
+never journaled, never queued, and never perturbs in-flight
+batches. ``--trace-jsonl PATH`` (or ``$PINT_TPU_TRACE_STREAM``)
+streams every completed span as a JSONL line; ``$PINT_TPU_TRACE``
+arms the ring tracer; ``$PINT_TPU_FLIGHT_DIR`` arms the flight
+recorder, which also dumps on the SIGTERM bounded-drain path.
 
 One JSON result line per request (input order NOT guaranteed — lines
 carry the request id); the final line is the engine metrics snapshot
@@ -241,6 +251,29 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
 
     rid = rec.get("id")
     kind = rec.get("kind", "fit_step")
+    if kind == "stats":
+        # introspection read: answered inline from host bookkeeping
+        # (histogram snapshots + flight status + dispatch counters)
+        # — zero engine submissions, zero journal lines, in-flight
+        # batches untouched
+        snap = engine.metrics.snapshot()
+        out = {"ok": True, "kind": "stats",
+               "latency": snap.get("latency", {}),
+               "obs": snap.get("obs"),
+               "dispatch": snap.get("dispatch"),
+               "admission": snap.get("admission"),
+               "queue_depth": snap.get("queue_depth"),
+               "completed": snap.get("completed"),
+               "submitted": snap.get("submitted")}
+        if rid is not None:
+            out["id"] = rid
+        report(out)
+        if ack is not None:
+            # a stats record replayed out of a legacy journal must
+            # ack terminally (zero submissions -> "failed"), never
+            # replay forever
+            ack.expect(0)
+        return 0
     tenant = rec.get("tenant")
     deadline_s = rec["deadline_ms"] / 1e3 \
         if rec.get("deadline_ms") is not None else None
@@ -396,6 +429,10 @@ def main(argv=None, stdin=None) -> int:
     p.add_argument("--drain-timeout-s", type=float, default=None,
                    help="graceful-shutdown drain bound (default "
                         "$PINT_TPU_SERVE_DRAIN_TIMEOUT_S or 30)")
+    p.add_argument("--trace-jsonl", default=None, metavar="PATH",
+                   help="stream completed tracer spans as JSONL to "
+                        "PATH (default $PINT_TPU_TRACE_STREAM; "
+                        "implies tracing on)")
     args = p.parse_args(argv)
 
     # handlers BEFORE the pint_tpu/jax import: startup takes seconds
@@ -412,6 +449,11 @@ def main(argv=None, stdin=None) -> int:
         enable_user_compile_cache()
         drain_timeout = serve_drain_timeout_s() \
             if args.drain_timeout_s is None else args.drain_timeout_s
+
+        if args.trace_jsonl is not None:
+            from pint_tpu import obs
+
+            obs.configure(stream=args.trace_jsonl)
 
         from pint_tpu.serve import ServeEngine
 
@@ -483,6 +525,12 @@ def main(argv=None, stdin=None) -> int:
 
         def handle(rec):
             nonlocal nsub
+            if rec.get("kind") == "stats":
+                # introspection: answered inline, never journaled
+                # (a journaled stats line would replay forever — it
+                # can never receive a terminal ack)
+                _submit_line(engine, cache, rec, None, report)
+                return
             rid = rec.get("id") or uuid.uuid4().hex
             ack = _LineAck(engine.journal, rid)
             if engine.journal is not None:
@@ -571,6 +619,14 @@ def main(argv=None, stdin=None) -> int:
     # is shed with a labeled ShutdownShed (emitted above as
     # {"status": "shed", "reason": "shutdown"}); unbounded only when
     # no signal asked us to leave
+    if shutdown_reason:
+        # SIGTERM-drain flight dump (ISSUE 10): capture what the
+        # engine was doing when the signal landed — BEFORE the drain
+        # mutates the queue, so the dump shows the pre-shutdown state
+        from pint_tpu import obs
+
+        obs.flight_dump("sigterm_drain", signal=shutdown_reason,
+                        drain_timeout_s=drain_timeout)
     engine.stop(drain=True,
                 timeout=drain_timeout if shutdown_reason else None)
     for _ in range(nsub):

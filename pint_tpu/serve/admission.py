@@ -40,6 +40,7 @@ request is always visible in the artifact, never a silent drop.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Dict, Optional
@@ -47,6 +48,12 @@ from typing import Dict, Optional
 from pint_tpu.runtime import faults
 
 __all__ = ["TokenBucket", "AdmissionController"]
+
+# shed-burst flight trigger (ISSUE 10): >= _BURST_N sheds inside
+# _BURST_WINDOW_S dumps the tracer ring to $PINT_TPU_FLIGHT_DIR —
+# a sustained shed storm is an incident, a lone deadline miss is not
+_BURST_N = 16
+_BURST_WINDOW_S = 5.0
 
 
 class TokenBucket:
@@ -109,6 +116,43 @@ class AdmissionController:
         self.shed_shutdown = 0   # bounded drain timeout at shutdown
         self.injected_overload = 0  # fault-plan overload rules fired
         self.tenants: Dict[str, dict] = {}
+        # recent shed stamps for the burst detector (bounded deque —
+        # the detector needs only the last _BURST_N arrivals)
+        self._shed_times: collections.deque = collections.deque(
+            maxlen=_BURST_N)
+        self.shed_bursts = 0     # burst-trigger firings
+
+    def note_shed(self, kind: str):
+        """Record one shed for the burst detector; a burst (>=
+        ``_BURST_N`` sheds inside ``_BURST_WINDOW_S``) triggers a
+        flight-recorder dump (rate-limited by the recorder itself).
+        Called next to every shed counter bump — quota, deadline,
+        expiry, overload. Several of those call sites hold the
+        ENGINE lock (submit's shed paths, the expiry sweeps), and a
+        shed storm is exactly when stalling admission behind a disk
+        fsync would hurt most — so the dump itself runs on a
+        detached daemon thread (bounded: one per burst trigger,
+        which the recorder rate-limits to one per 10 s per reason)."""
+        now = time.monotonic()
+        with self._lock:
+            self._shed_times.append(now)
+            burst = (len(self._shed_times) == _BURST_N
+                     and now - self._shed_times[0] <= _BURST_WINDOW_S)
+            if burst:
+                self.shed_bursts += 1
+                self._shed_times.clear()
+        if burst:
+            from pint_tpu import obs
+
+            obs.event("serve.shed_burst", kind=kind, n=_BURST_N,
+                      window_s=_BURST_WINDOW_S)
+
+            def dump():
+                obs.flight_dump("shed_burst", last_kind=kind,
+                                admission=self.snapshot())
+
+            threading.Thread(target=dump, daemon=True,
+                             name="pint-shed-burst-dump").start()
 
     # -- per-tenant quotas ---------------------------------------------
 
@@ -145,7 +189,9 @@ class AdmissionController:
             else:
                 t["shed"] += 1
                 self.shed_quota += 1
-            return ok
+        if not ok:
+            self.note_shed("quota")
+        return ok
 
     # -- capacity / shedding -------------------------------------------
 
@@ -203,6 +249,7 @@ class AdmissionController:
                 "shed_quota": self.shed_quota,
                 "shed_overload": self.shed_overload,
                 "shed_shutdown": self.shed_shutdown,
+                "shed_bursts": self.shed_bursts,
                 "injected_overload": self.injected_overload,
                 "tenants": {k: dict(v)
                             for k, v in sorted(self.tenants.items())},

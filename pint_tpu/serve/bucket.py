@@ -259,6 +259,8 @@ class ExecutableCache:
         routed host solve is planned capacity, not a failover).
         ``info`` (when given) is filled with the pool that actually
         produced the result, for the router's rate learning."""
+        from pint_tpu import obs
+
         if info is None:
             info = {}
         info.setdefault("pool", pool)
@@ -266,13 +268,17 @@ class ExecutableCache:
         if pool == "host":
             if sync:
                 def collect():
-                    out = self.supervisor.dispatch(
-                        host, key=dispatch_key, pinned=True)
+                    with obs.span("serve.pool.host",
+                                  key=dispatch_key):
+                        out = self.supervisor.dispatch(
+                            host, key=dispatch_key, pinned=True)
                     info["used_pool"] = "host"
                     return out
             else:
-                fut = self.supervisor.dispatch_async(
-                    host, key=dispatch_key, pinned=True)
+                with obs.span("serve.pool.host.issue",
+                              key=dispatch_key):
+                    fut = self.supervisor.dispatch_async(
+                        host, key=dispatch_key, pinned=True)
 
                 def collect():
                     out = fut.result()
@@ -303,13 +309,18 @@ class ExecutableCache:
             # real device work in sync mode too (an eager dispatch
             # here would leave the profiler attributing ~0 ms)
             def collect():
-                out = self.supervisor.dispatch(
-                    run, key=dispatch_key, fallback=host_counted)
+                with obs.span("serve.pool.device",
+                              key=dispatch_key):
+                    out = self.supervisor.dispatch(
+                        run, key=dispatch_key,
+                        fallback=host_counted)
                 _record()
                 return out
         else:
-            fut = self.supervisor.dispatch_async(
-                run, key=dispatch_key, fallback=host_counted)
+            with obs.span("serve.pool.device.issue",
+                          key=dispatch_key):
+                fut = self.supervisor.dispatch_async(
+                    run, key=dispatch_key, fallback=host_counted)
 
             def collect():
                 out = fut.result()

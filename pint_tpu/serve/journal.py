@@ -420,9 +420,13 @@ class AotStore:
 
         try:
             if supervisor is not None:
-                restored = supervisor.dispatch(
-                    _primed, key="serve.aot_restore",
-                    fallback=lambda: {})
+                from pint_tpu import obs
+
+                with obs.span("serve.aot_restore",
+                              n=len(compatible)):
+                    restored = supervisor.dispatch(
+                        _primed, key="serve.aot_restore",
+                        fallback=lambda: {})
             else:
                 restored = _primed()
         except Exception as e:

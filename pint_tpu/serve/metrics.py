@@ -111,6 +111,16 @@ class ServeMetrics:
         self.admission = admission
         self.router = router
         self.restart_info: dict = {}
+        # log-bucketed latency histograms per (pool, kind, class) x
+        # (queue_wait | dispatch_wall | e2e) — fixed power-of-two
+        # buckets, O(1) memory, p50/p90/p99/max without per-sample
+        # storage (ISSUE 10; the scheduler records into it at every
+        # dispatch finish). The per-bucket reservoir above remains
+        # the exact-quantile view of RECENT traffic; this is the
+        # unbounded-horizon tail view the artifacts embed.
+        from pint_tpu.obs import HistogramSet
+
+        self.latency = HistogramSet()
         self.submitted = 0
         self.completed = 0
         self.rejected = 0           # backpressure (queue cap) drops
@@ -179,6 +189,12 @@ class ServeMetrics:
         # dispatch_overhead observability contract, ISSUE 7)
         out["pipeline_depth"] = self.pipeline_depth
         out["donation"] = bool(self.donation)
+        # ISSUE 10: latency histograms + tracer/flight state — the
+        # `latency` and `obs` blocks every serve artifact carries
+        out["latency"] = self.latency.snapshot()
+        from pint_tpu import obs
+
+        out["obs"] = obs.status()
         if self.admission is not None:
             out["admission"] = self.admission.snapshot()
         if self.router is not None:

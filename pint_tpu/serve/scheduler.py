@@ -64,6 +64,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from pint_tpu import obs
 from pint_tpu.fitter import Fitter
 from pint_tpu.profiling import annotate
 from pint_tpu.runtime import faults
@@ -222,15 +223,32 @@ class ServeEngine:
         (backpressure — nothing is partially accepted). A
         deadline-doomed newcomer is NOT raised: its future is failed
         with ``DeadlineExceeded`` and returned (a labeled shed
-        response, not a transport error)."""
+        response, not a transport error).
+
+        Tracing (ISSUE 10): every submit opens the request's ROOT
+        span ("serve.request", a fresh trace id) before any
+        admission decision, and the request resolves to exactly one
+        terminal event (served / shed:* / failed) — either here on a
+        raise-path shed, or from the future's done callback. Queue
+        wait, dispatch and ack spans attach under this root as the
+        request moves through the engine."""
         if self._dead:
             raise EngineKilled(
                 "engine was killed (kill_restart); restart and "
                 "replay the journal")
+        osp = obs.open_root("serve.request", label="req",
+                            kind=req.kind,
+                            tenant=req.tenant or "default",
+                            rid=req.rid)
+        req._osp = osp
+        if osp.ctx is not None:
+            self._wire_terminal_span(req, osp)
         now = time.monotonic()
         # 1. tenant quota — before classification, so a shed tenant
         # never costs GLS assembly work
         if not self.admission.check_quota(req.tenant, now=now):
+            osp.event("serve.terminal", status="shed:quota")
+            osp.end(status="shed:quota")
             raise TenantOverQuota(
                 f"tenant {req.tenant or 'default'!r} is over its "
                 f"{self.admission.tenant_qps}/s quota; shed")
@@ -257,6 +275,7 @@ class ServeEngine:
                 if verdict == "victim":
                     self._remove_queued_locked(victim)
                     self.admission.shed_deadline += 1
+                    self.admission.note_shed("deadline")
                     victim.future.set_exception(DeadlineExceeded(
                         f"{victim.kind} request shed at admission: "
                         f"predicted wait exceeds its remaining "
@@ -265,6 +284,7 @@ class ServeEngine:
                         f"can still make it)"))
                 elif verdict == "newcomer":
                     self.admission.shed_deadline += 1
+                    self.admission.note_shed("deadline")
                     self.metrics.submitted += 1
                     req.future.set_exception(DeadlineExceeded(
                         f"{req.kind} request shed at admission: "
@@ -274,12 +294,17 @@ class ServeEngine:
                 else:
                     self.metrics.rejected += 1
                     self.admission.shed_overload += 1
+                    self.admission.note_shed("overload")
+                    osp.event("serve.terminal",
+                              status="shed:overload")
+                    osp.end(status="shed:overload")
                     raise ServeOverload(
                         f"admission queue full ({self.queue_cap}); "
                         f"shed load or raise "
                         f"PINT_TPU_SERVE_QUEUE_CAP")
             # admitted: stamp, journal, place into its open bucket
             req.admitted_at = now
+            osp.event("serve.admit", queued=self._nqueued + 1)
             if req.deadline_s is not None:
                 req.expires_at = now + float(req.deadline_s)
                 if self._earliest_expiry is None or \
@@ -307,6 +332,39 @@ class ServeEngine:
         self._journal_admit(req)
         return req.future
 
+    @staticmethod
+    def _terminal_status(fut) -> str:
+        """Classify a RESOLVED future into its terminal trace label —
+        the same taxonomy the journal ack uses."""
+        try:
+            fut.result(timeout=0)
+            return "served"
+        except DeadlineExceeded:
+            return "shed:deadline"
+        except ShutdownShed:
+            return "shed:shutdown"
+        except TenantOverQuota:
+            return "shed:quota"
+        except ServeOverload:
+            return "shed:overload"
+        except EngineKilled:
+            return "killed"
+        except Exception:
+            return "failed"
+
+    def _wire_terminal_span(self, req, osp):
+        """Close the request's root span with its terminal status
+        when the future resolves — every admitted request ends in
+        exactly one of served / shed:* / failed / killed (the
+        zero-orphan contract the chaos oracle asserts)."""
+
+        def _terminal(fut, osp=osp):
+            status = self._terminal_status(fut)
+            osp.event("serve.terminal", status=status)
+            osp.end(status=status)
+
+        req.future.add_done_callback(_terminal)
+
     def _journal_admit(self, req):
         if self.journal is None or req.payload is None:
             return
@@ -322,19 +380,20 @@ class ServeEngine:
                                deadline_s=req.deadline_s)
         journal = self.journal
 
+        osp = getattr(req, "_osp", None)
+
         def _ack(fut, rid=req.rid):
-            try:
-                fut.result(timeout=0)
-                st = "served"
-            except DeadlineExceeded:
-                st = "shed:deadline"
-            except ShutdownShed:
-                st = "shed:shutdown"
-            except ServeOverload:
-                st = "shed:overload"
-            except Exception:
-                st = "failed"
+            # the ONE exception->status classifier (shared with the
+            # trace terminal event, so journal and trace vocabularies
+            # can never drift). "killed" is deliberately NOT acked:
+            # the kill_restart contract is that journal entries stay
+            # unacknowledged — a killed engine's work must replay
+            st = self._terminal_status(fut)
+            if st == "killed":
+                return
             journal.ack(rid, st)
+            if osp is not None:
+                osp.event("serve.journal_ack", status=st)
 
         req.future.add_done_callback(_ack)
 
@@ -459,6 +518,7 @@ class ServeEngine:
                     self._nqueued -= 1
                     self.metrics.deadline_missed += 1
                     self.admission.shed_expired += 1
+                    self.admission.note_shed("expired")
                     r.future.set_exception(DeadlineExceeded(
                         f"{r.kind} request missed its "
                         f"{r.deadline_s}s deadline in queue"))
@@ -488,6 +548,8 @@ class ServeEngine:
             return
         if b.fallback:
             self.metrics.fallback_single += len(b.reqs)
+        obs.event("serve.seal",
+                  cls=ServeMetrics._fmt_key(key), n=len(b.reqs))
         self._ready.append((key, b.reqs))
         self._cv.notify_all()
 
@@ -561,6 +623,7 @@ class ServeEngine:
                     if r.expired(now):
                         self.metrics.deadline_missed += 1
                         self.admission.shed_expired += 1
+                        self.admission.note_shed("expired")
                         r.future.set_exception(DeadlineExceeded(
                             f"{r.kind} request missed its "
                             f"{r.deadline_s}s deadline in queue"))
@@ -624,7 +687,16 @@ class ServeEngine:
         ``sync``). Returns the state tuple ``_dispatch_finish``
         consumes; an assembly/issue failure rides along as the
         collect slot and fails the group at finish time, so begin
-        never throws into the drain loop."""
+        never throws into the drain loop.
+
+        Tracing: the unit gets its own trace ("serve.unit" root
+        carrying the member rids), the router verdict is a
+        "serve.route" child event, and the issue half runs inside a
+        "serve.issue" child span — so the supervised dispatch
+        (issued here under pipelining) parents under it. Each member
+        request additionally gets a retroactive "serve.queue" span
+        (admission -> issue) under its OWN root, tagged with the
+        unit's trace id, linking the two stories."""
         Pb = self._batch_pad(len(grp))
         full_key = key + (Pb,)
         t0 = time.monotonic()
@@ -632,28 +704,47 @@ class ServeEngine:
         rows = self._unit_rows(key, grp, Pb)
         pool = self.router.pick(kind, rows)
         self.router.issued(pool, len(grp), rows, kind=kind)
+        cls = ServeMetrics._fmt_key(key)
+        usp = obs.open_root(
+            "serve.unit", label="unit", kind=kind, cls=cls,
+            pool=pool, n=len(grp),
+            rids=[r.rid for r in grp if r.rid is not None])
+        usp.event("serve.route", pool=pool, rows=rows)
+        if usp.ctx is not None:
+            tracer = obs.get_tracer()
+            t0_trace = tracer.monotonic_us(t0)
+            for r in grp:
+                rosp = getattr(r, "_osp", None)
+                if rosp is not None and rosp.ctx is not None and \
+                        r.admitted_at is not None:
+                    tracer.record_span(
+                        "serve.queue",
+                        tracer.monotonic_us(r.admitted_at),
+                        t0_trace, parent=rosp.ctx,
+                        unit=usp.trace_id)
         info: dict = {}
         try:
-            if key[0] == "phase":
-                _, nb, kb = key
-                collect = self.cache.phase_begin(
-                    full_key, grp, nb, kb, Pb, sync=sync, pool=pool,
-                    info=info)
-            elif key[0] == "posterior":
-                _, nb, pb, qb = key[:4]
-                collect = self.cache.posterior_begin(
-                    full_key, grp, shape=(Pb, nb, pb, qb),
-                    sync=sync, pool=pool, info=info,
-                    progress=self._posterior_progress(grp))
-            else:
-                _, nb, pb, qb = key
-                collect = self.cache.gls_begin(
-                    full_key, [r.problem for r in grp],
-                    shape=(Pb, nb, pb, qb), sync=sync, pool=pool,
-                    info=info)
+            with obs.span("serve.issue", parent=usp.ctx, pool=pool):
+                if key[0] == "phase":
+                    _, nb, kb = key
+                    collect = self.cache.phase_begin(
+                        full_key, grp, nb, kb, Pb, sync=sync,
+                        pool=pool, info=info)
+                elif key[0] == "posterior":
+                    _, nb, pb, qb = key[:4]
+                    collect = self.cache.posterior_begin(
+                        full_key, grp, shape=(Pb, nb, pb, qb),
+                        sync=sync, pool=pool, info=info,
+                        progress=self._posterior_progress(grp))
+                else:
+                    _, nb, pb, qb = key
+                    collect = self.cache.gls_begin(
+                        full_key, [r.problem for r in grp],
+                        shape=(Pb, nb, pb, qb), sync=sync, pool=pool,
+                        info=info)
         except Exception as e:
             collect = e
-        return key, full_key, grp, Pb, t0, collect, pool, info
+        return key, full_key, grp, Pb, t0, collect, pool, info, usp
 
     def _unit_rows(self, key, grp: List, Pb: int) -> int:
         """Kind-local work units one sealed unit dispatches (feeds
@@ -685,17 +776,21 @@ class ServeEngine:
         return progress
 
     def _dispatch_finish(self, key, full_key, grp, Pb, t0, collect,
-                         pool, info):
+                         pool, info, usp):
         """Collect one issued dispatch and scatter results to the
         group's futures (the wait rides the supervisor's depth-scaled
         watchdog, so this always terminates). Feeds the router's
-        rate learning with the pool that ACTUALLY served."""
+        rate learning with the pool that ACTUALLY served — and the
+        latency histograms (queue wait / dispatch wall / e2e per
+        (pool, kind, class), ISSUE 10) with every member request."""
         kind = key[0] if key[0] in ("phase", "posterior") else "gls"
         rows = self._unit_rows(key, grp, Pb)
         try:
             if isinstance(collect, Exception):
                 raise collect
-            with annotate("serve.dispatch"):
+            with annotate("serve.dispatch"), \
+                    obs.span("serve.collect", parent=usp.ctx,
+                             pool=pool):
                 out = collect()
             if key[0] == "phase":
                 pi, pf = out
@@ -737,12 +832,16 @@ class ServeEngine:
         except Exception as e:
             self.router.finished(pool, kind, rows, 0.0,
                                  used_pool="error")
+            usp.end(status="failed",
+                    error=f"{type(e).__name__}: {e}")
             for r in grp:
                 if not r.future.done():
                     self.metrics.failed += 1
                     r.future.set_exception(e)
             return
         done = time.monotonic()
+        usp.end(status="ok",
+                used_pool=info.get("used_pool", pool))
         # rate-learning wall: a pipelined collect's issue-to-collect
         # span includes time spent queued behind other in-flight
         # dispatches (up to pipeline_depth x the true service time —
@@ -762,6 +861,18 @@ class ServeEngine:
         rows_real = sum(self._rows_of(r) for r in grp)
         self.metrics.bucket(full_key).record(
             len(grp), Pb, rows_real, Pb * nb, lats)
+        # log-bucketed latency histograms, keyed (pool, kind, class):
+        # one dispatch-wall sample per unit, one queue-wait + e2e
+        # sample per member request (ISSUE 10 — the `latency` block
+        # of every serve snapshot/artifact)
+        hkey = (info.get("used_pool", pool), kind,
+                ServeMetrics._fmt_key(key))
+        self.metrics.latency.record(hkey, "dispatch_wall", done - t0)
+        for r in grp:
+            adm = r.admitted_at or t0
+            self.metrics.latency.record(hkey, "queue_wait",
+                                        max(0.0, t0 - adm))
+            self.metrics.latency.record(hkey, "e2e", done - adm)
         self.metrics.completed += len(grp)
 
     @staticmethod
@@ -837,6 +948,13 @@ class ServeEngine:
             self._ready.clear()
             self._nqueued = 0
             self.metrics.queue_depth(0)
+        if reqs:
+            # shutdown-drain flight dump (ISSUE 10): the bounded
+            # drain expired with work still queued — the post-mortem
+            # pairing of the journal's unserved set with what the
+            # engine was doing when the clock ran out
+            obs.flight_dump("shutdown_shed", shed=len(reqs),
+                            admission=self.admission.snapshot())
         for r in reqs:
             self.admission.shed_shutdown += 1
             if not r.future.done():
@@ -900,6 +1018,13 @@ class ServeEngine:
                 self._drain_ready(stop_at=self._drain_stop_at)
             except EngineKilled:
                 return
+            except BaseException as e:
+                # unhandled engine exception: dump the black box
+                # before the drain thread dies — the one trigger
+                # where the trace is ALL the evidence there will be
+                obs.flight_dump("engine_exception",
+                                error=f"{type(e).__name__}: {e}")
+                raise
 
 
 class ServeGLSFitter(Fitter):

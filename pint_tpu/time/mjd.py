@@ -108,6 +108,50 @@ def mjd_to_str(day: float, frac, ndigits: int = 16) -> str:
     return f"{day}.{''.join(digits)}"
 
 
+# MJD of the civil epoch 1970-01-01 (Unix day 0)
+_MJD_UNIX_EPOCH = 40587
+
+
+def mjd_to_calendar(days):
+    """EXACT MJD -> civil (UTC) proleptic-Gregorian calendar:
+    returns (year, month, day_of_month, day_of_year) int64 arrays
+    for integer MJDs (ISSUE 10 satellite — the pintk day-of-year
+    axis used a Julian-year 365.25 d approximation that drifted
+    ~0.75 d within a year and fabricated day-366 artifacts at
+    non-leap year boundaries).
+
+    Fully VECTORIZED integer arithmetic (the civil_from_days
+    algorithm: 400-year eras of exactly 146097 days, year-of-era
+    recovered by correcting for the 4/100/400 leap rules, months
+    counted from March so the leap day lands last) — O(N) numpy
+    ops, no per-element datetime calls, exact for all
+    representable MJDs. Oracle: datetime itself, in
+    tests/test_obs.py::test_mjd_to_calendar_exact."""
+    days = np.atleast_1d(np.asarray(days))
+    z = np.floor(days).astype(np.int64) - _MJD_UNIX_EPOCH + 719468
+    era = np.floor_divide(z, 146097)
+    doe = z - era * 146097                              # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524
+           - doe // 146096) // 365                      # [0, 399]
+    y = yoe + era * 400                                 # March-based
+    doy_mar = doe - (365 * yoe + yoe // 4 - yoe // 100)  # [0, 365]
+    mp = (5 * doy_mar + 2) // 153                       # [0, 11]
+    dom = doy_mar - (153 * mp + 2) // 5 + 1             # [1, 31]
+    month = mp + np.where(mp < 10, 3, -9)               # [1, 12]
+    year = y + (month <= 2)
+    # day-of-year: the same algebra inverted for Jan 1 of `year`
+    # (days_from_civil(year, 1, 1)), so the leap rules can never
+    # disagree with the conversion above
+    yj = year - 1                                       # Jan -> m<=2
+    era_j = np.floor_divide(yj, 400)
+    yoe_j = yj - era_j * 400
+    doy_jan1 = (153 * 10 + 2) // 5                      # Jan 1, March-based
+    doe_j = yoe_j * 365 + yoe_j // 4 - yoe_j // 100 + doy_jan1
+    jan1_z = era_j * 146097 + doe_j - 719468
+    doy = z - 719468 - jan1_z + 1
+    return year, month, dom, doy
+
+
 def mjd_dd_to_seconds(day, frac, epoch_day: float):
     """(day + frac − epoch_day) in SI seconds as a dd pair (86400 s/day,
     pulsar-MJD convention — caller handles scale offsets separately)."""

@@ -125,23 +125,27 @@ class WidebandTOAFitter(Fitter):
             with self._solve_scope():
                 return _gls_kernel(*place(), f32mm=f32mm)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
 
-        if threshold is not None:
-            x, cov, chi2, noise, _ = sup.dispatch(
-                run_svd, kw={"th": float(threshold)},
-                key="wideband.svd", pinned=pinned)
-        else:
-            from pint_tpu.parallel.fit_step import _use_f32_matmul
+        from pint_tpu import obs
 
-            f32mm = False if pinned else _use_f32_matmul(None)
-            x, cov, chi2, noise, _, ok = sup.dispatch(
-                run_chol, kw={"f32mm": f32mm},
-                key="wideband.solve", pinned=pinned)
-            if not bool(ok):
-                from pint_tpu.fitter import warn_degenerate
-
-                warn_degenerate("wideband normal matrix")
+        with obs.span("wideband.solve_once",
+                      fitter=type(self).__name__):
+            if threshold is not None:
                 x, cov, chi2, noise, _ = sup.dispatch(
-                    run_svd, key="wideband.svd", pinned=pinned)
+                    run_svd, kw={"th": float(threshold)},
+                    key="wideband.svd", pinned=pinned)
+            else:
+                from pint_tpu.parallel.fit_step import _use_f32_matmul
+
+                f32mm = False if pinned else _use_f32_matmul(None)
+                x, cov, chi2, noise, _, ok = sup.dispatch(
+                    run_chol, kw={"f32mm": f32mm},
+                    key="wideband.solve", pinned=pinned)
+                if not bool(ok):
+                    from pint_tpu.fitter import warn_degenerate
+
+                    warn_degenerate("wideband normal matrix")
+                    x, cov, chi2, noise, _ = sup.dispatch(
+                        run_svd, key="wideband.svd", pinned=pinned)
         return x, cov, chi2, noise
 
     def fit_toas(self, maxiter=1, threshold=None):

@@ -547,6 +547,104 @@ def test_repo_clean():
     assert report.files_scanned > 50
 
 
+# ----------------------------------------------------------- G12
+
+
+def _lint_g12(src, relpath="pint_tpu/serve/_fixture.py"):
+    """Run only the span-context rule on one snippet."""
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    return gl.check_g12(m)
+
+
+def test_g12_flags_naked_supervised_dispatch():
+    v = _lint_g12("""
+        from pint_tpu.runtime import get_supervisor
+        def naked(fn):
+            return get_supervisor().dispatch(fn, key="x")
+    """)
+    assert [x.rule for x in v] == ["G12"]
+    assert "span context" in v[0].msg
+
+
+def test_g12_clean_under_with_span_and_attach():
+    v = _lint_g12("""
+        from pint_tpu import obs
+        def wrapped(sup, fn):
+            with obs.span("fit"):
+                return sup.dispatch(fn, key="x")
+        def worker(sup, fn, ctx):
+            with obs.attach(ctx):
+                return sup.dispatch_async(fn, key="y")
+    """)
+    assert not v
+
+
+def test_g12_span_context_propagates_to_callees_and_closures():
+    """The fit_toas -> _fit_device pattern (the span opened one
+    frame up, same module) and the _issue-closure pattern (the
+    dispatch deferred into a collect closure built inside a
+    span-bearing function) are both compliant — the same
+    approximation class as G10's frozen-guard check."""
+    v = _lint_g12("""
+        from pint_tpu import obs
+        class Fitter:
+            def fit_toas(self):
+                with obs.span("fit.device"):
+                    return self._fit_device()
+            def _fit_device(self):
+                sup = self.supervisor
+                return sup.dispatch(lambda: 1, key="k")
+        def build(self):
+            with obs.span("issue"):
+                fut = self.supervisor.dispatch_async(lambda: 1)
+            def collect():
+                return self.supervisor.dispatch(lambda: 2)
+            return collect
+    """)
+    assert not v
+
+
+def test_g12_flags_async_issue_without_context():
+    v = _lint_g12("""
+        def issue(self, fn):
+            return self.supervisor.dispatch_async(fn, key="x")
+    """)
+    assert [x.rule for x in v] == ["G12"]
+
+
+def test_g12_ignores_non_supervisor_dispatch_and_other_layers():
+    """An unrelated .dispatch() method (an event bus, say) never
+    flags, and the rule only applies to the dispatch layer — the
+    runtime package itself is exempt by construction."""
+    v = _lint_g12("""
+        def route(bus, msg):
+            return bus.dispatch(msg)
+    """)
+    assert not v
+    v = _lint_g12("""
+        def naked(self, fn):
+            return self.supervisor.dispatch(fn)
+    """, relpath="pint_tpu/runtime/_fixture.py")
+    assert not v
+    v = _lint_g12("""
+        def naked(self, fn):
+            return self.supervisor.dispatch(fn)
+    """, relpath="pint_tpu/pintk/_fixture.py")
+    assert not v
+
+
+def test_g12_pragma_suppression_works():
+    m = gl.ModuleInfo("pint_tpu/serve/_fixture.py", textwrap.dedent("""
+        def naked(sup, fn):
+            return sup.dispatch(fn, key="x")  # graftlint: allow G12 -- fixture: context established by the only caller
+    """))
+    report = gl.LintReport(violations=gl.check_g12(m))
+    gl.apply_suppressions(report, [],
+                         {"pint_tpu/serve/_fixture.py": m.src})
+    assert report.clean
+    assert len(report.suppressed) == 1
+
+
 def test_every_rule_is_documented():
     """The rule table in ARCHITECTURE.md must cover every implemented
     rule id (doc drift check)."""

@@ -1,0 +1,536 @@
+"""Observability acceptance suite (ISSUE 10).
+
+The structured-telemetry contracts CLAUDE.md promises:
+
+- span parent/child integrity across a PIPELINED serve drain (depth
+  >= 2): every submitted request resolves to a terminal span with
+  zero orphan spans, and the export loads in Perfetto's trace-event
+  parser (validated structurally);
+- an injected hang -> failover shows the timeout / breaker /
+  failover events in causal order under the dispatch span;
+- histogram quantiles against a known sample set (upper-edge,
+  one-octave resolution bound);
+- the flight recorder dumps on a ``runtime.faults`` breaker-open
+  plan (and is armed by the flight dir alone, tracing off);
+- the tracer-off hot path emits ZERO records.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu import obs
+from pint_tpu.runtime import (
+    DispatchSupervisor,
+    Fault,
+    FaultPlan,
+    reset_runtime,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    """A configured tracer (or tripped breaker) must never leak
+    across tests."""
+    obs.reset()
+    reset_runtime()
+    yield
+    obs.reset()
+    reset_runtime()
+
+
+def _assert_chrome_trace(path):
+    """Structural validation against Perfetto's trace-event parser
+    requirements: a JSON object with a ``traceEvents`` list whose
+    members carry name/ph/ts/pid/tid (and dur for complete events) —
+    plus this repo's causal contract: every parent reference
+    resolves inside the file (zero orphan spans)."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert isinstance(doc, dict)
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    ids = set()
+    for e in evs:
+        assert isinstance(e["name"], str)
+        assert e["ph"] in ("X", "i")
+        assert isinstance(e["ts"], (int, float))
+        assert isinstance(e["pid"], int)
+        assert isinstance(e["tid"], int)
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float))
+        ids.add(e["args"]["span"])
+    orphans = [e for e in evs
+               if e["args"].get("parent") is not None
+               and e["args"]["parent"] not in ids]
+    assert orphans == [], f"orphan spans: {orphans[:3]}"
+    return evs
+
+
+# ------------------------------------------------------------- tracer
+
+
+def test_span_nesting_context_and_export(tmp_path):
+    t = obs.configure(enabled=True)
+    with obs.span("root", kind="test") as root:
+        root.event("marker", x=1)
+        with obs.span("child") as child:
+            assert child.trace_id == root.trace_id
+            assert obs.current() == child.ctx
+    assert obs.current() is None
+    path = str(tmp_path / "trace.json")
+    n = t.export(path)
+    evs = _assert_chrome_trace(path)
+    assert n == len(evs) == 3
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["child"]["args"]["parent"] == \
+        by_name["root"]["args"]["span"]
+    assert by_name["marker"]["args"]["parent"] == \
+        by_name["root"]["args"]["span"]
+
+
+def test_attach_propagates_context_across_threads():
+    import threading
+
+    obs.configure(enabled=True)
+    out = {}
+    with obs.span("issuer") as sp:
+        ctx = obs.current()
+
+        def worker():
+            with obs.attach(ctx):
+                with obs.span("worker_side") as w:
+                    out["trace"] = w.trace_id
+                    out["parent"] = w.parent_id
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+    assert out["trace"] == sp.trace_id
+    assert out["parent"] == sp.span_id
+
+
+def test_tracer_off_hot_path_emits_zero_records():
+    obs.reset()  # env-driven: $PINT_TPU_TRACE unset in the suite
+    assert not obs.recording()
+    sp = obs.span("anything", key="x")
+    assert sp is obs.NOOP_SPAN
+    with sp as s:
+        s.event("nope")
+    obs.event("also_nope")
+    obs.record_span("still_nope", 0.0, 1.0)
+    assert len(obs.get_tracer()) == 0
+    # a full supervised dispatch with tracing off: still zero
+    sup = DispatchSupervisor()
+    assert sup.dispatch(lambda: 41, key="off.path") == 41
+    assert len(obs.get_tracer()) == 0
+
+
+def test_ring_bounds_and_drop_accounting():
+    t = obs.configure(enabled=True, ring_size=16)
+    for i in range(50):
+        obs.event(f"e{i}")
+    assert len(t) == 16
+    assert t.dropped == 34
+    names = [r["name"] for r in t.records()]
+    assert names == [f"e{i}" for i in range(34, 50)]  # newest kept
+
+
+def test_jsonl_stream_mode(tmp_path):
+    stream = str(tmp_path / "spans.jsonl")
+    obs.configure(enabled=True, stream=stream)
+    with obs.span("streamed", tag="s"):
+        pass
+    obs.event("inst")
+    lines = [json.loads(x) for x in
+             open(stream, encoding="utf-8").read().splitlines()]
+    assert [r["name"] for r in lines] == ["streamed", "inst"]
+    assert lines[0]["ph"] == "X" and lines[1]["ph"] == "i"
+
+
+# --------------------------------------------------------- histograms
+
+
+def test_histogram_quantiles_against_known_samples():
+    from pint_tpu.obs import LatencyHistogram
+
+    h = LatencyHistogram()
+    samples_ms = list(range(1, 101))     # 1..100 ms, uniform
+    for ms in samples_ms:
+        h.record(ms / 1e3)
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["max_ms"] == 100.0
+    assert abs(snap["mean_ms"] - np.mean(samples_ms)) < 1e-6
+    # upper-edge quantiles: within one octave above the true value,
+    # never below it (the conservative-bound contract)
+    for q in (50, 90, 99):
+        true = float(np.percentile(samples_ms, q))
+        got = h.quantile_ms(q)
+        assert true <= got <= 2.0 * true, (q, true, got)
+    # empty histogram: no NaNs, JSON-safe
+    empty = LatencyHistogram()
+    assert empty.quantile_ms(99) is None
+    assert empty.snapshot() == {"count": 0}
+    json.dumps(empty.snapshot())
+
+
+def test_histogram_set_keys_and_snapshot():
+    from pint_tpu.obs import HistogramSet
+
+    hs = HistogramSet()
+    hs.record(("device", "gls", "64"), "e2e", 0.004)
+    hs.record(("device", "gls", "64"), "queue_wait", 0.001)
+    hs.record(("host", "phase", "128"), "e2e", 0.020)
+    snap = hs.snapshot()
+    assert set(snap) == {"device/gls/64", "host/phase/128"}
+    assert set(snap["device/gls/64"]) == {"e2e", "queue_wait"}
+    json.dumps(snap)
+
+
+# ------------------------------------------------ supervisor tracing
+
+
+def test_hang_failover_spans_in_causal_order(monkeypatch):
+    """Injected hang: the dispatch span carries dispatch.timeout ->
+    breaker/failover children in causal (timestamp) order, parented
+    under the SAME dispatch span, which is itself a child of the
+    caller's context span."""
+    monkeypatch.setenv("PINT_TPU_DISPATCH_DEADLINE_MS", "150")
+    monkeypatch.setenv("PINT_TPU_BREAKER_THRESHOLD", "1")
+    t = obs.configure(enabled=True)
+    sup = DispatchSupervisor()
+    plan = FaultPlan([Fault(match="obs.hang", kind="hang",
+                            seconds=5.0)])
+    with plan.active():
+        with obs.span("caller.fit") as caller:
+            out = sup.dispatch(lambda: 1, key="obs.hang",
+                               fallback=lambda: "host")
+    assert out == "host"
+    recs = t.records()
+    disp = [r for r in recs if r["name"] == "dispatch/obs.hang"]
+    assert len(disp) == 1
+    dspan = disp[0]["args"]["span"]
+    # the dispatch span parents under the caller's span
+    caller_rec = next(r for r in recs if r["name"] == "caller.fit")
+    assert disp[0]["args"]["parent"] == caller_rec["args"]["span"]
+    assert disp[0]["args"]["trace"] == caller_rec["args"]["trace"]
+    events = {r["name"]: r for r in recs if r["ph"] == "i"}
+    for name in ("dispatch.timeout", "breaker.open",
+                 "dispatch.failover"):
+        assert name in events, (name, sorted(events))
+        assert events[name]["args"]["parent"] == dspan
+    assert events["dispatch.timeout"]["ts"] <= \
+        events["breaker.open"]["ts"] <= \
+        events["dispatch.failover"]["ts"]
+    # the NEXT dispatch short-circuits on the open breaker — a
+    # labeled breaker.reject under its own dispatch span
+    with plan.active():
+        assert sup.dispatch(lambda: 1, key="obs.hang",
+                            fallback=lambda: "host2") == "host2"
+    rej = [r for r in t.records() if r["name"] == "breaker.reject"]
+    assert rej
+
+
+def test_supervisor_latency_histograms_in_snapshot():
+    sup = DispatchSupervisor()
+    sup.dispatch(lambda: time.sleep(0.002) or 7, key="obs.lat")
+    sup.dispatch(lambda: 7, key="obs.lat")
+    snap = sup.snapshot()
+    lat = snap["latency"]
+    key = "cpu/obs.lat"
+    assert key in lat
+    assert lat[key]["dispatch_wall"]["count"] == 2
+    json.dumps(snap)
+
+
+# ------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_dumps_on_breaker_open_plan(tmp_path,
+                                                    monkeypatch):
+    """A runtime.faults plan that trips the breaker OPEN must leave
+    a flight dump in the armed dir — and arming the dir alone (no
+    $PINT_TPU_TRACE) must turn on ring recording so the dump has a
+    populated black box."""
+    monkeypatch.setenv("PINT_TPU_BREAKER_THRESHOLD", "1")
+    monkeypatch.setenv("PINT_TPU_DISPATCH_RETRIES", "0")
+    fdir = str(tmp_path / "flight")
+    obs.configure(enabled=False, flight_dir=fdir)
+    assert obs.recording()  # armed recorder implies ring recording
+    sup = DispatchSupervisor()
+    plan = FaultPlan([Fault(match="obs.brk", kind="error")])
+    with plan.active():
+        assert sup.dispatch(lambda: 1, key="obs.brk",
+                            fallback=lambda: "host") == "host"
+    f = obs.get_flight()
+    assert f is not None and f.dumps == 1
+    dumps = sorted((tmp_path / "flight").glob("flight-*.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"] == "breaker_open"
+    assert doc["extra"]["breaker"]["state"] == "open"
+    # the dump fires MID-dispatch (at the open transition), so the
+    # black box holds the dispatch span's child events — the
+    # enclosing "dispatch/obs.brk" span completes only afterwards
+    names = {e["name"] for e in doc["events"]}
+    assert "dispatch.transient_error" in names
+    assert "breaker.open" in names
+    status = obs.status()
+    assert status["flight"]["dumps"] == 1
+    assert status["flight"]["last_reason"] == "breaker_open"
+
+
+def test_flight_dump_rate_limited_per_reason(tmp_path):
+    obs.configure(enabled=True, flight_dir=str(tmp_path))
+    assert obs.flight_dump("storm") is not None
+    assert obs.flight_dump("storm") is None        # inside interval
+    assert obs.flight_dump("other") is not None    # distinct reason
+    assert obs.get_flight().suppressed == 1
+
+
+def test_shed_burst_triggers_flight_dump(tmp_path):
+    from pint_tpu.serve.admission import _BURST_N, AdmissionController
+
+    obs.configure(enabled=True, flight_dir=str(tmp_path))
+    adm = AdmissionController(policy="reject")
+    for _ in range(_BURST_N):
+        adm.note_shed("deadline")
+    assert adm.shed_bursts == 1
+    # the dump runs on a detached daemon thread (several note_shed
+    # call sites hold the engine lock — a disk fsync there would
+    # stall admission during the exact storm being recorded)
+    deadline = time.monotonic() + 5.0
+    dumps = []
+    while time.monotonic() < deadline:
+        dumps = list(tmp_path.glob("flight-*shed_burst*.json"))
+        if dumps:
+            break
+        time.sleep(0.01)
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["extra"]["admission"]["shed_bursts"] == 1
+
+
+# ------------------------------------------------ serve integration
+
+
+def _workload(n, base):
+    from pint_tpu.serve.workload import build_workload
+
+    return build_workload(n, sizes=(40, 90), base=base,
+                          prebuild=True, entry_name="OBS")
+
+
+def test_pipelined_drain_span_integrity(tmp_path):
+    """THE tracing acceptance oracle: a pipelined drain (depth 2)
+    produces a trace in which every submitted request resolves to a
+    terminal span, parent/child causality is intact (zero orphans),
+    per-request queue spans link to their unit's trace, and the
+    export parses as Chrome trace-event JSON."""
+    from pint_tpu.serve import ServeEngine
+
+    fresh = _workload(10, base=3300)
+    t = obs.configure(enabled=True)
+    eng = ServeEngine(pipeline_depth=2)
+    futs = [eng.submit(r) for r in fresh()]
+    eng.flush()
+    for f in futs:
+        f.result(timeout=0)
+    path = str(tmp_path / "serve.json")
+    t.export(path)
+    evs = _assert_chrome_trace(path)
+    by_name: dict = {}
+    for e in evs:
+        by_name.setdefault(e["name"], []).append(e)
+    roots = by_name.get("serve.request", [])
+    terms = by_name.get("serve.terminal", [])
+    assert len(roots) == len(futs)
+    assert len(terms) == len(futs)
+    assert all(e["args"]["status"] == "served" for e in terms)
+    # each terminal parents under its request root, same trace
+    root_by_span = {e["args"]["span"]: e for e in roots}
+    for e in terms:
+        parent = root_by_span[e["args"]["parent"]]
+        assert e["args"]["trace"] == parent["args"]["trace"]
+    # queue spans parent under request roots AND carry the unit
+    # trace id they dispatched in
+    unit_traces = {e["args"]["trace"]
+                   for e in by_name.get("serve.unit", [])}
+    queues = by_name.get("serve.queue", [])
+    assert len(queues) == len(futs)
+    for e in queues:
+        assert e["args"]["parent"] in root_by_span
+        assert e["args"]["unit"] in unit_traces
+    # units carry route decisions and issue/collect halves
+    assert by_name.get("serve.route")
+    assert by_name.get("serve.issue")
+    assert by_name.get("serve.collect")
+    # supervised dispatch spans joined the same tracer
+    assert any(n.startswith("dispatch/serve.") for n in by_name)
+    # pipelining really engaged
+    assert eng.metrics.snapshot()["dispatch"]["max_inflight"] >= 2
+
+
+def test_serve_latency_histograms_per_pool_kind_class():
+    from pint_tpu.serve import ServeEngine
+
+    fresh = _workload(8, base=3500)
+    eng = ServeEngine()
+    futs = [eng.submit(r) for r in fresh()]
+    eng.flush()
+    for f in futs:
+        f.result(timeout=0)
+    lat = eng.metrics.snapshot()["latency"]
+    assert lat, "latency block empty"
+    for key, metrics in lat.items():
+        pool, kind = key.split("/")[:2]
+        assert pool in ("device", "host", "host-failover")
+        assert kind in ("gls", "phase", "posterior")
+        assert set(metrics) == {"queue_wait", "dispatch_wall", "e2e"}
+        for m in metrics.values():
+            assert m["count"] >= 1
+    # total e2e samples == completed requests
+    tot = sum(m["e2e"]["count"] for m in lat.values())
+    assert tot == len(futs)
+
+
+def test_shed_requests_get_terminal_spans():
+    """Shed paths resolve to labeled terminal spans too: quota shed
+    at the raise path, deadline shed through the future."""
+    from pint_tpu.serve import ServeEngine
+    from pint_tpu.serve.request import (
+        DeadlineExceeded,
+        TenantOverQuota,
+    )
+
+    t = obs.configure(enabled=True)
+    fresh = _workload(3, base=3700)
+    eng = ServeEngine(tenant_qps=0.001, tenant_burst=1.0)
+    reqs = fresh()
+    for r in reqs:
+        r.tenant = "noisy"
+    futs = []
+    shed_quota = 0
+    for r in reqs:
+        try:
+            futs.append(eng.submit(r))
+        except TenantOverQuota:
+            shed_quota += 1
+    assert shed_quota >= 1
+    # an already-expired deadline: shed in queue at the next touch
+    dead = _workload(1, base=3800)()[0]
+    dead.deadline_s = 1e-9
+    fut = eng.submit(dead)
+    time.sleep(0.002)
+    eng.flush()
+    with pytest.raises(DeadlineExceeded):
+        fut.result(timeout=0)
+    for f in futs:
+        f.result(timeout=5)
+    recs = t.records()
+    statuses = [r["args"]["status"] for r in recs
+                if r["name"] == "serve.terminal"]
+    assert statuses.count("shed:quota") == shed_quota
+    assert "shed:deadline" in statuses
+    assert statuses.count("served") == len(futs)
+    # conservation: one terminal per submit attempt
+    assert len(statuses) == len(reqs) + 1
+
+
+# ------------------------------------------------------ the daemon
+
+
+def test_daemon_stats_request_answers_inline(capsys, tmp_path):
+    """Acceptance: {"kind": "stats"} answers with histogram
+    quantiles + flight status without perturbing in-flight batches —
+    and without journaling the introspection line."""
+    from pint_tpu.scripts.pint_serve import main
+
+    journal = str(tmp_path / "j.jsonl")
+    assert main(["--journal", journal],
+                stdin=[json.dumps({"kind": "stats", "id": "s1"})]) \
+        == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    stats = [x for x in lines if x.get("kind") == "stats"]
+    assert len(stats) == 1
+    s = stats[0]
+    assert s["ok"] and s["id"] == "s1"
+    assert "latency" in s
+    assert "obs" in s and "trace" in s["obs"]
+    assert "dispatch" in s
+    # never journaled: nothing to replay
+    content = open(journal, encoding="utf-8").read()
+    assert '"stats"' not in content
+
+
+# ----------------------------------------------------- config knobs
+
+
+def test_obs_env_knobs(monkeypatch):
+    from pint_tpu import config
+
+    assert config.trace_enabled() is False
+    monkeypatch.setenv("PINT_TPU_TRACE", "on")
+    assert config.trace_enabled() is True
+    monkeypatch.setenv("PINT_TPU_TRACE_RING", "512")
+    assert config.trace_ring_size() == 512
+    monkeypatch.setenv("PINT_TPU_TRACE_RING", "banana")
+    assert config.trace_ring_size() == 16384  # warned, defaulted
+    monkeypatch.setenv("PINT_TPU_FLIGHT_DIR", "/tmp/f")
+    assert config.flight_dir() == "/tmp/f"
+
+
+def test_dispatch_rtt_override_validated(monkeypatch):
+    """ISSUE 10 satellite: $PINT_TPU_DISPATCH_RTT_MS is validated
+    BEFORE the per-backend cache — finite positive floats only; a
+    typo or out-of-range value warns and is ignored (never silently
+    poisons deadline predictions)."""
+    from pint_tpu import config
+
+    monkeypatch.delenv("PINT_TPU_DISPATCH_RTT_MS", raising=False)
+    assert config.dispatch_rtt_override_ms() is None
+    monkeypatch.setenv("PINT_TPU_DISPATCH_RTT_MS", "42.5")
+    assert config.dispatch_rtt_override_ms() == 42.5
+    assert config.dispatch_rtt_ms() == 42.5  # cache never consulted
+    for bad in ("banana", "-5", "0", "nan", "inf"):
+        monkeypatch.setenv("PINT_TPU_DISPATCH_RTT_MS", bad)
+        assert config.dispatch_rtt_override_ms() is None, bad
+    # the supervisor's peek sees the same validated view
+    from pint_tpu.runtime.supervisor import DispatchSupervisor as DS
+
+    monkeypatch.setenv("PINT_TPU_DISPATCH_RTT_MS", "not-a-number")
+    assert DS._peek_rtt_ms("cpu") == config.dispatch_rtt_ms()
+
+
+# ------------------------------------------------------- satellites
+
+
+def test_mjd_to_calendar_exact():
+    """ISSUE 10 satellite: the exact MJD->calendar conversion the
+    pintk day-of-year axis now uses — leap years, century rules and
+    year boundaries against datetime itself."""
+    import datetime
+
+    from pint_tpu.time.mjd import mjd_to_calendar
+
+    rng = np.random.default_rng(7)
+    mjds = np.concatenate([
+        [51544, 51543, 51909, 51910, 58848, 60400, 40587, 59580],
+        rng.integers(-20000, 120000, 2000),   # ~1804 to ~2187
+    ])
+    yr, mo, dom, doy = mjd_to_calendar(mjds)
+    for k, m in enumerate(mjds):
+        d = datetime.date(1858, 11, 17) + datetime.timedelta(
+            days=int(m))
+        assert (yr[k], mo[k], dom[k]) == (d.year, d.month, d.day), m
+        assert doy[k] == d.timetuple().tm_yday, m
+    # the old 365.25-approximation failure mode: Dec 31 of a non-leap
+    # year must be day 365, never a fabricated 366
+    y, _, _, doy2 = mjd_to_calendar([51909.9])  # 2000-12-31 (leap)
+    assert y[0] == 2000 and doy2[0] == 366
+    y, _, _, doy3 = mjd_to_calendar([52274.0])  # 2001-12-31
+    assert y[0] == 2001 and doy3[0] == 365

@@ -40,11 +40,16 @@ from pint_tpu.runtime import (
 
 @pytest.fixture(autouse=True)
 def clean_runtime():
-    """A tripped breaker or leftover counters must never leak across
-    tests (breakers are process-global by design)."""
+    """A tripped breaker, leftover counters or a configured tracer
+    must never leak across tests (breakers are process-global by
+    design; the tracer is the process-global obs instance)."""
+    from pint_tpu import obs
+
     reset_runtime()
+    obs.reset()
     yield
     reset_runtime()
+    obs.reset()
 
 
 def _north_star_shaped(n=400, ndmx=4, seed=9):
@@ -398,13 +403,24 @@ def test_serve_drain_completes_every_future_under_backend_death(
 # ------------------------------------------------ chaos (ISSUE 8)
 
 
-def test_chaos_overload_tenant_burst_backend_death(monkeypatch):
+def test_chaos_overload_tenant_burst_backend_death(monkeypatch,
+                                                   tmp_path):
     """ISSUE-8 chaos oracle: injected backend death MID-BURST + a
     quota-exceeding tenant + injected admission overload, all at
     once. Required outcome: zero hung futures, every request
     accounted served / shed / failover in the metrics (nothing
     silently dropped), results for served requests still correct,
-    counters honest."""
+    counters honest.
+
+    ISSUE-10 extension: the chaos run happens under the tracer, and
+    the resulting trace must tell the SAME story — every submitted
+    request (raise-path sheds included) resolves to exactly one
+    terminal span with correct parent->child causality, zero orphan
+    spans, failover events present, and the export parses as Chrome
+    trace-event JSON."""
+    import json as _json
+
+    from pint_tpu import obs
     from pint_tpu.serve import ServeEngine, ServeOverload
     from pint_tpu.serve.request import TenantOverQuota
     from pint_tpu.serve.workload import build_workload
@@ -418,6 +434,7 @@ def test_chaos_overload_tenant_burst_backend_death(monkeypatch):
     ref_res = [f.result(timeout=0) for f in ref_futs]
 
     monkeypatch.setenv("PINT_TPU_DISPATCH_DEADLINE_MS", "250")
+    tracer = obs.configure(enabled=True)
     eng = ServeEngine()
     plan = FaultPlan([
         # the GLS backend dies after its first dispatch of the burst
@@ -479,6 +496,41 @@ def test_chaos_overload_tenant_burst_backend_death(monkeypatch):
     assert disp["timeouts"] >= 1
     assert "DEGRADED" in eng.metrics.report()
     assert "SHED" in eng.metrics.report()
+
+    # --- ISSUE 10: the trace is the same story, causally ---------
+    try:
+        path = str(tmp_path / "chaos_trace.json")
+        tracer.export(path)
+        doc = _json.load(open(path, encoding="utf-8"))
+        evs = doc["traceEvents"]
+        assert evs and all(
+            isinstance(e["name"], str) and e["ph"] in ("X", "i")
+            and isinstance(e["ts"], (int, float)) for e in evs)
+        ids = {e["args"]["span"] for e in evs}
+        orphans = [e for e in evs
+                   if e["args"].get("parent") is not None
+                   and e["args"]["parent"] not in ids]
+        assert orphans == []            # zero orphan spans
+        terms = [e for e in evs if e["name"] == "serve.terminal"]
+        # EVERY submitted request — served, quota-shed at the raise
+        # path, overload-rejected — resolved to exactly ONE terminal
+        assert len(terms) == len(reqs)
+        statuses = [e["args"]["status"] for e in terms]
+        assert statuses.count("served") == served
+        assert statuses.count("shed:quota") == shed_quota
+        assert statuses.count("shed:overload") == shed_overload
+        roots = {e["args"]["span"]: e for e in evs
+                 if e["name"] == "serve.request"}
+        for e in terms:
+            assert e["args"]["parent"] in roots
+            assert e["args"]["trace"] == \
+                roots[e["args"]["parent"]]["args"]["trace"]
+        # the injected backend death shows up as failover telemetry
+        names = {e["name"] for e in evs}
+        assert "dispatch.failover" in names
+        assert "dispatch.timeout" in names
+    finally:
+        obs.reset()
 
 
 # ------------------------------------------------- pipelined drain

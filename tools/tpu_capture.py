@@ -51,6 +51,7 @@ STAGES = {
     "serve": "serve_coalesced_vs_sequential_64req",
     "serve_degraded": "serve_degraded_overload",
     "posterior": "posterior_whole_chain_vs_per_step",
+    "trace": "trace_capture_north_star_plus_serve",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
 ATTR_VARIANTS = ("production", "no_hybrid_jac", "jac_f64",
@@ -336,6 +337,59 @@ def stage_posterior(backend):
     print(json.dumps(rec), flush=True)
 
 
+def stage_trace(backend):
+    """Chrome-trace capture of the north-star fit + one serve batch
+    ON CHIP (ISSUE 10): a live-tunnel window's causal record — every
+    supervised dispatch span with its real RTT, retries and breaker
+    events — written as trace_tpu_<utc>.json in the repo root
+    (viewable in Perfetto / chrome://tracing). The ledger record
+    carries the span counts and the measured tracing overhead."""
+    from pint_tpu import obs
+
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    path = os.path.join(REPO, f"trace_tpu_{stamp}.json")
+    obs.configure(enabled=True)
+    try:
+        model, toas = bench.build_problem()
+        t, chi2, jitted, args, _ = bench.measure_step(model, toas)
+        obs_block, _ = bench.measure_obs_overhead(
+            lambda: _block(jitted, args))
+        # measure_obs_overhead resets the global tracer on exit —
+        # re-arm it so the fit + serve legs below are recorded
+        obs.configure(enabled=True)
+        # one device fit + one coalesced serve batch inside the trace
+        from pint_tpu.gls import DeviceDownhillGLSFitter
+
+        DeviceDownhillGLSFitter(toas, model).fit_toas(maxiter=4)
+        try:
+            from pint_tpu.serve import ServeEngine
+            from pint_tpu.serve.workload import build_workload
+
+            eng = ServeEngine()
+            futs = [eng.submit(r) for r in build_workload(
+                8, sizes=(40, 90), base=5100, prebuild=True,
+                entry_name="TRACE")()]
+            eng.flush()
+            for f in futs:
+                f.result(timeout=0)
+        except Exception as e:
+            bench.log(f"  serve leg of the trace failed: {e!r}")
+        n = obs.export(path)
+    finally:
+        obs.reset()
+    rec = {"metric": STAGES["trace"], "backend": backend,
+           "unit": "events", "value": n, "path": os.path.basename(path),
+           "step_ms": round(t * 1e3, 2), "obs": obs_block}
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def _block(jitted, args):
+    import jax
+
+    return jax.block_until_ready(jitted(*args))
+
+
 def run_stage(name, backend):
     bench.log(f"=== stage {name} ===")
     t0 = time.perf_counter()
@@ -367,6 +421,8 @@ def run_stage(name, backend):
         stage_serve_degraded(backend)
     elif name == "posterior":
         stage_posterior(backend)
+    elif name == "trace":
+        stage_trace(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "
