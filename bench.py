@@ -114,6 +114,33 @@ def attach_dispatch_counters(rec):
     except Exception as e:  # the artifact must survive a broken import
         log(f"  dispatch counters unavailable: {e!r}")
     rec.setdefault("lint", _lint_state_cached())
+    attach_regress(rec)
+    return rec
+
+
+def attach_regress(rec):
+    """Embed the perf-regression verdict (tools/bench_regress.py,
+    ISSUE 11 satellite): the artifact's fields judged against the
+    committed BENCH_BASELINE.json tolerance bands, so a regressed
+    record is LABELED at the moment it is produced — the same
+    policy as the dispatch-supervisor counters. setdefault + a
+    skip-on-any-failure block: the verdict must never be able to
+    fail the bench that produces it, and a record with no baseline
+    entry (the per-config records) skips with a reason."""
+    try:
+        import importlib.util
+        import os
+
+        p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "bench_regress.py")
+        spec = importlib.util.spec_from_file_location(
+            "_pint_bench_regress", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rec.setdefault("regress", mod.regress_block(rec))
+    except Exception as e:
+        rec.setdefault("regress",
+                       {"verdict": "skip", "reason": repr(e)})
     return rec
 
 
@@ -647,6 +674,80 @@ def measure_obs_overhead(step_call, reps=5):
         obs.reset()
 
 
+def measure_metrics_overhead(step_call, reps=5):
+    """Metrics-plane overhead (ISSUE 11 acceptance: metrics-off
+    north-star step <1%, metrics-on <5%). The registry counter bumps
+    are always-on accounting (they replaced the old attr increments
+    one-for-one), so the OFF leg is the production default: registry
+    plumbing live, nothing armed. The ON leg arms everything the
+    plane can cost at once: the SLO watchdog sampling the registry
+    at a 20 ms interval AND a live /metrics scraper hammering the
+    exposition server — an adversarially hot pull load, far beyond
+    any real Prometheus cadence. Same methodology as
+    ``measure_obs_overhead``: the off/on delta on a x200
+    tiny-payload batch is the per-dispatch cost, reported against
+    the real step wall; the raw step walls ride as evidence."""
+    import threading
+
+    from pint_tpu.obs import metrics as om
+    from pint_tpu.obs.slo import SLOWatchdog, default_specs
+    from pint_tpu.runtime import DispatchSupervisor
+
+    sup = DispatchSupervisor()
+
+    def once():
+        sup.dispatch(step_call, key="bench.metrics_step")
+
+    def tiny_batch(n=_TINY_N):
+        for _ in range(n):
+            sup.dispatch(_noop_payload, key="bench.metrics_tiny")
+
+    once()
+    tiny_batch(2)  # warm both dispatch keys
+    t_tiny_off = t_off = float("inf")
+    for _ in range(max(2, reps)):
+        t_tiny_off = min(t_tiny_off, time_fn(tiny_batch, 1))
+        t_off = min(t_off, time_fn(once, 1))
+    srv = om.MetricsServer(port=0).start()
+    wd = SLOWatchdog(specs=default_specs(), interval_s=0.02).start()
+    stop = threading.Event()
+
+    def scrape_loop():
+        import urllib.request
+
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        while not stop.is_set():
+            try:
+                urllib.request.urlopen(url, timeout=5).read()
+            except Exception:
+                pass
+            stop.wait(0.02)
+
+    th = threading.Thread(target=scrape_loop, daemon=True,
+                          name="bench-metrics-scraper")
+    th.start()
+    try:
+        t_tiny_on = t_on = float("inf")
+        for _ in range(max(2, reps)):
+            t_tiny_on = min(t_tiny_on, time_fn(tiny_batch, 1))
+            t_on = min(t_on, time_fn(once, 1))
+    finally:
+        stop.set()
+        th.join(timeout=2.0)
+        wd.stop()
+        srv.close()
+    per_iter_us = max(0.0, t_tiny_on - t_tiny_off) / _TINY_N * 1e6
+    return {
+        # one supervised dispatch per north-star step, so the
+        # per-dispatch cost against the step wall IS the step frac
+        "metrics_per_dispatch_overhead_us": round(per_iter_us, 2),
+        "metrics_overhead_frac": round(per_iter_us * 1e-6 / t_off, 6)
+        if t_off and t_off != float("inf") else None,
+        "metrics_off_step_ms": round(t_off * 1e3, 3),
+        "metrics_on_step_ms": round(t_on * 1e3, 3),
+    }
+
+
 # tiny-payload iterations per timing sample in measure_obs_overhead
 # (the ONE constant both the batch default and the per-iteration
 # division use — tuning it in one place cannot skew the other)
@@ -1119,6 +1220,31 @@ def main():
             f"{obs_block['events_per_step']} events/step)")
     except Exception as e:
         log(f"tracing-overhead measurement failed: {e!r}")
+    # metrics-plane overhead (ISSUE 11): registry plumbing alone vs
+    # SLO watchdog + live /metrics scrape, extending the obs block
+    # with the off/on walls as acceptance evidence (<1% / <5%)
+    try:
+        mblock = measure_metrics_overhead(
+            lambda: jax.block_until_ready(jitted(*args)))
+        # feed the overhead gauge the SLO's gauge-type spec watches
+        if overhead_block is not None and \
+                overhead_block.get("overhead_frac") is not None:
+            from pint_tpu.obs import metrics as om
+
+            om.gauge("pint_tpu_dispatch_overhead_frac",
+                     "whole-fit dispatch overhead fraction "
+                     "(pure-step vs wall)").set(
+                overhead_block["overhead_frac"])
+        if obs_block is None:
+            obs_block = mblock
+        else:
+            obs_block.update(mblock)
+        log(f"metrics overhead [{backend}]: off "
+            f"{mblock['metrics_off_step_ms']} ms, on "
+            f"{mblock['metrics_on_step_ms']} ms "
+            f"(frac={mblock['metrics_overhead_frac']})")
+    except Exception as e:
+        log(f"metrics-overhead measurement failed: {e!r}")
 
     # transparency: the f32-Jacobian variant is auto-on only on TPU;
     # when we're on the CPU backend measure it too (it halves the CPU

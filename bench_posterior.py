@@ -95,7 +95,7 @@ def _run_mode(post, mode: str, nwalkers: int, nsteps: int,
     samp = DeviceEnsembleSampler(nwalkers, post.nparams,
                                  post.lnpost_batch)
     for r in range(repeats + 1):  # +1 warmup
-        samp.dispatches = 0
+        samp.reset_dispatch_count()
         t0 = time.perf_counter()
         samp.run_mcmc(p0, nsteps, seed=seed, mode=mode)
         w = time.perf_counter() - t0
@@ -274,6 +274,13 @@ def run(nwalkers: int = 32, nsteps: int = 512, repeats: int = 3,
     rec["obs"] = obs.status()
     if serve:
         rec["serve"] = measure_serve(nwalkers, max(64, nsteps // 4))
+    # perf-regression verdict against BENCH_BASELINE.json (ISSUE 11)
+    try:
+        import bench as _bench
+
+        _bench.attach_regress(rec)
+    except Exception:
+        pass
     return rec
 
 

@@ -196,6 +196,13 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
             co_best["coalesced_mesh"] * 1e3, 2)
         rec["mesh_sharded_speedup"] = round(
             seq_best / co_best["coalesced_mesh"], 2)
+    # perf-regression verdict against BENCH_BASELINE.json (ISSUE 11)
+    try:
+        import bench as _bench
+
+        _bench.attach_regress(rec)
+    except Exception:
+        pass
     log(co_eng.metrics.report())
     return rec
 

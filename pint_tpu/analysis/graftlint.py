@@ -82,6 +82,16 @@ Rules (see ARCHITECTURE.md "Static analysis" for the table):
       ``attach(...)``, or its enclosing function (or a lexical
       ancestor) must be reachable from a span-bearing function via
       same-module calls. Pragma/allowlist policy as G9.
+  G13 no ad-hoc counter mutation in the dispatch/serve layer (the
+      G6 dispatch file set): an attribute/dict INCREMENT on
+      counter-named state (``*_count``/``*_total``/``*counter*`` or
+      the serve/dispatch counter vocabulary — shed_*, submitted,
+      timeouts, failovers, ...) bypasses the ``obs.metrics``
+      registry (ISSUE 11), so the value would be invisible to
+      /metrics, the SLO watchdog and the registry-vs-snapshot
+      parity oracle. Mutate through a bound registry child
+      (``.inc()``) or the owning class's ``bump()`` instead.
+      Pragma/allowlist policy as G9.
 
 jit-reachability is inferred statically, seeded by project
 conventions: any function whose early positional parameters include
@@ -136,6 +146,8 @@ RULES = {
     "G12": "supervised-dispatch call sites must run under a tracer "
            "span context (obs.span/attach) so dispatch telemetry "
            "has a causal parent",
+    "G13": "no ad-hoc counter mutation in the dispatch/serve layer "
+           "outside the obs.metrics registry",
 }
 
 # entry points allowed to mutate global jax config (G7): the package
@@ -888,6 +900,112 @@ def check_g12(m: ModuleInfo) -> List[Violation]:
     return out
 
 
+# G13 — ad-hoc counter mutation outside obs.metrics ------------------
+
+# the counter vocabulary of the serve/dispatch stack: every name
+# that is (or was) a counter in the supervisor / serve metrics /
+# admission / router / bucket-stats / AOT-store snapshot blocks.
+# Kept explicit so a NEW counter name must be added here when its
+# class grows one — at which point the rule starts protecting it.
+G13_COUNTER_NAMES = frozenset({
+    # runtime supervisor
+    "dispatches", "guarded", "retries", "timeouts",
+    "transient_errors", "failovers", "breaker_rejections",
+    "breaker_recoveries", "abandoned_workers", "rtt_remeasures",
+    "async_dispatches",
+    # serve engine
+    "submitted", "completed", "rejected", "failed",
+    "deadline_missed", "fallback_single",
+    # admission
+    "shed_expired", "shed_deadline", "shed_quota", "shed_overload",
+    "shed_shutdown", "shed_bursts", "injected_overload",
+    "admitted", "shed", "acked",
+    # router pools
+    "demotions", "requests", "rows",
+    # bucket stats
+    "batches", "slots", "rows_real", "rows_padded",
+    # AOT store / journal / flight
+    "exported", "restored", "export_errors", "restore_errors",
+    "hits", "misses", "replayed", "compactions", "dumps",
+    "suppressed",
+})
+
+
+def _g13_counterish(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    n = name.lstrip("_")
+    return (n in G13_COUNTER_NAMES or n.endswith("_count")
+            or n.endswith("_total") or "counter" in n)
+
+
+def _g13_target_name(tgt: ast.AST) -> Optional[str]:
+    """The counter-ish name an increment target resolves to:
+    ``x.timeouts`` -> "timeouts"; ``d["shed"]`` -> "shed";
+    ``self.counters[k]`` -> "counters" (the container name)."""
+    if isinstance(tgt, ast.Attribute):
+        return tgt.attr
+    if isinstance(tgt, ast.Subscript):
+        sl = tgt.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            if _g13_counterish(sl.value):
+                return sl.value
+        return _tail_name(tgt.value)
+    return None
+
+
+def check_g13(m: ModuleInfo) -> List[Violation]:
+    """Ad-hoc counter mutation in the dispatch/serve layer (module
+    docstring G13): ``x.failovers += 1`` / ``d["shed"] += 1`` /
+    ``x.timeouts = x.timeouts + 1`` on counter-named state bypasses
+    the obs.metrics registry. Plain local names are never flagged
+    (loop tallies are not metrics), and only the G6 dispatch file
+    set is in scope — obs/ and runtime/ are the plane itself."""
+    if not _g6_dispatch_applies(m.relpath):
+        return []
+    out = []
+    for node in ast.walk(m.tree):
+        tgt = None
+        if isinstance(node, ast.AugAssign) and \
+                isinstance(node.op, ast.Add):
+            tgt = node.target
+        elif isinstance(node, ast.Assign) and \
+                len(node.targets) == 1 and \
+                isinstance(node.value, ast.BinOp) and \
+                isinstance(node.value.op, ast.Add):
+            # x.attr = x.attr + n / d[k] = d.get(k, 0) + n — flag
+            # only the SELF-REFERENTIAL form (a fresh assignment of
+            # a sum is not an increment)
+            cand = node.targets[0]
+            td = ast.unparse(cand)  # unparse: Load/Store ctx-blind
+            selfref = any(
+                (isinstance(sub, (ast.Attribute, ast.Subscript))
+                 and ast.unparse(sub) == td) or (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "get"
+                    and isinstance(cand, ast.Subscript)
+                    and ast.unparse(sub.func.value)
+                    == ast.unparse(cand.value))
+                for sub in ast.walk(node.value))
+            if selfref:
+                tgt = cand
+        if tgt is None or isinstance(tgt, ast.Name):
+            continue
+        name = _g13_target_name(tgt)
+        if not _g13_counterish(name):
+            continue
+        out.append(Violation(
+            "G13", m.relpath, node.lineno,
+            f"ad-hoc increment of counter state `{name}` in the "
+            f"dispatch/serve layer bypasses the obs.metrics "
+            f"registry (invisible to /metrics, the SLO watchdog "
+            f"and the parity oracle) — mutate through a bound "
+            f"registry child (.inc()) or the owning bump()",
+            m.line_text(node.lineno)))
+    return out
+
+
 def check_g6_python(m: ModuleInfo) -> List[Violation]:
     """Timeout bounds in tools//scripts Python. The bounded-probe
     requirement is module-wide and order-insensitive — a deliberate
@@ -1281,6 +1399,7 @@ def run_lint(root: str, dynamic: bool = True,
         report.violations += check_g6_dispatch(
             m, prod_per_module.get(m.relpath, set()) | prod_private)
         report.violations += check_g12(m)
+        report.violations += check_g13(m)
         report.violations += check_g7(m)
         report.violations += check_g8(m)
     for relpath, src in shell:

@@ -32,7 +32,8 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "journal_path", "serve_drain_timeout_s",
            "chain_chunk_steps", "journal_compact_bytes",
            "trace_enabled", "trace_stream_path", "trace_ring_size",
-           "flight_dir"]
+           "flight_dir", "f32_mode", "no_pallas", "slo_enabled",
+           "slo_interval_s", "slo_specs", "metrics_port"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -598,6 +599,59 @@ def journal_compact_bytes() -> int:
                                   16 * 1024 * 1024, cast=int)))
 
 
+# ------------------------------------------------ precision routing
+
+
+def f32_mode(env_name: str,
+             flag: Optional[bool] = None) -> Optional[bool]:
+    """The ONE tri-state parser for the f32/f64 route env vars
+    ($PINT_TPU_ANCHORED / $PINT_TPU_JAC / $PINT_TPU_GLS_MATMUL —
+    ISSUE 11 satellite, the dispatch_rtt_override_ms convention):
+    an explicit ``flag`` wins; else True for f32-ish values, False
+    for f64-ish ones, None (= auto: f32 on TPU) when unset — and an
+    unrecognized value WARNS once and is ignored (treated as unset)
+    instead of silently falling through to auto, which is what the
+    raw ``os.environ`` reads in parallel/fit_step.py used to do."""
+    if flag is not None:
+        return bool(flag)
+    raw = os.environ.get(env_name, "")
+    v = raw.lower()
+    if v in ("f32", "float32", "on", "true", "1"):
+        return True
+    if v in ("f64", "float64", "off", "false", "0"):
+        return False
+    if v and (env_name, raw) not in _WARNED_ENV:
+        _WARNED_ENV.add((env_name, raw))
+        from pint_tpu.logging import log
+
+        log.warning("unparsable $%s=%r (want f32/f64/on/off); "
+                    "using the backend default", env_name, raw)
+    return None
+
+
+def no_pallas(flag: Optional[bool] = None) -> bool:
+    """Validated $PINT_TPU_NO_PALLAS parser (ISSUE 11 satellite —
+    replaces the raw presence check in ops/pallas_kernels.py):
+    truthy values disable the Pallas photon kernels, falsy/unset
+    keep them; an unrecognized value warns once and is IGNORED
+    (kernels stay enabled), per the warn-and-ignore convention."""
+    if flag is not None:
+        return bool(flag)
+    raw = os.environ.get("PINT_TPU_NO_PALLAS", "")
+    v = raw.lower()
+    if v in ("1", "on", "true", "yes"):
+        return True
+    if v in ("", "0", "off", "false", "no"):
+        return False
+    if ("PINT_TPU_NO_PALLAS", raw) not in _WARNED_ENV:
+        _WARNED_ENV.add(("PINT_TPU_NO_PALLAS", raw))
+        from pint_tpu.logging import log
+
+        log.warning("unparsable $PINT_TPU_NO_PALLAS=%r (want on/"
+                    "off); keeping the Pallas kernels enabled", raw)
+    return False
+
+
 # ---------------------------------------------------- observability
 
 
@@ -644,6 +698,125 @@ def flight_dir():
     span RECORDING (ring only) even when $PINT_TPU_TRACE is off."""
     d = os.environ.get("PINT_TPU_FLIGHT_DIR")
     return d if d else None
+
+
+def slo_enabled() -> bool:
+    """SLO burn-rate watchdog armed? ($PINT_TPU_SLO, default OFF.)
+    Any value slo_specs() can resolve to a non-empty spec list arms
+    it: a truthy flag (the default spec set), inline JSON, or a JSON
+    file path. Off (unset/falsy) costs nothing — no sampling thread,
+    no ring."""
+    raw = os.environ.get("PINT_TPU_SLO", "")
+    if raw.lower() in ("", "0", "off", "false", "no"):
+        return False
+    return bool(slo_specs())
+
+
+def slo_interval_s() -> float:
+    """SLO self-sampling interval [s] ($PINT_TPU_SLO_INTERVAL_S,
+    default 10): how often the watchdog snapshots the registry into
+    its time-series ring. Validated finite positive — a zero or
+    negative interval would spin the sampler; warn-and-ignore per
+    the dispatch_rtt_override_ms convention."""
+    import math
+
+    v = float(_env_number("PINT_TPU_SLO_INTERVAL_S", 10.0))
+    if not math.isfinite(v) or v <= 0.0:
+        raw = os.environ.get("PINT_TPU_SLO_INTERVAL_S")
+        key = ("PINT_TPU_SLO_INTERVAL_S", f"range:{raw}")
+        if key not in _WARNED_ENV:
+            _WARNED_ENV.add(key)
+            from pint_tpu.logging import log
+
+            log.warning("$PINT_TPU_SLO_INTERVAL_S=%r is not a "
+                        "finite positive interval; using 10", raw)
+        return 10.0
+    return v
+
+
+def slo_specs() -> list:
+    """Validated SLO spec list from $PINT_TPU_SLO (ISSUE 11):
+
+    - a truthy flag ("1"/"on"/"true"/"yes") -> the default spec set
+      (obs.slo.default_specs: e2e p99 per kind, shed rate, dispatch
+      overhead_frac);
+    - a JSON array (inline, or the contents of the file the value
+      points at) -> custom specs, each entry validated by
+      SLOSpec.from_dict — an invalid ENTRY warns and is dropped
+      (warn-and-ignore, never a mis-armed watchdog), an unreadable
+      value warns and yields [] (watchdog stays off).
+    """
+    import json
+
+    from pint_tpu.obs.slo import SLOSpec, default_specs
+
+    raw = os.environ.get("PINT_TPU_SLO", "")
+    v = raw.strip()
+    if v.lower() in ("", "0", "off", "false", "no"):
+        return []
+    if v.lower() in ("1", "on", "true", "yes"):
+        return default_specs()
+    text = v
+    if not v.startswith(("[", "{")):
+        try:
+            with open(v, encoding="utf-8") as fh:
+                text = fh.read()
+        except OSError:
+            if ("PINT_TPU_SLO", raw) not in _WARNED_ENV:
+                _WARNED_ENV.add(("PINT_TPU_SLO", raw))
+                from pint_tpu.logging import log
+
+                log.warning("$PINT_TPU_SLO=%r is neither a flag, "
+                            "JSON, nor a readable file; SLO "
+                            "watchdog stays off", raw)
+            return []
+    try:
+        entries = json.loads(text)
+        if isinstance(entries, dict):
+            entries = [entries]
+    except ValueError:
+        if ("PINT_TPU_SLO", raw) not in _WARNED_ENV:
+            _WARNED_ENV.add(("PINT_TPU_SLO", raw))
+            from pint_tpu.logging import log
+
+            log.warning("unparsable $PINT_TPU_SLO JSON; SLO "
+                        "watchdog stays off")
+        return []
+    out = []
+    for e in entries:
+        try:
+            out.append(SLOSpec.from_dict(e))
+        except (ValueError, TypeError) as exc:
+            key = ("PINT_TPU_SLO", f"entry:{e!r}"[:200])
+            if key not in _WARNED_ENV:
+                _WARNED_ENV.add(key)
+                from pint_tpu.logging import log
+
+                log.warning("dropping invalid SLO spec entry: %s",
+                            exc)
+    return out
+
+
+def metrics_port() -> Optional[int]:
+    """Default /metrics exposition port for the daemon
+    ($PINT_TPU_METRICS_PORT; None = off, 0 = ephemeral). The
+    pint_serve --metrics-port flag overrides. Validated int in
+    [0, 65535]; warn-and-ignore otherwise."""
+    v = _env_number("PINT_TPU_METRICS_PORT", None, cast=int)
+    if v is None:
+        return None
+    v = int(v)
+    if not 0 <= v <= 65535:
+        raw = os.environ.get("PINT_TPU_METRICS_PORT")
+        key = ("PINT_TPU_METRICS_PORT", f"range:{raw}")
+        if key not in _WARNED_ENV:
+            _WARNED_ENV.add(key)
+            from pint_tpu.logging import log
+
+            log.warning("$PINT_TPU_METRICS_PORT=%r out of range; "
+                        "metrics server stays off", raw)
+        return None
+    return v
 
 
 def serve_pipeline_depth() -> int:

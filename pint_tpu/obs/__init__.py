@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from pint_tpu.obs import metrics  # noqa: F401  (ISSUE 11 registry)
 from pint_tpu.obs.flight import FlightRecorder  # noqa: F401
 from pint_tpu.obs.hist import HistogramSet, LatencyHistogram  # noqa: F401
 from pint_tpu.obs.tracer import (  # noqa: F401
@@ -43,7 +44,8 @@ from pint_tpu.obs.tracer import (  # noqa: F401
 )
 
 __all__ = ["Tracer", "SpanHandle", "LatencyHistogram",
-           "HistogramSet", "FlightRecorder", "get_tracer",
+           "HistogramSet", "FlightRecorder", "metrics",
+           "get_tracer",
            "get_flight", "configure", "reset", "span", "open_span",
            "open_root", "event", "record_span", "current", "attach",
            "flight_dump", "status", "export"]
@@ -128,7 +130,11 @@ def configure(enabled: Optional[bool] = None,
 
 def reset():
     """Drop the global instances; the next use re-reads the env
-    (tests: a configured tracer must never leak across tests)."""
+    (tests: a configured tracer must never leak across tests). Also
+    swaps in a fresh metric registry and stops the SLO watchdog
+    (ISSUE 11) — the same isolation contract: consumers built before
+    the reset keep their old bound children, fresh consumers
+    register fresh."""
     global _TRACER, _FLIGHT, _CONFIGURED
     with _LOCK:
         if _TRACER is not None:
@@ -136,6 +142,10 @@ def reset():
         _TRACER = None
         _FLIGHT = None
         _CONFIGURED = False
+    from pint_tpu.obs import slo
+
+    slo.reset()
+    metrics.reset()
 
 
 # ------------------------------------------------------------------

@@ -107,18 +107,28 @@ class LatencyHistogram:
 class HistogramSet:
     """Keyed histogram table: one LatencyHistogram per
     (key..., metric) row, created on first record. Keys are joined
-    with "/" in snapshots (the serve metrics key convention)."""
+    with "/" in snapshots (the serve metrics key convention).
 
-    def __init__(self):
+    ``row_factory(key, metric)`` (ISSUE 11) lets the row objects be
+    SHARED with a registry histogram (``obs.metrics.Histogram.row``)
+    — both views then read the same LatencyHistogram, so the
+    snapshot block and the /metrics exposition can never disagree."""
+
+    def __init__(self, row_factory=None):
         self._rows: Dict[Tuple, LatencyHistogram] = {}
         self._lock = threading.Lock()
+        self._factory = row_factory or \
+            (lambda key, metric: LatencyHistogram())
 
     def record(self, key: Tuple, metric: str, seconds: float):
         row = (tuple(key), metric)
         h = self._rows.get(row)
         if h is None:
             with self._lock:
-                h = self._rows.setdefault(row, LatencyHistogram())
+                h = self._rows.get(row)
+                if h is None:
+                    h = self._rows[row] = self._factory(row[0],
+                                                        metric)
         h.record(seconds)
 
     def get(self, key: Tuple, metric: str) -> Optional[LatencyHistogram]:

@@ -25,7 +25,6 @@ hardware.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
@@ -39,7 +38,12 @@ _LANES = 128
 
 
 def pallas_available() -> bool:
-    if os.environ.get("PINT_TPU_NO_PALLAS"):
+    # $PINT_TPU_NO_PALLAS through the validated config parser
+    # (ISSUE 11 satellite): an unparsable value warns once and keeps
+    # the kernels enabled instead of silently disabling them
+    from pint_tpu.config import no_pallas
+
+    if no_pallas():
         return False
     return jax.default_backend() == "tpu"
 

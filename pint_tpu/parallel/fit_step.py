@@ -22,8 +22,6 @@ from __future__ import annotations
 
 from typing import Optional
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,14 +40,15 @@ def _pad_to(n: int, multiple: int) -> int:
 def _resolve_f32(flag: Optional[bool], env_name: str) -> bool:
     """Shared f32/f64 mode resolution: explicit argument > env var
     (f32/f64) > auto (f32 on TPU — f64 there is software-emulated and
-    bypasses the MXU/VPU fast paths — f64 elsewhere)."""
-    if flag is not None:
-        return bool(flag)
-    env = os.environ.get(env_name, "").lower()
-    if env in ("f32", "float32", "on", "true", "1"):
-        return True
-    if env in ("f64", "float64", "off", "false", "0"):
-        return False
+    bypasses the MXU/VPU fast paths — f64 elsewhere). The env read
+    goes through the validated ``config.f32_mode`` parser (ISSUE 11
+    satellite): an unrecognized value warns once and falls back to
+    auto instead of silently doing so."""
+    from pint_tpu.config import f32_mode
+
+    mode = f32_mode(env_name, flag)
+    if mode is not None:
+        return mode
     return jax.default_backend() == "tpu"
 
 
@@ -233,8 +232,14 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
                 e = int(min(max(round(idx * L), math.ceil(e_lo), 0),
                             math.floor(e_hi), 126))
                 scale_np[i] = 2.0 ** (-e)
+    # "no explicit matmul setting" = the VALIDATED parser resolves
+    # to auto (config.f32_mode, ISSUE 11 satellite): an unparsable
+    # $PINT_TPU_GLS_MATMUL now warns and behaves like unset instead
+    # of silently disabling the dtype coupling below
+    from pint_tpu.config import f32_mode as _f32_mode
+
     if matmul_f32 is None and \
-            not os.environ.get("PINT_TPU_GLS_MATMUL", ""):
+            _f32_mode("PINT_TPU_GLS_MATMUL") is None:
         # auto-resolution couples the matmul route to the FINAL
         # Jacobian dtype (after the F8+ scale-window fallback above
         # may have cleared jac32): f32 columns lose nothing to an

@@ -113,7 +113,17 @@ class BackendUnavailable(DispatchError):
 class RuntimeMetrics:
     """Supervisor counters — the observability contract: a degraded
     run must be LABELED (bench artifacts and serve snapshots embed
-    ``snapshot()``), never silently slow."""
+    ``snapshot()``), never silently slow.
+
+    ISSUE 11: the counters are REGISTRY-BACKED — each instance holds
+    bound children of the process-global ``obs.metrics`` registry
+    (``pint_tpu_dispatch_<name>_total``, labelled by a per-instance
+    ``scope`` so a serve engine's supervisor stays distinguishable
+    from the fitters' global one), and ``snapshot()``/attribute
+    reads are derived views of the same values. The dispatch-wall
+    HistogramSet shares its rows with the registry's
+    ``pint_tpu_dispatch_wall_seconds`` histogram, so /metrics and
+    the artifact `latency` block can never disagree."""
 
     _COUNTERS = ("dispatches", "guarded", "retries", "timeouts",
                  "transient_errors", "failovers",
@@ -123,31 +133,72 @@ class RuntimeMetrics:
 
     def __init__(self):
         from pint_tpu.obs import HistogramSet
+        from pint_tpu.obs import metrics as om
 
         self._lock = threading.Lock()
-        for name in self._COUNTERS:
-            setattr(self, name, 0)
+        self.scope = om.new_scope("sup")
+        self._c = {
+            name: om.counter(
+                f"pint_tpu_dispatch_{name}_total",
+                f"supervisor {name.replace('_', ' ')}"
+            ).child(scope=self.scope)
+            for name in self._COUNTERS}
+        self._g_inflight = om.gauge(
+            "pint_tpu_dispatch_max_inflight",
+            "peak pipelined in-flight depth").child(scope=self.scope)
+        self._g_rtt = om.gauge(
+            "pint_tpu_dispatch_last_rtt_ms",
+            "last re-measured dispatch RTT").child(scope=self.scope)
+        self._g_k = om.gauge(
+            "pint_tpu_dispatch_last_k",
+            "last re-picked steps-per-dispatch K"
+        ).child(scope=self.scope)
         self.last_rtt_ms: Optional[float] = None
         self.last_k: Optional[int] = None
         self.max_inflight = 0   # peak pipelined depth observed
         # per-(pool, key) dispatch-wall histograms (ISSUE 10):
         # log-bucketed, O(1) memory, embedded as the `latency` block
-        # of snapshot() — how bench artifacts judge tails without
+        # of snapshot() — rows shared with the registry histogram
+        # (ISSUE 11), how bench artifacts judge tails without
         # per-sample storage
-        self.latency = HistogramSet()
+        hist = om.histogram("pint_tpu_dispatch_wall_seconds",
+                            "supervised dispatch wall per "
+                            "(pool, key)")
+        scope = self.scope
+        self.latency = HistogramSet(
+            row_factory=lambda key, metric: hist.row(
+                scope=scope, pool=str(key[0]), key=str(key[1]),
+                metric=metric))
+
+    def __getattr__(self, name):
+        # registry-backed counter reads (tests and call sites keep
+        # the `metrics.timeouts` attribute surface)
+        c = self.__dict__.get("_c")
+        if c is not None and not name.startswith("_") and \
+                name in type(self)._COUNTERS:
+            return int(c[name].value())
+        raise AttributeError(name)
 
     def bump(self, name: str, n: int = 1):
-        with self._lock:
-            setattr(self, name, getattr(self, name) + n)
+        self._c[name].inc(n)
 
     def note_inflight(self, depth: int):
         with self._lock:
             self.max_inflight = max(self.max_inflight, depth)
+            self._g_inflight.set(self.max_inflight)
+
+    def note_rtt(self, rtt_ms: float, k: int):
+        """Record a drift re-measure outcome (value gauges ride the
+        registry; the attributes stay the artifact surface)."""
+        self.last_rtt_ms = rtt_ms
+        self.last_k = k
+        self._g_rtt.set(rtt_ms)
+        self._g_k.set(k)
 
     def snapshot(self) -> dict:
+        out = {name: int(self._c[name].value())
+               for name in self._COUNTERS}
         with self._lock:
-            out = {name: getattr(self, name)
-                   for name in self._COUNTERS}
             out["max_inflight"] = self.max_inflight
         if self.last_rtt_ms is not None:
             out["last_rtt_ms"] = round(self.last_rtt_ms, 3)
@@ -389,6 +440,18 @@ class DispatchSupervisor:
                     backend)
             first_call = key not in self._seen
             self._seen.add(key)
+            if first_call:
+                # per-compile-key compile wall (ISSUE 11): the first
+                # call per key is the one the deadline logic budgets
+                # the compile allowance for — its wall IS the
+                # trace+compile+dispatch cost of that executable
+                from pint_tpu.obs import metrics as om
+
+                om.gauge(
+                    "pint_tpu_compile_wall_seconds",
+                    "first-call (trace+compile+dispatch) wall per "
+                    "dispatch key").set(
+                    wall, scope=self.metrics.scope, key=key)
             # no drift verdict on the first call per key: its wall
             # includes the compile the deadline logic itself budgets
             # a separate allowance for — it would read as "drift" on
@@ -698,8 +761,8 @@ class DispatchSupervisor:
                 config.breaker_probe_timeout_s(), 0.0, False))
         except Exception:
             return
-        self.metrics.last_rtt_ms = new_rtt
-        self.metrics.last_k = config.auto_steps_per_dispatch()
+        self.metrics.note_rtt(new_rtt,
+                              config.auto_steps_per_dispatch())
         from pint_tpu import obs
 
         obs.event("rtt.remeasure", key=key,

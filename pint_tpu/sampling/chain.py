@@ -62,8 +62,28 @@ class DeviceEnsembleSampler(ChainStats):
         self.lnprob: Optional[np.ndarray] = None
         self.naccepted = 0
         self.niterations = 0
-        self.dispatches = 0          # supervised chunk dispatches
         self.mode: Optional[str] = None
+        # supervised chunk dispatches — registry-backed (ISSUE 11 /
+        # graftlint G13): the per-run attribute read is a derived
+        # view of the bound counter child
+        from pint_tpu.obs import metrics as om
+
+        self._c_dispatches = om.counter(
+            "pint_tpu_chain_dispatches_total",
+            "whole-chain-on-device chunk dispatches"
+        ).child(scope=om.new_scope("chain"))
+
+        self._dispatch_base = 0
+
+    @property
+    def dispatches(self) -> int:
+        return int(self._c_dispatches.value()) - self._dispatch_base
+
+    def reset_dispatch_count(self):
+        """Zero the per-run ``dispatches`` view (bench repeats).
+        The registry counter stays monotonic — only the derived
+        per-sampler view rebases."""
+        self._dispatch_base = int(self._c_dispatches.value())
 
     def _chunk(self, k: int):
         import jax
@@ -161,7 +181,7 @@ class DeviceEnsembleSampler(ChainStats):
                 out = sup.dispatch(run, key="sampling.chain",
                                    steps=budget,
                                    fallback=run_pinned)
-            self.dispatches += 1
+            self._c_dispatches.inc()
             pos = np.asarray(out[0], np.float64)
             lp = np.asarray(out[1], np.float64)
             self.naccepted += int(out[2])

@@ -49,6 +49,16 @@ streams every completed span as a JSONL line; ``$PINT_TPU_TRACE``
 arms the ring tracer; ``$PINT_TPU_FLIGHT_DIR`` arms the flight
 recorder, which also dumps on the SIGTERM bounded-drain path.
 
+Metrics plane (ISSUE 11): ``--metrics-port N`` (or
+``$PINT_TPU_METRICS_PORT``; 0 = ephemeral, announced as a
+``{"event": "metrics_server", "port": ...}`` line) serves Prometheus
+text exposition on ``/metrics`` and breaker/pool health JSON on
+``/healthz`` from a stdlib daemon thread that NEVER takes the engine
+lock — the pull surface a multi-worker fleet scrapes per worker. The
+``stats`` answer carries a ``registry`` summary of the same metric
+plane; ``$PINT_TPU_SLO`` arms the burn-rate watchdog (fires the
+flight recorder with reason ``slo_burn:<name>``).
+
 One JSON result line per request (input order NOT guaranteed — lines
 carry the request id); the final line is the engine metrics snapshot
 ({"metric": "serve_session", ...}) whose ``admission``/``router``/
@@ -256,6 +266,8 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
         # (histogram snapshots + flight status + dispatch counters)
         # — zero engine submissions, zero journal lines, in-flight
         # batches untouched
+        from pint_tpu.obs import metrics as om
+
         snap = engine.metrics.snapshot()
         out = {"ok": True, "kind": "stats",
                "latency": snap.get("latency", {}),
@@ -264,7 +276,12 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
                "admission": snap.get("admission"),
                "queue_depth": snap.get("queue_depth"),
                "completed": snap.get("completed"),
-               "submitted": snap.get("submitted")}
+               "submitted": snap.get("submitted"),
+               # ISSUE 11: the same answer as a registry view — the
+               # inline twin of a /metrics scrape
+               "registry": om.get_registry().snapshot()}
+        if snap.get("slo") is not None:
+            out["slo"] = snap["slo"]
         if rid is not None:
             out["id"] = rid
         report(out)
@@ -433,6 +450,12 @@ def main(argv=None, stdin=None) -> int:
                    help="stream completed tracer spans as JSONL to "
                         "PATH (default $PINT_TPU_TRACE_STREAM; "
                         "implies tracing on)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve Prometheus /metrics + /healthz on "
+                        "this port (0 = ephemeral, announced as an "
+                        "event line; default $PINT_TPU_METRICS_PORT "
+                        "or off)")
     args = p.parse_args(argv)
 
     # handlers BEFORE the pint_tpu/jax import: startup takes seconds
@@ -462,6 +485,31 @@ def main(argv=None, stdin=None) -> int:
             else args.window_ms / 1e3,
             max_batch=args.max_batch, queue_cap=args.queue_cap,
             aot_dir=args.aot_dir, journal=args.journal)
+
+        # metrics plane (ISSUE 11): /metrics + /healthz on a stdlib
+        # daemon thread — reads registry/breaker state only, never
+        # the engine lock, so a scrape cannot perturb admission or
+        # an in-flight drain
+        metrics_srv = None
+        from pint_tpu import config as _config
+
+        mport = args.metrics_port if args.metrics_port is not None \
+            else _config.metrics_port()
+        if mport is not None:
+            from pint_tpu.obs import metrics as _om
+
+            def _health(engine=engine, _om=_om):
+                h = _om.default_health()
+                try:
+                    h["pools"] = engine.supervisor.pool_health()
+                except Exception:
+                    pass
+                return h
+
+            metrics_srv = _om.MetricsServer(
+                port=mport, health_fn=_health).start()
+            print(json.dumps({"event": "metrics_server",
+                              "port": metrics_srv.port}), flush=True)
     except _Shutdown as sig:
         _ignore_signals()
         shed = 0 if args.demo is not None else \
@@ -635,6 +683,9 @@ def main(argv=None, stdin=None) -> int:
     snap["metric"] = "serve_session"
     if shutdown_reason:
         snap["shutdown_signal"] = shutdown_reason
+    if metrics_srv is not None:
+        snap["metrics_port"] = metrics_srv.port
+        metrics_srv.close()
     with out_lock:
         print(json.dumps(snap), flush=True)
     print(engine.metrics.report(), file=sys.stderr)

@@ -108,19 +108,63 @@ class AdmissionController:
         self._lock = threading.Lock()
         # shed accounting (the admission block of the metrics
         # snapshot): every decision that drops a request lands in
-        # exactly one of these
-        self.shed_expired = 0    # deadline passed while queued
-        self.shed_deadline = 0   # deadline-aware policy shed (doomed)
-        self.shed_quota = 0      # tenant token bucket drained
-        self.shed_overload = 0   # plain backpressure rejection
-        self.shed_shutdown = 0   # bounded drain timeout at shutdown
-        self.injected_overload = 0  # fault-plan overload rules fired
-        self.tenants: Dict[str, dict] = {}
+        # exactly one of these. ISSUE 11: the counters are bound
+        # children of the process metric registry
+        # (pint_tpu_admission_*_total, scope-labelled) and the
+        # attribute reads below are derived views — mutation goes
+        # through bump() only (graftlint G13).
+        from pint_tpu.obs import metrics as om
+
+        self.scope = om.new_scope("adm")
+        self._c = {
+            name: om.counter(
+                f"pint_tpu_admission_{name}_total",
+                f"admission {name.replace('_', ' ')}"
+            ).child(scope=self.scope)
+            for name in self._COUNTERS}
+        # per-tenant admit/shed accounting as a labelled counter
+        self._tenant_counter = om.counter(
+            "pint_tpu_admission_tenant_total",
+            "per-tenant admission outcomes")
+        self._tenant_names: set = set()
+        # aggregate shed stream, labelled by kind — fed by note_shed
+        # (called next to every shed counter bump); the shed-rate
+        # SLO's numerator
+        self._shed_total = om.counter(
+            "pint_tpu_serve_shed_total",
+            "sheds by kind (quota/deadline/expired/overload)")
         # recent shed stamps for the burst detector (bounded deque —
         # the detector needs only the last _BURST_N arrivals)
         self._shed_times: collections.deque = collections.deque(
             maxlen=_BURST_N)
-        self.shed_bursts = 0     # burst-trigger firings
+
+    _COUNTERS = ("shed_expired", "shed_deadline", "shed_quota",
+                 "shed_overload", "shed_shutdown",
+                 "injected_overload", "shed_bursts")
+
+    def __getattr__(self, name):
+        c = self.__dict__.get("_c")
+        if c is not None and name in type(self)._COUNTERS:
+            return int(c[name].value())
+        raise AttributeError(name)
+
+    def bump(self, name: str, n: int = 1):
+        """The ONE mutation surface for the admission counters
+        (graftlint G13 flags ad-hoc attr increments in this layer)."""
+        self._c[name].inc(n)
+
+    @property
+    def tenants(self) -> Dict[str, dict]:
+        """Derived per-tenant view of the labelled registry counter
+        (snapshot-compatible with the pre-ISSUE-11 dict)."""
+        with self._lock:
+            names = sorted(self._tenant_names)
+        return {name: {
+            "admitted": int(self._tenant_counter.value(
+                scope=self.scope, tenant=name, outcome="admitted")),
+            "shed": int(self._tenant_counter.value(
+                scope=self.scope, tenant=name, outcome="shed")),
+        } for name in names}
 
     def note_shed(self, kind: str):
         """Record one shed for the burst detector; a burst (>=
@@ -134,12 +178,13 @@ class AdmissionController:
         detached daemon thread (bounded: one per burst trigger,
         which the recorder rate-limits to one per 10 s per reason)."""
         now = time.monotonic()
+        self._shed_total.inc(scope=self.scope, kind=kind)
         with self._lock:
             self._shed_times.append(now)
             burst = (len(self._shed_times) == _BURST_N
                      and now - self._shed_times[0] <= _BURST_WINDOW_S)
             if burst:
-                self.shed_bursts += 1
+                self._c["shed_bursts"].inc()
                 self._shed_times.clear()
         if burst:
             from pint_tpu import obs
@@ -156,10 +201,14 @@ class AdmissionController:
 
     # -- per-tenant quotas ---------------------------------------------
 
-    def _tenant(self, name: Optional[str]) -> dict:
-        t = self.tenants.setdefault(name or "default",
-                                    {"admitted": 0, "shed": 0})
-        return t
+    def _note_tenant(self, name: str, outcome: str):
+        """One tenant admission outcome into the labelled registry
+        counter (the ``tenants`` property is its derived view).
+        Caller holds ``self._lock`` (for the name set only — the
+        counter has its own lock)."""
+        self._tenant_names.add(name)
+        self._tenant_counter.inc(scope=self.scope, tenant=name,
+                                 outcome=outcome)
 
     def check_quota(self, tenant: Optional[str],
                     now: Optional[float] = None) -> bool:
@@ -183,12 +232,11 @@ class AdmissionController:
             if burst_hit:
                 b.drain()
             ok = b.take(time.monotonic() if now is None else now)
-            t = self._tenant(name)
             if ok:
-                t["admitted"] += 1
+                self._note_tenant(name, "admitted")
             else:
-                t["shed"] += 1
-                self.shed_quota += 1
+                self._note_tenant(name, "shed")
+                self._c["shed_quota"].inc()
         if not ok:
             self.note_shed("quota")
         return ok
@@ -202,7 +250,7 @@ class AdmissionController:
         plan = faults.active_plan()
         if plan is not None and plan.faults_for(
                 "serve.admit/capacity", kinds=("overload",)):
-            self.injected_overload += 1
+            self._c["injected_overload"].inc()
             return True
         return queued >= cap
 
@@ -240,20 +288,23 @@ class AdmissionController:
     # -- reporting -----------------------------------------------------
 
     def snapshot(self) -> dict:
-        with self._lock:
-            return {
-                "policy": self.policy,
-                "tenant_qps": self.tenant_qps,
-                "shed_expired": self.shed_expired,
-                "shed_deadline": self.shed_deadline,
-                "shed_quota": self.shed_quota,
-                "shed_overload": self.shed_overload,
-                "shed_shutdown": self.shed_shutdown,
-                "shed_bursts": self.shed_bursts,
-                "injected_overload": self.injected_overload,
-                "tenants": {k: dict(v)
-                            for k, v in sorted(self.tenants.items())},
-            }
+        # no self._lock here: every field is a registry read with
+        # its own metric lock (the tenants property takes self._lock
+        # for the name set) — a snapshot must never serialize behind
+        # the admission hot path
+        return {
+            "policy": self.policy,
+            "tenant_qps": self.tenant_qps,
+            "shed_expired": self.shed_expired,
+            "shed_deadline": self.shed_deadline,
+            "shed_quota": self.shed_quota,
+            "shed_overload": self.shed_overload,
+            "shed_shutdown": self.shed_shutdown,
+            "shed_bursts": self.shed_bursts,
+            "injected_overload": self.injected_overload,
+            "tenants": {k: dict(v)
+                        for k, v in sorted(self.tenants.items())},
+        }
 
     @property
     def total_shed(self) -> int:

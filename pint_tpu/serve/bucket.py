@@ -205,6 +205,28 @@ class ExecutableCache:
 
             self.aot = AotStore(aot_dir, donation=self.donation)
             self.aot.restore_all(supervisor=self.supervisor)
+        # ISSUE 11: pull-gauges into the metric registry — compile
+        # count and jit-cache entries per engine cache, evaluated at
+        # scrape time through a weakref (a dead engine's gauge just
+        # stops producing samples, it can never keep the cache alive)
+        import weakref
+
+        from pint_tpu.obs import metrics as om
+
+        ref = weakref.ref(self)
+        scope = om.new_scope("cache")
+        om.gauge("pint_tpu_jit_cache_size",
+                 "live jit-cache entries per engine executable "
+                 "cache").set_fn(
+            lambda: (lambda c: c.jit_cache_size()
+                     if c is not None else None)(ref()),
+            scope=scope)
+        om.gauge("pint_tpu_serve_compile_count",
+                 "distinct shape classes compiled per engine"
+                 ).set_fn(
+            lambda: (lambda c: c.compile_count
+                     if c is not None else None)(ref()),
+            scope=scope)
 
     @property
     def compile_count(self) -> int:

@@ -94,10 +94,17 @@ class RequestJournal:
 
     def __init__(self, path: str,
                  compact_bytes: Optional[int] = None):
+        from pint_tpu.obs import metrics as om
+
         self.path = path
         self._lock = threading.Lock()
         self._fh = None
-        self.compactions = 0
+        # ISSUE 11: compaction count rides the metric registry (the
+        # counts() dict reads it back — derived view, G13-clean)
+        self._c_compactions = om.counter(
+            "pint_tpu_journal_compactions_total",
+            "journal auto/explicit compactions"
+        ).child(scope=om.new_scope("journal"))
         if compact_bytes is None:
             from pint_tpu import config
 
@@ -187,7 +194,7 @@ class RequestJournal:
         if not reopen:
             # compacting a closed journal leaves it closed
             self._fh.close()
-        self.compactions += 1
+        self._c_compactions.inc()
         # hysteresis: when the LIVE unacknowledged set itself exceeds
         # the threshold, compaction cannot shrink below it — without
         # a backoff every subsequent append would re-scan and rewrite
@@ -197,6 +204,10 @@ class RequestJournal:
         if self._compact_bytes:
             self._next_compact = max(self._compact_bytes,
                                      2 * self._bytes)
+
+    @property
+    def compactions(self) -> int:
+        return int(self._c_compactions.value())
 
     def close(self):
         with self._lock:
@@ -286,7 +297,12 @@ class AotStore:
     compile is left for the first real request. Restored callables
     are fetched with ``get``."""
 
+    _COUNTERS = ("exported", "export_errors", "restore_errors",
+                 "hits", "misses")
+
     def __init__(self, dirpath: str, donation: bool = False):
+        from pint_tpu.obs import metrics as om
+
         self.dir = dirpath
         self.donation = bool(donation)
         os.makedirs(dirpath, exist_ok=True)
@@ -294,10 +310,27 @@ class AotStore:
         self._restored: Dict[str, Callable] = {}
         self._saved: set = set()
         self._lock = threading.Lock()
-        self.exported = 0
+        # ISSUE 11: registry-backed counters (scope-labelled), read
+        # back via __getattr__ — snapshot() stays a derived view;
+        # hits/misses count restored-executable lookups at dispatch
+        # time (the warm-restart effectiveness gauge)
+        self._scope = om.new_scope("aot")
+        self._c = {
+            name: om.counter(
+                f"pint_tpu_aot_{name}_total",
+                f"AOT store {name.replace('_', ' ')}"
+            ).child(scope=self._scope)
+            for name in self._COUNTERS}
+        self._g_restored = om.gauge(
+            "pint_tpu_aot_restored",
+            "restored executables held").child(scope=self._scope)
         self.restored = 0
-        self.export_errors = 0
-        self.restore_errors = 0
+
+    def __getattr__(self, name):
+        c = self.__dict__.get("_c")
+        if c is not None and name in type(self)._COUNTERS:
+            return int(c[name].value())
+        raise AttributeError(name)
 
     # -- manifest ------------------------------------------------------
 
@@ -359,9 +392,9 @@ class AotStore:
                     **_fingerprint(),
                 }
                 self._write_manifest(manifest)
-            self.exported += 1
+            self._c["exported"].inc()
         except Exception as e:
-            self.export_errors += 1
+            self._c["export_errors"].inc()
             _log().warning("AOT export of %s failed: %r", ks, e)
 
     # -- restore -------------------------------------------------------
@@ -413,7 +446,7 @@ class AotStore:
                     jax.tree_util.tree_map(np.asarray, out)
                     restored[ks] = fn
                 except Exception as e:
-                    self.restore_errors += 1
+                    self._c["restore_errors"].inc()
                     _log().warning("AOT restore of %s failed: %r",
                                    ks, e)
             return restored
@@ -430,25 +463,34 @@ class AotStore:
             else:
                 restored = _primed()
         except Exception as e:
-            self.restore_errors += 1
+            self._c["restore_errors"].inc()
             _log().warning("AOT restore pass failed: %r", e)
             restored = {}
         with self._lock:
             self._restored.update(restored)
             self.restored = len(self._restored)
+            self._g_restored.set(self.restored)
         return self.restored
 
     def get(self, kind: str, full_key: tuple) -> Optional[Callable]:
         with self._lock:
-            return self._restored.get(_key_str(kind, full_key))
+            fn = self._restored.get(_key_str(kind, full_key))
+        # restore hit/miss accounting (ISSUE 11): a dispatch-time
+        # lookup that finds a restored executable is a warm-restart
+        # win; a miss is a class this process compiled itself
+        self._c["hits" if fn is not None else "misses"].inc()
+        return fn
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {"dir": self.dir,
-                    "restored": self.restored,
-                    "exported": self.exported,
-                    "export_errors": self.export_errors,
-                    "restore_errors": self.restore_errors}
+            restored = self.restored
+        return {"dir": self.dir,
+                "restored": restored,
+                "exported": self.exported,
+                "export_errors": self.export_errors,
+                "restore_errors": self.restore_errors,
+                "hits": self.hits,
+                "misses": self.misses}
 
 
 # ------------------------------------------------------------------

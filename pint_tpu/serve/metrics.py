@@ -46,23 +46,40 @@ def _pct_ms(sorted_xs: List[float], q: float) -> Optional[float]:
 
 
 class BucketStats:
-    """Counters for one shape class (one executable)."""
+    """Counters for one shape class (one executable) — registry-
+    backed (ISSUE 11): each stat is a bound child of the
+    ``pint_tpu_serve_bucket_*_total`` counters labelled
+    (scope, cls), read back through ``__getattr__`` so the snapshot
+    stays a derived view. The latency reservoir is per-sample state,
+    not a counter, and stays local."""
 
-    def __init__(self):
-        self.requests = 0          # requests served through this class
-        self.batches = 0           # device dispatches
-        self.slots = 0             # padded batch slots dispatched
-        self.rows_real = 0         # real TOA/MJD rows
-        self.rows_padded = 0       # padded TOA/MJD rows incl. batch pad
+    _COUNTERS = ("requests", "batches", "slots", "rows_real",
+                 "rows_padded")
+
+    def __init__(self, scope: str = "", cls: str = ""):
+        from pint_tpu.obs import metrics as om
+
+        self._c = {
+            name: om.counter(
+                f"pint_tpu_serve_bucket_{name}_total",
+                f"per-shape-class {name.replace('_', ' ')}"
+            ).child(scope=scope, cls=cls)
+            for name in self._COUNTERS}
         self.latencies_s: List[float] = []  # admit -> future resolved
+
+    def __getattr__(self, name):
+        c = self.__dict__.get("_c")
+        if c is not None and name in type(self)._COUNTERS:
+            return int(c[name].value())
+        raise AttributeError(name)
 
     def record(self, nreal: int, pb: int, rows_real: int,
                rows_padded: int, lats: List[float]):
-        self.requests += nreal
-        self.batches += 1
-        self.slots += pb
-        self.rows_real += rows_real
-        self.rows_padded += rows_padded
+        self._c["requests"].inc(nreal)
+        self._c["batches"].inc()
+        self._c["slots"].inc(pb)
+        self._c["rows_real"].inc(rows_real)
+        self._c["rows_padded"].inc(rows_padded)
         self.latencies_s.extend(lats)
         if len(self.latencies_s) > _LAT_CAP:
             del self.latencies_s[:-_LAT_CAP]
@@ -117,31 +134,76 @@ class ServeMetrics:
         # storage (ISSUE 10; the scheduler records into it at every
         # dispatch finish). The per-bucket reservoir above remains
         # the exact-quantile view of RECENT traffic; this is the
-        # unbounded-horizon tail view the artifacts embed.
+        # unbounded-horizon tail view the artifacts embed. ISSUE 11:
+        # rows are SHARED with the registry's
+        # pint_tpu_serve_latency_seconds histogram and the engine
+        # counters are bound registry children (scope-labelled), so
+        # snapshot() is a derived view of the metrics plane.
         from pint_tpu.obs import HistogramSet
+        from pint_tpu.obs import metrics as om
 
-        self.latency = HistogramSet()
-        self.submitted = 0
-        self.completed = 0
-        self.rejected = 0           # backpressure (queue cap) drops
-        self.deadline_missed = 0
-        self.fallback_single = 0    # no-bucket single-request path
-        self.failed = 0             # dispatch errors propagated
+        self.scope = om.new_scope("serve")
+        hist = om.histogram(
+            "pint_tpu_serve_latency_seconds",
+            "serve latency per (pool, kind, class) x "
+            "(queue_wait|dispatch_wall|e2e)")
+        scope = self.scope
+        self.latency = HistogramSet(
+            row_factory=lambda key, metric: hist.row(
+                scope=scope, pool=str(key[0]), kind=str(key[1]),
+                cls=str(key[2]) if len(key) > 2 else "",
+                metric=metric))
+        self._c = {
+            name: om.counter(
+                f"pint_tpu_serve_{name}_total",
+                f"serve engine {name.replace('_', ' ')}"
+            ).child(scope=scope)
+            for name in self._COUNTERS}
+        self._g_queue = om.gauge("pint_tpu_serve_queue_depth",
+                                 "admitted-and-undispatched "
+                                 "requests").child(scope=scope)
+        self._g_queue_max = om.gauge(
+            "pint_tpu_serve_max_queue_depth",
+            "peak queue depth").child(scope=scope)
         self.max_queue_depth = 0
         self._queue_depth = 0
         self.buckets: Dict[tuple, BucketStats] = {}
+
+    # "attempts" counts every submit() entry BEFORE any shed
+    # decision (ISSUE 11 review): quota and overload sheds never
+    # reach the `submitted` counter, so a shed-rate SLO with
+    # `submitted` as denominator would be blind to a pure-shed
+    # storm — attempts is the honest denominator
+    _COUNTERS = ("attempts", "submitted", "completed", "rejected",
+                 "deadline_missed", "fallback_single", "failed")
+
+    def __getattr__(self, name):
+        c = self.__dict__.get("_c")
+        if c is not None and name in type(self)._COUNTERS:
+            return int(c[name].value())
+        raise AttributeError(name)
+
+    def bump(self, name: str, n: int = 1):
+        """The ONE mutation surface for the engine counters
+        (graftlint G13 flags ad-hoc attr increments in the serve
+        layer)."""
+        self._c[name].inc(n)
 
     # -- gauges --------------------------------------------------------
 
     def queue_depth(self, depth: Optional[int] = None) -> int:
         if depth is not None:
             self._queue_depth = depth
-            self.max_queue_depth = max(self.max_queue_depth, depth)
+            self._g_queue.set(depth)
+            if depth > self.max_queue_depth:
+                self.max_queue_depth = depth
+                self._g_queue_max.set(depth)
         return self._queue_depth
 
     def bucket(self, key) -> BucketStats:
         if key not in self.buckets:
-            self.buckets[key] = BucketStats()
+            self.buckets[key] = BucketStats(
+                scope=self.scope, cls=self._fmt_key(key))
         return self.buckets[key]
 
     @property
@@ -166,6 +228,7 @@ class ServeMetrics:
         rows_r = sum(b.rows_real for b in self.buckets.values())
         rows_p = sum(b.rows_padded for b in self.buckets.values())
         out = {
+            "attempts": self.attempts,
             "submitted": self.submitted, "completed": self.completed,
             "rejected": self.rejected,
             "deadline_missed": self.deadline_missed,
@@ -195,6 +258,14 @@ class ServeMetrics:
         from pint_tpu import obs
 
         out["obs"] = obs.status()
+        # ISSUE 11: the SLO watchdog's burn state rides the snapshot
+        # when armed ($PINT_TPU_SLO) — absent otherwise, keeping the
+        # pre-metrics-plane snapshot shape bit-compatible
+        from pint_tpu.obs import slo as _slo
+
+        slo_state = _slo.status()
+        if slo_state is not None:
+            out["slo"] = slo_state
         if self.admission is not None:
             out["admission"] = self.admission.snapshot()
         if self.router is not None:

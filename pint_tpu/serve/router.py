@@ -55,19 +55,51 @@ _DEVICE_PRIOR = 1e9
 
 
 class _Pool:
-    __slots__ = ("name", "dispatches", "requests", "rows",
-                 "rates", "inflight_rows", "inflight_kind",
-                 "demotions")
+    """One capacity pool's accounting. ISSUE 11: the monotonic
+    counters (dispatches/requests/rows/demotions) are bound children
+    of the registry's ``pint_tpu_router_*_total`` metrics labelled
+    (scope, pool) and read back through ``__getattr__``; the learned
+    EWMA rates and in-flight backlog mirror into gauges. Routing
+    logic keeps its local ``rates``/``inflight_kind`` dicts — the
+    registry is the observability plane, not the decision state."""
 
-    def __init__(self, name: str):
+    _COUNTERS = ("dispatches", "requests", "rows", "demotions")
+
+    __slots__ = ("name", "rates", "inflight_rows", "inflight_kind",
+                 "_c", "_g_rate", "_g_inflight", "_scope")
+
+    def __init__(self, name: str, scope: str = ""):
+        from pint_tpu.obs import metrics as om
+
         self.name = name
-        self.dispatches = 0
-        self.requests = 0
-        self.rows = 0
+        self._scope = scope
+        self._c = {
+            cn: om.counter(
+                f"pint_tpu_router_{cn}_total",
+                f"capacity-router {cn} per pool"
+            ).child(scope=scope, pool=name)
+            for cn in self._COUNTERS}
+        self._g_rate = om.gauge(
+            "pint_tpu_router_rate_rows_per_s",
+            "learned EWMA service rate per (pool, kind)")
+        self._g_inflight = om.gauge(
+            "pint_tpu_router_inflight_rows",
+            "in-flight kind-local rows per pool"
+        ).child(scope=scope, pool=name)
         self.rates: Dict[str, float] = {}   # kind -> EWMA rows/s
         self.inflight_rows = 0
         self.inflight_kind: Dict[str, int] = {}  # kind -> rows
-        self.demotions = 0
+
+    def __getattr__(self, name):
+        # __slots__ class: _c exists once __init__ ran; counter
+        # names read through the registry children
+        if name in _Pool._COUNTERS:
+            return int(object.__getattribute__(self, "_c")[name]
+                       .value())
+        raise AttributeError(name)
+
+    def bump(self, counter: str, n: int = 1):
+        self._c[counter].inc(n)
 
     def rate(self, kind: str) -> Optional[float]:
         return self.rates.get(kind)
@@ -79,6 +111,8 @@ class _Pool:
         prev = self.rates.get(kind)
         self.rates[kind] = r if prev is None else \
             (1.0 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * r
+        self._g_rate.set(self.rates[kind], scope=self._scope,
+                         pool=self.name, kind=kind)
 
     def snapshot(self) -> dict:
         return {
@@ -95,11 +129,13 @@ class _Pool:
         self.inflight_rows += rows
         self.inflight_kind[kind] = \
             self.inflight_kind.get(kind, 0) + rows
+        self._g_inflight.set(self.inflight_rows)
 
     def sub_inflight(self, kind: str, rows: int):
         self.inflight_rows = max(0, self.inflight_rows - rows)
         self.inflight_kind[kind] = max(
             0, self.inflight_kind.get(kind, 0) - rows)
+        self._g_inflight.set(self.inflight_rows)
 
 
 class CapacityRouter:
@@ -110,8 +146,12 @@ class CapacityRouter:
     accounting, like the engine's compile counts."""
 
     def __init__(self, supervisor=None):
+        from pint_tpu.obs import metrics as om
+
         self.supervisor = supervisor
-        self.pools = {"device": _Pool("device"), "host": _Pool("host")}
+        self.scope = om.new_scope("router")
+        self.pools = {"device": _Pool("device", scope=self.scope),
+                      "host": _Pool("host", scope=self.scope)}
         self._lock = threading.Lock()
 
     # -- routing -------------------------------------------------------
@@ -133,7 +173,7 @@ class CapacityRouter:
         with self._lock:
             dev, host = self.pools["device"], self.pools["host"]
             if self._device_open():
-                host.demotions += 1
+                host.bump("demotions")
                 return "host"
             hr = host.rate(kind)
             if hr is None:
@@ -199,9 +239,9 @@ class CapacityRouter:
                kind: str = "gls"):
         with self._lock:
             p = self.pools[pool]
-            p.dispatches += 1
-            p.requests += nreq
-            p.rows += rows
+            p.bump("dispatches")
+            p.bump("requests", nreq)
+            p.bump("rows", rows)
             p.add_inflight(kind, rows)
 
     def finished(self, pool: str, kind: str, rows: int,
@@ -226,7 +266,10 @@ class CapacityRouter:
         """Directly set a pool's learned rate (tests, and the bench's
         host-probe warmup)."""
         with self._lock:
-            self.pools[pool].rates[kind] = float(rows_per_s)
+            p = self.pools[pool]
+            p.rates[kind] = float(rows_per_s)
+            p._g_rate.set(p.rates[kind], scope=self.scope,
+                          pool=pool, kind=kind)
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -198,6 +198,12 @@ class ServeEngine:
         self._dispatch_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # ISSUE 11: arm the SLO burn-rate watchdog when $PINT_TPU_SLO
+        # is set (a no-op otherwise — no thread, no ring); it samples
+        # the process metric registry this engine now writes through
+        from pint_tpu.obs import slo as _slo
+
+        _slo.maybe_start()
 
     def _restart_info(self, aot_dir) -> dict:
         info = {"warm": False, "replayed": 0}
@@ -236,6 +242,10 @@ class ServeEngine:
             raise EngineKilled(
                 "engine was killed (kill_restart); restart and "
                 "replay the journal")
+        # every live submit is an ATTEMPT, counted before any shed
+        # decision — the shed-rate SLO's denominator (quota/overload
+        # sheds never reach `submitted`)
+        self.metrics.bump("attempts")
         osp = obs.open_root("serve.request", label="req",
                             kind=req.kind,
                             tenant=req.tenant or "default",
@@ -257,8 +267,8 @@ class ServeEngine:
         try:
             key, fb = self._class_of(req)
         except Exception as e:
-            self.metrics.submitted += 1
-            self.metrics.failed += 1
+            self.metrics.bump("submitted")
+            self.metrics.bump("failed")
             req.future.set_exception(e)
             return req.future
         with self._cv:
@@ -274,7 +284,7 @@ class ServeEngine:
                     self._predicted_wait_locked(req), now)
                 if verdict == "victim":
                     self._remove_queued_locked(victim)
-                    self.admission.shed_deadline += 1
+                    self.admission.bump("shed_deadline")
                     self.admission.note_shed("deadline")
                     victim.future.set_exception(DeadlineExceeded(
                         f"{victim.kind} request shed at admission: "
@@ -283,17 +293,17 @@ class ServeEngine:
                         f"anyway; capacity given to a request that "
                         f"can still make it)"))
                 elif verdict == "newcomer":
-                    self.admission.shed_deadline += 1
+                    self.admission.bump("shed_deadline")
                     self.admission.note_shed("deadline")
-                    self.metrics.submitted += 1
+                    self.metrics.bump("submitted")
                     req.future.set_exception(DeadlineExceeded(
                         f"{req.kind} request shed at admission: "
                         f"predicted wait exceeds its {req.deadline_s}"
                         f"s deadline (would miss anyway)"))
                     return req.future
                 else:
-                    self.metrics.rejected += 1
-                    self.admission.shed_overload += 1
+                    self.metrics.bump("rejected")
+                    self.admission.bump("shed_overload")
                     self.admission.note_shed("overload")
                     osp.event("serve.terminal",
                               status="shed:overload")
@@ -320,7 +330,7 @@ class ServeEngine:
             self._nqueued += 1
             if len(b.reqs) >= self.max_batch:
                 self._seal_locked(key)
-            self.metrics.submitted += 1
+            self.metrics.bump("submitted")
             self.metrics.queue_depth(self._nqueued)
             self._cv.notify()
         # journal OUTSIDE the engine lock: the per-admit fsync must
@@ -516,8 +526,8 @@ class ServeEngine:
             for r in reqs:
                 if r.expired(now):
                     self._nqueued -= 1
-                    self.metrics.deadline_missed += 1
-                    self.admission.shed_expired += 1
+                    self.metrics.bump("deadline_missed")
+                    self.admission.bump("shed_expired")
                     self.admission.note_shed("expired")
                     r.future.set_exception(DeadlineExceeded(
                         f"{r.kind} request missed its "
@@ -547,7 +557,7 @@ class ServeEngine:
         if not b.reqs:
             return
         if b.fallback:
-            self.metrics.fallback_single += len(b.reqs)
+            self.metrics.bump("fallback_single", len(b.reqs))
         obs.event("serve.seal",
                   cls=ServeMetrics._fmt_key(key), n=len(b.reqs))
         self._ready.append((key, b.reqs))
@@ -621,8 +631,8 @@ class ServeEngine:
                 live = []
                 for r in grp:
                     if r.expired(now):
-                        self.metrics.deadline_missed += 1
-                        self.admission.shed_expired += 1
+                        self.metrics.bump("deadline_missed")
+                        self.admission.bump("shed_expired")
                         self.admission.note_shed("expired")
                         r.future.set_exception(DeadlineExceeded(
                             f"{r.kind} request missed its "
@@ -836,7 +846,7 @@ class ServeEngine:
                     error=f"{type(e).__name__}: {e}")
             for r in grp:
                 if not r.future.done():
-                    self.metrics.failed += 1
+                    self.metrics.bump("failed")
                     r.future.set_exception(e)
             return
         done = time.monotonic()
@@ -873,7 +883,7 @@ class ServeEngine:
             self.metrics.latency.record(hkey, "queue_wait",
                                         max(0.0, t0 - adm))
             self.metrics.latency.record(hkey, "e2e", done - adm)
-        self.metrics.completed += len(grp)
+        self.metrics.bump("completed", len(grp))
 
     @staticmethod
     def _rows_of(r) -> int:
@@ -956,7 +966,7 @@ class ServeEngine:
             obs.flight_dump("shutdown_shed", shed=len(reqs),
                             admission=self.admission.snapshot())
         for r in reqs:
-            self.admission.shed_shutdown += 1
+            self.admission.bump("shed_shutdown")
             if not r.future.done():
                 r.future.set_exception(ShutdownShed(
                     f"{r.kind} request shed: engine shut down "

@@ -668,3 +668,70 @@ def test_cli_exit_code():
         capture_output=True, text=True, timeout=420, env=env,
         cwd=REPO)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ----------------------------------------------------------- G13
+
+
+def _lint_g13(src, relpath="pint_tpu/serve/_fixture.py"):
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    return gl.check_g13(m)
+
+
+def test_g13_flags_attr_and_dict_counter_increments():
+    v = _lint_g13("""
+    def submit(self, req):
+        self.metrics.submitted += 1
+        self.admission.shed_quota += 1
+        self.counters["shed"] += 1
+        self.timeouts = self.timeouts + 1
+        self.tally["shed_deadline"] = self.tally.get(
+            "shed_deadline", 0) + 1
+    """)
+    assert [x.rule for x in v] == ["G13"] * 5
+
+
+def test_g13_clean_on_registry_children_and_non_counters():
+    assert _lint_g13("""
+    def submit(self, req):
+        self._c["submitted"].inc()
+        self.metrics.bump("completed", 3)
+        self._nqueued += 1            # queue gauge, not a counter
+        self.inflight_rows += rows    # backlog gauge
+        done += 1                     # plain local tally
+        self.wall_s += dt             # not counter-named
+    """) == []
+
+
+def test_g13_fresh_assignment_is_not_an_increment():
+    # assigning a SUM of other things is not an increment of the
+    # counter itself
+    assert _lint_g13("""
+    def snapshot(self):
+        self.requests = a.requests + b.requests
+        out["submitted"] = x + 1
+    """) == []
+
+
+def test_g13_only_applies_to_the_dispatch_layer():
+    src = """
+    def bump(self):
+        self.timeouts += 1
+    """
+    assert _lint_g13(src, relpath="pint_tpu/serve/_f.py")
+    assert _lint_g13(src, relpath="pint_tpu/parallel/_f.py")
+    assert not _lint_g13(src, relpath="pint_tpu/runtime/_f.py")
+    assert not _lint_g13(src, relpath="pint_tpu/obs/_f.py")
+    assert not _lint_g13(src, relpath="pint_tpu/pintk/_f.py")
+
+
+def test_g13_pragma_suppression_works():
+    src = ("def f(self):\n"
+           "    self.timeouts += 1"
+           "  # graftlint: allow G13 -- fixture: local tally\n")
+    m = gl.ModuleInfo("pint_tpu/serve/_fixture.py", src)
+    report = gl.LintReport(violations=gl.check_g13(m))
+    gl.apply_suppressions(
+        report, [], {"pint_tpu/serve/_fixture.py": src})
+    assert report.violations == []
+    assert len(report.suppressed) == 1

@@ -1,0 +1,721 @@
+"""Metrics-plane acceptance suite (ISSUE 11).
+
+The contracts CLAUDE.md promises for the registry / exposition / SLO
+watchdog / regression-gate stack:
+
+- registry-vs-snapshot PARITY: every counter in the supervisor /
+  admission / router / serve artifact blocks is readable through the
+  process registry with identical values (derived views, not double
+  bookkeeping);
+- the Prometheus text exposition parses (minimal parser here) and
+  round-trips: parsed sample values equal registry reads, histogram
+  buckets are cumulative and consistent with _count;
+- a /metrics scrape NEVER takes the engine lock (proven by scraping
+  while this test holds it);
+- SLO burn-rate math on synthetic series: fast+slow windows must
+  BOTH burn to fire, a one-sample spike does not fire, one fire per
+  burn episode;
+- validated config parsers (f32_mode, no_pallas, SLO knobs) warn
+  and ignore bad values per the dispatch_rtt_override_ms convention;
+- tools/bench_regress.py verdicts (pass/fail/skip) and the
+  artifact-embedded regress block.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from pint_tpu import obs
+from pint_tpu.obs import metrics as om
+from pint_tpu.obs import slo
+from pint_tpu.runtime import DispatchSupervisor, reset_runtime
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    """Registry/watchdog/tracer/breaker state must never leak across
+    tests (obs.reset() swaps the registry and stops the watchdog —
+    the test_obs.py autouse pattern extended to the metrics plane)."""
+    obs.reset()
+    reset_runtime()
+    yield
+    obs.reset()
+    reset_runtime()
+
+
+# ------------------------------------------------------ registry core
+
+
+def test_registry_types_and_labels():
+    reg = om.get_registry()
+    c = reg.counter("t_events_total", "help text")
+    c.inc(pool="device")
+    c.inc(2, pool="host")
+    assert c.value(pool="device") == 1
+    assert c.value(pool="host") == 2
+    assert c.total() == 3
+    assert reg.counter("t_events_total") is c  # get-or-create
+    with pytest.raises(TypeError):
+        reg.gauge("t_events_total")  # type conflict
+    g = reg.gauge("t_depth")
+    g.set(7)
+    g.set_max(3)          # watermark: never goes down
+    assert g.value() == 7
+    g.set_max(11)
+    assert g.value() == 11
+    h = reg.histogram("t_lat_seconds")
+    h.observe(0.004, kind="gls")
+    assert h.row(kind="gls").count == 1
+    # bound children are the hot-path handles
+    b = reg.counter("t_bumps_total").child(scope="s1")
+    b.inc()
+    b.inc(3)
+    assert b.value() == 4
+    # counters are monotonic
+    with pytest.raises(TypeError):
+        b.set(0)
+
+
+def test_pull_gauge_stops_exporting_when_producer_dies():
+    """A set_fn gauge whose producer yields None (dead weakref,
+    absent feature) must DROP its series, not freeze the last
+    sampled value forever — and resume if the producer returns."""
+    g = om.gauge("t_pull")
+    state = {"v": 5.0}
+    g.set_fn(lambda: state["v"], scope="e1")
+    assert dict(g.series())[(("scope", "e1"),)] == 5.0
+    state["v"] = None             # producer died
+    assert g.series() == []       # stale sample gone
+    assert "t_pull{" not in om.render()
+    state["v"] = 7.0              # transient: resumes
+    assert dict(g.series())[(("scope", "e1"),)] == 7.0
+
+
+def test_shed_rate_slo_fires_on_pure_quota_shed_storm():
+    """Review fix: quota sheds never reach `submitted`, so the
+    shed-rate SLO uses `attempts` as denominator — a 100%-shed
+    storm must fire, not evaluate to None."""
+    from pint_tpu.serve import ServeEngine
+    from pint_tpu.serve.request import TenantOverQuota
+
+    spec = next(s for s in slo.default_specs()
+                if s.name == "shed_rate")
+    spec.fast_s, spec.slow_s, spec.burn = 10.0, 30.0, 2.0
+    clock = {"t": 0.0}
+    wd = slo.SLOWatchdog(specs=[spec], interval_s=5.0,
+                         clock=lambda: clock["t"])
+    fresh = _workload(2, base=6700)
+    eng = ServeEngine(tenant_qps=1000.0,
+                      tenant_burst=100.0)  # healthy first
+
+    def tick(noisy=False):
+        fired = []
+        for r in fresh():
+            r.tenant = "noisy" if noisy else "calm"
+            try:
+                eng.submit(r)
+            except TenantOverQuota:
+                pass
+        eng.flush()
+        fired = wd.tick(now=clock["t"])
+        clock["t"] += 5.0
+        return fired
+
+    for _ in range(8):
+        assert tick() == []
+    # pure-shed storm: drain the noisy tenant's bucket every tick
+    from pint_tpu.runtime import Fault, FaultPlan
+
+    plan = FaultPlan([Fault(match="serve.admit/noisy",
+                            kind="tenant_burst")])
+    fired = []
+    with plan.active():
+        for _ in range(6):
+            fired += tick(noisy=True)
+    assert fired == ["shed_rate"]
+    assert eng.metrics.attempts > eng.metrics.submitted
+
+
+def test_registry_reset_isolation():
+    om.counter("t_old_total").inc()
+    old = om.get_registry()
+    om.reset()
+    assert om.get_registry() is not old
+    assert om.get_registry().value("t_old_total") == 0.0
+
+
+# ------------------------------------------------------- exposition
+
+
+def _parse_prom(text):
+    """Minimal Prometheus text-format 0.0.4 parser: returns
+    ({(name, labels_frozenset): value}, {name: type})."""
+    samples = {}
+    types = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            continue
+        meta, sval = line.rsplit(" ", 1)
+        if "{" in meta:
+            name, lbl = meta.split("{", 1)
+            assert lbl.endswith("}"), line
+            items = []
+            body = lbl[:-1]
+            while body:
+                k, rest = body.split("=", 1)
+                assert rest.startswith('"')
+                # labels in this suite contain no escaped quotes
+                v, body = rest[1:].split('"', 1)
+                body = body.lstrip(",")
+                items.append((k, v))
+            key = (name, frozenset(items))
+        else:
+            key = (meta, frozenset())
+        assert key not in samples, f"duplicate sample {key}"
+        samples[key] = float(sval)
+    return samples, types
+
+
+def test_exposition_parses_and_round_trips():
+    reg = om.get_registry()
+    reg.counter("rt_events_total", "ev").inc(5, pool="device",
+                                             kind="gls")
+    reg.gauge("rt_depth").set(3.5, scope="e1")
+    h = reg.histogram("rt_lat_seconds")
+    for ms in (0.5, 1.0, 3.0, 700.0):
+        h.observe(ms / 1e3, kind="gls")
+    text = reg.render()
+    samples, types = _parse_prom(text)
+    assert types["rt_events_total"] == "counter"
+    assert types["rt_depth"] == "gauge"
+    assert types["rt_lat_seconds"] == "histogram"
+    # round-trip: parsed values == registry reads
+    assert samples[("rt_events_total",
+                    frozenset({("pool", "device"),
+                               ("kind", "gls")}))] == 5
+    assert samples[("rt_depth",
+                    frozenset({("scope", "e1")}))] == 3.5
+    # histogram: cumulative buckets, +Inf == _count, _sum consistent
+    buckets = sorted(
+        (float(dict(k[1])["le"]) if dict(k[1])["le"] != "+Inf"
+         else float("inf"), v)
+        for k, v in samples.items() if k[0] == "rt_lat_seconds_bucket")
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert counts[-1] == 4
+    count = samples[("rt_lat_seconds_count",
+                     frozenset({("kind", "gls")}))]
+    assert count == 4
+    s = samples[("rt_lat_seconds_sum", frozenset({("kind", "gls")}))]
+    assert s == pytest.approx(0.7045, rel=1e-6)
+    # every sample in the exposition has a le-monotone position for
+    # its value: the 700 ms sample is only in buckets >= ~1.05 s edge
+    below_ms = [le for le, v in buckets if v < 4]
+    assert below_ms and max(below_ms) < 1.1
+
+
+def test_label_escaping():
+    reg = om.get_registry()
+    reg.counter("esc_total").inc(key='we"ird\nname\\x')
+    text = reg.render()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("esc_total{"))
+    assert '\\"' in line and "\\n" in line and "\\\\" in line
+    # the raw newline must NOT appear inside the sample line
+    assert "\n" not in line
+
+
+# ---------------------------------------------------------- parity
+
+
+def test_supervisor_registry_snapshot_parity():
+    sup = DispatchSupervisor()
+    for _ in range(3):
+        assert sup.dispatch(lambda: 1, key="par.k") == 1
+    snap = sup.snapshot()
+    reg = om.get_registry()
+    scope = sup.metrics.scope
+    for name in ("dispatches", "guarded", "retries", "timeouts",
+                 "failovers", "breaker_rejections"):
+        assert reg.value(f"pint_tpu_dispatch_{name}_total",
+                         scope=scope) == snap[name], name
+    assert snap["dispatches"] == 3
+    # first-call compile wall gauge exists for the key
+    assert reg.value("pint_tpu_compile_wall_seconds",
+                     scope=scope, key="par.k") > 0.0
+    # the dispatch-wall histogram row is SHARED with the snapshot
+    lat = snap["latency"]["cpu/par.k"]["dispatch_wall"]
+    m = reg.get("pint_tpu_dispatch_wall_seconds")
+    row = m.row(scope=scope, pool="cpu", key="par.k",
+                metric="dispatch_wall")
+    assert row.count == lat["count"] == 3
+
+
+def _workload(n, base):
+    from pint_tpu.serve.workload import build_workload
+
+    return build_workload(n, sizes=(40, 90), base=base,
+                          prebuild=True, entry_name="METR")
+
+
+def test_serve_engine_registry_snapshot_parity():
+    from pint_tpu.serve import ServeEngine
+
+    fresh = _workload(8, base=6100)
+    eng = ServeEngine()
+    futs = [eng.submit(r) for r in fresh()]
+    eng.flush()
+    for f in futs:
+        f.result(timeout=0)
+    snap = eng.metrics.snapshot()
+    reg = om.get_registry()
+    # attempts == submitted on a shed-free run (the shed-rate SLO
+    # denominator counts submit() entries BEFORE any shed decision)
+    assert snap["attempts"] == snap["submitted"] == len(futs)
+    for name in ("attempts", "submitted", "completed", "rejected",
+                 "failed", "deadline_missed", "fallback_single"):
+        assert reg.value(f"pint_tpu_serve_{name}_total",
+                         scope=eng.metrics.scope) == snap[name], name
+    adm = snap["admission"]
+    for name in ("shed_expired", "shed_deadline", "shed_quota",
+                 "shed_overload", "shed_shutdown", "shed_bursts",
+                 "injected_overload"):
+        assert reg.value(f"pint_tpu_admission_{name}_total",
+                         scope=eng.admission.scope) == adm[name], name
+    rt = snap["router"]
+    for pool in ("device", "host"):
+        for name in ("dispatches", "requests", "rows", "demotions"):
+            assert reg.value(f"pint_tpu_router_{name}_total",
+                             scope=eng.router.scope,
+                             pool=pool) == rt[pool][name], (pool,
+                                                           name)
+    # per-bucket counters: sum across classes == engine totals
+    reqs = sum(b.requests for b in eng.metrics.buckets.values())
+    assert reqs == snap["completed"]
+    tot = om.get_registry().get(
+        "pint_tpu_serve_bucket_requests_total")
+    assert sum(v for k, v in tot.series()
+               if ("scope", eng.metrics.scope) in k) == reqs
+    # e2e histogram rows shared with the registry
+    m = reg.get("pint_tpu_serve_latency_seconds")
+    e2e = sum(h.count for h in m.matching(
+        {"scope": eng.metrics.scope, "metric": "e2e"}))
+    assert e2e == len(futs)
+
+
+# ------------------------------------------------- exposition server
+
+
+def test_metrics_server_scrape_and_healthz():
+    om.counter("srv_events_total").inc(7)
+    srv = om.MetricsServer(port=0).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(base + "/metrics",
+                                      timeout=10).read().decode()
+        samples, types = _parse_prom(text)
+        assert samples[("srv_events_total", frozenset())] == 7
+        with urllib.request.urlopen(base + "/healthz",
+                                    timeout=10) as r:
+            h = json.loads(r.read().decode())
+            ctype = r.headers.get("Content-Type")
+        assert h["ok"] is True
+        assert ctype == "application/json"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope", timeout=10)
+    finally:
+        srv.close()
+
+
+def test_scrape_never_blocks_on_the_engine_lock():
+    """THE fleet-readiness contract: /metrics and /healthz answer
+    while the serve engine lock is HELD (a scrape that needed it
+    would deadlock here and time out)."""
+    from pint_tpu.serve import ServeEngine
+
+    fresh = _workload(4, base=6300)
+    eng = ServeEngine(pipeline_depth=2)
+    futs = [eng.submit(r) for r in fresh()]
+    eng.flush()
+    for f in futs:
+        f.result(timeout=0)
+
+    def _health():
+        h = om.default_health()
+        h["pools"] = eng.supervisor.pool_health()
+        return h
+
+    srv = om.MetricsServer(port=0, health_fn=_health).start()
+    out = {}
+    try:
+        assert eng._lock.acquire(timeout=5)
+        try:
+            def scrape():
+                base = f"http://127.0.0.1:{srv.port}"
+                out["metrics"] = urllib.request.urlopen(
+                    base + "/metrics", timeout=10).read().decode()
+                out["health"] = json.loads(urllib.request.urlopen(
+                    base + "/healthz", timeout=10).read().decode())
+
+            th = threading.Thread(target=scrape, daemon=True)
+            th.start()
+            th.join(timeout=10)
+            assert not th.is_alive(), \
+                "scrape blocked while the engine lock was held"
+        finally:
+            eng._lock.release()
+    finally:
+        srv.close()
+    samples, _ = _parse_prom(out["metrics"])
+    key = ("pint_tpu_serve_completed_total",
+           frozenset({("scope", eng.metrics.scope)}))
+    assert samples[key] == len(futs)
+    assert out["health"]["pools"]["host"]["open"] is False
+
+
+# ---------------------------------------------------- SLO watchdog
+
+
+def _latency_spec(**kw):
+    base = dict(name="p99", type="latency",
+                metric="syn_lat_seconds",
+                labels={"metric": "e2e"},
+                objective_ms=8.192,   # = 2^13 us bucket edge
+                target=0.9, fast_s=10.0, slow_s=30.0, burn=2.0,
+                min_events=4, min_samples=2)
+    base.update(kw)
+    return slo.SLOSpec(**base)
+
+
+def test_slo_burn_rate_math_on_synthetic_series(tmp_path):
+    obs.configure(enabled=False, flight_dir=str(tmp_path))
+    reg = om.get_registry()
+    row = reg.histogram("syn_lat_seconds").row(metric="e2e",
+                                               kind="gls")
+    clock = {"t": 0.0}
+    wd = slo.SLOWatchdog(specs=[_latency_spec()], interval_s=5.0,
+                         registry=reg,
+                         clock=lambda: clock["t"])
+
+    def tick_with(good=0, bad=0):
+        for _ in range(good):
+            row.record(0.001)          # 1 ms — inside objective
+        for _ in range(bad):
+            row.record(0.5)            # 500 ms — way outside
+        fired = wd.tick(now=clock["t"])
+        clock["t"] += 5.0
+        return fired
+
+    # windows not covered yet: even all-bad traffic cannot fire
+    assert tick_with(bad=10) == []
+    # healthy traffic long enough to cover the slow window
+    for _ in range(8):
+        assert tick_with(good=10) == []
+    # ONE-sample spike: fast window burns, slow does not -> no fire
+    assert tick_with(bad=10) == []
+    assert tick_with(good=10) == []    # recovered
+    # sustained regression: fires EXACTLY ONCE (latched)
+    fired = []
+    for _ in range(6):
+        fired += tick_with(bad=10)
+    assert fired == ["p99"]
+    assert wd.fires == 1
+    # the flight recorder got the slo_burn dump
+    dumps = list(tmp_path.glob("flight-*slo_burn*p99*.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"] == "slo_burn:p99"
+    assert doc["extra"]["slo"]["burning"] is True
+    # recovery clears the latch; a NEW burn episode fires again
+    for _ in range(8):
+        tick_with(good=10)
+    for _ in range(6):
+        tick_with(bad=10)
+    assert wd.fires == 2
+    st = wd.status()
+    assert st["armed"] and st["fires"] == 2
+    assert st["specs"][0]["name"] == "p99"
+
+
+def test_slo_ratio_and_gauge_specs():
+    reg = om.get_registry()
+    bad = reg.counter("syn_shed_total")
+    tot = reg.counter("syn_submitted_total")
+    g = reg.gauge("syn_overhead_frac")
+    specs = [
+        slo.SLOSpec(name="shed", type="ratio",
+                    bad=["syn_shed_total"],
+                    total=["syn_submitted_total"], budget=0.05,
+                    fast_s=10.0, slow_s=20.0, burn=2.0,
+                    min_events=4),
+        slo.SLOSpec(name="overhead", type="gauge",
+                    metric="syn_overhead_frac", objective=0.1,
+                    budget=0.5, fast_s=10.0, slow_s=20.0, burn=1.5),
+    ]
+    clock = {"t": 0.0}
+    wd = slo.SLOWatchdog(specs=specs, interval_s=5.0, registry=reg,
+                         clock=lambda: clock["t"])
+
+    def tick(shed=0, total=0, frac=0.0):
+        bad.inc(shed)
+        tot.inc(total)
+        g.set(frac)
+        fired = wd.tick(now=clock["t"])
+        clock["t"] += 5.0
+        return fired
+
+    for _ in range(6):
+        assert tick(shed=0, total=10, frac=0.02) == []
+    fired = []
+    for _ in range(5):
+        fired += tick(shed=5, total=10, frac=0.4)
+    assert sorted(set(fired)) == ["overhead", "shed"]
+    assert fired.count("shed") == 1  # latched
+
+
+def test_slo_default_specs_and_config_parsing(monkeypatch):
+    from pint_tpu import config
+
+    monkeypatch.delenv("PINT_TPU_SLO", raising=False)
+    assert config.slo_enabled() is False
+    assert config.slo_specs() == []
+    monkeypatch.setenv("PINT_TPU_SLO", "on")
+    assert config.slo_enabled() is True
+    names = [s.name for s in config.slo_specs()]
+    assert "shed_rate" in names and "e2e_p99_gls" in names
+    # inline JSON: invalid entries warn-and-drop, valid ones survive
+    monkeypatch.setenv("PINT_TPU_SLO", json.dumps([
+        {"name": "ok", "type": "ratio", "bad": ["a"],
+         "total": ["b"]},
+        {"name": "broken", "type": "latency"},     # no metric
+        {"type": "gauge", "metric": "m"},          # no name
+    ]))
+    got = config.slo_specs()
+    assert [s.name for s in got] == ["ok"]
+    # garbage value: warns, watchdog stays off
+    monkeypatch.setenv("PINT_TPU_SLO", "/no/such/file.json")
+    assert config.slo_specs() == []
+    assert config.slo_enabled() is False
+    # interval validation
+    monkeypatch.setenv("PINT_TPU_SLO_INTERVAL_S", "2.5")
+    assert config.slo_interval_s() == 2.5
+    monkeypatch.setenv("PINT_TPU_SLO_INTERVAL_S", "-3")
+    assert config.slo_interval_s() == 10.0
+    monkeypatch.setenv("PINT_TPU_SLO_INTERVAL_S", "banana")
+    assert config.slo_interval_s() == 10.0
+
+
+def test_slo_maybe_start_idempotent(monkeypatch):
+    monkeypatch.setenv("PINT_TPU_SLO", "on")
+    monkeypatch.setenv("PINT_TPU_SLO_INTERVAL_S", "60")
+    w1 = slo.maybe_start()
+    w2 = slo.maybe_start()
+    assert w1 is w2 is slo.get_watchdog()
+    assert slo.status()["armed"] is True
+    slo.reset()
+    assert slo.get_watchdog() is None
+
+
+# ------------------------------------------- validated env parsers
+
+
+def test_f32_mode_parser_behavior_preserving(monkeypatch):
+    from pint_tpu import config
+
+    monkeypatch.delenv("PINT_TPU_JAC", raising=False)
+    assert config.f32_mode("PINT_TPU_JAC") is None        # auto
+    assert config.f32_mode("PINT_TPU_JAC", flag=True) is True
+    assert config.f32_mode("PINT_TPU_JAC", flag=False) is False
+    for v, want in (("f32", True), ("on", True), ("1", True),
+                    ("f64", False), ("off", False), ("0", False)):
+        monkeypatch.setenv("PINT_TPU_JAC", v)
+        assert config.f32_mode("PINT_TPU_JAC") is want, v
+    monkeypatch.setenv("PINT_TPU_JAC", "banana")
+    assert config.f32_mode("PINT_TPU_JAC") is None  # warned, auto
+    # the fit_step resolver sees the same view (CPU backend -> auto
+    # resolves False)
+    from pint_tpu.parallel.fit_step import _resolve_f32
+
+    assert _resolve_f32(None, "PINT_TPU_JAC") is False
+    monkeypatch.setenv("PINT_TPU_JAC", "f32")
+    assert _resolve_f32(None, "PINT_TPU_JAC") is True
+
+
+def test_no_pallas_parser(monkeypatch):
+    from pint_tpu import config
+    from pint_tpu.ops.pallas_kernels import pallas_available
+
+    monkeypatch.delenv("PINT_TPU_NO_PALLAS", raising=False)
+    assert config.no_pallas() is False
+    for v in ("1", "on", "true", "yes"):
+        monkeypatch.setenv("PINT_TPU_NO_PALLAS", v)
+        assert config.no_pallas() is True, v
+        assert pallas_available() is False
+    for v in ("0", "off", "false", "no"):
+        monkeypatch.setenv("PINT_TPU_NO_PALLAS", v)
+        assert config.no_pallas() is False, v
+    monkeypatch.setenv("PINT_TPU_NO_PALLAS", "banana")
+    assert config.no_pallas() is False  # warned, ignored
+
+
+def test_metrics_port_parser(monkeypatch):
+    from pint_tpu import config
+
+    monkeypatch.delenv("PINT_TPU_METRICS_PORT", raising=False)
+    assert config.metrics_port() is None
+    monkeypatch.setenv("PINT_TPU_METRICS_PORT", "0")
+    assert config.metrics_port() == 0
+    monkeypatch.setenv("PINT_TPU_METRICS_PORT", "9095")
+    assert config.metrics_port() == 9095
+    monkeypatch.setenv("PINT_TPU_METRICS_PORT", "99999")
+    assert config.metrics_port() is None
+    monkeypatch.setenv("PINT_TPU_METRICS_PORT", "banana")
+    assert config.metrics_port() is None
+
+
+# ------------------------------------------------- the serve daemon
+
+
+def test_daemon_metrics_port_flag_and_registry_stats(capsys,
+                                                     monkeypatch):
+    from pint_tpu.scripts.pint_serve import main
+
+    monkeypatch.delenv("PINT_TPU_METRICS_PORT", raising=False)
+    assert main(["--metrics-port", "0"],
+                stdin=[json.dumps({"kind": "stats",
+                                   "id": "s1"})]) == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    events = [x for x in lines
+              if x.get("event") == "metrics_server"]
+    assert len(events) == 1 and events[0]["port"] > 0
+    stats = next(x for x in lines if x.get("kind") == "stats")
+    assert "registry" in stats
+    assert any(k.startswith("pint_tpu_serve_")
+               for k in stats["registry"])
+    session = next(x for x in lines
+                   if x.get("metric") == "serve_session")
+    assert session["metrics_port"] == events[0]["port"]
+
+
+# --------------------------------------------------- bench_regress
+
+
+def _load_bench_regress():
+    import importlib.util
+    import os
+
+    p = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "bench_regress.py")
+    spec = importlib.util.spec_from_file_location("_t_bregress", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_regress_verdicts(tmp_path):
+    br = _load_bench_regress()
+    baseline = {"artifacts": {
+        "m1": {"only_backend": "cpu", "fields": {
+            "value": {"baseline": 100.0, "rel_tol": 0.5,
+                      "direction": "higher"},
+            "wall_ms": {"max": 50},
+            "nested.x": {"min": 1},
+        }}}}
+    ok = {"metric": "m1", "backend": "cpu", "value": 80.0,
+          "wall_ms": 10, "nested": {"x": 2}}
+    assert br.evaluate(ok, baseline)["verdict"] == "pass"
+    slow = dict(ok, value=40.0)       # < 100*(1-0.5)
+    v = br.evaluate(slow, baseline)
+    assert v["verdict"] == "fail"
+    assert any(c["verdict"] == "fail" and c["field"] == "value"
+               for c in v["checks"])
+    hot = dict(ok, wall_ms=80)
+    assert br.evaluate(hot, baseline)["verdict"] == "fail"
+    # missing field skips its check, never fails the record
+    missing = {"metric": "m1", "backend": "cpu", "value": 90.0}
+    assert br.evaluate(missing, baseline)["verdict"] == "pass"
+    # wrong backend / unknown metric skip
+    tpu = dict(ok, backend="tpu")
+    assert br.evaluate(tpu, baseline)["verdict"] == "skip"
+    assert br.evaluate({"metric": "zzz"}, baseline)["verdict"] \
+        == "skip"
+    # last_json_line: the committed wire contract
+    text = "log line\n{broken\n" + json.dumps(ok) + "\n"
+    assert br.last_json_line(text)["metric"] == "m1"
+    assert br.last_json_line("no json at all") is None
+    # CLI over an artifact file against the COMMITTED baseline:
+    # a north-star-shaped record inside its bands passes
+    art = tmp_path / "a.json"
+    art.write_text(json.dumps({
+        "metric": "gls_fit_iteration_throughput_10k_toas_40p",
+        "backend": "cpu", "value": 300000.0, "step_ms": 30.0,
+        "vs_baseline": 120.0}) + "\n")
+    assert br.main([str(art)]) == 0
+    art.write_text(json.dumps({
+        "metric": "gls_fit_iteration_throughput_10k_toas_40p",
+        "backend": "cpu", "value": 5000.0, "step_ms": 30.0,
+        "vs_baseline": 120.0}) + "\n")
+    assert br.main([str(art)]) == 1
+
+
+def test_bench_artifact_embeds_regress_block():
+    import bench
+
+    rec = bench.attach_regress({
+        "metric": "gls_fit_iteration_throughput_10k_toas_40p",
+        "backend": "cpu", "value": 300000.0, "step_ms": 30.0,
+        "vs_baseline": 120.0})
+    assert rec["regress"]["verdict"] == "pass"
+    # unknown metric: labeled skip, never a failure
+    rec2 = bench.attach_regress({"metric": "unknown_thing"})
+    assert rec2["regress"]["verdict"] == "skip"
+    # setdefault: a subprocess-carried verdict is not overwritten
+    rec3 = bench.attach_regress({
+        "metric": "gls_fit_iteration_throughput_10k_toas_40p",
+        "regress": {"verdict": "fail"}})
+    assert rec3["regress"] == {"verdict": "fail"}
+
+
+# ------------------------------------------------------ new gauges
+
+
+def test_aot_hit_miss_and_compile_gauges(tmp_path, monkeypatch):
+    """AOT restore hits/misses ride the registry and the snapshot;
+    jit-cache-size pull gauge produces samples at scrape time."""
+    from pint_tpu.serve import ServeEngine
+
+    fresh = _workload(3, base=6500)
+    aot = str(tmp_path / "aot")
+    eng = ServeEngine(aot_dir=aot)
+    futs = [eng.submit(r) for r in fresh()]
+    eng.flush()
+    for f in futs:
+        f.result(timeout=0)
+    snap = eng.metrics.snapshot()["restart"]["aot"]
+    assert snap["exported"] >= 1
+    assert snap["misses"] >= 1        # cold engine: no restored hits
+    assert snap["hits"] == 0
+    # warm restart: the restored classes now HIT
+    eng2 = ServeEngine(aot_dir=aot)
+    futs2 = [eng2.submit(r) for r in fresh()]
+    eng2.flush()
+    for f in futs2:
+        f.result(timeout=0)
+    snap2 = eng2.metrics.snapshot()["restart"]["aot"]
+    assert snap2["restored"] >= 1
+    assert snap2["hits"] >= 1
+    reg = om.get_registry()
+    assert reg.total("pint_tpu_aot_hits_total") >= 1
+    # pull gauges render at scrape time
+    text = reg.render()
+    assert "pint_tpu_jit_cache_size" in text
+    assert "pint_tpu_serve_compile_count" in text

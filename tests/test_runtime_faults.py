@@ -805,3 +805,157 @@ def test_runtime_env_knobs_parse(monkeypatch):
     assert config.breaker_threshold() == 5
     monkeypatch.setenv("PINT_TPU_BREAKER_THRESHOLD", "banana")
     assert config.breaker_threshold() == 3  # warned, defaulted
+
+
+# ------------------------------------- metrics plane (ISSUE 11)
+
+
+def test_chaos_registry_parity_and_slo_burn_before_breaker(
+        monkeypatch, tmp_path):
+    """ISSUE-11 chaos oracle: an injected latency regression (every
+    dispatch wedged past its watchdog deadline, served via labeled
+    host failover) must fire EXACTLY ONE ``slo_burn:*`` flight dump
+    BEFORE the breaker opens — the post-mortem starts while the
+    regression is happening, not at the breaker-open autopsy. A
+    /metrics scrape MID-BURST returns a parseable exposition
+    consistent with the final counter story, and at the end every
+    counter in the engine's snapshot blocks reads back through the
+    registry with identical values (parity across a chaos run)."""
+    import urllib.request
+
+    from pint_tpu import obs
+    from pint_tpu.obs import metrics as om
+    from pint_tpu.obs import slo
+    from pint_tpu.serve import ServeEngine
+    from pint_tpu.serve.workload import build_workload
+
+    fresh = build_workload(2, sizes=(40, 90), base=7100,
+                           prebuild=True, entry_name="SLOCHAOS")
+    # env BEFORE any dispatch: the per-backend breaker reads its
+    # threshold at construction (first dispatch constructs it)
+    monkeypatch.setenv("PINT_TPU_DISPATCH_DEADLINE_MS", "400")
+    monkeypatch.setenv("PINT_TPU_BREAKER_THRESHOLD", "12")
+    monkeypatch.setenv("PINT_TPU_DISPATCH_RETRIES", "0")
+    # reference pass: warm every compile so the healthy-phase e2e
+    # sits far inside the SLO objective
+    ref_eng = ServeEngine()
+    futs = [ref_eng.submit(r) for r in fresh()]
+    ref_eng.flush()
+    for f in futs:
+        f.result(timeout=0)
+
+    obs.configure(enabled=False, flight_dir=str(tmp_path))
+    eng = ServeEngine()
+    # e2e SLO: objective at the 2^18 us bucket edge (262.144 ms) —
+    # warm healthy requests are ~ms, a deadline-timed-out dispatch
+    # is >= 400 ms, one octave above the objective
+    spec = slo.SLOSpec(
+        name="e2e_p99", type="latency",
+        metric="pint_tpu_serve_latency_seconds",
+        labels={"scope": eng.metrics.scope, "metric": "e2e"},
+        objective_ms=262.144, target=0.9,
+        fast_s=10.0, slow_s=30.0, burn=2.0,
+        min_events=4, min_samples=2)
+    clock = {"t": 0.0}
+    wd = slo.SLOWatchdog(specs=[spec], interval_s=5.0,
+                         clock=lambda: clock["t"])
+    srv = om.MetricsServer(port=0).start()
+
+    def drive_and_tick():
+        fs = [eng.submit(r) for r in fresh()]
+        eng.flush()
+        for f in fs:
+            f.result(timeout=0)
+        fired = wd.tick(now=clock["t"])
+        clock["t"] += 5.0
+        return fired
+
+    def scrape():
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        return urllib.request.urlopen(url, timeout=10).read() \
+            .decode("utf-8")
+
+    def prom_value(text, name, **labels):
+        want = {f'{k}="{v}"' for k, v in labels.items()}
+        for line in text.splitlines():
+            if not line.startswith(name + "{"):
+                continue
+            body = line.split("{", 1)[1].rsplit("}", 1)[0]
+            if want <= set(body.split(",")):
+                return float(line.rsplit(" ", 1)[1])
+        return None
+
+    served = 0
+    try:
+        # healthy phase: cover the slow window with good traffic
+        for _ in range(7):
+            assert drive_and_tick() == []
+            served += 2
+        # degraded phase: every dispatch wedges past the deadline
+        plan = FaultPlan([Fault(match="serve.", kind="hang",
+                                seconds=5.0)])
+        fired_at = None
+        with plan.active():
+            for i in range(3):
+                fired = drive_and_tick()
+                served += 2
+                if fired:
+                    fired_at = i
+                    break
+            assert fired_at is not None, "SLO never fired"
+            # the burn fired BEFORE the breaker opened
+            assert not breaker_for("cpu").is_open
+            slo_dumps = list(tmp_path.glob("flight-*slo_burn*.json"))
+            assert len(slo_dumps) == 1
+            doc = __import__("json").loads(slo_dumps[0].read_text())
+            assert doc["reason"] == "slo_burn:e2e_p99"
+            # mid-burst scrape: parseable, consistent direction
+            mid = scrape()
+            mid_timeouts = prom_value(
+                mid, "pint_tpu_dispatch_timeouts_total",
+                scope=eng.supervisor.metrics.scope)
+            assert mid_timeouts is not None and mid_timeouts >= 1
+            # keep failing until the breaker opens (12 consecutive
+            # unit timeouts; each flush times out ~2 units)
+            for _ in range(10):
+                if breaker_for("cpu").is_open:
+                    break
+                fs = [eng.submit(r) for r in fresh()]
+                eng.flush()
+                for f in fs:
+                    f.result(timeout=0)
+                served += 2
+            assert breaker_for("cpu").is_open
+        # exactly one slo_burn dump, and it predates breaker-open
+        slo_dumps = list(tmp_path.glob("flight-*slo_burn*.json"))
+        brk_dumps = list(tmp_path.glob("flight-*breaker_open*.json"))
+        assert len(slo_dumps) == 1
+        assert len(brk_dumps) >= 1
+        import os as _os
+
+        assert _os.path.getmtime(slo_dumps[0]) <= \
+            min(_os.path.getmtime(p) for p in brk_dumps)
+        # final counter story: scrape == registry == snapshot
+        snap = eng.metrics.snapshot()
+        final = scrape()
+        reg = om.get_registry()
+        for name in ("submitted", "completed", "failed"):
+            want = snap[name]
+            assert reg.value(f"pint_tpu_serve_{name}_total",
+                             scope=eng.metrics.scope) == want, name
+            assert prom_value(
+                final, f"pint_tpu_serve_{name}_total",
+                scope=eng.metrics.scope) == want, name
+        disp = snap["dispatch"]
+        sscope = eng.supervisor.metrics.scope
+        for name in ("timeouts", "failovers", "dispatches",
+                     "breaker_rejections"):
+            assert prom_value(
+                final, f"pint_tpu_dispatch_{name}_total",
+                scope=sscope) == disp[name], name
+        assert mid_timeouts <= disp["timeouts"]
+        assert snap["completed"] == served  # zero silent drops
+        assert disp["timeouts"] >= 12
+        assert disp["failovers"] >= 12
+    finally:
+        srv.close()

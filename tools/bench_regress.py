@@ -1,0 +1,257 @@
+#!/usr/bin/env python
+"""bench_regress — perf-regression gate over the bench artifacts
+(ISSUE 11 satellite).
+
+ROADMAP item 5 ("re-take the on-chip record") is unenforceable
+without a machine check over the numbers the bench drivers emit:
+before this tool a silent 2x regression in the north-star step, the
+serve speedup, or the posterior chain throughput survived until a
+human diffed artifacts. This tool compares the LAST-JSON-line
+artifacts of ``bench.py`` / ``bench_serve.py`` / ``bench_posterior.py``
+against the committed ``BENCH_BASELINE.json`` tolerance bands:
+
+- each baseline entry keys on the artifact's ``metric`` name and
+  lists per-field checks: ``{"min": x}`` / ``{"max": x}`` hard
+  bounds, or ``{"baseline": v, "rel_tol": 0.5, "direction":
+  "higher"}`` relative bands (fail when the value falls outside
+  ``baseline*(1 - rel_tol)`` for higher-is-better fields, or above
+  ``baseline*(1 + rel_tol)`` for lower-is-better ones). Dotted field
+  paths reach into nested blocks (``dispatch_overhead.
+  pipelined_vs_sync``);
+- entries carry ``only_backend`` (default "cpu"): an artifact from a
+  different backend SKIPS rather than judging tunnel numbers against
+  CPU-mesh bands — the on-chip record is tracked by BENCH_TPU.jsonl,
+  not this gate;
+- ``regress_block(rec)`` is the library half the drivers embed: each
+  artifact now carries its own ``regress`` verdict block, so a
+  regressed record is LABELED at the moment it is produced (the
+  dispatch-supervisor "degradation is labeled" policy, applied to
+  performance);
+- the CLI compares artifact files (their last JSON line — the
+  committed wire contract of every driver) or, with ``--run``,
+  executes the three drivers in bounded subprocesses first. Exit 1
+  on any FAIL — the opt-in lane in tools/check.sh
+  ($PINT_TPU_BENCH_REGRESS=1).
+
+Bands are deliberately generous (driver container load varies ~2x
+run to run); the gate exists to catch ORDER-type regressions — a
+lost jit cache, an accidentally-serial drain, a dead coalescing
+path — not 10% noise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "BENCH_BASELINE.json")
+
+# driver -> (argv tail, timeout_s) for --run; every subprocess is
+# timeout-bounded (graftlint G6: a wedged tunnel hangs, never errors)
+DRIVERS = {
+    "bench.py": (["--north-star-only"], 1800),
+    # the DEFAULT 64-request workload: the committed bands (speedup
+    # baseline, occupancy floor) were measured from it, and the
+    # artifact's metric name says 64req — a smaller run would judge
+    # a different workload against them
+    "bench_serve.py": (["--nreq", "64", "--repeats", "2"], 1800),
+    "bench_posterior.py": ([], 1500),
+}
+
+
+def last_json_line(text: str) -> Optional[dict]:
+    """The LAST parseable JSON object line — the artifact contract
+    every bench driver prints. Falls back to parsing the whole text
+    as one JSON document (the committed BENCH_rNN.json wrappers,
+    whose ``parsed`` key holds the record)."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        return None
+    if isinstance(obj, dict):
+        inner = obj.get("parsed")
+        return inner if isinstance(inner, dict) else obj
+    return None
+
+
+def _field(rec: dict, path: str):
+    cur = rec
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _check_one(value, band: dict) -> (str, str):
+    """(verdict, detail) for one field against one band."""
+    if value is None or not isinstance(value, (int, float)):
+        return "skip", "field missing or non-numeric"
+    v = float(value)
+    if "min" in band and v < float(band["min"]):
+        return "fail", f"{v} < min {band['min']}"
+    if "max" in band and v > float(band["max"]):
+        return "fail", f"{v} > max {band['max']}"
+    if "baseline" in band:
+        base = float(band["baseline"])
+        tol = float(band.get("rel_tol", 0.5))
+        direction = band.get("direction", "higher")
+        if direction == "higher":
+            floor = base * (1.0 - tol)
+            if v < floor:
+                return ("fail", f"{v} < {floor:.4g} "
+                                f"(baseline {base} -{tol:.0%})")
+        else:
+            ceil = base * (1.0 + tol)
+            if v > ceil:
+                return ("fail", f"{v} > {ceil:.4g} "
+                                f"(baseline {base} +{tol:.0%})")
+    return "pass", ""
+
+
+def evaluate(rec: dict, baseline: dict) -> dict:
+    """Verdict block for one artifact record against the baseline
+    document. Never raises — an unevaluable record SKIPS with a
+    reason (the regress block must not be able to fail a bench)."""
+    metric = rec.get("metric")
+    entry = (baseline.get("artifacts") or {}).get(metric)
+    if entry is None:
+        return {"verdict": "skip",
+                "reason": f"no baseline entry for metric {metric!r}"}
+    only = entry.get("only_backend", "cpu")
+    if only and rec.get("backend") not in (None, only):
+        return {"verdict": "skip",
+                "reason": f"backend {rec.get('backend')!r} outside "
+                          f"the {only!r} bands (on-chip numbers are "
+                          f"tracked by BENCH_TPU.jsonl)"}
+    checks = []
+    verdict = "pass"
+    for path, band in sorted(entry.get("fields", {}).items()):
+        res, detail = _check_one(_field(rec, path), band)
+        checks.append({"field": path, "verdict": res,
+                       **({"detail": detail} if detail else {})})
+        if res == "fail":
+            verdict = "fail"
+    return {"verdict": verdict, "baseline": os.path.basename(
+        baseline.get("_path", DEFAULT_BASELINE)), "checks": checks}
+
+
+def load_baseline(path: Optional[str] = None) -> dict:
+    path = path or DEFAULT_BASELINE
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    doc["_path"] = path
+    return doc
+
+
+def regress_block(rec: dict, baseline_path: Optional[str] = None
+                  ) -> dict:
+    """The block every bench driver embeds in its artifact. Never
+    raises."""
+    try:
+        return evaluate(rec, load_baseline(baseline_path))
+    except Exception as e:
+        return {"verdict": "skip", "reason": f"baseline unreadable: "
+                                             f"{type(e).__name__}: {e}"}
+
+
+def _run_driver(name: str) -> Optional[dict]:
+    import subprocess
+
+    argv_tail, timeout_s = DRIVERS[name]
+    env = dict(os.environ)
+    env.setdefault("PINT_TPU_BENCH_FALLBACK", "1")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, name)] + argv_tail,
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=REPO, env=env)
+    except Exception as e:
+        print(f"[bench_regress] {name} did not produce an artifact:"
+              f" {e!r}", file=sys.stderr)
+        return None
+    return last_json_line(r.stdout)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/bench_regress.py",
+        description="compare bench artifacts (last JSON line) "
+                    "against BENCH_BASELINE.json tolerance bands")
+    ap.add_argument("artifacts", nargs="*",
+                    help="artifact files (the last JSON line of "
+                         "each is the record)")
+    ap.add_argument("--baseline", default=None)
+    ap.add_argument("--run", action="store_true",
+                    help="run bench.py/bench_serve.py/"
+                         "bench_posterior.py (bounded subprocesses) "
+                         "and gate their fresh artifacts")
+    ap.add_argument("--json", action="store_true",
+                    help="one verdict JSON object per line")
+    args = ap.parse_args(argv)
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except Exception as e:
+        print(f"[bench_regress] cannot read baseline: {e!r}",
+              file=sys.stderr)
+        return 2
+    records: List[dict] = []
+    for path in args.artifacts:
+        try:
+            rec = last_json_line(open(path, encoding="utf-8").read())
+        except OSError as e:
+            print(f"[bench_regress] {path}: {e!r}", file=sys.stderr)
+            return 2
+        if rec is None:
+            print(f"[bench_regress] {path}: no JSON artifact line",
+                  file=sys.stderr)
+            return 2
+        rec["_source"] = path
+        records.append(rec)
+    if args.run:
+        for name in DRIVERS:
+            rec = _run_driver(name)
+            if rec is not None:
+                rec["_source"] = name
+                records.append(rec)
+    if not records:
+        ap.error("no artifacts (pass files or --run)")
+    failed = 0
+    for rec in records:
+        verdict = evaluate(rec, baseline)
+        verdict["metric"] = rec.get("metric")
+        verdict["source"] = rec.get("_source")
+        if args.json:
+            print(json.dumps(verdict))
+        else:
+            line = f"[{verdict['verdict'].upper():4}] " \
+                   f"{rec.get('metric')} ({verdict['source']})"
+            reasons = [f"{c['field']}: {c.get('detail', '')}"
+                       for c in verdict.get("checks", [])
+                       if c["verdict"] == "fail"]
+            if verdict["verdict"] == "skip":
+                reasons = [verdict.get("reason", "")]
+            print(line + ("" if not reasons
+                          else " — " + "; ".join(reasons)))
+        if verdict["verdict"] == "fail":
+            failed += 1
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

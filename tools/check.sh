@@ -28,4 +28,13 @@ echo "[check 3/3] fast test lane (pytest -m 'not slow')"
 timeout -k 10 870 python -m pytest tests/ -q -m "not slow" \
     -p no:cacheprovider
 
+# opt-in perf-regression lane (ISSUE 11): runs the three bench
+# drivers in bounded subprocesses and gates their LAST-JSON-line
+# artifacts against BENCH_BASELINE.json. Off by default — benches
+# take minutes; arm with PINT_TPU_BENCH_REGRESS=1.
+if [[ "${PINT_TPU_BENCH_REGRESS:-0}" == "1" ]]; then
+    echo "[check 4/4, opt-in] bench perf-regression gate"
+    timeout -k 10 3600 python tools/bench_regress.py --run
+fi
+
 echo "[check] all gates green"

@@ -52,6 +52,7 @@ STAGES = {
     "serve_degraded": "serve_degraded_overload",
     "posterior": "posterior_whole_chain_vs_per_step",
     "trace": "trace_capture_north_star_plus_serve",
+    "metrics": "serve_metrics_plane",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
 ATTR_VARIANTS = ("production", "no_hybrid_jac", "jac_f64",
@@ -384,6 +385,71 @@ def stage_trace(backend):
     print(json.dumps(rec), flush=True)
 
 
+def stage_metrics(backend):
+    """Metrics-plane scrape during a live-tunnel serve window
+    (ISSUE 11): drive a coalesced serve workload with the /metrics
+    exposition live, scrape it + the SLO watchdog snapshot, and
+    ledger the parse/parity evidence — the on-chip proof that the
+    pull surface works against real tunnel-latency dispatches."""
+    import urllib.request
+
+    from pint_tpu import obs
+    from pint_tpu.obs import metrics as om
+    from pint_tpu.obs.slo import SLOSpec, SLOWatchdog
+
+    obs.reset()  # fresh registry: the scrape counts THIS window
+    srv = om.MetricsServer(port=0).start()
+    wd = SLOWatchdog(specs=[SLOSpec(
+        name="e2e_p99_gls", type="latency",
+        metric="pint_tpu_serve_latency_seconds",
+        labels={"metric": "e2e", "kind": "gls"},
+        objective_ms=5000.0, target=0.99, fast_s=5.0, slow_s=20.0)],
+        interval_s=1.0)
+    try:
+        from pint_tpu.serve import ServeEngine
+        from pint_tpu.serve.workload import build_workload
+
+        eng = ServeEngine()
+        futs = [eng.submit(r) for r in build_workload(
+            16, sizes=(40, 90), base=5300, prebuild=True,
+            entry_name="METRICS")()]
+        eng.flush()
+        for f in futs:
+            f.result(timeout=0)
+        wd.tick()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=30) as r:
+            text = r.read().decode("utf-8")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz",
+                timeout=30) as r:
+            health = json.loads(r.read().decode("utf-8"))
+        series = sum(1 for ln in text.splitlines()
+                     if ln and not ln.startswith("#"))
+        completed = om.get_registry().value(
+            "pint_tpu_serve_completed_total",
+            scope=eng.metrics.scope)
+        snap = eng.metrics.snapshot()
+        rec = {"metric": STAGES["metrics"], "backend": backend,
+               "unit": "series", "value": series,
+               "scrape_bytes": len(text),
+               "completed": snap["completed"],
+               "registry_completed": int(completed),
+               "parity_ok": int(completed) == snap["completed"],
+               "healthz_ok": bool(health.get("ok")),
+               "slo": wd.status()}
+    finally:
+        srv.close()
+        obs.reset()
+    if not rec.get("parity_ok"):
+        raise RuntimeError(
+            "registry-vs-snapshot parity failed in the metrics "
+            "stage; stage stays on the to-do list")
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
 def _block(jitted, args):
     import jax
 
@@ -423,6 +489,8 @@ def run_stage(name, backend):
         stage_posterior(backend)
     elif name == "trace":
         stage_trace(backend)
+    elif name == "metrics":
+        stage_metrics(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "
