@@ -1003,11 +1003,24 @@ def config4_j0613like_fullcov():
 
     tnp = time_fn(np_once, reps=3)
     log(f"  config4: fullcov kernel {t * 1e3:.1f} ms, numpy "
-        f"{tnp * 1e3:.1f} ms")
+        f"{tnp * 1e3:.1f} ms (accuracy cross-check, not a perf "
+        f"config)")
+    # VERDICT weak #6 (ISSUE 12 satellite): config 4's status is
+    # recorded IN the artifact — the dense O(N^2) full-covariance
+    # kernel exists to cross-check the basis-Woodbury algebra, it
+    # never beat numpy at 2k and the streaming matrix-free path
+    # (gls_streaming_scan) supersedes it as the large-N story.
     return {"metric": "config4_j0613like_fullcov_gls_2k",
             "value": round(n / t, 1), "unit": "TOA/s",
             "vs_baseline": round(tnp / t, 2),
-            "solve_ms": round(t * 1e3, 2)}
+            "solve_ms": round(t * 1e3, 2),
+            "status": "accuracy_cross_check",
+            "rationale": ("dense O(N^2) full-covariance solve kept "
+                          "as an algebra cross-check only: it never "
+                          "beat the numpy mirror at this size, and "
+                          "the matrix-free streaming path "
+                          "(gls_streaming_scan) is the large-N "
+                          "configuration")}
 
 
 def config5_pta():
@@ -1077,6 +1090,183 @@ def late_tpu_probe(extra_timeout: float = 900.0):
             return d
     log(f"late probe: no parseable result (rc={r.returncode})")
     return None
+
+
+def build_problem_streaming():
+    """The --scan streaming model: the north-star model WITHOUT
+    ECORR. The streaming path handles ECORR (segment boundary carry,
+    oracle-tested), but the DENSE host oracle the acceptance gate
+    demands (gls_solve_np) would need the quantization basis as
+    ~N/4 dense columns — unbuildable at these N. Red noise + EFAC/
+    EQUAD keeps q fixed at 2*TNREDC so the oracle stays dense-able
+    to 131k while N scales unbounded."""
+    import numpy as np
+
+    span0, span1 = 53000.0, 57000.0
+    par = [
+        "PSR J0000+0001", "RAJ 12:00:00.0 1", "DECJ 30:00:00.0 1",
+        "PMRA 2.0 1", "PMDEC -3.0 1", "PX 1.2 1",
+        "F0 300.123456789 1", "F1 -1.0e-15 1", "F2 1e-26 1",
+        "DM 20.0", "DM1 1e-4", "DM2 1e-6",
+        "PEPOCH 55000", "POSEPOCH 55000", "DMEPOCH 55000",
+        "TZRMJD 55000.1", "TZRSITE @", "TZRFRQ 1400", "UNITS TDB",
+        "EFAC -be X 1.1", "EQUAD -be X 0.3",
+        "TNREDAMP -13.7", "TNREDGAM 3.5", "TNREDC 15",
+    ]
+    _add_dmx(par, span0, span1, NDMX)
+    mjds = _clustered_mjds(span0, span1, NTOA)
+    freqs = np.tile([1400.0, 1400.0, 820.0, 820.0], NTOA // 4)
+    return _make_model_toas(par, mjds, freqs, seed=1,
+                            flag_sets={"be": lambda i: "X"})
+
+
+def _streaming_oracle(model, toas, dp, chi2_fit):
+    """Dense host GLS (gls_solve_np — the reference-algorithm numpy
+    mirror) vs the streaming CG solution: max |d dparams| in sigma
+    and the relative chi2 error. Only callable where the dense
+    (N, p+q) host assembly is sane (the <=131k gate)."""
+    import numpy as np
+
+    from pint_tpu.gls import gls_solve_np
+    from pint_tpu.residuals import Residuals
+
+    r = Residuals(toas, model).time_resids
+    M, names, _ = model.designmatrix(toas, incoffset=True)
+    nvec = model.scaled_toa_uncertainty(toas) ** 2
+    F = model.noise_model_designmatrix(toas)
+    phi = model.noise_model_basis_weight(toas)
+    x, cov, chi2, _ = gls_solve_np(np.asarray(M), np.asarray(F),
+                                   np.asarray(phi), np.asarray(r),
+                                   np.asarray(nvec))
+    sig = np.sqrt(np.abs(np.diag(cov)))
+    # gls_solve_np returns xhat (correction to ADD is -xhat) and
+    # the LINEARIZED post-fit chi2 — compare like with like
+    return (float(np.max(np.abs(dp - (-x)) / sig)),
+            float(abs(chi2_fit - chi2) / abs(chi2)))
+
+
+def _peak_rss_mb():
+    import resource
+
+    return round(resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss / 1024.0, 1)
+
+
+def scan_streaming():
+    """--scan extension (ISSUE 12): the matrix-free streaming path's
+    N-scaling curve to 1M TOAs on a single chip. Each point is one
+    full accumulate+CG pass (the unit a fit iterates); the 1M point
+    additionally runs a full StreamingGLSFitter downhill fit. The
+    CPU equality oracle (streaming CG vs dense host gls_solve_np) is
+    ASSERTED at every size <= 131072 — an oracle failure fails the
+    bench loudly rather than shipping a wrong curve."""
+    import gc
+
+    import jax
+    import numpy as np
+
+    from pint_tpu.parallel.streaming import StreamingGLS
+
+    global NTOA
+    out = []
+    fit_block = None
+    for n in (10_000, 30_000, 100_000, 300_000, 1_000_000):
+        NTOA = n
+        try:
+            model, toas = build_problem_streaming()
+            sg = StreamingGLS(model, toas)
+            t0 = time.perf_counter()
+            state = sg.accumulate(sg.th0, sg.tl0)
+            dp, cov, chi2, chi2r, xf, ok, iters = sg.solve(state)
+            wall = time.perf_counter() - t0
+            # second pass on the warm compile = the honest per-pass
+            # cost a fit iteration pays
+            t0 = time.perf_counter()
+            state = sg.accumulate(sg.th0, sg.tl0)
+            _ = sg.solve(state)
+            wall = min(wall, time.perf_counter() - t0)
+            P = sg.p + sg.q
+            rec = {"metric": "gls_streaming_scan", "ntoa": n,
+                   "value": round(n / wall, 1), "unit": "TOA/s",
+                   "pass_wall_ms": round(wall * 1e3, 1),
+                   "chunk": sg.chunk, "nchunks": sg.nchunks,
+                   "cg_iters": int(iters), "cg_ok": bool(ok),
+                   "nparam": sg.p, "nbasis": sg.q,
+                   "state_bytes": int((P * P + 4 * P + 16) * 8),
+                   "peak_rss_mb": _peak_rss_mb(),
+                   "backend": jax.default_backend()}
+            if n <= 131_072:
+                worst_sig, chi_rel = _streaming_oracle(
+                    model, toas, dp, chi2)
+                rec["oracle_max_sigma"] = float(
+                    f"{worst_sig:.3e}")
+                rec["oracle_chi2_rel"] = float(f"{chi_rel:.3e}")
+                assert ok and worst_sig < 1e-6 and chi_rel < 1e-8, (
+                    f"streaming oracle FAILED at N={n}: "
+                    f"{worst_sig=} {chi_rel=} {ok=}")
+                log(f"N={n}: streaming {rec['pass_wall_ms']} ms/pass"
+                    f" ({rec['value']:.0f} TOA/s), oracle "
+                    f"{worst_sig:.2e} sigma")
+            else:
+                log(f"N={n}: streaming {rec['pass_wall_ms']} ms/pass"
+                    f" ({rec['value']:.0f} TOA/s), chunk "
+                    f"{sg.chunk} x {sg.nchunks}")
+            if n == 1_000_000:
+                # the acceptance headline: a complete million-TOA
+                # single-chip downhill fit
+                from pint_tpu.gls import StreamingGLSFitter
+
+                import copy as _copy
+
+                fm = _copy.deepcopy(model)
+                f = StreamingGLSFitter(toas, fm)
+                t0 = time.perf_counter()
+                chi2_fit = f.fit_toas(maxiter=8)
+                fit_wall = time.perf_counter() - t0
+                fit_block = {
+                    "fit_wall_s": round(fit_wall, 2),
+                    "passes": f.passes,
+                    "chi2": round(float(chi2_fit), 2),
+                    "reduced_chi2": round(
+                        f.stats.reduced_chi2, 4),
+                    "converged": bool(f.converged),
+                    "toas_per_sec": round(
+                        f.stats.toas_per_sec, 1)}
+                log(f"1M-TOA fit: {fit_wall:.1f} s, "
+                    f"{f.passes} passes, red-chi2 "
+                    f"{f.stats.reduced_chi2:.3f}")
+            if rec["backend"] == "tpu":
+                tpu_record_append(rec)
+            out.append(rec)
+        except AssertionError:
+            raise
+        except Exception as e:
+            log(f"  streaming scan point N={n} failed: {e!r}")
+            out.append({"metric": "gls_streaming_scan", "ntoa": n,
+                        "error": repr(e)})
+        finally:
+            gc.collect()
+    for rec in out:
+        print(json.dumps(rec))
+    # the banded summary artifact: the 1M point + the fit block +
+    # the memory curve, judged by the regress gate
+    head = [r for r in out if r.get("ntoa") == 1_000_000
+            and "value" in r]
+    if head:
+        summary = dict(head[0], metric="gls_streaming_scan_1m")
+        if fit_block is not None:
+            summary["fit"] = fit_block
+        summary["memory_curve"] = [
+            {"ntoa": r["ntoa"], "peak_rss_mb": r["peak_rss_mb"],
+             "state_bytes": r["state_bytes"]}
+            for r in out if "peak_rss_mb" in r]
+        oracles = [r["oracle_max_sigma"] for r in out
+                   if "oracle_max_sigma" in r]
+        summary["oracle_worst_sigma"] = max(oracles) if oracles \
+            else None
+        print(json.dumps(attach_dispatch_counters(summary)))
+        if summary["backend"] == "tpu":
+            tpu_record_append(summary)
 
 
 def scan_nscaling():
@@ -1164,6 +1354,9 @@ def main():
 
     if "--scan" in sys.argv:
         scan_nscaling()
+        # ISSUE 12: the matrix-free streaming curve to 1M TOAs (its
+        # banded summary line prints LAST — the --scan artifact)
+        scan_streaming()
         return
 
     backend = jax.default_backend()

@@ -34,9 +34,11 @@ ALLOWLIST = [
     # the closure are (re)built together — the exact situation the
     # pv-convention's "values are runtime args" rule is relaxing for.
     dict(rule="G10", file="pint_tpu/parallel/fit_step.py",
-         match="def step_fn(th, tl, fh, fl, batch, cache",
+         match="def parts_fn(th, tl, fh, fl, batch, cache",
          max_hits=2,
-         why="step_fn captures `afn`/`f0_ref`: the anchored delta-"
+         why="parts_fn (the assembly half the step and the "
+             "streaming accumulator share) captures `afn`/`f0_ref`: "
+             "the anchored delta-"
              "phase convention — build_anchor computes the exact "
              "reference ONCE on the host and the step's (th, tl) "
              "arguments carry only theta - theta_ref; the anchor "

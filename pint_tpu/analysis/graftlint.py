@@ -928,6 +928,8 @@ G13_COUNTER_NAMES = frozenset({
     "exported", "restored", "export_errors", "restore_errors",
     "hits", "misses", "replayed", "compactions", "dumps",
     "suppressed",
+    # streaming GLS / append serving (ISSUE 12)
+    "chunk_dispatches", "cg_solves", "cold_builds", "rank_updates",
 })
 
 

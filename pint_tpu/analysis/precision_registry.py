@@ -47,14 +47,14 @@ DEMOTIONS = [
              "relative accuracy (they feed equilibrated normal "
              "equations); tests/test_jac32.py is the CPU equality "
              "oracle. Gate lives at the call sites: _tree_to32 is "
-             "invoked only inside step_fn's `if jac32:` block."),
+             "invoked only inside parts_fn's `if jac32:` block."),
     dict(file="pint_tpu/parallel/fit_step.py", func="_split32",
          flag="jac32", guard=None,
          why="device-side f64 -> (f32, f32) error-free split of the "
              "step's parameter-pair inputs for the f32 Jacobian "
              "re-trace (splitting, not truncating). Gate lives at "
-             "the call sites inside step_fn's `if jac32:` block."),
-    dict(file="pint_tpu/parallel/fit_step.py", func="step_fn",
+             "the call sites inside parts_fn's `if jac32:` block."),
+    dict(file="pint_tpu/parallel/fit_step.py", func="parts_fn",
          flag="jac32", guard="jac32", max_hits=7,
          why="the f32 Jacobian block of the production step: batch/"
              "cache/scale/f0/valid demote together so the WHOLE "

@@ -19,6 +19,7 @@ from typing import Optional
 
 __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "obs_override", "enable_compile_cache", "solve_device",
+           "solve_streaming", "stream_chunk",
            "solve_scope", "dispatch_rtt_ms", "auto_steps_per_dispatch",
            "remeasure_dispatch_rtt", "dispatch_deadline_ms",
            "dispatch_rtt_override_ms",
@@ -278,6 +279,69 @@ def breaker_probe_timeout_s() -> float:
     never does)."""
     return max(1.0, float(_env_number(
         "PINT_TPU_BREAKER_PROBE_TIMEOUT_S", 150.0)))
+
+
+def solve_streaming() -> int:
+    """TOA-count threshold above which ``Fitter.auto`` picks the
+    matrix-free streaming GLS path (``parallel.streaming``) over the
+    dense device/host fitters ($PINT_TPU_STREAM_MIN_TOA; 0 disables
+    the route entirely). Default 200k: comfortably above the largest
+    dense shape the device memory plan was validated at (the 131k
+    sharded oracle) and below where a dense (N, p+q) whitened design
+    stops fitting in HBM. Validated finite positive int — a bad
+    value warns once and falls back (the
+    ``dispatch_rtt_override_ms`` convention)."""
+    v = _env_number("PINT_TPU_STREAM_MIN_TOA", 200_000, cast=int)
+    v = int(v)
+    if v < 0:
+        raw = os.environ.get("PINT_TPU_STREAM_MIN_TOA")
+        key = ("PINT_TPU_STREAM_MIN_TOA", f"range:{raw}")
+        if key not in _WARNED_ENV:
+            _WARNED_ENV.add(key)
+            from pint_tpu.logging import log
+
+            log.warning("$PINT_TPU_STREAM_MIN_TOA=%r is negative; "
+                        "using 200000", raw)
+        return 200_000
+    return v
+
+
+def stream_chunk(ntoa: int) -> int:
+    """Streaming-accumulator chunk length for an ``ntoa``-TOA fit
+    ($PINT_TPU_STREAM_CHUNK): a POWER OF TWO, because the chunk
+    length is the compile key of the chunk kernel — the whole-fit-K
+    quantization discipline (auto_steps_per_dispatch): a raw
+    ceil(N/k) would compile one executable per distinct N, while the
+    quantized set stays bounded. Default: the smallest power of two
+    >= ntoa/8 clamped to [4096, 65536] (>=8 chunks keeps per-chunk
+    padding waste <12.5%; the 65536 cap bounds the (chunk, p+q)
+    device working set). A pinned override is validated (finite
+    positive int, warn-and-ignore otherwise) and rounded UP to the
+    nearest power of two in [256, 131072] so a typo can never
+    un-quantize the compile keys."""
+    env = _env_number("PINT_TPU_STREAM_CHUNK", None, cast=int)
+    if env is not None:
+        v = int(env)
+        if v <= 0:
+            raw = os.environ.get("PINT_TPU_STREAM_CHUNK")
+            key = ("PINT_TPU_STREAM_CHUNK", f"range:{raw}")
+            if key not in _WARNED_ENV:
+                _WARNED_ENV.add(key)
+                from pint_tpu.logging import log
+
+                log.warning("$PINT_TPU_STREAM_CHUNK=%r is not a "
+                            "positive chunk length; using the auto "
+                            "size", raw)
+        else:
+            k = 256
+            while k < v and k < 131072:
+                k *= 2
+            return k
+    k = 4096
+    target = -(-int(ntoa) // 8)
+    while k < target and k < 65536:
+        k *= 2
+    return k
 
 
 def solve_device(ntoa: int):

@@ -197,11 +197,22 @@ class Fitter:
 
     @staticmethod
     def auto(toas, model, downhill=True, device=None, serve=None,
-             **kw):
+             streaming=None, **kw):
         """Pick a fitter from model contents and data (reference:
         Fitter.auto): wideband when TOAs carry -pp_dm DM channels, GLS
         when correlated-noise components are present, WLS otherwise;
         downhill wrappers by default.
+
+        ``streaming`` selects the matrix-free StreamingGLSFitter
+        (chunked normal-equation accumulation + preconditioned CG,
+        ISSUE 12) whose peak device memory is O(chunk + (p+q)^2) —
+        the million-TOA path. Default: auto-on for narrowband
+        downhill fits at or above the ``config.solve_streaming`` TOA
+        threshold ($PINT_TPU_STREAM_MIN_TOA, default 200k; 0
+        disables), where the dense (N, p+q) whitened design stops
+        being a sane device allocation; explicit True/False
+        overrides. An explicit ``device=True`` wins over the auto
+        route (never over ``streaming=True``).
 
         ``serve`` routes the fit through a running
         ``pint_tpu.serve.ServeEngine``: the returned ServeGLSFitter
@@ -243,6 +254,22 @@ class Fitter:
 
             return ServeGLSFitter(toas, model, engine=serve, **kw)
         wideband = has_wideband_dm(toas)
+        if streaming is None:
+            from pint_tpu.config import solve_streaming
+
+            thresh = solve_streaming()
+            streaming = (downhill and not wideband and device is not
+                         True and thresh > 0
+                         and toas.ntoas >= thresh)
+        if streaming:
+            if wideband:
+                raise ValueError(
+                    "streaming=True cannot fit wideband TOAs (the "
+                    "streaming accumulator has no stacked [time; DM] "
+                    "system); use the dense wideband fitters")
+            from pint_tpu.gls import StreamingGLSFitter
+
+            return StreamingGLSFitter(toas, model, **kw)
         if device and not downhill:
             raise ValueError(
                 "device=True requires downhill=True: the device fit "

@@ -52,12 +52,17 @@ class EcorrOverlapError(ValueError):
     """A TOA fell into two ECORR epochs (overlapping masks)."""
 
 
-def _tdb_seconds(toas) -> np.ndarray:
+def _tdb_seconds(toas, ref_day=None) -> np.ndarray:
     """TDB seconds since the first TOA's day (f64 is ample for a noise
-    basis: sub-ns phase error on multi-decade spans)."""
+    basis: sub-ns phase error on multi-decade spans). ``ref_day``
+    pins the zero point to another dataset's first day — with the
+    Tspan pin, the serve append path's basis-ALIGNMENT contract
+    (a time shift rotates each Fourier sin/cos pair, so rows built
+    against a different epoch describe rotated columns that cannot
+    extend a cached Gram)."""
     if toas.tdb_day is None:
         raise ValueError("TOAs need compute_TDBs() before noise bases")
-    day0 = toas.tdb_day.min()
+    day0 = toas.tdb_day.min() if ref_day is None else ref_day
     return ((toas.tdb_day - day0) + toas.tdb_frac[0]
             + toas.tdb_frac[1]) * 86400.0
 
@@ -145,8 +150,17 @@ class NoiseComponent(Component):
         """Transform per-TOA wideband-DM variance [(pc/cm^3)^2]."""
         return sigma2
 
-    def noise_basis_weight(self, toas):
-        """(F (N,q), phi (q,)) for basis components, else None."""
+    def noise_basis_weight(self, toas, tspan=None,
+                           tref_day=None):
+        """(F (N,q), phi (q,)) for basis components, else None.
+
+        ``tspan`` [s] pins the Fourier fundamental 1/T instead of
+        deriving it from the passed TOAs' own span — the
+        basis-ALIGNMENT contract of the serve append path (ISSUE
+        12): rows appended to a cached accumulated system must be
+        evaluated on the ORIGINAL span's frequencies, or their
+        columns describe a different GP than the cached Gram.
+        Ignored by non-Fourier bases (ECORR quantization)."""
         return None
 
 
@@ -284,7 +298,8 @@ class EcorrNoise(NoiseComponent):
         self.setup()
         return p
 
-    def noise_basis_weight(self, toas):
+    def noise_basis_weight(self, toas, tspan=None,
+                           tref_day=None):
         mjd = toas.get_mjds()
         Us, ws = [], []
         for name in self.ecorrs:
@@ -383,13 +398,14 @@ class PLRedNoise(NoiseComponent):
             raise ValueError("red-noise amplitude set without index "
                              "(TNREDGAM/RNIDX)")
 
-    def noise_basis_weight(self, toas):
+    def noise_basis_weight(self, toas, tspan=None,
+                           tref_day=None):
         A, gamma = self.amplitude_gamma()
         if A is None:
             return None
         nmodes = int(self.TNREDC.value or 30)
-        t = _tdb_seconds(toas)
-        F, freqs = create_fourier_design_matrix(t, nmodes)
+        t = _tdb_seconds(toas, ref_day=tref_day)
+        F, freqs = create_fourier_design_matrix(t, nmodes, Tspan=tspan)
         df = freqs[0]
         phi = powerlaw(freqs, A, gamma) * df
         return F, phi
@@ -439,14 +455,15 @@ class PLDMNoise(NoiseComponent):
             "TNDMC", value=30, aliases=["TNDMC"],
             description="number of DM Fourier modes"))
 
-    def noise_basis_weight(self, toas):
+    def noise_basis_weight(self, toas, tspan=None,
+                           tref_day=None):
         if self.TNDMAMP.value is None:
             return None
         A = 10.0 ** self.TNDMAMP.value
         gamma = self.TNDMGAM.value
         nmodes = int(self.TNDMC.value or 30)
-        t = _tdb_seconds(toas)
-        F, freqs = create_fourier_design_matrix(t, nmodes)
+        t = _tdb_seconds(toas, ref_day=tref_day)
+        F, freqs = create_fourier_design_matrix(t, nmodes, Tspan=tspan)
         scale = (self.REF_FREQ_MHZ / toas.get_freqs()) ** 2
         F = F * scale[:, None]
         df = freqs[0]
@@ -496,14 +513,15 @@ class PLChromNoise(NoiseComponent):
                 self.TNCHROMGAM.value is None:
             raise ValueError("TNCHROMAMP set without TNCHROMGAM")
 
-    def noise_basis_weight(self, toas):
+    def noise_basis_weight(self, toas, tspan=None,
+                           tref_day=None):
         if self.TNCHROMAMP.value is None:
             return None
         A = 10.0 ** self.TNCHROMAMP.value
         gamma = self.TNCHROMGAM.value
         nmodes = int(self.TNCHROMC.value or 30)
-        t = _tdb_seconds(toas)
-        F, freqs = create_fourier_design_matrix(t, nmodes)
+        t = _tdb_seconds(toas, ref_day=tref_day)
+        F, freqs = create_fourier_design_matrix(t, nmodes, Tspan=tspan)
         scale = (self.REF_FREQ_MHZ / toas.get_freqs()) ** self._alpha()
         F = F * np.where(np.isfinite(scale), scale, 0.0)[:, None]
         df = freqs[0]
@@ -544,7 +562,8 @@ class PLSWNoise(NoiseComponent):
                 self.TNSWGAM.value is None:
             raise ValueError("TNSWAMP set without TNSWGAM")
 
-    def noise_basis_weight(self, toas):
+    def noise_basis_weight(self, toas, tspan=None,
+                           tref_day=None):
         if self.TNSWAMP.value is None:
             return None
         parent = getattr(self, "_parent", None)
@@ -553,8 +572,8 @@ class PLSWNoise(NoiseComponent):
         A = 10.0 ** self.TNSWAMP.value
         gamma = self.TNSWGAM.value
         nmodes = int(self.TNSWC.value or 10)
-        t = _tdb_seconds(toas)
-        F, freqs = create_fourier_design_matrix(t, nmodes)
+        t = _tdb_seconds(toas, ref_day=tref_day)
+        F, freqs = create_fourier_design_matrix(t, nmodes, Tspan=tspan)
         # geometry at nominal astrometry (second-order in updates):
         # n_e -> DM conversion normalized at 90-degree elongation, 1 AU
         from pint_tpu.models.components_extra import AU_M, PC_M

@@ -1249,7 +1249,8 @@ class TimingModel:
             sigma2 = c.scale_dm_sigma2(toas, sigma2)
         return np.sqrt(sigma2)
 
-    def noise_model_basis_weight_pairs(self, toas, exclude=()):
+    def noise_model_basis_weight_pairs(self, toas, exclude=(),
+                                       tspan=None, tref_day=None):
         """[(component name, F, phi), ...] for every active basis.
         Cached per (TOA set, noise hyperparameter values, exclude set):
         the bases are static during a least-squares fit (hyperparameters
@@ -1262,7 +1263,7 @@ class TimingModel:
             (p.name, p.value, getattr(p, "key", None),
              tuple(getattr(p, "key_value", ())))
             for c in self.noise_components for p in c.params.values()
-        ) + (exclude,)
+        ) + (exclude, tspan, tref_day)
         cached = self.__dict__.get("_noise_basis_cache")
         # identity check via a held reference (not a bare id(), which
         # CPython reuses after garbage collection) PLUS the mutation
@@ -1279,26 +1280,31 @@ class TimingModel:
             if not getattr(c, "is_basis_noise", False) or \
                     type(c).__name__ in exclude:
                 continue
-            pair = c.noise_basis_weight(toas)
+            pair = c.noise_basis_weight(toas, tspan=tspan,
+                                         tref_day=tref_day)
             if pair is not None:
                 out.append((type(c).__name__, pair[0], pair[1]))
         self._noise_basis_cache = (toas, serial, key, out)
         return out
 
-    def noise_model_designmatrix(self, toas, exclude=()):
+    def noise_model_designmatrix(self, toas, exclude=(), tspan=None,
+                                 tref_day=None):
         """Stacked (N, q) noise basis, or None when no basis is active.
         ``exclude`` drops named components (the fit step excludes the
-        segment-handled ECORR components)."""
-        pairs = self.noise_model_basis_weight_pairs(toas,
-                                                    exclude=exclude)
+        segment-handled ECORR components); ``tspan`` [s] pins the
+        Fourier fundamental (the serve append path's basis-alignment
+        contract — see NoiseComponent.noise_basis_weight)."""
+        pairs = self.noise_model_basis_weight_pairs(
+            toas, exclude=exclude, tspan=tspan, tref_day=tref_day)
         if not pairs:
             return None
         return np.concatenate([F for _, F, _ in pairs], axis=1)
 
-    def noise_model_basis_weight(self, toas, exclude=()):
+    def noise_model_basis_weight(self, toas, exclude=(), tspan=None,
+                                 tref_day=None):
         """Stacked (q,) prior variances matching the designmatrix."""
-        pairs = self.noise_model_basis_weight_pairs(toas,
-                                                    exclude=exclude)
+        pairs = self.noise_model_basis_weight_pairs(
+            toas, exclude=exclude, tspan=tspan, tref_day=tref_day)
         if not pairs:
             return None
         return np.concatenate([phi for _, _, phi in pairs])

@@ -14,9 +14,11 @@ process pool for chi2 grids. Here the parallel axes are TPU-native:
 
 from pint_tpu.parallel.fit_step import (  # noqa: F401
     build_fit_loop,
+    build_fit_parts,
     build_fit_step,
     build_sharded_fit_step,
 )
+from pint_tpu.parallel.streaming import StreamingGLS  # noqa: F401
 from pint_tpu.parallel.pta import (  # noqa: F401
     build_problem,
     fit_pta,

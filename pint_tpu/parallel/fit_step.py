@@ -29,7 +29,7 @@ import numpy as np
 from pint_tpu.ops.dd import DD, dd_add, dd_frac, dd_to_dd32
 from pint_tpu.ops.dd import dd as dd_new
 
-__all__ = ["build_fit_loop", "build_fit_step",
+__all__ = ["build_fit_loop", "build_fit_step", "build_fit_parts",
            "build_sharded_fit_step", "toa_sharding"]
 
 
@@ -114,13 +114,14 @@ def _split32(hi, lo=None):
     return d.hi, d.lo
 
 
-def build_fit_step(model, toas, pad_to: Optional[int] = None,
-                   matmul_f32: Optional[bool] = None,
-                   jac_f32: Optional[bool] = None,
-                   anchored: Optional[bool] = None,
-                   hybrid_jac: Optional[bool] = None,
-                   wideband: bool = False):
-    """(step_fn, args, names): step_fn is pure and jittable,
+def _build_fit_core(model, toas, pad_to: Optional[int] = None,
+                    matmul_f32: Optional[bool] = None,
+                    jac_f32: Optional[bool] = None,
+                    anchored: Optional[bool] = None,
+                    hybrid_jac: Optional[bool] = None,
+                    wideband: bool = False):
+    """(step_fn, parts_fn, args, names, meta): step_fn is pure and
+    jittable,
 
         step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid)
             -> (dparams, cov, chi2, resids)
@@ -366,8 +367,18 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
                 k += 1
         return jnp.stack(out, axis=1)
 
-    def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
-                eid, jvar):
+    def parts_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
+                 eid, jvar):
+        """Design/residual ASSEMBLY half of the step: everything up
+        to (but excluding) the normal-equation solve. Returns
+        (M, Fv, r0, nvec', valid', eid', tmask) where r0 is the
+        masked residual WITHOUT the weighted-mean subtraction (the
+        streaming accumulator applies the mean correction post-hoc
+        from accumulated scalars — exact algebra, see
+        parallel/streaming.py) and tmask marks the valid TIME rows
+        (the rows the mean subtraction acts on; zero on wideband DM
+        rows). The primed outputs are the possibly [time; DM]-stacked
+        versions of the inputs."""
         if anchored_on:
             def phase_f64(thx):
                 fr, _ = afn(thx, tl, fh, fl, batch, cache)
@@ -390,13 +401,10 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
         else:
             f0 = (th[i] + tl[i]) if f0_src[0] == "free" \
                 else (fh[i] + fl[i])
-        w = valid / nvec
-        if incoffset:
-            wmean = jnp.sum(frac * w) / jnp.sum(w)
-            r = (frac - wmean) / f0
-        else:
-            # PHOFF models: the fitted offset replaces mean removal
-            r = frac / f0
+        # NOT mean-subtracted here: the step wrapper below subtracts
+        # the weighted mean (incoffset models) so parts consumers can
+        # accumulate the mean correction exactly instead
+        r = frac / f0
         if jac32:
             # Jacobian via the f32/dd32 re-trace of the same phase
             # chain (see _use_f32_jac). Inputs split device-side so the
@@ -446,7 +454,7 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             M = jnp.concatenate(cols, axis=1)
         r = r * valid
         Fv = F * valid[:, None]
-        r_time = r
+        tmask = valid
         if wideband:
             # stacked [time; DM] rows: DM residuals in f64 (the
             # measurement scale needs it), DM jacobian in the same
@@ -493,17 +501,39 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             # all other bases are zero there
             Fv = jnp.concatenate(
                 [Fv, cache["wb_Fdm"] * valid[:, None]], axis=0)
+            tmask = jnp.concatenate([valid, jnp.zeros_like(valid)])
             valid = jnp.concatenate([valid, valid])
             # DM rows ride the zero-variance 'no epoch' ECORR slot
             eid = jnp.concatenate(
                 [eid, jnp.full_like(eid, nseg - 1)])
+        return M, Fv, r, nvec, valid, eid, tmask
+
+    # jac32 column-scale unscaling vector (identity when jac32 off):
+    # precomputed so the step wrapper and streaming finalize share it
+    sfull_np = np.concatenate([np.ones(noff), scale_np])
+
+    def step_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
+                eid, jvar):
+        M, Fv, r0, nvec2, valid2, eid2, tmask = parts_fn(
+            th, tl, fh, fl, batch, cache, F, phi, nvec, valid, eid,
+            jvar)
+        if incoffset:
+            # weighted-mean subtraction over the valid time rows
+            # (reference Residuals semantics; PHOFF models skip it —
+            # the fitted offset plays that role)
+            wt = tmask / nvec2
+            r = r0 - (jnp.sum(r0 * wt) / jnp.sum(wt)) * tmask
+        else:
+            r = r0
         dp, cov, chi2, _ = _gls_core(
-            M, Fv, phi, r, nvec, valid, eid, jvar, nseg, f32mm=f32mm)
+            M, Fv, phi, r, nvec2, valid2, eid2, jvar, nseg,
+            f32mm=f32mm)
         if jac32:
-            sfull = jnp.concatenate([jnp.ones(noff), s64])
+            sfull = jnp.asarray(sfull_np)
             dp = dp * sfull
             cov = cov * jnp.outer(sfull, sfull)
-        return dp, cov, chi2, r_time
+        # time residuals only (the first N rows of a wideband stack)
+        return dp, cov, chi2, r[:valid.shape[0]]
 
     # captured before the anchored zeroing below: the wideband DM
     # channel rebuilds pv as ref + delta in anchored mode
@@ -534,7 +564,35 @@ def build_fit_step(model, toas, pad_to: Optional[int] = None,
             jnp.asarray(phi_np), jnp.asarray(nvec_np),
             jnp.asarray(valid_np), jnp.asarray(eid_np),
             jnp.asarray(jvar_np))
-    return step_fn, args, (["Offset"] if incoffset else []) + free
+    meta = {"incoffset": incoffset, "nseg": nseg, "f32mm": f32mm,
+            "jac32": jac32, "sfull": sfull_np,
+            "anchored": anchored_on, "wideband": wideband,
+            "has_ecorr": seg is not None}
+    return (step_fn, parts_fn, args,
+            (["Offset"] if incoffset else []) + free, meta)
+
+
+def build_fit_step(model, toas, **flags):
+    """(step_fn, args, names) — the public one-XLA-program fit
+    iteration (see ``_build_fit_core`` for the full contract)."""
+    step_fn, _, args, names, _ = _build_fit_core(model, toas, **flags)
+    return step_fn, args, names
+
+
+def build_fit_parts(model, toas, **flags):
+    """(parts_fn, args, names, meta): the design/residual ASSEMBLY
+    half of the fit step as its own pure jittable function — the unit
+    the streaming normal-equation accumulator maps over fixed-size
+    TOA chunks (``pint_tpu.parallel.streaming``). ``parts_fn`` takes
+    the same 12 arguments as ``step_fn`` and returns
+    ``(M, Fv, r0, nvec', valid', eid', tmask)`` with r0 the masked,
+    NOT-mean-subtracted residuals; ``meta`` carries the static build
+    facts (incoffset / nseg / f32mm / jac32 / the jac32 unscale
+    vector ``sfull`` / anchored / has_ecorr) consumers need to finish
+    the algebra exactly as ``step_fn`` would."""
+    _, parts_fn, args, names, meta = _build_fit_core(model, toas,
+                                                     **flags)
+    return parts_fn, args, names, meta
 
 
 def build_fit_loop(model, toas, max_iter: int = 8,

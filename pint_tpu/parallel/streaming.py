@@ -1,0 +1,844 @@
+"""Matrix-free GLS: streaming normal-equation accumulation +
+preconditioned CG (ISSUE 12 tentpole).
+
+Dense-Cholesky GLS materializes the (N, p+q) whitened design on
+device, so it tops out where device memory does (the 131k sharded
+oracle was the ceiling). PAPERS.md 1407.6710 formalizes the structure
+that makes million-TOA fits cheap: the noise covariance is
+N (diagonal, plus the rank-1-per-epoch ECORR blocks) plus a rank-q
+basis term, so the whitened normal equations
+
+    Sigma = [M|F]^T N_eff^-1 [M|F] + diag(0, 1/phi),
+    b     = [M|F]^T N_eff^-1 r
+
+never need the (N, p+q) matrices at full N: they are ACCUMULATED
+chunk-by-chunk over the TOA stream (the GP formulation of PAPERS.md
+1407.1838 — the same basis-Woodbury split the serve slot kernel
+exploits). Peak device memory is O(chunk + (p+q)^2), unbounded in N.
+
+Two device kernels, both supervised dispatches under obs spans:
+
+- the **chunk accumulator**: ``build_fit_parts``'s assembly function
+  (phase, Jacobian, bases — the SAME trace the dense step uses)
+  evaluated on one fixed-size chunk, its Gram/cross/moment
+  contributions folded into a small running state. Chunk sizes are
+  quantized to powers of two (``config.stream_chunk`` — the whole-fit
+  K discipline: the chunk length is a compile key, so a raw
+  ceil(N/k) would compile one executable per N while the quantized
+  set is bounded). ECORR rides the Sherman-Morrison segment path with
+  a BOUNDARY CARRY: epochs are contiguous in the (epoch-sorted) TOA
+  stream, so a chunk boundary splits at most one epoch, whose partial
+  (s, E, wr) sums carry to the next chunk; complete epochs are
+  downdated in-kernel. The weighted-mean subtraction of the reference
+  residuals is applied POST-HOC from accumulated scalars (exact
+  algebra — see ``_finalize_prep``), because a chunk cannot know the
+  global mean.
+
+- the **preconditioned-CG finalize**: the parameter-block solution of
+  the accumulated system via its Schur complement
+  ``S = A - B^T C^-1 B`` applied MATRIX-FREE (the basis-Woodbury
+  inner solve ``C^-1`` is a q x q Cholesky; S itself is never
+  formed), Jacobi-preconditioned from the accumulated diagonal, as a
+  ``lax.while_loop`` with a RUNTIME iteration budget. The covariance
+  rides the same loop: CG over the stacked right-hand sides
+  ``[b_schur | I_p]`` solves xhat and S^-1 together (S is p x p, so
+  exact-arithmetic CG terminates in <= p iterations; the budget is a
+  safety bound, not a truncation).
+
+Scale-safety: accumulated M-block quantities are stored relative to a
+RUNNING column max (``cm``) — the streaming analog of the dense
+kernel's two-stage column scaling, rescaled in-kernel when a chunk
+raises the max — so no intermediate ever exceeds the exponent range
+of TPU-emulated f64.
+
+Numpy mirrors (``stream_solve_np``) implement the identical algebra
+for the supervisor's host failover and the CPU equality oracles
+(tests/test_streaming_gls.py: chunk-size invariance, CG-vs-dense
+Cholesky across the component zoo).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pint_tpu.parallel.fit_step import _symm_mm, build_fit_parts
+
+__all__ = ["StreamingGLS", "stream_solve_np", "acc_init_np",
+           "acc_update_np", "acc_finalize_np", "cg_solve_np"]
+
+
+# ------------------------------------------------------------ algebra
+#
+# Accumulator state (P = p + q; ~(P^2 + 4P + 16) * 8 bytes — small
+# enough that StreamingGLS round-trips it to HOST between chunk
+# dispatches: the supervisor's watchdog contract wants the D2H read
+# inside the guarded closure, and a fresh upload per dispatch is what
+# makes retries/failover donation-safe. On-device chunk chaining — a
+# scan over resident chunk data, the PR-9 chain pattern — is the
+# queued on-chip follow-up, see ROADMAP item 2):
+#   cm    (p,)    running column max of |M| (power-free, exact max)
+#   Sig   (P,P)   [M/cm | F]^T W [M/cm | F], ECORR-downdated for
+#                 every COMPLETE epoch seen so far
+#   b     (P,)    [M/cm | F]^T W r0, same downdates
+#   u     (P,)    [M/cm | F]^T w·tmask      (mean-correction vector)
+#   vE    (P,)    sum_k g_k s_k E_k         (mean x ECORR cross term)
+#   scal  (8,)    [rCr0, swr0, sw, e_rr, e_swr, e_ss, carry_s,
+#                  carry_wr]
+#   carE  (P,)    partial E row of the boundary epoch
+#   cjv   ()      boundary epoch's jitter variance
+#   cid   ()      boundary epoch's global id (int32; -1 = none)
+
+
+def _rescale_state(cm, Sig, b, u, vE, carE, cm_new, p):
+    """Re-express every M-block-scaled accumulated quantity relative
+    to a grown column max (algebraically exact: pure rescaling)."""
+    rho = cm / cm_new
+    rfull = jnp.concatenate([rho, jnp.ones(Sig.shape[0] - p,
+                                           rho.dtype)])
+    Sig = Sig * jnp.outer(rfull, rfull)
+    return Sig, b * rfull, u * rfull, vE * rfull, carE * rfull
+
+
+def _acc_chunk(state, M, Fv, r0, nvec, valid, eid, jv_toa, tmask,
+               f32mm: bool, has_ecorr: bool):
+    """Fold one chunk's contributions into the accumulator state.
+    Pure jittable; shapes fixed by the chunk length. ``jv_toa`` is
+    the per-TOA jitter variance (jvar[eid] gathered on host)."""
+    cm, Sig, b, u, vE, scal, carE, cjv, cid = state
+    p = cm.shape[0]
+    P = Sig.shape[0]
+    C = M.shape[0]
+    w = valid / nvec
+    # running column max: grow-only, then rescale history
+    cm_c = jnp.max(jnp.abs(M) * valid[:, None].astype(M.dtype),
+                   axis=0).astype(jnp.float64)
+    cm_new = jnp.maximum(cm, jnp.where(cm_c == 0, cm, cm_c))
+    cm_new = jnp.where(cm_new == 0, 1.0, cm_new)
+    Sig, b, u, vE, carE = _rescale_state(cm, Sig, b, u, vE, carE,
+                                         cm_new, p)
+    cm = cm_new
+    Ms = M / cm[None, :].astype(M.dtype)
+    big = jnp.concatenate([Ms, Fv.astype(Ms.dtype)], axis=1)
+    sw = jnp.sqrt(w)
+    bigs = big * sw[:, None].astype(big.dtype)
+    Sig = Sig + _symm_mm(bigs, bigs, f32mm)
+    bigw64 = big.astype(jnp.float64) * w[:, None]
+    b = b + bigw64.T @ r0
+    u = u + bigw64.T @ tmask
+    wt = w * tmask
+    scal = scal.at[0].add(jnp.sum(w * r0 * r0))
+    scal = scal.at[1].add(jnp.sum(wt * r0))
+    scal = scal.at[2].add(jnp.sum(wt))
+    if not has_ecorr:
+        return (cm, Sig, b, u, vE, scal, carE, cjv, cid)
+
+    # ---- ECORR Sherman-Morrison with boundary carry ----------------
+    # chunk-local segment relabel (requires eid nondecreasing within
+    # the epoch-sorted stream; StreamingGLS sorts at build)
+    rid = eid - eid[0]
+    seg = partial(jax.ops.segment_sum, segment_ids=rid,
+                  num_segments=C)
+    s_seg = seg(w)
+    E_seg = seg(bigw64)
+    wr_seg = seg(w * r0)
+    jv_seg = jax.ops.segment_max(jv_toa, rid, num_segments=C)
+    jv_seg = jnp.where(jnp.isfinite(jv_seg), jv_seg, 0.0)
+    # merge the carried boundary epoch into segment 0 when it is the
+    # same global epoch; otherwise the carry is COMPLETE — downdate it
+    merge = (eid[0] == cid) & (cid >= 0)
+    c_s, c_wr = scal[6], scal[7]
+    g_c = jnp.where(merge, 0.0, cjv / (1.0 + cjv * c_s))
+    Sig = Sig - g_c * jnp.outer(carE, carE)
+    b = b - g_c * c_wr * carE
+    vE = vE + g_c * c_s * carE
+    scal = scal.at[3].add(g_c * c_wr * c_wr)
+    scal = scal.at[4].add(g_c * c_s * c_wr)
+    scal = scal.at[5].add(g_c * c_s * c_s)
+    s_seg = s_seg.at[0].add(jnp.where(merge, c_s, 0.0))
+    wr_seg = wr_seg.at[0].add(jnp.where(merge, c_wr, 0.0))
+    E_seg = E_seg.at[0].add(jnp.where(merge, 1.0, 0.0) * carE)
+    jv_seg = jv_seg.at[0].max(jnp.where(merge, cjv, 0.0))
+    # complete segments: 0..L-1 (L = the chunk's last epoch, carried)
+    L = rid[C - 1]
+    mask = (jnp.arange(C) < L).astype(jnp.float64)
+    g = jv_seg / (1.0 + jv_seg * s_seg) * mask
+    sg = jnp.sqrt(g)
+    Eg = E_seg * sg[:, None]
+    Sig = Sig - _symm_mm(Eg.astype(bigs.dtype),
+                         Eg.astype(bigs.dtype), f32mm)
+    b = b - Eg.T @ (sg * wr_seg)
+    vE = vE + Eg.T @ (sg * s_seg)
+    scal = scal.at[3].add(jnp.sum(g * wr_seg * wr_seg))
+    scal = scal.at[4].add(jnp.sum(g * s_seg * wr_seg))
+    scal = scal.at[5].add(jnp.sum(g * s_seg * s_seg))
+    # new carry: the chunk's trailing (possibly straddling) epoch
+    scal = scal.at[6].set(s_seg[L])
+    scal = scal.at[7].set(wr_seg[L])
+    carE = E_seg[L]
+    cjv = jv_seg[L]
+    cid = eid[C - 1]
+    return (cm, Sig, b, u, vE, scal, carE, cjv, cid)
+
+
+def _flush_carry(state):
+    """Downdate the final boundary epoch (end of stream)."""
+    cm, Sig, b, u, vE, scal, carE, cjv, cid = state
+    c_s, c_wr = scal[6], scal[7]
+    g_c = jnp.where(cid >= 0, cjv / (1.0 + cjv * c_s), 0.0)
+    Sig = Sig - g_c * jnp.outer(carE, carE)
+    b = b - g_c * c_wr * carE
+    vE = vE + g_c * c_s * carE
+    scal = scal.at[3].add(g_c * c_wr * c_wr)
+    scal = scal.at[4].add(g_c * c_s * c_wr)
+    scal = scal.at[5].add(g_c * c_s * c_s)
+    scal = scal.at[6].set(0.0)
+    scal = scal.at[7].set(0.0)
+    return (cm, Sig, b, u, vE, scal, jnp.zeros_like(carE),
+            jnp.zeros_like(cjv), jnp.full_like(cid, -1))
+
+
+def _finalize_prep(state, phi, incoffset: bool):
+    """Mean-correct and prior-load the accumulated system: returns
+    (Sigma, b, rCr, cm) of the EXACT dense normal equations (modulo
+    rounding) the one-shot kernel would have assembled."""
+    cm, Sig, b, u, vE, scal, _, _, _ = state
+    p = cm.shape[0]
+    rCr0, swr0, sw = scal[0], scal[1], scal[2]
+    e_rr, e_swr, e_ss = scal[3], scal[4], scal[5]
+    mu = jnp.where(incoffset & (sw > 0), swr0 / jnp.where(sw > 0, sw,
+                                                          1.0), 0.0)
+    # the mean correction r -> r0 - mu: b loses mu*(u - vE) (vE is
+    # the ECORR downdate's response to the constant direction)
+    b = b - mu * (u - vE)
+    rCr = (rCr0 - 2.0 * mu * swr0 + mu * mu * sw) \
+        - (e_rr - 2.0 * mu * e_swr + mu * mu * e_ss)
+    q = Sig.shape[0] - p
+    prior = jnp.concatenate([jnp.zeros(p), 1.0 / phi]) if q else \
+        jnp.zeros(p)
+    return Sig + jnp.diag(prior), b, rCr, cm
+
+
+def _cg_schur(Sigma, b, rCr, cm, budget, tol):
+    """Matrix-free preconditioned-CG solve of the parameter block of
+    ``Sigma x = b`` via the Schur complement of the basis block.
+
+    The whitened normal equations are Jacobi-scaled to unit diagonal
+    (the preconditioner the accumulated diagonal provides), the basis
+    block C is Cholesky-factored ONCE (the basis-Woodbury inner
+    solve, q x q), and the Schur operator
+    ``v -> A v - B^T (C^-1 (B v))`` is applied matrix-free inside a
+    ``lax.while_loop`` CG over the stacked RHS ``[b_schur | I_p]`` —
+    solution and covariance in one loop. ``budget`` is a RUNTIME
+    iteration bound (compile-free across callers); ``tol`` the
+    relative residual target. Returns (dparams, cov, chi2, chi2r,
+    ok, iters): dparams is the correction to ADD (the _gls_core sign
+    convention), ok False when the basis Cholesky or CG failed
+    (caller falls back to a dense/host solve)."""
+    P = Sigma.shape[0]
+    p = cm.shape[0]
+    q = P - p
+    d = jnp.sqrt(jnp.diagonal(Sigma))
+    d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+    St = Sigma / jnp.outer(d, d)
+    bt = b / d
+    A = St[:p, :p]
+    if q:
+        B = St[p:, :p]
+        Cq = St[p:, p:]
+        cf = jax.scipy.linalg.cho_factor(Cq, lower=True)
+        CiB = jax.scipy.linalg.cho_solve(cf, B)          # (q, p)
+        bF = bt[p:]
+        CibF = jax.scipy.linalg.cho_solve(cf, bF)
+        rhs0 = bt[:p] - B.T @ CibF
+        chi2r = rCr - bF @ CibF
+        # exact Schur diagonal — the Jacobi preconditioner of the
+        # REDUCED system (diag(A) is 1 after scaling; the correction
+        # is the basis-projection mass per column)
+        dS = 1.0 - jnp.sum(B * CiB, axis=0)
+    else:
+        B = jnp.zeros((0, p))
+        CiB = jnp.zeros((0, p))
+        rhs0 = bt[:p]
+        chi2r = rCr
+        dS = jnp.ones(p)
+    dS = jnp.where(dS > 1e-14, dS, 1.0)
+
+    def op(V):
+        out = A @ V
+        if q:
+            out = out - CiB.T @ (B @ V)
+        return out
+
+    RHS = jnp.concatenate([rhs0[:, None], jnp.eye(p)], axis=1)
+    bnorm = jnp.sqrt(jnp.sum(RHS * RHS, axis=0))
+    bnorm = jnp.where(bnorm == 0, 1.0, bnorm)
+    X0 = jnp.zeros_like(RHS)
+    R0 = RHS
+    Z0 = R0 / dS[:, None]
+    rz0 = jnp.sum(R0 * Z0, axis=0)
+
+    def active(R):
+        return jnp.sqrt(jnp.sum(R * R, axis=0)) > tol * bnorm
+
+    def cond(c):
+        k, X, R, Z, Pd, rz = c
+        return (k < budget) & jnp.any(active(R))
+
+    def body(c):
+        k, X, R, Z, Pd, rz = c
+        act = active(R)
+        AP = op(Pd)
+        pAp = jnp.sum(Pd * AP, axis=0)
+        alpha = jnp.where(act & (pAp > 0),
+                          rz / jnp.where(pAp > 0, pAp, 1.0), 0.0)
+        X = X + alpha[None, :] * Pd
+        R = R - alpha[None, :] * AP
+        Zn = R / dS[:, None]
+        rzn = jnp.sum(R * Zn, axis=0)
+        beta = jnp.where(act & (rz > 0),
+                         rzn / jnp.where(rz > 0, rz, 1.0), 0.0)
+        Pd = Zn + beta[None, :] * Pd
+        return (k + 1, X, R, Z, Pd, rzn)
+
+    k, X, R, _, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), X0, R0, Z0, Z0, rz0))
+    xt = X[:, 0]
+    Sinv = X[:, 1:]
+    # basis amplitudes + full-system products for chi2
+    if q:
+        yt = jax.scipy.linalg.cho_solve(cf, bF - B @ xt)
+        chi2 = rCr - (xt @ bt[:p] + yt @ bF)
+        xf = yt / d[p:]
+    else:
+        chi2 = rCr - xt @ bt[:p]
+        xf = jnp.zeros(0)
+    scale = d[:p] * cm
+    dparams = -xt / scale
+    cov = Sinv / jnp.outer(scale, scale)
+    resid = jnp.max(jnp.sqrt(jnp.sum(R * R, axis=0)) / bnorm)
+    ok = jnp.all(jnp.isfinite(xt)) & jnp.all(jnp.isfinite(cov)) \
+        & jnp.isfinite(chi2) & (resid <= jnp.sqrt(tol))
+    return dparams, cov, chi2, chi2r, xf, ok, k
+
+
+# -------------------------------------------------- jitted wrappers
+
+
+def _finalize_kernel(state, phi, sfull, budget, tol,
+                     incoffset: bool = True):
+    """Flush the ECORR carry, mean-correct, and CG-solve. ``sfull``
+    is the jac32 column-unscale vector (ones when jac32 off)."""
+    state = _flush_carry(state)
+    Sigma, b, rCr, cm = _finalize_prep(state, phi, incoffset)
+    dparams, cov, chi2, chi2r, xf, ok, iters = _cg_schur(
+        Sigma, b, rCr, cm, budget, tol)
+    dparams = dparams * sfull
+    cov = cov * jnp.outer(sfull, sfull)
+    return dparams, cov, chi2, chi2r, xf, ok, iters
+
+
+# ------------------------------------------------------ numpy mirror
+
+
+def acc_init_np(p: int, q: int):
+    """Zero accumulator state (host mirror layout == device layout)."""
+    P = p + q
+    return [np.ones(p), np.zeros((P, P)), np.zeros(P), np.zeros(P),
+            np.zeros(P), np.zeros(8), np.zeros(P), np.asarray(0.0),
+            np.asarray(-1, np.int32)]
+
+
+def acc_update_np(state, M, F, r0, nvec, valid, tmask=None,
+                  eid=None, jv_toa=None):
+    """Numpy mirror of ``_acc_chunk`` (f64 accumulation, same
+    boundary-carry ECORR downdates) — the host-failover path and the
+    chunk-invariance oracle. Mutates and returns ``state``."""
+    cm, Sig, b, u, vE, scal, carE, cjv, cid = state
+    p = cm.shape[0]
+    M = np.asarray(M, np.float64)
+    C = M.shape[0]
+    if tmask is None:
+        tmask = valid
+    w = valid / nvec
+    cm_c = np.max(np.abs(M) * valid[:, None], axis=0) \
+        if C else np.zeros(p)
+    cm_new = np.maximum(cm, np.where(cm_c == 0, cm, cm_c))
+    cm_new[cm_new == 0] = 1.0
+    rho = cm / cm_new
+    rfull = np.concatenate([rho, np.ones(Sig.shape[0] - p)])
+    Sig *= np.outer(rfull, rfull)
+    b *= rfull
+    u *= rfull
+    vE *= rfull
+    carE *= rfull
+    cm = cm_new
+    big = np.concatenate([M / cm[None, :], np.asarray(F, np.float64)],
+                         axis=1)
+    bigw = big * w[:, None]
+    Sig += big.T @ bigw
+    b += bigw.T @ r0
+    u += bigw.T @ tmask
+    wt = w * tmask
+    scal[0] += float(np.sum(w * r0 * r0))
+    scal[1] += float(np.sum(wt * r0))
+    scal[2] += float(np.sum(wt))
+    state[0], state[1], state[2], state[3], state[4] = \
+        cm, Sig, b, u, vE
+    if eid is None or jv_toa is None:
+        return state
+    # ECORR boundary-carry (mirror of the in-kernel path)
+    eid = np.asarray(eid)
+    order_ok = np.all(np.diff(eid) >= 0)
+    if not order_ok:
+        raise ValueError("streaming ECORR requires epoch-sorted rows")
+    uniq, starts = np.unique(eid, return_index=True)
+    ends = np.append(starts[1:], C)
+    for k0, (gidx, s0, s1) in enumerate(zip(uniq, starts, ends)):
+        seg_w = w[s0:s1]
+        s_s = float(np.sum(seg_w))
+        E_s = bigw[s0:s1].T @ np.ones(s1 - s0)
+        wr_s = float(np.sum(seg_w * r0[s0:s1]))
+        jv_s = float(np.max(jv_toa[s0:s1])) if s1 > s0 else 0.0
+        if k0 == 0 and gidx == int(cid) and int(cid) >= 0:
+            s_s += scal[6]
+            wr_s += scal[7]
+            E_s = E_s + carE
+            jv_s = max(jv_s, float(cjv))
+        elif k0 == 0 and int(cid) >= 0:
+            _downdate_np(state, float(cjv))
+            cid = np.asarray(-1, np.int32)
+        if gidx == uniq[-1]:
+            scal[6], scal[7] = s_s, wr_s
+            state[6] = E_s
+            state[7] = np.asarray(jv_s)
+            state[8] = np.asarray(gidx, np.int32)
+        else:
+            g = jv_s / (1.0 + jv_s * s_s)
+            state[1] -= g * np.outer(E_s, E_s)
+            state[2] -= g * wr_s * E_s
+            state[4] += g * s_s * E_s
+            scal[3] += g * wr_s * wr_s
+            scal[4] += g * s_s * wr_s
+            scal[5] += g * s_s * s_s
+    return state
+
+
+def _downdate_np(state, jv):
+    """Downdate the carried boundary epoch in the host mirror."""
+    scal = state[5]
+    c_s, c_wr = scal[6], scal[7]
+    carE = state[6]
+    g = jv / (1.0 + jv * c_s)
+    state[1] -= g * np.outer(carE, carE)
+    state[2] -= g * c_wr * carE
+    state[4] += g * c_s * carE
+    scal[3] += g * c_wr * c_wr
+    scal[4] += g * c_s * c_wr
+    scal[5] += g * c_s * c_s
+    scal[6] = 0.0
+    scal[7] = 0.0
+    state[6] = np.zeros_like(carE)
+    state[7] = np.asarray(0.0)
+    state[8] = np.asarray(-1, np.int32)
+
+
+def cg_solve_np(Sigma, b, rCr, cm, budget=None, tol=1e-13):
+    """Numpy mirror of ``_cg_schur`` (same Jacobi scaling, Schur
+    operator, preconditioned CG over stacked RHS)."""
+    from scipy.linalg import cho_factor, cho_solve
+
+    P = Sigma.shape[0]
+    p = cm.shape[0]
+    q = P - p
+    d = np.sqrt(np.diagonal(Sigma)).copy()
+    d[(d == 0) | ~np.isfinite(d)] = 1.0
+    St = Sigma / np.outer(d, d)
+    bt = b / d
+    A = St[:p, :p]
+    if q:
+        B = St[p:, :p]
+        cf = cho_factor(St[p:, p:], lower=True)
+        CiB = cho_solve(cf, B)
+        bF = bt[p:]
+        CibF = cho_solve(cf, bF)
+        rhs0 = bt[:p] - B.T @ CibF
+        chi2r = rCr - bF @ CibF
+        dS = 1.0 - np.sum(B * CiB, axis=0)
+    else:
+        B = np.zeros((0, p))
+        CiB = np.zeros((0, p))
+        rhs0 = bt[:p]
+        chi2r = rCr
+        dS = np.ones(p)
+    dS = np.where(dS > 1e-14, dS, 1.0)
+    if budget is None:
+        budget = 8 * (p + 1)
+
+    def op(V):
+        out = A @ V
+        if q:
+            out = out - CiB.T @ (B @ V)
+        return out
+
+    RHS = np.concatenate([rhs0[:, None], np.eye(p)], axis=1)
+    bnorm = np.sqrt(np.sum(RHS * RHS, axis=0))
+    bnorm[bnorm == 0] = 1.0
+    X = np.zeros_like(RHS)
+    R = RHS.copy()
+    Z = R / dS[:, None]
+    rz = np.sum(R * Z, axis=0)
+    Pd = Z.copy()
+    iters = 0
+    for _ in range(int(budget)):
+        act = np.sqrt(np.sum(R * R, axis=0)) > tol * bnorm
+        if not np.any(act):
+            break
+        iters += 1
+        AP = op(Pd)
+        pAp = np.sum(Pd * AP, axis=0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            alpha = np.where(act & (pAp > 0), rz / np.where(
+                pAp > 0, pAp, 1.0), 0.0)
+        X += alpha[None, :] * Pd
+        R -= alpha[None, :] * AP
+        Zn = R / dS[:, None]
+        rzn = np.sum(R * Zn, axis=0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            beta = np.where(act & (rz > 0), rzn / np.where(
+                rz > 0, rz, 1.0), 0.0)
+        Pd = Zn + beta[None, :] * Pd
+        rz = rzn
+    xt = X[:, 0]
+    Sinv = X[:, 1:]
+    if q:
+        yt = cho_solve(cf, bF - B @ xt)
+        chi2 = rCr - (xt @ bt[:p] + yt @ bF)
+        xf = yt / d[p:]
+    else:
+        chi2 = rCr - xt @ bt[:p]
+        xf = np.zeros(0)
+    scale = d[:p] * cm
+    dparams = -xt / scale
+    cov = Sinv / np.outer(scale, scale)
+    resid = float(np.max(np.sqrt(np.sum(R * R, axis=0)) / bnorm))
+    ok = bool(np.all(np.isfinite(xt)) and np.all(np.isfinite(cov))
+              and np.isfinite(chi2) and resid <= np.sqrt(tol))
+    return dparams, cov, float(chi2), float(chi2r), xf, ok, iters
+
+
+def acc_finalize_np(state, phi, sfull=None, incoffset=True,
+                    budget=None, tol=1e-13):
+    """Numpy mirror of ``_finalize_kernel``: flush carry,
+    mean-correct, prior-load, CG-solve."""
+    if int(state[8]) >= 0:
+        _downdate_np(state, float(state[7]))
+    cm, Sig, b, u, vE, scal = state[0], state[1], state[2], \
+        state[3], state[4], state[5]
+    p = cm.shape[0]
+    rCr0, swr0, sw = scal[0], scal[1], scal[2]
+    e_rr, e_swr, e_ss = scal[3], scal[4], scal[5]
+    mu = (swr0 / sw) if (incoffset and sw > 0) else 0.0
+    b = b - mu * (u - vE)
+    rCr = (rCr0 - 2.0 * mu * swr0 + mu * mu * sw) \
+        - (e_rr - 2.0 * mu * e_swr + mu * mu * e_ss)
+    q = Sig.shape[0] - p
+    prior = np.concatenate([np.zeros(p), 1.0 / np.asarray(phi)]) \
+        if q else np.zeros(p)
+    Sigma = Sig + np.diag(prior)
+    out = cg_solve_np(Sigma, b, float(rCr), cm, budget=budget,
+                      tol=tol)
+    if sfull is not None:
+        dp, cov = out[0] * sfull, out[1] * np.outer(sfull, sfull)
+        out = (dp, cov) + out[2:]
+    return out
+
+
+def stream_solve_np(M, F, phi, r0, nvec, chunk: int,
+                    incoffset: bool = True, eid=None, jvar=None,
+                    tol=1e-13):
+    """Host streaming solve over prebuilt dense rows (the failover
+    and oracle path): chunked ``acc_update_np`` + ``acc_finalize_np``.
+    ``r0`` must be the NOT-mean-subtracted residuals."""
+    M = np.asarray(M, np.float64)
+    n, p = M.shape
+    F = np.asarray(F, np.float64)
+    q = F.shape[1]
+    state = acc_init_np(p, q)
+    jv_toa = None if (eid is None or jvar is None) \
+        else np.asarray(jvar)[np.asarray(eid)]
+    for s0 in range(0, n, int(chunk)):
+        s1 = min(n, s0 + int(chunk))
+        sl = slice(s0, s1)
+        acc_update_np(
+            state, M[sl], F[sl], np.asarray(r0)[sl],
+            np.asarray(nvec)[sl], np.ones(s1 - s0),
+            eid=None if eid is None else np.asarray(eid)[sl],
+            jv_toa=None if jv_toa is None else jv_toa[sl])
+    return acc_finalize_np(state, phi, incoffset=incoffset, tol=tol)
+
+
+# --------------------------------------------------------- StreamingGLS
+
+
+class StreamingGLS:
+    """One model+TOAs' streaming GLS machinery: the chunked
+    accumulator and the CG finalize, built ONCE (one compile per
+    quantized chunk length) and re-runnable at any parameter point
+    (th, tl) — the unit ``pint_tpu.gls.StreamingGLSFitter`` iterates.
+
+    Build-time host work: ``build_fit_parts`` (the same assembly the
+    dense step compiles), an epoch-sort permutation when ECORR is
+    active (accumulation is row-order-invariant, and epoch-contiguous
+    rows are what lets a chunk boundary split at most one epoch), and
+    per-chunk host views of every TOA-axis array. Device work per
+    pass: ceil(N/C) SEQUENTIAL supervised chunk dispatches — device
+    memory is O(C + (p+q)^2), and the ~40 kB state round-trips to
+    host between dispatches (fresh uploads keep supervisor
+    retries/failover donation-safe; the D2H read inside the guarded
+    closure is the watchdog contract) — plus one finalize dispatch.
+    Per-dispatch RTT over the axon tunnel makes a pass
+    RTT * ceil(N/C)-bound there; the on-chip follow-up (ROADMAP
+    item 2) is device-resident chunk chaining via the PR-9 scan
+    pattern.
+    """
+
+    def __init__(self, model, toas, chunk: Optional[int] = None,
+                 **flags):
+        from pint_tpu import config
+
+        if flags.get("wideband"):
+            raise ValueError("streaming GLS does not support "
+                             "wideband TOAs (stacked DM rows); use "
+                             "the dense fitters")
+        flags.pop("wideband", None)
+        parts_fn, args, names, meta = build_fit_parts(model, toas,
+                                                      **flags)
+        self.names = names
+        self.meta = meta
+        self.model = model
+        self.toas = toas
+        n = toas.ntoas
+        self.ntoa = n
+        self.chunk = config.stream_chunk(n) if chunk is None \
+            else int(chunk)
+        (th, tl, fh, fl, batch, sc, F, phi, nvec, valid, eid,
+         jvar) = args
+        self.th0 = np.asarray(th, np.float64).copy()
+        self.tl0 = np.asarray(tl, np.float64).copy()
+        self.fh = np.asarray(fh)
+        self.fl = np.asarray(fl)
+        self.phi = np.asarray(phi)
+        self.p = len(names)
+        self.q = self.phi.shape[0]
+        jvar_np = np.asarray(jvar)
+        eid_np = np.asarray(eid)
+        # epoch-sort permutation: accumulation is row-order-invariant
+        # and the boundary-carry ECORR path needs nondecreasing eid
+        if meta["has_ecorr"] and np.any(np.diff(eid_np) < 0):
+            perm = np.argsort(eid_np, kind="stable")
+        else:
+            perm = None
+        self._perm = perm
+
+        def host(a):
+            a = np.asarray(a)
+            if perm is not None and a.ndim >= 1 and a.shape[0] == n:
+                return a[perm]
+            if perm is not None and a.ndim == 3 and a.shape[1] == n:
+                return a[:, perm]
+            return a
+
+        self._batch = jax.tree.map(host, jax.tree.map(np.asarray,
+                                                      batch))
+        self._sc = jax.tree.map(host, jax.tree.map(np.asarray, sc))
+        self._F = host(F)
+        self._nvec = host(nvec)
+        self._valid = host(valid)
+        self._eid = host(eid_np)
+        self._jv_toa = jvar_np[self._eid]
+        self._jvar = jvar_np
+        self.nchunks = -(-n // self.chunk)
+        incoffset = bool(meta["incoffset"])
+        f32mm = bool(meta["f32mm"])
+        has_ecorr = bool(meta["has_ecorr"])
+        self.incoffset = incoffset
+
+        def chunk_fn(state, th_, tl_, fh_, fl_, batch_c, sc_c, F_c,
+                     phi_, nvec_c, valid_c, eid_c, jvar_, jv_c):
+            # parameter VALUES — including frozen ones, phi and the
+            # epoch jitter variances — are runtime arguments, never
+            # trace constants (the G10 discipline)
+            M, Fv, r0, nvec2, valid2, eid2, tmask = parts_fn(
+                th_, tl_, fh_, fl_, batch_c, sc_c, F_c, phi_,
+                nvec_c, valid_c, eid_c, jvar_)
+            return _acc_chunk(state, M, Fv, r0, nvec2, valid2, eid2,
+                              jv_c, tmask, f32mm=f32mm,
+                              has_ecorr=has_ecorr)
+
+        donate = config.donation_enabled() and \
+            jax.default_backend() != "cpu"
+        self._jit_chunk = jax.jit(chunk_fn, donate_argnums=(0,)) \
+            if donate else jax.jit(chunk_fn)
+        self._jit_final = jax.jit(partial(_finalize_kernel,
+                                          incoffset=incoffset))
+
+    # -- chunk views ---------------------------------------------------
+
+    def _chunk_views(self, k: int):
+        """Host views/pads of chunk k's per-TOA arrays (last chunk
+        edge-padded with valid=0, the _pad_leaf convention)."""
+        C = self.chunk
+        n = self.ntoa
+        s0, s1 = k * C, min(n, (k + 1) * C)
+        pad = C - (s1 - s0)
+
+        def cut(a):
+            a = np.asarray(a)
+            if a.ndim == 0 or a.shape == (1,):
+                return a
+            if a.ndim == 3 and a.shape[1] == n:
+                v = a[:, s0:s1]
+                return np.pad(v, [(0, 0), (0, pad), (0, 0)],
+                              mode="edge") if pad else v
+            if a.ndim >= 1 and a.shape[0] == n:
+                v = a[s0:s1]
+                if pad:
+                    v = np.pad(v, [(0, pad)] + [(0, 0)] * (a.ndim - 1),
+                               mode="edge")
+                return v
+            return a
+
+        batch_c = jax.tree.map(cut, self._batch)
+        sc_c = jax.tree.map(cut, self._sc)
+        F_c = cut(self._F)
+        nvec_c = cut(self._nvec)
+        valid_c = cut(self._valid)
+        if pad:
+            valid_c = valid_c.copy()
+            valid_c[-pad:] = 0.0
+        eid_c = cut(self._eid)
+        jv_c = cut(self._jv_toa)
+        return batch_c, sc_c, F_c, nvec_c, valid_c, eid_c, jv_c
+
+    def _init_state_np(self):
+        return acc_init_np(self.p, self.q)
+
+    # -- device passes -------------------------------------------------
+
+    def accumulate(self, th, tl):
+        """One full streaming pass at parameter point (th, tl):
+        ceil(N/C) supervised chunk dispatches. Returns the host-side
+        accumulator state. Raises DispatchError through to the caller
+        (the fitter's failover boundary)."""
+        from pint_tpu import obs
+        from pint_tpu.runtime import get_supervisor
+
+        sup = get_supervisor()
+        state = tuple(np.asarray(x) for x in self._init_state_np())
+        th = np.asarray(th, np.float64)
+        tl = np.asarray(tl, np.float64)
+        with obs.span("stream.accumulate", ntoa=self.ntoa,
+                      chunk=self.chunk, nchunks=self.nchunks):
+            for k in range(self.nchunks):
+                (batch_c, sc_c, F_c, nvec_c, valid_c, eid_c,
+                 jv_c) = self._chunk_views(k)
+
+                def run(st=state, bc=batch_c, scc=sc_c, Fc=F_c,
+                        nc=nvec_c, vc=valid_c, ec=eid_c, jc=jv_c):
+                    # fresh device uploads per call (donation-safe
+                    # under supervisor retries); host reads inside so
+                    # the watchdog covers completion
+                    dev = tuple(jnp.asarray(x) for x in st)
+                    out = self._jit_chunk(dev, jnp.asarray(th), jnp.asarray(tl), jnp.asarray(self.fh), jnp.asarray(self.fl), jax.tree.map(jnp.asarray, bc), jax.tree.map(jnp.asarray, scc), jnp.asarray(Fc), jnp.asarray(self.phi), jnp.asarray(nc), jnp.asarray(vc), jnp.asarray(ec), jnp.asarray(self._jvar), jnp.asarray(jc))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+                    return tuple(np.asarray(o) for o in out)
+
+                state = sup.dispatch(run, key="stream.chunk")
+        from pint_tpu.obs import metrics as om
+
+        om.counter("pint_tpu_stream_chunk_dispatches_total",
+                   "streaming-GLS chunk dispatches").inc(self.nchunks)
+        return state
+
+    def solve(self, state, budget: Optional[int] = None,
+              tol: float = 1e-13):
+        """CG-finalize an accumulated state (one supervised
+        dispatch). Returns (dparams, cov, chi2, chi2r, xf, ok,
+        iters) — dparams the correction to ADD aligned with
+        ``self.names``, chi2 the linearized post-fit chi2, chi2r the
+        bases-marginalized chi2 at the point (``Residuals.chi2``
+        semantics), xf the ML basis amplitudes."""
+        from pint_tpu import obs
+        from pint_tpu.obs import metrics as om
+        from pint_tpu.runtime import get_supervisor
+
+        if budget is None:
+            budget = 8 * (self.p + 1)
+        sup = get_supervisor()
+        sfull = np.asarray(self.meta["sfull"], np.float64)
+
+        def run():
+            dev = tuple(jnp.asarray(x) for x in state)
+            out = self._jit_final(dev, jnp.asarray(self.phi), jnp.asarray(sfull), jnp.asarray(int(budget), jnp.int32), jnp.asarray(float(tol)))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            return tuple(np.asarray(o) for o in out)
+
+        with obs.span("stream.solve", p=self.p, q=self.q):
+            out = sup.dispatch(run, key="stream.solve")
+        dp, cov, chi2, chi2r, xf, ok, iters = out
+        om.counter("pint_tpu_stream_cg_solves_total",
+                   "streaming-GLS CG finalize dispatches").inc()
+        return (np.asarray(dp), np.asarray(cov), float(chi2),
+                float(chi2r), np.asarray(xf), bool(ok), int(iters))
+
+    def noise_realization(self, xf) -> np.ndarray:
+        """ML correlated-noise realization F @ xf [s] in the ORIGINAL
+        TOA order (undoing the epoch-sort permutation)."""
+        noise = self._F @ np.asarray(xf)
+        if self._perm is not None:
+            out = np.empty_like(noise)
+            out[self._perm] = noise
+            return out
+        return noise
+
+    # -- host mirror ---------------------------------------------------
+
+    def solve_np(self, tol: float = 1e-13):
+        """Full host-mirror pass (failover path, 'degraded in speed,
+        not correctness'): dense host assembly of the rows at the
+        MODEL'S CURRENT parameter point — syncing the model to the
+        point being asked about is the caller's job (the failover
+        fitter updates the model before every trial pass) — then the
+        chunked numpy accumulate + CG finalize."""
+        from pint_tpu.residuals import Residuals
+
+        model = self.model
+        res = Residuals(self.toas, model, subtract_mean=False)
+        M, names, _ = model.designmatrix(self.toas, incoffset=True)
+        nvec = model.scaled_toa_uncertainty(self.toas) ** 2
+        seg = model.noise_model_ecorr_segments(self.toas)
+        if seg is not None:
+            eid, jvar, exclude = seg
+        else:
+            eid, jvar, exclude = None, None, ()
+        F = model.noise_model_designmatrix(self.toas,
+                                           exclude=exclude)
+        phi = model.noise_model_basis_weight(self.toas,
+                                             exclude=exclude)
+        if F is None:
+            F = np.zeros((self.toas.ntoas, 0))
+            phi = np.ones(0)
+        r0 = np.asarray(res.time_resids)
+        if eid is not None and np.any(np.diff(eid) < 0):
+            perm = np.argsort(eid, kind="stable")
+            M, F, r0, nvec, eid = (M[perm], F[perm], r0[perm],
+                                   nvec[perm], eid[perm])
+        out = stream_solve_np(M, F, phi, r0, nvec, self.chunk,
+                              incoffset=self.incoffset, eid=eid,
+                              jvar=jvar, tol=tol)
+        dp, cov, chi2, chi2r, xf, ok, iters = out
+        return (np.asarray(dp), np.asarray(cov), float(chi2),
+                float(chi2r), np.asarray(xf), bool(ok), int(iters))

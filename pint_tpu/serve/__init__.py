@@ -39,6 +39,8 @@ Entry points: ``scripts/pint_serve.py`` (stdin JSONL daemon) and
 """
 
 from pint_tpu.serve.request import (  # noqa: F401
+    AppendResult,
+    AppendTOAsRequest,
     DeadlineExceeded,
     EngineKilled,
     FitStepRequest,
@@ -52,7 +54,12 @@ from pint_tpu.serve.request import (  # noqa: F401
     ServeFuture,
     ServeOverload,
     ShutdownShed,
+    StateMissing,
     TenantOverQuota,
+)
+from pint_tpu.serve.append import (  # noqa: F401
+    AppendStore,
+    build_append_rows,
 )
 from pint_tpu.serve.scheduler import (  # noqa: F401
     ServeEngine,

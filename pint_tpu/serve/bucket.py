@@ -32,7 +32,7 @@ from pint_tpu.parallel.pta import _solve_one, pta_solve_np, \
 
 __all__ = ["bucket_for", "pad_dim", "pow2_ceil", "ExecutableCache",
            "gls_shape_class", "phase_shape_class",
-           "posterior_shape_class"]
+           "posterior_shape_class", "append_shape_class"]
 
 
 def pow2_ceil(n: int) -> int:
@@ -98,6 +98,19 @@ def posterior_shape_class(n: int, p: int, q: int, W: int, K: int,
         return None
     return ("posterior", nb, pad_dim(p), pad_dim(q), int(W), int(K),
             int(thin))
+
+
+def append_shape_class(n: int, p: int, q: int,
+                       edges: Tuple[int, ...]):
+    """(kind, N_bucket, p_pad, q_pad) for an append request — the
+    NEW-row count buckets like a GLS TOA axis (the accumulated state
+    is already padded to (p_pad, q_pad), so state and rows share one
+    class), or None when the batch exceeds every edge (the cold-build
+    fallback-single case)."""
+    nb = bucket_for(n, edges)
+    if nb is None:
+        return None
+    return ("append", nb, pad_dim(p), pad_dim(q))
 
 
 def _phase_eval_one(coeffs, tmid, rphase_int, rphase_frac, f0, mjds,
@@ -177,6 +190,11 @@ class ExecutableCache:
         else:
             self._gls = jax.jit(jax.vmap(_solve_one))
             self._phase = jax.jit(jax.vmap(_phase_eval_one))
+        # append rank-update kernel (ISSUE 12): one jitted vmapped
+        # wrapper, built lazily on the first append class (XLA caches
+        # per padded shape). NOT donated: the state arrays are read
+        # back as deltas for the host-side store commit.
+        self._append = None
         # posterior chain kernels (ISSUE 9): one jitted vmapped slot
         # kernel per (W, K, thin) walker/step class — W and K are
         # compile-time constants of the scan program, so unlike the
@@ -241,6 +259,8 @@ class ExecutableCache:
         try:
             return int(self._gls._cache_size()) + \
                 int(self._phase._cache_size()) + \
+                (int(self._append._cache_size())
+                 if self._append is not None else 0) + \
                 sum(int(fn._cache_size())
                     for fn in self._posterior.values())
         except AttributeError:
@@ -494,6 +514,94 @@ class ExecutableCache:
         """Synchronous ``phase_begin`` + collect."""
         return self.phase_begin(key, requests, nb, kb, Pb,
                                 sync=True)()
+
+    def append_begin(self, key, requests, shape, entries,
+                     sync: bool = False, pool: str = "device",
+                     info: Optional[dict] = None):
+        """Pad the append batch to its class shape and issue ONE
+        supervised dispatch of the vmapped rank-update + CG-resolve
+        slot kernel (``serve.append._append_slot``). ``entries`` is
+        the per-request list of cached ``AppendStateEntry`` (None
+        for cold slots — they start from the zero state). The kernel
+        is PURE: it returns per-slot state DELTAS; the scheduler
+        commits them to the store at collect time. Not AOT-exported:
+        like the posterior kernel there is no LAPACK-heavy retrace
+        to amortize, and a restored executable could not resurrect
+        the in-memory state store anyway. Host failover: the numpy
+        mirror ``append_slot_np`` per slot."""
+        import jax
+
+        from pint_tpu.serve.append import append_slot_np
+
+        Pb, nb, pb, qb = shape
+        P = pb + qb
+        cm = np.ones((Pb, pb))
+        Sig = np.zeros((Pb, P, P))
+        bb = np.zeros((Pb, P))
+        uu = np.zeros((Pb, P))
+        scal = np.zeros((Pb, 8))
+        M = np.zeros((Pb, nb, pb))
+        F = np.zeros((Pb, nb, qb))
+        phi = np.ones((Pb, qb))
+        r0 = np.zeros((Pb, nb))
+        nvec = np.ones((Pb, nb))
+        valid = np.zeros((Pb, nb))
+        pvalid = np.zeros((Pb, pb))
+        submean = np.zeros(Pb)
+        coldf = np.zeros(Pb)
+        budget = np.int32(8 * (pb + 1))
+        for k, r in enumerate(requests):
+            pr = r.problem
+            n, p = pr.M.shape
+            q = pr.F.shape[1]
+            M[k, :n, :p] = pr.M
+            F[k, :n, :q] = pr.F
+            phi[k, :q] = pr.phi
+            r0[k, :n] = pr.r
+            nvec[k, :n] = pr.nvec
+            valid[k, :n] = 1.0
+            pvalid[k, :p] = 1.0
+            submean[k] = 1.0 if pr.submean else 0.0
+            e = entries[k]
+            if e is None:
+                coldf[k] = 1.0
+            else:
+                cm[k] = e.cm
+                Sig[k] = e.Sig
+                bb[k] = e.b
+                uu[k] = e.u
+                scal[k] = e.scal
+                phi[k] = e.stacked_phi()
+        arrs = {"cm": cm, "Sig": Sig, "b": bb, "u": uu, "scal": scal,
+                "M": M, "F": F, "phi": phi, "r0": r0, "nvec": nvec,
+                "valid": valid, "pvalid": pvalid, "submean": submean,
+                "cold": coldf}
+        if self._append is None:
+            from pint_tpu.serve.append import append_kernel
+
+            self._append = append_kernel()
+        fn = self._append
+        names = ("cm", "Sig", "b", "u", "scal", "M", "F", "phi",
+                 "r0", "nvec", "valid", "pvalid", "submean", "cold")
+
+        def run():
+            st = self._place(arrs)
+            out = fn(*(st[n_] for n_ in names), jax.numpy.asarray(budget), jax.numpy.asarray(1e-13))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            return tuple(np.asarray(o) for o in out)
+
+        def host():
+            outs = [append_slot_np(
+                cm[k], Sig[k], bb[k], uu[k], scal[k], M[k], F[k],
+                phi[k], r0[k], nvec[k], valid[k], pvalid[k],
+                submean[k], coldf[k], budget=int(budget))
+                for k in range(Pb)]
+            return tuple(np.stack([np.asarray(o[j]) for o in outs])
+                         for j in range(11))
+
+        return self._issue(
+            run, host,
+            f"serve.append/{'/'.join(str(x) for x in key)}", key,
+            sync, pool=pool, info=info)
 
     def _posterior_kernel(self, W: int, K: int, thin: int):
         import jax

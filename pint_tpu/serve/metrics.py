@@ -127,6 +127,7 @@ class ServeMetrics:
         # artifact, never a silent drop
         self.admission = admission
         self.router = router
+        self.append_store = None   # wired by the engine (ISSUE 12)
         self.restart_info: dict = {}
         # log-bucketed latency histograms per (pool, kind, class) x
         # (queue_wait | dispatch_wall | e2e) — fixed power-of-two
@@ -268,6 +269,10 @@ class ServeMetrics:
             out["slo"] = slo_state
         if self.admission is not None:
             out["admission"] = self.admission.snapshot()
+        if self.append_store is not None:
+            # ISSUE 12: per-pulsar append-state accounting (cold
+            # builds vs rank updates — the warm/cold serving mix)
+            out["append"] = self.append_store.snapshot()
         if self.router is not None:
             out["router"] = self.router.snapshot()
         if self.restart_info:

@@ -37,9 +37,11 @@ import numpy as np
 
 __all__ = ["ServeFuture", "DeadlineExceeded", "ServeOverload",
            "TenantOverQuota", "ShutdownShed", "EngineKilled",
+           "StateMissing",
            "FitStepRequest", "ResidualsRequest", "PhasePredictRequest",
-           "PosteriorRequest", "FitStepResult", "ResidualsResult",
-           "PhasePredictResult", "PosteriorResult"]
+           "PosteriorRequest", "AppendTOAsRequest", "FitStepResult",
+           "ResidualsResult", "PhasePredictResult", "PosteriorResult",
+           "AppendResult"]
 
 
 class DeadlineExceeded(TimeoutError):
@@ -290,6 +292,119 @@ class PosteriorRequest(_GLSRequest):
         'rows' unit the capacity router learns posterior service
         rates in."""
         return self.nsteps * self.nwalkers
+
+
+class StateMissing(RuntimeError):
+    """An ``AppendTOAsRequest`` with ``cold=False`` named a pulsar
+    state the engine does not hold (process restart lost the
+    in-memory accumulator store, or the key was never cold-built):
+    the caller must re-submit a cold build — silently rebuilding
+    from only the appended rows would serve a fit of the tail of the
+    data as if it covered all of it."""
+
+
+@dataclass
+class AppendResult:
+    """One pulsar's re-converged incremental fit: ``dparams`` is the
+    TOTAL correction to ADD to the model at the state's linearization
+    point theta_0 (the ``parallel.pta`` convention), reflecting every
+    TOA accumulated into the state INCLUDING this request's batch.
+    ``chi2r`` is the bases-marginalized chi2 of the combined set at
+    theta_0 (``Residuals.chi2`` semantics)."""
+
+    names: List[str]
+    dparams: np.ndarray
+    cov: np.ndarray
+    chi2: float          # linearized post-fit chi2, combined set
+    chi2r: float         # chi2 at theta_0, combined set
+    ntoa_total: int      # TOAs accumulated in the state after this
+    cold: bool           # True when this request cold-built the state
+    cg_iters: int
+
+    def errors(self) -> Dict[str, float]:
+        sig = np.sqrt(np.diag(self.cov))
+        return {n: float(s) for n, s in zip(self.names, sig)
+                if n != "Offset"}
+
+
+class AppendTOAsRequest(_GLSRequest):
+    """Append a batch of TOAs to a pulsar's cached accumulated normal
+    equations and re-converge in O(new TOAs) (ISSUE 12).
+
+    ``state_key`` names the per-pulsar accumulator state the engine
+    holds (``ServeEngine.append_store``). The FIRST request for a key
+    is the cold build: ``toas`` is the full initial dataset,
+    accumulated chunk-free into a fresh state whose noise-basis span
+    is recorded. Subsequent requests carry ONLY the new TOAs: their
+    rows are assembled at admission (O(new) host work — design
+    matrix, residuals, and the noise basis evaluated on the COLD
+    span's Fourier frequencies via the ``tspan`` override, so the
+    columns align with the cached Gram), the device work is a rank
+    update + preconditioned-CG re-solve of the small accumulated
+    system, and the result is the total correction at the state's
+    linearization point theta_0.
+
+    Contract: the served model stays AT theta_0 (the linearized-
+    serving convention PosteriorRequest also uses) — apply the
+    returned ``dparams`` to a COPY if you want parameter values.
+    Cold is EXPLICIT: only ``cold=True`` creates (or REBUILDS —
+    that is how you re-linearize after a parameter/hyperparameter
+    change) a state, and a warm append against a missing state
+    fails with ``StateMissing`` instead of silently promoting
+    itself to a cold build — otherwise a small append racing an
+    in-flight cold build could install a tail-only state as if it
+    covered the full dataset. ECORR models are rejected (appended
+    epochs would grow the basis rank and break the fixed shape
+    classes); wideband TOAs are rejected like every serve GLS kind.
+    States are in-memory: after a process restart the first request
+    per key must be cold."""
+
+    kind = "append"
+
+    def __init__(self, state_key: str, toas=None, model=None,
+                 cold: Optional[bool] = None, **kw):
+        super().__init__(toas=toas, model=model, **kw)
+        self.state_key = str(state_key)
+        self.cold = cold
+        self._store = None   # bound by the engine at admission
+
+    def bind_store(self, store):
+        self._store = store
+
+    def ensure_problem(self):
+        """Assemble ONLY this request's rows, basis-aligned with the
+        cached state (tspan pinned to the cold span). Raises
+        ``StateMissing`` for a warm append with no cached state and
+        ``ValueError`` for ECORR/wideband/shape-mismatched models."""
+        if self.problem is not None:
+            return self.problem
+        from pint_tpu.serve.append import build_append_rows
+
+        entry = None
+        if self._store is not None:
+            entry = self._store.get(self.state_key)
+        cold = self.cold
+        if cold is None:
+            # never auto-promote to cold: an unspecified-cold append
+            # is a WARM append, and a missing state is an error — a
+            # tail batch must not masquerade as the full dataset
+            # (e.g. racing an in-flight cold build, or after a
+            # process restart lost the store)
+            cold = False
+        if not cold and entry is None:
+            raise StateMissing(
+                f"append state {self.state_key!r} not found (process "
+                f"restart, or never cold-built?); submit a cold "
+                f"build (cold=True with the full dataset) first")
+        self.cold = bool(cold)
+        tspan = None if cold or entry is None else entry.tspan
+        tref = None if cold or entry is None else entry.tref
+        self.problem = build_append_rows(
+            self.toas, self.model, tspan=tspan, tref=tref,
+            track_mode=self.track_mode)
+        if entry is not None and not cold:
+            entry.check_compatible(self.problem)
+        return self.problem
 
 
 class PhasePredictRequest(Request):

@@ -71,6 +71,7 @@ from pint_tpu.runtime import faults
 from pint_tpu.serve.admission import AdmissionController
 from pint_tpu.serve.bucket import (
     ExecutableCache,
+    append_shape_class,
     gls_shape_class,
     pad_dim,
     phase_shape_class,
@@ -79,6 +80,8 @@ from pint_tpu.serve.bucket import (
 )
 from pint_tpu.serve.metrics import ServeMetrics
 from pint_tpu.serve.request import (
+    AppendResult,
+    AppendTOAsRequest,
     DeadlineExceeded,
     EngineKilled,
     FitStepRequest,
@@ -186,6 +189,13 @@ class ServeEngine:
                                     admission=self.admission,
                                     router=self.router)
         self.metrics.restart_info = self._restart_info(aot_dir)
+        # per-pulsar cached accumulated normal equations (ISSUE 12):
+        # the AppendTOAsRequest state registry — in-memory, delta
+        # commits under its own lock at collect time
+        from pint_tpu.serve.append import AppendStore
+
+        self.append_store = AppendStore()
+        self.metrics.append_store = self.append_store
         self._open: dict = {}                  # key -> _OpenBucket
         self._ready: collections.deque = collections.deque()
         self._pool_last_collect: dict = {}     # pool -> last collect t
@@ -456,6 +466,8 @@ class ServeEngine:
             return "phase"
         if isinstance(req, PosteriorRequest):
             return "posterior"
+        if isinstance(req, AppendTOAsRequest):
+            return "append"
         return "gls"
 
     def _predicted_wait_locked(self, req) -> float:
@@ -662,6 +674,21 @@ class ServeEngine:
             if key is None:
                 return ("phase", pow2_ceil(n), pad_dim(k, 4)), True
             return key, False
+        if isinstance(r, AppendTOAsRequest):
+            # bind the engine's state store BEFORE assembly: a warm
+            # append's rows must be built on the cold span's Fourier
+            # frequencies (the tspan override), which only the store
+            # knows
+            r.bind_store(self.append_store)
+            with annotate("serve.assemble"):
+                pr = r.ensure_problem()
+            n, p = pr.M.shape
+            q = pr.F.shape[1]
+            key = append_shape_class(n, p, q, self.bucket_edges)
+            if key is None:
+                return ("append", pow2_ceil(n), pad_dim(p),
+                        pad_dim(q)), True
+            return key, False
         with annotate("serve.assemble"):
             pr = r.ensure_problem()
         n, p = pr.M.shape
@@ -710,7 +737,8 @@ class ServeEngine:
         Pb = self._batch_pad(len(grp))
         full_key = key + (Pb,)
         t0 = time.monotonic()
-        kind = key[0] if key[0] in ("phase", "posterior") else "gls"
+        kind = key[0] if key[0] in ("phase", "posterior",
+                                    "append") else "gls"
         rows = self._unit_rows(key, grp, Pb)
         pool = self.router.pick(kind, rows)
         self.router.issued(pool, len(grp), rows, kind=kind)
@@ -740,6 +768,14 @@ class ServeEngine:
                     collect = self.cache.phase_begin(
                         full_key, grp, nb, kb, Pb, sync=sync,
                         pool=pool, info=info)
+                elif key[0] == "append":
+                    _, nb, pb, qb = key
+                    entries = self._append_entries(grp)
+                    info["append_entries"] = entries
+                    collect = self.cache.append_begin(
+                        full_key, grp, shape=(Pb, nb, pb, qb),
+                        entries=entries, sync=sync, pool=pool,
+                        info=info)
                 elif key[0] == "posterior":
                     _, nb, pb, qb = key[:4]
                     collect = self.cache.posterior_begin(
@@ -755,6 +791,52 @@ class ServeEngine:
         except Exception as e:
             collect = e
         return key, full_key, grp, Pb, t0, collect, pool, info, usp
+
+    def _append_entries(self, grp: List):
+        """Per-request cached state entries at ISSUE time (None =
+        cold slot, starts from the zero state). Two same-key
+        requests in one unit both read the pre-batch state — the
+        kernel returns additive DELTAS, so both land at commit and
+        each response reflects the data up to its own rows."""
+        entries = []
+        for r in grp:
+            e = None
+            if not r.cold:
+                e = self.append_store.get(r.state_key)
+            entries.append(e)
+        return entries
+
+    def _append_finish(self, key, grp: List, out, info: dict):
+        """Commit the append deltas to the state store and scatter
+        results. A slot whose CG/basis solve failed (ok False) fails
+        its future WITHOUT committing — the state stays exactly as
+        before, so the caller can retry or cold-rebuild."""
+        (cm_used, dSig, db, du, dscal, dparams, cov, chi2, chi2r,
+         ok, iters) = out
+        entries = info.get("append_entries") or [None] * len(grp)
+        for k, r in enumerate(grp):
+            pr = r.problem
+            p = pr.M.shape[1]
+            if not bool(ok[k]):
+                r.future.set_exception(ValueError(
+                    f"append solve for state {r.state_key!r} failed "
+                    f"(singular/degenerate combined system); state "
+                    f"NOT updated"))
+                continue
+            try:
+                entry = self.append_store.commit(
+                    r.state_key, pr, key[2], key[3],
+                    cold=entries[k] is None, cm_used=cm_used[k],
+                    dSig=dSig[k], db=db[k], du=du[k],
+                    dscal=dscal[k], nrows=pr.M.shape[0])
+            except Exception as e:
+                r.future.set_exception(e)
+                continue
+            r.future.set_result(AppendResult(
+                names=pr.names, dparams=dparams[k][:p],
+                cov=cov[k][:p, :p], chi2=float(chi2[k]),
+                chi2r=float(chi2r[k]), ntoa_total=entry.ntoa,
+                cold=entries[k] is None, cg_iters=int(iters[k])))
 
     def _unit_rows(self, key, grp: List, Pb: int) -> int:
         """Kind-local work units one sealed unit dispatches (feeds
@@ -793,7 +875,8 @@ class ServeEngine:
         rate learning with the pool that ACTUALLY served — and the
         latency histograms (queue wait / dispatch wall / e2e per
         (pool, kind, class), ISSUE 10) with every member request."""
-        kind = key[0] if key[0] in ("phase", "posterior") else "gls"
+        kind = key[0] if key[0] in ("phase", "posterior",
+                                    "append") else "gls"
         rows = self._unit_rows(key, grp, Pb)
         try:
             if isinstance(collect, Exception):
@@ -825,6 +908,8 @@ class ServeEngine:
                         acceptance_fraction=float(acc[k])
                         / max(1, r.walker_steps),
                         nsteps=r.nsteps))
+            elif key[0] == "append":
+                self._append_finish(key, grp, out, info)
             else:
                 dparams, cov, chi2, chi2r = out
                 for k, r in enumerate(grp):

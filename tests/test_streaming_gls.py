@@ -1,0 +1,439 @@
+"""Matrix-free streaming GLS oracles (ISSUE 12).
+
+CPU equality oracles for the chunked normal-equation accumulator +
+preconditioned-CG solve (``pint_tpu.parallel.streaming``): chunk-size
+invariance (the same answer at every chunk K), CG-vs-dense-Cholesky
+equality against the one-shot ``build_fit_step`` kernel, the
+StreamingGLSFitter-vs-DownhillGLSFitter fit equality, ``Fitter.auto``
+routing, the validated config parsers, the labeled host-mirror
+failover, and the serve-side AppendTOAsRequest (rank update vs the
+combined-set oracle, basis alignment, chaos failover)."""
+
+import copy
+import io
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from pint_tpu.models import get_model
+from pint_tpu.simulation import make_fake_toas_fromMJDs
+from pint_tpu.toa import merge_TOAs
+
+@pytest.fixture(autouse=True)
+def clean_runtime():
+    """Breakers are process-global; a tripped one (the failover
+    tests) must never leak across tests — the test_runtime_faults
+    isolation pattern (obs.reset also swaps the metric registry)."""
+    from pint_tpu import obs
+    from pint_tpu.runtime import reset_runtime
+
+    reset_runtime()
+    obs.reset()
+    yield
+    reset_runtime()
+    obs.reset()
+
+
+PAR = """PSR J1744-1134
+RAJ 17:44:29.39 1
+DECJ -11:34:54.6 1
+PMRA 18.8 1
+PMDEC -9.4 1
+F0 245.4261196 1
+F1 -5.38e-16 1
+DM 3.14 1
+PEPOCH 54500
+POSEPOCH 54500
+TZRMJD 54500.1
+TZRSITE @
+TZRFRQ 1400
+UNITS TDB
+EFAC -be X 1.1
+EQUAD -be X 0.4
+TNREDAMP -13.5
+TNREDGAM 2.9
+TNREDC 8
+"""
+
+PAR_ECORR = PAR + "ECORR -be X 1.1\n"
+
+
+def _mk(par, n=600, seed=3, span=(53500.0, 56500.0),
+        clustered=False):
+    rng = np.random.default_rng(seed)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(par))
+        if clustered:
+            nclu = n // 4
+            centers = np.linspace(span[0] + 1, span[1] - 1, nclu)
+            offs = np.linspace(0.0, 0.02, 4)
+            mjds = (centers[:, None] + offs[None, :]).ravel()
+        else:
+            mjds = np.sort(rng.uniform(*span, n))
+        toas = make_fake_toas_fromMJDs(
+            mjds, model, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 820.0], len(mjds) // 2),
+            add_noise=True, rng=rng)
+        for f in toas.flags:
+            f["be"] = "X"
+    return model, toas
+
+
+def _dense_oracle(model, toas, **flags):
+    from pint_tpu.parallel import build_fit_step
+
+    step, args, names = build_fit_step(model, toas, anchored=False,
+                                       jac_f32=False,
+                                       matmul_f32=False, **flags)
+    out = jax.jit(step)(*args)
+    return (np.asarray(out[0]), np.asarray(out[1]), float(out[2]),
+            names)
+
+
+def _stream(model, toas, chunk, **flags):
+    from pint_tpu.parallel.streaming import StreamingGLS
+
+    sg = StreamingGLS(model, toas, chunk=chunk, anchored=False,
+                      jac_f32=False, matmul_f32=False, **flags)
+    state = sg.accumulate(sg.th0, sg.tl0)
+    return sg, sg.solve(state)
+
+
+def test_cg_matches_dense_cholesky():
+    """The matrix-free CG solution equals the dense one-shot kernel
+    (dparams, covariance, bases-marginalized chi2) at f64."""
+    model, toas = _mk(PAR)
+    dpD, covD, chi2D, names = _dense_oracle(model, toas)
+    sig = np.sqrt(np.abs(np.diag(covD)))
+    sg, (dp, cov, chi2, chi2r, xf, ok, iters) = _stream(
+        model, toas, 128)
+    assert ok
+    assert iters <= 8 * (len(names) + 1)
+    assert np.max(np.abs(dp - dpD) / sig) < 1e-8
+    assert abs(chi2r - chi2D) < 1e-9 * abs(chi2D)
+    assert np.max(np.abs(cov - covD)
+                  / np.outer(sig, sig)) < 1e-8
+
+
+def test_chunk_size_invariance():
+    """Same answer at every chunk K — including a K that does not
+    divide N (padded final chunk)."""
+    model, toas = _mk(PAR, n=600)
+    results = {}
+    for chunk in (64, 100, 256, 1024):
+        _, (dp, cov, chi2, chi2r, xf, ok, iters) = _stream(
+            model, toas, chunk)
+        assert ok, chunk
+        results[chunk] = (dp, chi2r)
+    ref_dp, ref_chi = results[1024]
+    sig = np.sqrt(np.abs(np.diag(cov)))
+    for chunk, (dp, chi) in results.items():
+        assert np.max(np.abs(dp - ref_dp) / sig) < 1e-9, chunk
+        assert abs(chi - ref_chi) < 1e-10 * abs(ref_chi), chunk
+
+
+def test_ecorr_boundary_carry():
+    """ECORR epochs straddling chunk boundaries are downdated
+    exactly (the Sherman-Morrison boundary carry): clustered epochs
+    of 4 TOAs with chunk sizes that split them mid-epoch."""
+    model, toas = _mk(PAR_ECORR, n=400, clustered=True)
+    dpD, covD, chi2D, names = _dense_oracle(model, toas)
+    sig = np.sqrt(np.abs(np.diag(covD)))
+    for chunk in (66, 128):   # 66: every chunk boundary mid-epoch
+        _, (dp, cov, chi2, chi2r, xf, ok, iters) = _stream(
+            model, toas, chunk)
+        assert ok
+        assert np.max(np.abs(dp - dpD) / sig) < 1e-8, chunk
+        assert abs(chi2r - chi2D) < 1e-9 * abs(chi2D), chunk
+
+
+def test_numpy_mirror_matches_device():
+    """The host failover mirror (chunked numpy accumulate + numpy
+    CG) reproduces the device path."""
+    model, toas = _mk(PAR_ECORR, n=400, clustered=True)
+    sg, (dp, cov, chi2, chi2r, xf, ok, iters) = _stream(
+        model, toas, 128)
+    dpn, covn, chin, chirn, xfn, okn, _ = sg.solve_np()
+    assert okn
+    sig = np.sqrt(np.abs(np.diag(cov)))
+    assert np.max(np.abs(dpn - dp) / sig) < 1e-7
+    assert abs(chirn - chi2r) < 1e-8 * abs(chi2r)
+
+
+def test_production_flags_streaming():
+    """The forced TPU production trio (anchored + f32 Jacobian +
+    f32 Gram) streams within the f32 discipline of the dense step."""
+    from pint_tpu.parallel import build_fit_step
+    from pint_tpu.parallel.streaming import StreamingGLS
+
+    model, toas = _mk(PAR, n=600)
+    step, args, names = build_fit_step(model, toas, anchored=True,
+                                       jac_f32=True, matmul_f32=True)
+    out = jax.jit(step)(*args)
+    dpD = np.asarray(out[0])
+    sig = np.sqrt(np.abs(np.diag(np.asarray(out[1]))))
+    sg = StreamingGLS(model, toas, chunk=128, anchored=True,
+                      jac_f32=True, matmul_f32=True)
+    state = sg.accumulate(sg.th0, sg.tl0)
+    dp, cov, chi2, chi2r, xf, ok, iters = sg.solve(state)
+    assert ok
+    assert np.max(np.abs(dp - dpD) / sig) < 3e-2
+    assert abs(chi2r - float(out[2])) < 1e-5 * abs(float(out[2]))
+
+
+def test_streaming_fitter_matches_downhill():
+    """StreamingGLSFitter converges to the DownhillGLSFitter fit."""
+    from pint_tpu.gls import DownhillGLSFitter, StreamingGLSFitter
+
+    model, toas = _mk(PAR, n=600)
+    m1, m2 = copy.deepcopy(model), copy.deepcopy(model)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c1 = DownhillGLSFitter(toas, m1).fit_toas(maxiter=10)
+    f2 = StreamingGLSFitter(toas, m2, chunk=128, anchored=False,
+                            jac_f32=False, matmul_f32=False)
+    c2 = f2.fit_toas(maxiter=10)
+    assert abs(c1 - c2) < 1e-6 * abs(c1)
+    for n in m1.free_params:
+        e = m1.get_param(n).uncertainty or 1.0
+        assert abs(m1.get_param(n).value
+                   - m2.get_param(n).value) / e < 1e-4, n
+    assert f2.passes >= 2
+    assert f2.stats is not None and f2.stats.converged
+
+
+def test_fitter_auto_routing(monkeypatch):
+    """Fitter.auto picks the streaming path above the threshold,
+    honors 0 = off and the explicit flag."""
+    from pint_tpu.fitter import Fitter
+    from pint_tpu.gls import DownhillGLSFitter, StreamingGLSFitter
+
+    model, toas = _mk(PAR, n=600)
+    monkeypatch.setenv("PINT_TPU_STREAM_MIN_TOA", "500")
+    assert isinstance(Fitter.auto(toas, copy.deepcopy(model)),
+                      StreamingGLSFitter)
+    monkeypatch.setenv("PINT_TPU_STREAM_MIN_TOA", "0")
+    assert isinstance(Fitter.auto(toas, copy.deepcopy(model)),
+                      DownhillGLSFitter)
+    monkeypatch.setenv("PINT_TPU_STREAM_MIN_TOA", "500")
+    assert isinstance(
+        Fitter.auto(toas, copy.deepcopy(model), streaming=False),
+        DownhillGLSFitter)
+    monkeypatch.delenv("PINT_TPU_STREAM_MIN_TOA", raising=False)
+    assert isinstance(
+        Fitter.auto(toas, copy.deepcopy(model), streaming=True),
+        StreamingGLSFitter)
+
+
+def test_config_parsers_validated(monkeypatch):
+    """The ISSUE 12 knobs go through warn-and-ignore validated
+    parsers, never raw env reads; a pinned chunk rounds UP to a
+    power of two so a typo can never un-quantize the compile keys."""
+    from pint_tpu import config
+
+    monkeypatch.delenv("PINT_TPU_STREAM_CHUNK", raising=False)
+    assert config.stream_chunk(100_000) == 16384
+    assert config.stream_chunk(1_000_000) == 65536
+    assert config.stream_chunk(1000) == 4096
+    monkeypatch.setenv("PINT_TPU_STREAM_CHUNK", "3000")
+    assert config.stream_chunk(10_000) == 4096   # rounded up pow2
+    monkeypatch.setenv("PINT_TPU_STREAM_CHUNK", "bogus")
+    assert config.stream_chunk(100_000) == 16384  # warned + auto
+    monkeypatch.setenv("PINT_TPU_STREAM_CHUNK", "-5")
+    assert config.stream_chunk(100_000) == 16384
+    monkeypatch.setenv("PINT_TPU_STREAM_MIN_TOA", "nope")
+    assert config.solve_streaming() == 200_000
+    monkeypatch.setenv("PINT_TPU_STREAM_MIN_TOA", "-1")
+    assert config.solve_streaming() == 200_000
+    monkeypatch.setenv("PINT_TPU_STREAM_MIN_TOA", "12345")
+    assert config.solve_streaming() == 12345
+
+
+def test_streaming_failover_is_labeled(monkeypatch):
+    """A wedged backend (injected hang past the watchdog deadline)
+    fails the whole streaming fit over to the numpy mirror —
+    warned, counted, and equal to the direct dense fit."""
+    from pint_tpu.gls import StreamingGLSFitter
+    from pint_tpu.runtime import faults, get_supervisor
+
+    model, toas = _mk(PAR, n=400)
+    m = copy.deepcopy(model)
+    monkeypatch.setenv("PINT_TPU_DISPATCH_DEADLINE_MS", "300")
+    plan = faults.FaultPlan(
+        [faults.Fault(match="stream", kind="hang", seconds=10.0)])
+    f = StreamingGLSFitter(toas, m, chunk=128, anchored=False,
+                           jac_f32=False, matmul_f32=False)
+    with plan.active():
+        with pytest.warns(RuntimeWarning, match="failed over"):
+            chi2 = f.fit_toas(maxiter=6)
+    assert np.isfinite(chi2)
+    assert get_supervisor().snapshot()["failovers"] >= 1
+    # equality vs the direct dense fit
+    from pint_tpu.gls import DownhillGLSFitter
+
+    m2 = copy.deepcopy(model)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c2 = DownhillGLSFitter(toas, m2).fit_toas(maxiter=6)
+    assert abs(chi2 - c2) < 1e-6 * abs(c2)
+
+
+# ---------------------------------------------------------- serving
+
+
+def _mk_append(n0=800, nnew=48, seed=11):
+    rng = np.random.default_rng(seed)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        model = get_model(io.StringIO(PAR))
+        mjds = np.sort(rng.uniform(53500, 56000, n0))
+        toas0 = make_fake_toas_fromMJDs(
+            mjds, model, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 820.0], n0 // 2),
+            add_noise=True, rng=rng)
+        mjds2 = np.sort(rng.uniform(56001, 56030, nnew))
+        toas_new = make_fake_toas_fromMJDs(
+            mjds2, model, error_us=1.0,
+            freq_mhz=np.tile([1400.0, 820.0], nnew // 2),
+            add_noise=True, rng=rng)
+        for t in (toas0, toas_new):
+            for f in t.flags:
+                f["be"] = "X"
+    return model, toas0, toas_new
+
+
+def test_append_rank_update_matches_combined_oracle():
+    """Cold build + warm append == a fresh solve over the combined
+    set (basis pinned to the cold span/epoch): the O(new-TOA)
+    re-convergence is exact, not approximate."""
+    from pint_tpu.serve import AppendTOAsRequest, ServeEngine
+    from pint_tpu.serve.append import build_append_rows
+    from pint_tpu.parallel.streaming import stream_solve_np
+
+    model, toas0, toas_new = _mk_append()
+    eng = ServeEngine()
+    r1 = eng.submit(AppendTOAsRequest(
+        "psr", toas=toas0, model=model,
+        cold=True)).result(timeout=60)
+    assert r1.cold and r1.ntoa_total == toas0.ntoas
+    r2 = eng.submit(AppendTOAsRequest("psr", toas=toas_new,
+                                      model=model)).result(timeout=60)
+    assert not r2.cold
+    assert r2.ntoa_total == toas0.ntoas + toas_new.ntoas
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        comb = merge_TOAs([toas0, toas_new])
+    entry = eng.append_store.get("psr")
+    pr = build_append_rows(comb, model, tspan=entry.tspan,
+                           tref=entry.tref)
+    dpO, covO, chi2O, chi2rO, _, okO, _ = stream_solve_np(
+        pr.M, pr.F, pr.phi, pr.r, pr.nvec, 512,
+        incoffset=pr.submean)
+    assert okO
+    sig = np.sqrt(np.abs(np.diag(covO)))
+    assert np.max(np.abs(r2.dparams - dpO) / sig) < 1e-7
+    assert abs(r2.chi2r - chi2rO) < 1e-8 * abs(chi2rO)
+    snap = eng.metrics.snapshot()["append"]
+    assert snap["cold_builds"] == 1 and snap["rank_updates"] == 1
+
+
+def test_append_state_contracts():
+    """Cold is EXPLICIT: an unspecified-cold append against a
+    missing state fails with StateMissing (it must never
+    self-promote to a cold build — a tail batch racing an in-flight
+    cold build would otherwise install a tail-only state); ECORR
+    models are rejected; an explicit second cold build REBUILDS the
+    state from scratch (the re-linearization path)."""
+    from pint_tpu.serve import (
+        AppendTOAsRequest,
+        ServeEngine,
+        StateMissing,
+    )
+
+    model, toas0, toas_new = _mk_append(n0=200, nnew=16)
+    eng = ServeEngine()
+    # unspecified cold == warm: missing state is an error, not an
+    # implicit cold build
+    with pytest.raises(StateMissing):
+        eng.submit(AppendTOAsRequest(
+            "ghost", toas=toas_new,
+            model=model)).result(timeout=60)
+    with pytest.raises(StateMissing):
+        eng.submit(AppendTOAsRequest(
+            "ghost", toas=toas_new, model=model,
+            cold=False)).result(timeout=60)
+    # ECORR models rejected at assembly
+    me, te = _mk(PAR_ECORR, n=64, clustered=True)
+    fut = eng.submit(AppendTOAsRequest("ec", toas=te, model=me,
+                                       cold=True))
+    with pytest.raises(ValueError, match="ECORR"):
+        fut.result(timeout=60)
+    # cold build, warm extend, then explicit cold REBUILD resets
+    r1 = eng.submit(AppendTOAsRequest(
+        "dup", toas=toas0, model=model,
+        cold=True)).result(timeout=60)
+    assert r1.cold
+    r2 = eng.submit(AppendTOAsRequest(
+        "dup", toas=toas_new, model=model)).result(timeout=60)
+    assert r2.ntoa_total == toas0.ntoas + toas_new.ntoas
+    r3 = eng.submit(AppendTOAsRequest(
+        "dup", toas=toas0, model=model,
+        cold=True)).result(timeout=60)
+    assert r3.cold and r3.ntoa_total == toas0.ntoas
+
+
+def test_append_chaos_mid_append_failover():
+    """Mid-append backend death: the append dispatch fails over to
+    the host mirror — labeled in the supervisor counters, future
+    resolves with the SAME answer, zero hung futures."""
+    from pint_tpu.runtime import faults, get_supervisor
+    from pint_tpu.serve import AppendTOAsRequest, ServeEngine
+
+    model, toas0, toas_new = _mk_append(n0=300, nnew=32)
+    eng = ServeEngine()
+    r1 = eng.submit(AppendTOAsRequest(
+        "psr", toas=toas0, model=model,
+        cold=True)).result(timeout=60)
+    assert r1.cold
+    before = get_supervisor().snapshot()["failovers"] + \
+        eng.supervisor.snapshot()["failovers"]
+    plan = faults.FaultPlan(
+        [faults.Fault(match="serve.append", kind="error")])
+    with plan.active():
+        r2 = eng.submit(AppendTOAsRequest(
+            "psr", toas=toas_new, model=model)).result(timeout=120)
+    assert not r2.cold
+    assert r2.ntoa_total == toas0.ntoas + toas_new.ntoas
+    after = get_supervisor().snapshot()["failovers"] + \
+        eng.supervisor.snapshot()["failovers"]
+    assert after > before
+    # and the state is intact: a clean follow-up append still works
+    _, _, toas_more = _mk_append(n0=300, nnew=32, seed=12)
+    r3 = eng.submit(AppendTOAsRequest(
+        "psr", toas=toas_more, model=model)).result(timeout=60)
+    assert r3.ntoa_total == r2.ntoa_total + toas_more.ntoas
+
+
+def test_append_journal_ack():
+    """Payload-carrying append requests journal like every kind:
+    admitted before dispatch, acked served on completion."""
+    from pint_tpu.serve import AppendTOAsRequest, ServeEngine
+    from pint_tpu.serve.journal import RequestJournal
+
+    model, toas0, _ = _mk_append(n0=200, nnew=16)
+    j = RequestJournal.__new__(RequestJournal)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        j = RequestJournal(d + "/j.jsonl")
+        eng = ServeEngine(journal=j)
+        fut = eng.submit(AppendTOAsRequest(
+            "psr", toas=toas0, model=model, cold=True, rid="r1",
+            payload={"kind": "append", "key": "psr"}))
+        fut.result(timeout=60)
+        counts = j.counts()
+        assert counts["admitted"] == 1
+        assert counts["acked"] == 1

@@ -53,8 +53,14 @@ STAGES = {
     "posterior": "posterior_whole_chain_vs_per_step",
     "trace": "trace_capture_north_star_plus_serve",
     "metrics": "serve_metrics_plane",
+    "streaming": "gls_streaming_scan",
+    "append": "serve_append_incremental_vs_cold_100k",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
+# on-chip streaming points: bounded to fit one watcher stage window
+# (the 1M CPU-mesh point is the bench artifact; on chip the curve's
+# shape is the evidence, captured at sizes that finish in minutes)
+STREAM_NS = (100_000, 1_000_000)
 ATTR_VARIANTS = ("production", "no_hybrid_jac", "jac_f64",
                  "matmul_f64", "unanchored", "round3_all_f64")
 PTA_SIZES = (67, 134, 268)
@@ -81,6 +87,8 @@ def remaining():
     for stage, metric in STAGES.items():
         if stage == "scan":
             done = all(have(metric, ntoa=n) for n in SCAN_NS)
+        elif stage == "streaming":
+            done = all(have(metric, ntoa=n) for n in STREAM_NS)
         elif stage == "attr":
             done = all(have(metric, variant=v) for v in ATTR_VARIANTS)
         elif stage == "pta_scale":
@@ -320,6 +328,33 @@ def stage_serve_degraded(backend):
     print(json.dumps(rec), flush=True)
 
 
+def stage_streaming(backend):
+    """Matrix-free streaming GLS ON CHIP (ISSUE 12): the chunked
+    accumulator + CG curve at 100k and 1M TOAs on a single chip —
+    the memory-unbounded fit path measured on the hardware it was
+    built for. Reuses bench.scan_streaming (its per-point records
+    are backend-tagged and self-appended to the ledger; the CPU
+    equality oracle auto-skips above 131k)."""
+    bench.scan_streaming()
+
+
+def stage_append(backend):
+    """Incremental AppendTOAsRequest vs cold refit ON CHIP (ISSUE
+    12): the O(new-TOA) re-convergence under real dispatch RTT —
+    over the tunnel the cold refit pays the full (N-row upload +
+    solve) while the warm append ships a bucket's worth of rows."""
+    import bench_serve
+
+    rec = bench_serve.run_append(ntoa=100_000, nnew=128)
+    if rec.get("backend") != backend:
+        raise RuntimeError(
+            f"bench_serve.run_append ran on {rec.get('backend')!r}, "
+            f"not {backend!r} (tunnel died?); stage stays on the "
+            f"to-do list")
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
 def stage_posterior(backend):
     """Whole-chain-on-device MCMC vs the per-step dispatch baseline
     ON CHIP (ISSUE 9): over the axon tunnel the host-loop mode pays
@@ -491,6 +526,10 @@ def run_stage(name, backend):
         stage_trace(backend)
     elif name == "metrics":
         stage_metrics(backend)
+    elif name == "streaming":
+        stage_streaming(backend)
+    elif name == "append":
+        stage_append(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "
