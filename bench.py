@@ -420,7 +420,9 @@ def measure_step_chained(built, k=8, reps=3):
     def chained(th_, tl_, *rest_):
         def body(carry, _):
             thc = carry
-            _, _, chi2, _ = step_fn(thc, tl_, *rest_)
+            # [:4]: with $PINT_TPU_HEALTH armed the step returns
+            # its in-trace health vector as a fifth output
+            _, _, chi2, _ = step_fn(thc, tl_, *rest_)[:4]
             return thc + 1e-18 * chi2, chi2
 
         _, chis = lax.scan(body, th_, None, length=k)
@@ -450,7 +452,9 @@ def measure_step_pipelined(built, k=8, depth=2, reps=3):
     def chained(th_, tl_, *rest_):
         def body(carry, _):
             thc = carry
-            _, _, chi2, _ = step_fn(thc, tl_, *rest_)
+            # [:4]: with $PINT_TPU_HEALTH armed the step returns
+            # its in-trace health vector as a fifth output
+            _, _, chi2, _ = step_fn(thc, tl_, *rest_)[:4]
             return thc + 1e-18 * chi2, chi2
 
         _, chis = lax.scan(body, th_, None, length=k)
@@ -746,6 +750,88 @@ def measure_metrics_overhead(step_call, reps=5):
         "metrics_off_step_ms": round(t_off * 1e3, 3),
         "metrics_on_step_ms": round(t_on * 1e3, 3),
     }
+
+
+def measure_health_overhead(model, toas, reps=5):
+    """Numerical-health overhead (ISSUE 14 acceptance: disarmed <1%,
+    armed <5% on the north-star step). The OFF leg is the production
+    default: $PINT_TPU_HEALTH unset, the step program byte-identical
+    to pre-health builds (the flag is a static compile-key bit) and
+    every ``HealthMonitor.observe`` a single branch. The ON leg arms
+    everything at once: the step REBUILT with the in-trace health
+    vector (the extra reductions ride the same dispatch) and the
+    monitor evaluating every vector against its thresholds.
+    Alternating mins, the ``measure_obs_overhead`` methodology.
+
+    Returns (overhead_block, evidence_block): the first carries the
+    ``health_off/on_step_ms`` walls + fraction for the ``obs`` block
+    and the perf-regression band; the second is the north-star
+    ``health`` block — the armed monitor's status after one
+    streaming CG pass and one FORCED shadow replay on the same
+    problem (CG-iteration histogram + device-vs-host drift in
+    sigma as on-artifact evidence)."""
+    import jax
+    import numpy as np
+
+    from pint_tpu import obs
+    from pint_tpu.obs import health as oh
+    from pint_tpu.parallel import build_fit_step
+    from pint_tpu.runtime import DispatchSupervisor
+
+    sup = DispatchSupervisor()
+    fn_off, args_off, _ = build_fit_step(model, toas, health=False)
+    j_off = jax.jit(fn_off)
+    fn_on, args_on, _ = build_fit_step(model, toas, health=True)
+    j_on = jax.jit(fn_on)
+
+    def once_off():
+        sup.dispatch(
+            lambda: jax.block_until_ready(j_off(*args_off)),
+            key="bench.health_off")
+
+    def once_on():
+        out = sup.dispatch(
+            lambda: jax.block_until_ready(j_on(*args_on)),
+            key="bench.health_on")
+        oh.observe("fit.device", {"hv": np.asarray(out[4])},
+                   key="bench.health_on")
+
+    try:
+        oh.configure(enabled=False)
+        once_off()   # warm both compiles + dispatch keys
+        oh.configure(enabled=True)
+        once_on()
+        t_off = t_on = float("inf")
+        for _ in range(max(2, reps)):
+            oh.configure(enabled=False)
+            t_off = min(t_off, time_fn(once_off, 1))
+            oh.configure(enabled=True)
+            t_on = min(t_on, time_fn(once_on, 1))
+        block = {
+            "health_off_step_ms": round(t_off * 1e3, 3),
+            "health_on_step_ms": round(t_on * 1e3, 3),
+            "health_overhead_frac": round(
+                max(0.0, t_on - t_off) / t_off, 6) if t_off else None,
+        }
+        # evidence run: armed monitor + forced shadow (rate 1) on a
+        # streaming pass of the SAME problem — populates the CG
+        # effort histogram and the device-vs-host drift histogram
+        # the north-star artifact embeds
+        mon = oh.configure(enabled=True, shadow_rate=1)
+        from pint_tpu.parallel.streaming import StreamingGLS
+
+        sg = StreamingGLS(model, toas, health=True)
+        state = sg.accumulate(sg.th0, sg.tl0)
+        sg.solve(state)
+        t0 = time.perf_counter()
+        while mon._c_shadow.total() < 1 and \
+                time.perf_counter() - t0 < 60.0:
+            time.sleep(0.05)   # the replay runs on a daemon thread
+        evidence = mon.status()
+        evidence["overhead"] = dict(block)
+        return block, evidence
+    finally:
+        obs.reset()
 
 
 # tiny-payload iterations per timing sample in measure_obs_overhead
@@ -1177,7 +1263,8 @@ def scan_streaming():
             sg = StreamingGLS(model, toas)
             t0 = time.perf_counter()
             state = sg.accumulate(sg.th0, sg.tl0)
-            dp, cov, chi2, chi2r, xf, ok, iters = sg.solve(state)
+            (dp, cov, chi2, chi2r, xf, ok, iters,
+             cg_resid) = sg.solve(state)
             wall = time.perf_counter() - t0
             # second pass on the warm compile = the honest per-pass
             # cost a fit iteration pays
@@ -1191,6 +1278,8 @@ def scan_streaming():
                    "pass_wall_ms": round(wall * 1e3, 1),
                    "chunk": sg.chunk, "nchunks": sg.nchunks,
                    "cg_iters": int(iters), "cg_ok": bool(ok),
+                   "cg_rel_residual": float(f"{cg_resid:.3e}"),
+                   "cg_budget": sg.default_budget,
                    "nparam": sg.p, "nbasis": sg.q,
                    "state_bytes": int((P * P + 4 * P + 16) * 8),
                    "peak_rss_mb": _peak_rss_mb(),
@@ -1231,7 +1320,17 @@ def scan_streaming():
                         f.stats.reduced_chi2, 4),
                     "converged": bool(f.converged),
                     "toas_per_sec": round(
-                        f.stats.toas_per_sec, 1)}
+                        f.stats.toas_per_sec, 1),
+                    # solver effort per pass (ISSUE 14 satellite):
+                    # the CG iterations each streaming pass spent
+                    # vs its runtime budget, plus the final pass's
+                    # relative residual — previously computed on
+                    # device and discarded
+                    "cg_iters_per_pass": f.cg_iters_per_pass,
+                    "cg_budget": f.cg_budget,
+                    "cg_rel_residual": float(
+                        f"{f.cg_rel_residual:.3e}")
+                    if f.cg_rel_residual is not None else None}
                 log(f"1M-TOA fit: {fit_wall:.1f} s, "
                     f"{f.passes} passes, red-chi2 "
                     f"{f.stats.reduced_chi2:.3f}")
@@ -1438,6 +1537,23 @@ def main():
             f"(frac={mblock['metrics_overhead_frac']})")
     except Exception as e:
         log(f"metrics-overhead measurement failed: {e!r}")
+    # numerical-health overhead + evidence (ISSUE 14): disarmed step
+    # vs armed in-trace taps + monitor, same methodology; the armed
+    # evidence run populates the CG-effort and shadow-drift
+    # histograms the artifact's `health` block carries
+    health_block = None
+    try:
+        hblock, health_block = measure_health_overhead(model, toas)
+        if obs_block is None:
+            obs_block = hblock
+        else:
+            obs_block.update(hblock)
+        log(f"health overhead [{backend}]: off "
+            f"{hblock['health_off_step_ms']} ms, on "
+            f"{hblock['health_on_step_ms']} ms "
+            f"(frac={hblock['health_overhead_frac']})")
+    except Exception as e:
+        log(f"health-overhead measurement failed: {e!r}")
 
     # transparency: the f32-Jacobian variant is auto-on only on TPU;
     # when we're on the CPU backend measure it too (it halves the CPU
@@ -1531,6 +1647,8 @@ def main():
         north["dispatch_overhead"] = overhead_block
     if obs_block is not None:
         north["obs"] = obs_block
+    if health_block is not None:
+        north["health"] = health_block
     if lat_block is not None:
         north["latency"] = lat_block
     north.update(roofline_fields(jitted, args, per_iter_t, backend))

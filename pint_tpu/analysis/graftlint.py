@@ -92,6 +92,19 @@ Rules (see ARCHITECTURE.md "Static analysis" for the table):
       parity oracle. Mutate through a bound registry child
       (``.inc()``) or the owning class's ``bump()`` instead.
       Pragma/allowlist policy as G9.
+  G14 health taps flow through ``HealthMonitor.observe`` (ISSUE 14):
+      (a) ``pint_tpu_health_*`` registry metrics may be created/
+      mutated ONLY inside pint_tpu/obs/health.py — a call site
+      minting its own health counter/gauge forks the incident
+      vocabulary away from the monitor's verdict/threshold/flight
+      machinery; (b) in the dispatch layer (the G6 file set), a
+      function that reads an in-trace health vector (an ``hv``-named
+      binding or an "hv" signal key) must hand it to a
+      ``.observe(...)`` call in the same function — ad-hoc host math
+      on a health vector at the call site bypasses the validated
+      thresholds, the registry recording, the span event and the
+      incident/flight path all at once. Pragma/allowlist policy as
+      G9.
 
 jit-reachability is inferred statically, seeded by project
 conventions: any function whose early positional parameters include
@@ -148,6 +161,9 @@ RULES = {
            "has a causal parent",
     "G13": "no ad-hoc counter mutation in the dispatch/serve layer "
            "outside the obs.metrics registry",
+    "G14": "health taps read through HealthMonitor.observe: "
+           "pint_tpu_health_* metrics only in obs/health.py, and "
+           "dispatch-layer health vectors must reach an observe()",
 }
 
 # entry points allowed to mutate global jax config (G7): the package
@@ -930,6 +946,9 @@ G13_COUNTER_NAMES = frozenset({
     "suppressed",
     # streaming GLS / append serving (ISSUE 12)
     "chunk_dispatches", "cg_solves", "cold_builds", "rank_updates",
+    # numerical health (ISSUE 14)
+    "health_incidents", "shadow_replays", "shadow_drift_exceeded",
+    "cg_budget_exhausted",
 })
 
 
@@ -1005,6 +1024,100 @@ def check_g13(m: ModuleInfo) -> List[Violation]:
             f"and the parity oracle) — mutate through a bound "
             f"registry child (.inc()) or the owning bump()",
             m.line_text(node.lineno)))
+    return out
+
+
+# G14 — health taps flow through HealthMonitor.observe --------------
+
+# the registry factory calls a stray health metric would ride
+_G14_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+_G14_PREFIX = "pint_tpu_health_"
+
+
+def _g14_hv_name(name: Optional[str]) -> bool:
+    return bool(name) and (name == "hv" or name.startswith("hv_"))
+
+
+def check_g14(m: ModuleInfo) -> List[Violation]:
+    """Health-tap routing (module docstring G14). Two halves:
+
+    (a) repo-wide except obs/health.py itself:
+    ``om.counter("pint_tpu_health_...")`` (or gauge/histogram)
+    anywhere else — health.py's obs/ SIBLINGS included — mints a
+    health metric the monitor's verdict machinery never sees;
+
+    (b) dispatch layer only: a function binding/reading an ``hv``
+    health vector must call ``.observe(...)`` somewhere in its body
+    (the lexical approximation class of G10's frozen-guard check —
+    a vector handed to a helper that observes escapes it, same as
+    every other rule's known aliasing limit)."""
+    out = []
+    if m.relpath != "pint_tpu/obs/health.py":
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _tail_name(node.func) not in _G14_METRIC_FACTORIES:
+                continue
+            for a in node.args[:1]:
+                if isinstance(a, ast.Constant) and \
+                        isinstance(a.value, str) and \
+                        a.value.startswith(_G14_PREFIX):
+                    out.append(Violation(
+                        "G14", m.relpath, node.lineno,
+                        f"health metric {a.value!r} created outside "
+                        f"pint_tpu/obs/health.py: the monitor's "
+                        f"thresholds/incident/flight machinery never "
+                        f"sees it — record through "
+                        f"HealthMonitor.observe instead",
+                        m.line_text(node.lineno)))
+    if not _g6_dispatch_applies(m.relpath):
+        return out
+    for f in m.functions:
+        if m.in_jit_region(f):
+            # the PRODUCER side: in-trace kernels build the hv —
+            # traced code cannot (and must not) call observe
+            continue
+        uses_hv = False
+        observes = False
+        todo = [f]
+        while todo:
+            cur = todo.pop()
+            for node in ast.iter_child_nodes(cur):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node is not f and node in m.jit_funcs:
+                    continue  # nested PRODUCER kernel: in-trace hv
+                todo.append(node)
+                if isinstance(node, ast.Name) and \
+                        _g14_hv_name(node.id):
+                    uses_hv = True
+                elif isinstance(node, ast.Constant) and \
+                        node.value == "hv":
+                    uses_hv = True
+                elif isinstance(node, ast.Call) and \
+                        _tail_name(node.func) == "observe":
+                    observes = True
+        if uses_hv and not observes:
+            # closure pattern: a nested dispatch closure may hand
+            # the vector back to its builder, which observes — a
+            # lexical ancestor's observe covers it (the G12
+            # ancestor-closure approximation)
+            cur = m.enclosing_function(f)
+            while cur is not None and not observes:
+                observes = any(
+                    isinstance(n, ast.Call)
+                    and _tail_name(n.func) == "observe"
+                    for n in ast.walk(cur))
+                cur = m.enclosing_function(cur)
+        if uses_hv and not observes:
+            out.append(Violation(
+                "G14", m.relpath, f.lineno,
+                f"`{f.name}` reads an in-trace health vector (hv) "
+                f"without routing it through HealthMonitor.observe "
+                f"— ad-hoc host math at the call site bypasses the "
+                f"validated thresholds, registry recording, span "
+                f"event and incident path",
+                m.line_text(f.lineno)))
     return out
 
 
@@ -1402,6 +1515,7 @@ def run_lint(root: str, dynamic: bool = True,
             m, prod_per_module.get(m.relpath, set()) | prod_private)
         report.violations += check_g12(m)
         report.violations += check_g13(m)
+        report.violations += check_g14(m)
         report.violations += check_g7(m)
         report.violations += check_g8(m)
     for relpath, src in shell:

@@ -34,7 +34,10 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "chain_chunk_steps", "journal_compact_bytes",
            "trace_enabled", "trace_stream_path", "trace_ring_size",
            "flight_dir", "f32_mode", "no_pallas", "slo_enabled",
-           "slo_interval_s", "slo_specs", "metrics_port"]
+           "slo_interval_s", "slo_specs", "metrics_port",
+           "health_enabled", "shadow_rate", "health_drift_sigma",
+           "health_chi2_factor", "health_resid_sigma",
+           "health_cg_budget_frac"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -699,21 +702,8 @@ def no_pallas(flag: Optional[bool] = None) -> bool:
     truthy values disable the Pallas photon kernels, falsy/unset
     keep them; an unrecognized value warns once and is IGNORED
     (kernels stay enabled), per the warn-and-ignore convention."""
-    if flag is not None:
-        return bool(flag)
-    raw = os.environ.get("PINT_TPU_NO_PALLAS", "")
-    v = raw.lower()
-    if v in ("1", "on", "true", "yes"):
-        return True
-    if v in ("", "0", "off", "false", "no"):
-        return False
-    if ("PINT_TPU_NO_PALLAS", raw) not in _WARNED_ENV:
-        _WARNED_ENV.add(("PINT_TPU_NO_PALLAS", raw))
-        from pint_tpu.logging import log
-
-        log.warning("unparsable $PINT_TPU_NO_PALLAS=%r (want on/"
-                    "off); keeping the Pallas kernels enabled", raw)
-    return False
+    return _env_bool("PINT_TPU_NO_PALLAS", flag,
+                     context="keeping the Pallas kernels enabled")
 
 
 # ---------------------------------------------------- observability
@@ -782,20 +772,7 @@ def slo_interval_s() -> float:
     its time-series ring. Validated finite positive — a zero or
     negative interval would spin the sampler; warn-and-ignore per
     the dispatch_rtt_override_ms convention."""
-    import math
-
-    v = float(_env_number("PINT_TPU_SLO_INTERVAL_S", 10.0))
-    if not math.isfinite(v) or v <= 0.0:
-        raw = os.environ.get("PINT_TPU_SLO_INTERVAL_S")
-        key = ("PINT_TPU_SLO_INTERVAL_S", f"range:{raw}")
-        if key not in _WARNED_ENV:
-            _WARNED_ENV.add(key)
-            from pint_tpu.logging import log
-
-            log.warning("$PINT_TPU_SLO_INTERVAL_S=%r is not a "
-                        "finite positive interval; using 10", raw)
-        return 10.0
-    return v
+    return _env_positive_float("PINT_TPU_SLO_INTERVAL_S", 10.0)
 
 
 def slo_specs() -> list:
@@ -859,6 +836,187 @@ def slo_specs() -> list:
                 log.warning("dropping invalid SLO spec entry: %s",
                             exc)
     return out
+
+
+def _warn_env_range(name: str, default):
+    """Once-per-distinct-value out-of-range warning (the shared tail
+    of every validated numeric parser below)."""
+    raw = os.environ.get(name)
+    key = (name, f"range:{raw}")
+    if key not in _WARNED_ENV:
+        _WARNED_ENV.add(key)
+        from pint_tpu.logging import log
+
+        log.warning("$%s=%r is out of range; using %r", name, raw,
+                    default)
+
+
+def _env_positive_float(name: str, default: float,
+                        minimum_exclusive: float = 0.0) -> float:
+    """Validated finite float env knob > ``minimum_exclusive`` (the
+    ``slo_interval_s`` convention): warn-and-ignore on anything
+    else. THE one home of the bounded-float boilerplate — new
+    threshold knobs extend this, not re-implement it."""
+    import math
+
+    v = float(_env_number(name, default))
+    if not math.isfinite(v) or v <= minimum_exclusive:
+        _warn_env_range(name, default)
+        return default
+    return v
+
+
+def _env_bool(name: str, flag=None, default: bool = False,
+              context: str = "") -> bool:
+    """Shared tri-state on/off env parser (the warn-and-ignore
+    convention): explicit ``flag`` wins; truthy/falsy values map;
+    anything else warns once and yields ``default``. The bool
+    sibling of ``_env_positive_float``."""
+    if flag is not None:
+        return bool(flag)
+    raw = os.environ.get(name, "")
+    v = raw.lower()
+    if v in ("1", "on", "true", "yes"):
+        return True
+    if v in ("", "0", "off", "false", "no"):
+        return False
+    if (name, raw) not in _WARNED_ENV:
+        _WARNED_ENV.add((name, raw))
+        from pint_tpu.logging import log
+
+        log.warning("unparsable $%s=%r (want on/off)%s", name, raw,
+                    f"; {context}" if context else "")
+    return default
+
+
+def _env_nonneg_int(name: str, default: int) -> int:
+    """Validated non-negative int env knob; warn-and-ignore
+    otherwise (the int sibling of ``_env_positive_float``)."""
+    v = int(_env_number(name, default, cast=int))
+    if v < 0:
+        _warn_env_range(name, default)
+        return default
+    return v
+
+
+def health_enabled(flag: Optional[bool] = None) -> bool:
+    """In-trace numerical-health taps armed? ($PINT_TPU_HEALTH,
+    default OFF — the same opt-in stance as $PINT_TPU_TRACE /
+    $PINT_TPU_SLO.) When armed, the device kernels return a cheap
+    in-trace health vector as extra scalars (non-finite counts,
+    max residual in sigma, CG effort) and the process
+    ``obs.health.HealthMonitor`` evaluates it against the validated
+    thresholds below. Disarmed, the taps compile to NOTHING: the
+    health flag is a static build/compile-key bit (like donation),
+    so the disarmed executables are byte-identical to pre-health
+    ones. An explicit ``flag`` wins; an unrecognized env value warns
+    once and is ignored (stays off)."""
+    return _env_bool("PINT_TPU_HEALTH", flag,
+                     context="health taps stay off")
+
+
+def shadow_rate() -> int:
+    """Shadow-oracle drift sampling rate ($PINT_TPU_SHADOW_RATE;
+    default 0 = off): every Nth successful supervised dispatch of a
+    shadow-capable key replays the completed solve on the existing
+    numpy mirrors in a BACKGROUND thread and records device-vs-host
+    drift in sigma into the registry drift histogram — the
+    production-grade answer to "is emulated f64 still holding" at
+    sizes where no dense oracle can run. Validated non-negative int
+    (e.g. 256 = one replay per 256 dispatches per key);
+    warn-and-ignore otherwise."""
+    return _env_nonneg_int("PINT_TPU_SHADOW_RATE", 0)
+
+
+def health_drift_sigma() -> float:
+    """Shadow-oracle drift band [sigma] ($PINT_TPU_HEALTH_DRIFT_SIGMA;
+    route-aware auto default): device-vs-host-mirror parameter drift
+    beyond this many (reported) sigma is a ``numerics:drift``
+    incident.
+
+    The auto default follows the ACTIVE precision routes, because
+    the sanctioned f32 production config (auto-on on TPU) carries a
+    known, documented <1e-2-sigma quantization the shadow must
+    tolerate, while an exact-f64 deployment should flag drift far
+    below that:
+
+    - f64 routes (no f32 env, non-TPU backend): 1e-5 — the measured
+      f64 replay floor is ~1e-13 sigma and the emulated-f64 budget
+      sits decades below the band, while an UNSANCTIONED f32
+      demotion (a G9-class bug the config does not know about, so
+      the band stays tight) measures ~1.5e-4 sigma — one decade
+      above, so the detector demonstrably detects
+      (tests/test_health.py);
+    - any sanctioned f32 route active ($PINT_TPU_GLS_MATMUL /
+      $PINT_TPU_JAC f32, or auto on a TPU backend): 2e-2 — above
+      the documented f32 agreement bound, so a healthy production
+      worker never flaps /healthz on its own sanctioned
+      quantization while true garbage still flags.
+
+    An explicit env value wins (validated finite positive,
+    warn-and-ignore otherwise). Backend-init-safe: the auto
+    resolution PEEKS jax's already-built client table only (the
+    ``sample_device_memory`` discipline — this runs on the /healthz
+    scrape path under the monitor lock, and backend discovery HANGS
+    with no error on a wedged axon tunnel); an uninitialized
+    backend reads as the f64 default."""
+    auto = 1e-5
+    backend = _backend_if_initialized()
+    for env in ("PINT_TPU_GLS_MATMUL", "PINT_TPU_JAC"):
+        mode = f32_mode(env)
+        if mode is True or (mode is None and backend == "tpu"):
+            auto = 2e-2
+            break
+    return _env_positive_float("PINT_TPU_HEALTH_DRIFT_SIGMA", auto)
+
+
+def _backend_if_initialized():
+    """jax.default_backend() ONLY when a backend client already
+    exists; None otherwise — never triggers backend discovery (which
+    hangs, not errors, on a wedged axon tunnel)."""
+    import sys
+
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None or not getattr(xb, "_backends", None):
+        return None
+    import jax
+
+    return jax.default_backend()
+
+
+def health_chi2_factor() -> float:
+    """chi2 blow-up incident threshold
+    ($PINT_TPU_HEALTH_CHI2_FACTOR, default 4.0): a step whose chi2
+    GROWS past factor x the previous accepted value is a
+    ``numerics:chi2_blowup`` incident (a descent method moving
+    uphill is a numerics symptom, not an optimization choice).
+    Validated finite > 1."""
+    return _env_positive_float("PINT_TPU_HEALTH_CHI2_FACTOR", 4.0,
+                               minimum_exclusive=1.0)
+
+
+def health_resid_sigma() -> float:
+    """Max |residual|/sigma incident threshold
+    ($PINT_TPU_HEALTH_RESID_SIGMA, default 1e8): a single whitened
+    residual past this is numeric garbage (overflow, a broken phase
+    chain), not a bad timing model — genuinely mis-fit pulsars sit
+    orders of magnitude below it. Validated finite positive."""
+    return _env_positive_float("PINT_TPU_HEALTH_RESID_SIGMA", 1e8)
+
+
+def health_cg_budget_frac() -> float:
+    """CG effort incident threshold as a fraction of the runtime
+    iteration budget ($PINT_TPU_HEALTH_CG_BUDGET_FRAC, default 1.0 =
+    exhaustion only): iterations-used >= frac x budget is a
+    ``numerics:cg_budget`` incident. Lower it to be warned while CG
+    still converges but is working unusually hard. Validated finite
+    in (0, 1] — a frac > 1 could never fire (iters <= budget), so it
+    warns and falls back like every other out-of-range value."""
+    v = _env_positive_float("PINT_TPU_HEALTH_CG_BUDGET_FRAC", 1.0)
+    if v > 1.0:
+        _warn_env_range("PINT_TPU_HEALTH_CG_BUDGET_FRAC", 1.0)
+        return 1.0
+    return v
 
 
 def metrics_port() -> Optional[int]:

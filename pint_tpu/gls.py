@@ -48,13 +48,21 @@ class NonFiniteStepError(ValueError):
     over to the host fitters' SVD-capable path."""
 
 
-@partial(jax.jit, static_argnames=("f32mm",))
-def _gls_kernel(M, F, phi, r, nvec, f32mm: bool = False):
+@partial(jax.jit, static_argnames=("f32mm", "health"))
+def _gls_kernel(M, F, phi, r, nvec, f32mm: bool = False,
+                health: bool = False):
     """Basis-Woodbury GLS solve. Returns (dparams, cov_pp, chi2,
     noise_resid, xhat_full, ok) — ok False when the Cholesky produced
     non-finite values (caller falls back to SVD). With ``f32mm`` the
     normal-equation matmuls run in f32 at HIGHEST precision (the TPU
-    MXU path; see pint_tpu.parallel.fit_step._use_f32_matmul)."""
+    MXU path; see pint_tpu.parallel.fit_step._use_f32_matmul).
+
+    With ``health`` (STATIC, ISSUE 14 — part of the compile key like
+    f32mm) a seventh output rides the same dispatch: the in-trace
+    health vector ``[nonfinite_count, max_resid_sigma, chi2,
+    solve_rel_residual]`` the process ``obs.health.HealthMonitor``
+    evaluates host-side. Disarmed, the program is byte-identical to
+    the pre-health kernel."""
     p = M.shape[1]
     w = 1.0 / nvec                       # N^-1 diagonal
     # two-stage column scaling: sum(M^2*w) can exceed the exponent
@@ -100,7 +108,17 @@ def _gls_kernel(M, F, phi, r, nvec, f32mm: bool = False):
     # must still pass; exact singularity leaves O(1) relative residual
     ok = (jnp.all(jnp.isfinite(xhat)) & jnp.all(jnp.isfinite(cov))
           & (solve_err <= 1e-6 * (jnp.linalg.norm(b / d) + 1.0)))
-    return dparams, cov, chi2, noise_resid, xhat, ok
+    if not health:
+        return dparams, cov, chi2, noise_resid, xhat, ok
+    rel = solve_err / (jnp.linalg.norm(b / d) + 1.0)
+    hv = jnp.stack([
+        (jnp.sum(~jnp.isfinite(xhat)) + jnp.sum(~jnp.isfinite(chi2))
+         ).astype(jnp.float64),
+        jnp.max(jnp.abs(r) / jnp.sqrt(nvec)),
+        chi2,
+        rel,
+    ])
+    return dparams, cov, chi2, noise_resid, xhat, ok, hv
 
 
 @partial(jax.jit, static_argnames=("threshold",))
@@ -387,11 +405,30 @@ class GLSFitter(Fitter):
                     return _gls_kernel_svd(*place())  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
                 return _gls_kernel_svd(*place(), threshold=th)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
 
+        from pint_tpu import config as _config
+
+        health_on = _config.health_enabled()
+
         def run_chol(f32mm=False):
             with self._solve_scope():
-                return _gls_kernel(*place(), f32mm=f32mm)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+                return _gls_kernel(*place(), f32mm=f32mm, health=health_on)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+
+        def shadow_chol(out):
+            # shadow-oracle replay (ISSUE 14): the numpy mirror of
+            # the same algebra; drift = max |d dparams| in sigma of
+            # the device covariance. A failed-Cholesky result
+            # (ok=False — the DESIGNED degenerate route, about to be
+            # SVD-retried by the call site) carries garbage dparams:
+            # drifting the mirror against it would be a false
+            # numerics verdict, so it is not shadow-applicable.
+            if not bool(np.asarray(out[5])):
+                return None
+            mx, _, _, _ = gls_solve_np(M_h, Fb_h, phi_h, r_h,
+                                       nvec_h)
+            return _health.drift_sigma(out[0], out[1], mx)
 
         from pint_tpu import obs
+        from pint_tpu.obs import health as _health
 
         with obs.span("gls.solve_once",
                       fitter=type(self).__name__,
@@ -410,15 +447,29 @@ class GLSFitter(Fitter):
                 # f32-MXU auto-on (keyed on the process backend) is
                 # moot: CPU f64 is native, so keep full precision
                 f32mm = False if pinned else _use_f32_matmul(None)
-                x, cov, chi2, noise, _, ok = sup.dispatch(
+                out = sup.dispatch(
                     run_chol, kw={"f32mm": f32mm}, key="gls.solve",
-                    pinned=pinned)
-                if not bool(ok):
+                    pinned=pinned, shadow=shadow_chol,
+                    shadow_kind="gls")
+                x, cov, chi2, noise, _, ok = out[:6]
+                hsig = {"values": [x, chi2]}
+                if bool(ok):
+                    if health_on and len(out) > 6:
+                        hsig["hv"] = out[6]
+                else:
+                    # the DESIGNED degenerate route: warn + SVD
+                    # retry. Observed with the FINAL outcome — a
+                    # handled fallback that succeeds is not a
+                    # numerics incident (the nonfinite check on the
+                    # retried values still catches true garbage)
                     from pint_tpu.fitter import warn_degenerate
 
                     warn_degenerate()
                     x, cov, chi2, noise, _ = sup.dispatch(
                         run_svd, key="gls.svd", pinned=pinned)
+                    hsig = {"values": [x, chi2]}
+                _health.observe("gls.solve", hsig, key="gls.solve",
+                                pool="host" if pinned else "device")
         # r ≈ M (θ − θ_true): the correction is −x (see WLSFitter)
         return (-np.asarray(x), np.asarray(cov), float(chi2),
                 np.asarray(noise), names)
@@ -528,6 +579,14 @@ class StreamingGLSFitter(GLSFitter):
         self.step_flags = dict(step_flags)
         self.cg_iters = None   # CG iterations of the last solve
         self.passes = None     # streaming passes of the last fit
+        # solver effort per pass (ISSUE 14 satellite): the CG
+        # iteration count and final relative residual of EVERY
+        # streaming pass of the last fit, in pass order — the
+        # gls_streaming_scan_1m artifact reports these so a 1M-TOA
+        # fit's convergence effort is visible, not discarded
+        self.cg_iters_per_pass: Optional[list] = None
+        self.cg_rel_residual = None  # of the last solve
+        self.cg_budget = None        # runtime budget of the solves
 
     def fit_toas(self, maxiter=20, min_lambda=1e-3,
                  required_chi2_decrease=1e-2, cg_tol=1e-13):
@@ -564,11 +623,38 @@ class StreamingGLSFitter(GLSFitter):
             s = dd_np.add(dd_np.dd(th_, tl_), dd_np.dd(d))
             return np.asarray(s[0]), np.asarray(s[1])
 
-        def one_pass(th_, tl_):
-            state = sg.accumulate(th_, tl_)
-            return sg.solve(state, tol=cg_tol)
+        effort: list = []   # (cg_iters, rel_resid) per pass
+        self.cg_budget = sg.default_budget
 
-        dp, cov, _, best, xf, ok, iters = one_pass(th, tl)
+        def one_pass(th_, tl_, observe=True):
+            # trial passes suppress the per-pass health observation
+            # (a rejected line-search overshoot is the damping
+            # working, not an incident — the build_fit_loop hv
+            # discipline); ACCEPTED trials are observed below
+            state = sg.accumulate(th_, tl_, observe=observe)
+            out = sg.solve(state, tol=cg_tol, observe=observe)
+            effort.append((int(out[6]), float(out[7])))
+            return out
+
+        def observe_accepted(out):
+            from pint_tpu.obs import health as _health
+
+            sig = {"cg_iters": int(out[6]),
+                   "cg_budget": int(self.cg_budget),
+                   "cg_rel_residual": float(out[7]),
+                   "ok": bool(out[5]), "chi2": float(out[3]),
+                   "values": [out[0], out[2]]}
+            hv = sg.last_pass_hv
+            if hv is not None:
+                # the accepted pass's ACCUMULATE taps too (nonfinite
+                # Sig/b, colmax rescale) — suppressed per-trial
+                # above, owed for the state the fit actually keeps
+                sig["nonfinite"] = hv[0]
+                sig["rescale"] = hv[1]
+            _health.observe("stream.solve", sig,
+                            key="stream.solve")
+
+        dp, cov, _, best, xf, ok, iters, rel = one_pass(th, tl)
         npass = 1
         if not ok or not np.all(np.isfinite(dp)):
             raise NonFiniteStepError(
@@ -583,12 +669,13 @@ class StreamingGLSFitter(GLSFitter):
             lam, accepted = 1.0, False
             while lam >= min_lambda:
                 thc, tlc = bump(th, tl, lam * d)
-                dpc, covc, _, chic, xfc, okc, iters = \
-                    one_pass(thc, tlc)
+                outc = one_pass(thc, tlc, observe=False)
+                dpc, covc, _, chic, xfc, okc, iters, rel = outc
                 npass += 1
                 if okc and np.isfinite(chic) and \
                         chic <= best + 1e-12:
                     accepted = True
+                    observe_accepted(outc)
                     break
                 lam /= 2.0
             if not accepted:
@@ -603,6 +690,8 @@ class StreamingGLSFitter(GLSFitter):
         else:
             maxed_out = True
         self.cg_iters = int(iters)
+        self.cg_rel_residual = float(rel)
+        self.cg_iters_per_pass = [it for it, _ in effort]
         self.passes = npass
         # sync the model to the accepted point (dd-exact difference
         # vs the build slots, the device-fitter convention)
@@ -640,15 +729,19 @@ class StreamingGLSFitter(GLSFitter):
                           **self.step_flags)
         names = sg.names
         noff = 1 if names and names[0] == "Offset" else 0
+        effort: list = []
+        self.cg_budget = sg.default_budget
 
         def one_pass():
-            return sg.solve_np(tol=cg_tol)
+            out = sg.solve_np(tol=cg_tol)
+            effort.append((int(out[6]), float(out[7])))
+            return out
 
         def apply(x, sign=1.0):
             self.update_model(sign * np.concatenate(
                 [np.zeros(noff), x]), names)
 
-        dp, cov, _, best, xf, ok, iters = one_pass()
+        dp, cov, _, best, xf, ok, iters, rel = one_pass()
         if not ok or not np.all(np.isfinite(dp)):
             raise NonFiniteStepError(
                 "streaming host-mirror solve failed (singular/"
@@ -662,7 +755,8 @@ class StreamingGLSFitter(GLSFitter):
             lam, accepted = 1.0, False
             while lam >= min_lambda:
                 apply(lam * d)
-                dpc, covc, _, chic, xfc, okc, iters = one_pass()
+                dpc, covc, _, chic, xfc, okc, iters, rel = \
+                    one_pass()
                 if okc and np.isfinite(chic) and \
                         chic <= best + 1e-12:
                     accepted = True
@@ -680,6 +774,8 @@ class StreamingGLSFitter(GLSFitter):
         else:
             maxed_out = True
         self.cg_iters = int(iters)
+        self.cg_rel_residual = float(rel)
+        self.cg_iters_per_pass = [it for it, _ in effort]
         self.set_uncertainties(cov, names)
         self.noise_resids = sg.noise_realization(xf)
         self.resids = Residuals(self.toas, self.model,
@@ -974,6 +1070,8 @@ class DeviceDownhillGLSFitter(GLSFitter):
                 out = jitted(jnp.asarray(th_), jnp.asarray(tl_), *rest)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
                 return [np.asarray(o) for o in out]
 
+        from pint_tpu.obs import health as _health
+
         if steps_per_dispatch > 1:
             budget = int(min(chained_k, maxiter))
             handle = None
@@ -988,6 +1086,18 @@ class DeviceDownhillGLSFitter(GLSFitter):
                 dp = np.asarray(out[2], np.float64)
                 cov = np.asarray(out[3])
                 best = float(out[4])
+                # health tap (ISSUE 14): the loop's in-trace vector
+                # (accepted-state non-finite count / max whitened
+                # residual / chi2) when armed, plus the returned
+                # host scalars either way — observed BEFORE the
+                # non-finite guard below so an injected-NaN readback
+                # is an incident AND the failover story is unchanged
+                hsig = {"values": [dp, out[4]], "chi2": best,
+                        "chi2_prev": float(out[5])}
+                if len(out) > 11:
+                    hsig["hv"] = out[11]
+                _health.observe("fit.device", hsig,
+                                key="gls.fit_loop")
                 if iterations == 0 and (
                         not np.isfinite(float(out[5]))
                         or not np.all(np.isfinite(dp))):
@@ -1033,6 +1143,10 @@ class DeviceDownhillGLSFitter(GLSFitter):
             dp = np.asarray(out[0], np.float64)
             cov = np.asarray(out[1])
             best = float(out[2])
+            hsig = {"values": [dp, out[2]], "chi2": best}
+            if len(out) > 4:
+                hsig["hv"] = out[4]
+            _health.observe("fit.device", hsig, key="gls.fit_step")
             if not np.isfinite(best) or not np.all(np.isfinite(dp)):
                 nonfinite_error()
             for _ in range(maxiter):
@@ -1052,6 +1166,16 @@ class DeviceDownhillGLSFitter(GLSFitter):
                 if not accepted:
                     converged = True
                     break
+                # the ACCEPTED step's health tap (rejected trials
+                # are the damping working — the build_fit_loop hv
+                # discipline; this mirrors the chained path's
+                # accepted-state observation)
+                hsig = {"values": [outc[0], outc[2]],
+                        "chi2": newchi2, "chi2_prev": best}
+                if len(outc) > 4:
+                    hsig["hv"] = outc[4]
+                _health.observe("fit.device", hsig,
+                                key="gls.fit_step")
                 improved = best - newchi2
                 th, tl = thc, tlc
                 dp = np.asarray(outc[0], np.float64)

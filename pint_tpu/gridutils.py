@@ -51,8 +51,11 @@ def _build_grid_eval(model, toas, parnames: Sequence[str],
         th = th0
 
         def one_iter(th):
+            # out[:4] rather than a fixed unpack: with
+            # $PINT_TPU_HEALTH armed the step carries its in-trace
+            # health vector as a fifth output (ISSUE 14)
             dparams, cov, chi2, r = step_fn(
-                th, args[1], fh, fl_z, *args[4:])
+                th, args[1], fh, fl_z, *args[4:])[:4]
             # drop the Offset column when present; the rest align
             # with th (PHOFF models have no implicit offset column)
             return th + dparams[noff:], chi2

@@ -32,6 +32,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from pint_tpu.obs import health  # noqa: F401  (ISSUE 14 monitor)
 from pint_tpu.obs import metrics  # noqa: F401  (ISSUE 11 registry)
 from pint_tpu.obs.flight import FlightRecorder  # noqa: F401
 from pint_tpu.obs.hist import HistogramSet, LatencyHistogram  # noqa: F401
@@ -44,7 +45,7 @@ from pint_tpu.obs.tracer import (  # noqa: F401
 )
 
 __all__ = ["Tracer", "SpanHandle", "LatencyHistogram",
-           "HistogramSet", "FlightRecorder", "metrics",
+           "HistogramSet", "FlightRecorder", "metrics", "health",
            "get_tracer",
            "get_flight", "configure", "reset", "span", "open_span",
            "open_root", "event", "record_span", "current", "attach",
@@ -146,6 +147,9 @@ def reset():
 
     slo.reset()
     metrics.reset()
+    # ISSUE 14: the health monitor holds bound registry children and
+    # env-derived thresholds — same staleness hazard as the tracer
+    health.reset()
 
 
 # ------------------------------------------------------------------

@@ -35,8 +35,13 @@ _MAX_BUCKET = 41
 
 
 def _bucket_of(us: float) -> int:
-    if us < 1.0:
+    # not (us >= 1.0) also catches NaN; the top-bucket clamp catches
+    # inf BEFORE int() (int(inf) raises OverflowError — a garbage
+    # sample must clamp, never crash the recording thread)
+    if not (us >= 1.0):
         return 0
+    if us >= float(1 << _MAX_BUCKET):
+        return _MAX_BUCKET
     return min(_MAX_BUCKET, int(us).bit_length())
 
 
@@ -59,7 +64,7 @@ class LatencyHistogram:
         self._lock = threading.Lock()
 
     def record(self, seconds: float):
-        if seconds < 0.0:
+        if not (seconds >= 0.0):   # negative AND NaN clamp to zero
             seconds = 0.0
         k = _bucket_of(seconds * 1e6)
         with self._lock:

@@ -479,6 +479,22 @@ def default_health() -> dict:
             out["slo"] = w.status()
     except Exception:
         pass
+    try:
+        # ISSUE 14: the numerical-health verdict (worst recent
+        # verdict per (pool, kind), last incident reason + age) —
+        # monitor-lock only, never an engine lock; an armed monitor
+        # with an unresolved incident degrades /healthz to 503 the
+        # same way an open breaker does
+        from pint_tpu.obs import health as _health
+
+        h = _health.status()
+        if h is not None:
+            out["numerics"] = h
+            if any(not v.get("ok", True)
+                   for v in h.get("worst", {}).values()):
+                out["ok"] = False
+    except Exception:
+        pass
     return out
 
 

@@ -125,6 +125,16 @@ def default_specs() -> List[SLOSpec]:
         name="dispatch_overhead", type="gauge",
         metric="pint_tpu_dispatch_overhead_frac",
         objective=0.1, budget=0.5))
+    # ISSUE 14: numerical-health incident rate against the dispatch
+    # volume — a sustained numerics episode (NaN storms, CG budget
+    # exhaustion, drift beyond band) burns this budget and fires the
+    # slo_burn flight dump on top of the per-incident numerics:<...>
+    # dumps, the same escalation shape as shed_rate
+    specs.append(SLOSpec(
+        name="numerics_incident_rate", type="ratio",
+        bad=["pint_tpu_health_incidents_total"],
+        total=["pint_tpu_dispatch_dispatches_total"],
+        budget=0.01))
     return specs
 
 

@@ -119,7 +119,8 @@ def _build_fit_core(model, toas, pad_to: Optional[int] = None,
                     jac_f32: Optional[bool] = None,
                     anchored: Optional[bool] = None,
                     hybrid_jac: Optional[bool] = None,
-                    wideband: bool = False):
+                    wideband: bool = False,
+                    health: Optional[bool] = None):
     """(step_fn, parts_fn, args, names, meta): step_fn is pure and
     jittable,
 
@@ -161,6 +162,14 @@ def _build_fit_core(model, toas, pad_to: Optional[int] = None,
     n = toas.ntoas
     f32mm = _use_f32_matmul(matmul_f32)
     jac32 = _use_f32_jac(jac_f32)
+    # in-trace health taps (ISSUE 14): a STATIC build flag, resolved
+    # once here like the precision routes — part of the compile key
+    # (same discipline as donation), so disarmed step programs are
+    # byte-identical to pre-health ones and arming never mixes with
+    # the quantized K/chunk keys
+    from pint_tpu.config import health_enabled
+
+    health_on = health_enabled(health)
 
     # per-TOA PHASE-command offsets (tim -padd flags, turns): folded
     # into the device residual exactly where the host Residuals adds
@@ -532,8 +541,24 @@ def _build_fit_core(model, toas, pad_to: Optional[int] = None,
             sfull = jnp.asarray(sfull_np)
             dp = dp * sfull
             cov = cov * jnp.outer(sfull, sfull)
-        # time residuals only (the first N rows of a wideband stack)
-        return dp, cov, chi2, r[:valid.shape[0]]
+        if not health_on:
+            # time residuals only (first N rows of a wideband stack)
+            return dp, cov, chi2, r[:valid.shape[0]]
+        # in-trace health vector (ISSUE 14): three reductions riding
+        # the existing dispatch — total non-finite count across the
+        # step's outputs, max |whitened residual| in sigma over the
+        # valid rows, and the step chi2. Costs O(N) elementwise work
+        # fused into the program; compiled OUT entirely when the
+        # static health flag is off.
+        def nf(x):
+            return jnp.sum(~jnp.isfinite(x)).astype(jnp.float64)
+
+        hv = jnp.stack([
+            nf(r) + nf(dp) + nf(chi2),
+            jnp.max(jnp.abs(r) * tmask / jnp.sqrt(nvec2)),
+            chi2.astype(jnp.float64),
+        ])
+        return dp, cov, chi2, r[:valid.shape[0]], hv
 
     # captured before the anchored zeroing below: the wideband DM
     # channel rebuilds pv as ref + delta in anchored mode
@@ -567,14 +592,21 @@ def _build_fit_core(model, toas, pad_to: Optional[int] = None,
     meta = {"incoffset": incoffset, "nseg": nseg, "f32mm": f32mm,
             "jac32": jac32, "sfull": sfull_np,
             "anchored": anchored_on, "wideband": wideband,
-            "has_ecorr": seg is not None}
+            "has_ecorr": seg is not None, "health": health_on}
     return (step_fn, parts_fn, args,
             (["Offset"] if incoffset else []) + free, meta)
 
 
 def build_fit_step(model, toas, **flags):
     """(step_fn, args, names) — the public one-XLA-program fit
-    iteration (see ``_build_fit_core`` for the full contract)."""
+    iteration (see ``_build_fit_core`` for the full contract).
+
+    With ``health=True`` (or $PINT_TPU_HEALTH armed; ISSUE 14) the
+    step returns a FIFTH output — the in-trace health vector
+    ``[nonfinite_count, max_resid_sigma, chi2]`` — computed inside
+    the same dispatch; disarmed (the default) the 4-tuple and the
+    compiled program are byte-identical to pre-health builds (the
+    flag is a static compile-key bit, like donation)."""
     step_fn, _, args, names, _ = _build_fit_core(model, toas, **flags)
     return step_fn, args, names
 
@@ -661,7 +693,9 @@ def build_fit_loop(model, toas, max_iter: int = 8,
     """
     from jax import lax
 
-    step_fn, args, names = build_fit_step(model, toas, **step_flags)
+    step_fn, _, args, names, loop_meta = _build_fit_core(
+        model, toas, **step_flags)
+    health_on = bool(loop_meta["health"])
     noff = 1 if names and names[0] == "Offset" else 0
     K = int(max_iter)
 
@@ -678,11 +712,17 @@ def build_fit_loop(model, toas, max_iter: int = 8,
     def loop_fn(th, tl, fh, fl, batch, cache, F, phi, nvec, valid,
                 eid, jvar, budget):
         def step(a, b):
-            dp, cov, chi2, _ = step_fn(a, b, fh, fl, batch, cache, F,
-                                       phi, nvec, valid, eid, jvar)
-            return dp, cov, chi2
+            out = step_fn(a, b, fh, fl, batch, cache, F,
+                          phi, nvec, valid, eid, jvar)
+            # health (ISSUE 14): the static flag appends the
+            # in-trace vector — disarmed, the tuple (and therefore
+            # this whole loop program) is the pre-health one
+            if health_on:
+                return out[0], out[1], out[2], out[4]
+            return out[0], out[1], out[2]
 
-        dp0, cov0, chi2_0 = step(th, tl)
+        out0 = step(th, tl)
+        dp0, cov0, chi2_0 = out0[0], out0[1], out0[2]
         p = th.shape[0]
         deltas0 = jnp.zeros((K, p), th.dtype)
         lams0 = jnp.zeros(K, th.dtype)
@@ -694,7 +734,8 @@ def build_fit_loop(model, toas, max_iter: int = 8,
                 jnp.logical_and(k < K, k < budget))
 
         def body(c):
-            k, done, thk, tlk, dpk, covk, best, deltas, lams, nev = c
+            (k, done, thk, tlk, dpk, covk, best, deltas, lams,
+             nev) = c[:10]
             d = dpk[noff:]
 
             def hcond(h):
@@ -703,23 +744,31 @@ def build_fit_loop(model, toas, max_iter: int = 8,
                                        lam >= min_lambda)
 
             def hbody(h):
-                lam, _, thc, tlc, dpc, covc, chic, nv = h
+                lam, _, thc, tlc, dpc, covc, chic, nv = h[:8]
                 tht, tlt = _two_sum_add(thk, tlk, lam * d)
-                dpt, covt, chit = step(tht, tlt)
+                trial = step(tht, tlt)
+                dpt, covt, chit = trial[0], trial[1], trial[2]
                 ok = jnp.logical_and(jnp.isfinite(chit),
                                      chit <= best + 1e-12)
                 keep = lambda new, old: jnp.where(ok, new, old)
-                return (jnp.where(ok, lam, lam / 2.0), ok,
-                        keep(tht, thc), keep(tlt, tlc),
-                        keep(dpt, dpc), keep(covt, covc),
-                        keep(chit, chic), nv + 1)
+                out = (jnp.where(ok, lam, lam / 2.0), ok,
+                       keep(tht, thc), keep(tlt, tlc),
+                       keep(dpt, dpc), keep(covt, covc),
+                       keep(chit, chic), nv + 1)
+                if health_on:
+                    # the ACCEPTED trial's health vector (a rejected
+                    # overshoot legitimately NaNs its chi2 — the
+                    # line search's job, not an incident)
+                    out = out + (keep(trial[3], h[8]),)
+                return out
 
-            lam, acc, thc, tlc, dpc, covc, chic, nev = \
-                lax.while_loop(
-                    hcond, hbody,
-                    (jnp.asarray(1.0, th.dtype), jnp.asarray(False),
-                     thk, tlk, dpk, covk,
-                     jnp.asarray(jnp.inf, th.dtype), nev))
+            hcarry = (jnp.asarray(1.0, th.dtype), jnp.asarray(False),
+                      thk, tlk, dpk, covk,
+                      jnp.asarray(jnp.inf, th.dtype), nev)
+            if health_on:
+                hcarry = hcarry + (c[10],)
+            hout = lax.while_loop(hcond, hbody, hcarry)
+            lam, acc, thc, tlc, dpc, covc, chic, nev = hout[:8]
 
             improved = best - chic
             applied = jnp.where(acc, lam * d, jnp.zeros_like(d))
@@ -729,18 +778,30 @@ def build_fit_loop(model, toas, max_iter: int = 8,
             done = jnp.logical_or(
                 jnp.logical_not(acc),
                 improved < required_chi2_decrease)
-            return (k + 1, done, keep(thc, thk), keep(tlc, tlk),
-                    keep(dpc, dpk), keep(covc, covk),
-                    keep(chic, best), deltas, lams, nev)
+            out = (k + 1, done, keep(thc, thk), keep(tlc, tlk),
+                   keep(dpc, dpk), keep(covc, covk),
+                   keep(chic, best), deltas, lams, nev)
+            if health_on:
+                out = out + (keep(hout[8], c[10]),)
+            return out
 
-        k, done, thf, tlf, dpf, covf, best, deltas, lams, nev = \
-            lax.while_loop(cond, body,
-                           (jnp.asarray(0, jnp.int32),
-                            jnp.asarray(False), th, tl, dp0, cov0,
-                            chi2_0, deltas0, lams0,
-                            jnp.asarray(1, jnp.int32)))
-        return (thf, tlf, dpf, covf, best, chi2_0, k, done, deltas,
-                lams, nev)
+        carry = (jnp.asarray(0, jnp.int32),
+                 jnp.asarray(False), th, tl, dp0, cov0,
+                 chi2_0, deltas0, lams0,
+                 jnp.asarray(1, jnp.int32))
+        if health_on:
+            carry = carry + (out0[3],)
+        fin = lax.while_loop(cond, body, carry)
+        (k, done, thf, tlf, dpf, covf, best, deltas, lams,
+         nev) = fin[:10]
+        out = (thf, tlf, dpf, covf, best, chi2_0, k, done, deltas,
+               lams, nev)
+        if health_on:
+            # the accepted-state health vector rides as output 11 —
+            # appended at the END so every pre-health index (out[4]
+            # chi2, out[10] nevals, ...) is untouched
+            out = out + (fin[10],)
+        return out
 
     return loop_fn, args + (jnp.asarray(K, jnp.int32),), names
 
@@ -928,8 +989,8 @@ def build_sharded_fit_step(model, toas, mesh, axis: str = "toa",
 
     nshard = mesh.shape[axis]
     pad_to = _pad_to(toas.ntoas, nshard)
-    step_fn, args, names = build_fit_step(model, toas, pad_to=pad_to,
-                                          **flags)
+    step_fn, _, args, names, smeta = _build_fit_core(
+        model, toas, pad_to=pad_to, **flags)
     th, tl, fh, fl, batch, sc, F, phi, nvec, valid, eid, jvar = args
 
     shard = toa_sharding(mesh, axis)
@@ -960,6 +1021,9 @@ def build_sharded_fit_step(model, toas, mesh, axis: str = "toa",
         jax.device_put(eid, shard(eid)), jax.device_put(jvar, rep),
     )
     out_shardings = (rep, rep, rep, shard(jnp.zeros(n)))
+    if smeta["health"]:
+        # the in-trace health vector is a replicated 3-scalar output
+        out_shardings = out_shardings + (rep,)
     jitted = jax.jit(step_fn, out_shardings=out_shardings)
 
     def supervised(*step_args):

@@ -105,10 +105,20 @@ def _rescale_state(cm, Sig, b, u, vE, carE, cm_new, p):
 
 
 def _acc_chunk(state, M, Fv, r0, nvec, valid, eid, jv_toa, tmask,
-               f32mm: bool, has_ecorr: bool):
+               f32mm: bool, has_ecorr: bool,
+               health: bool = False):
     """Fold one chunk's contributions into the accumulator state.
     Pure jittable; shapes fixed by the chunk length. ``jv_toa`` is
-    the per-TOA jitter variance (jvar[eid] gathered on host)."""
+    the per-TOA jitter variance (jvar[eid] gathered on host).
+
+    With ``health`` (a STATIC flag, ISSUE 14) the chunk additionally
+    returns a 2-vector ``[nonfinite_count, rescale_magnitude]`` —
+    non-finites across the accumulated (Sig, b) plus the chunk's
+    residual/design rows, and the worst running-colmax growth factor
+    this chunk caused (a huge late-stream rescale is the scale-safety
+    machinery working overtime — worth seeing before it overflows
+    TPU-emulated f64's f32-limited exponent range). Compiled out
+    entirely when disarmed."""
     cm, Sig, b, u, vE, scal, carE, cjv, cid = state
     p = cm.shape[0]
     P = Sig.shape[0]
@@ -119,9 +129,22 @@ def _acc_chunk(state, M, Fv, r0, nvec, valid, eid, jv_toa, tmask,
                    axis=0).astype(jnp.float64)
     cm_new = jnp.maximum(cm, jnp.where(cm_c == 0, cm, cm_c))
     cm_new = jnp.where(cm_new == 0, 1.0, cm_new)
+    if health:
+        # worst colmax growth this chunk forced (cm is grow-only and
+        # >= 1 after init, so the ratio is well-defined)
+        resc = jnp.max(cm_new / jnp.where(cm == 0, 1.0, cm))
     Sig, b, u, vE, carE = _rescale_state(cm, Sig, b, u, vE, carE,
                                          cm_new, p)
     cm = cm_new
+
+    def _out(st):
+        if not health:
+            return st
+        nf = (jnp.sum(~jnp.isfinite(st[1]))
+              + jnp.sum(~jnp.isfinite(st[2]))
+              + jnp.sum(~jnp.isfinite(M))
+              + jnp.sum(~jnp.isfinite(r0))).astype(jnp.float64)
+        return st, jnp.stack([nf, resc])
     Ms = M / cm[None, :].astype(M.dtype)
     big = jnp.concatenate([Ms, Fv.astype(Ms.dtype)], axis=1)
     sw = jnp.sqrt(w)
@@ -135,7 +158,7 @@ def _acc_chunk(state, M, Fv, r0, nvec, valid, eid, jv_toa, tmask,
     scal = scal.at[1].add(jnp.sum(wt * r0))
     scal = scal.at[2].add(jnp.sum(wt))
     if not has_ecorr:
-        return (cm, Sig, b, u, vE, scal, carE, cjv, cid)
+        return _out((cm, Sig, b, u, vE, scal, carE, cjv, cid))
 
     # ---- ECORR Sherman-Morrison with boundary carry ----------------
     # chunk-local segment relabel (requires eid nondecreasing within
@@ -182,7 +205,7 @@ def _acc_chunk(state, M, Fv, r0, nvec, valid, eid, jv_toa, tmask,
     carE = E_seg[L]
     cjv = jv_seg[L]
     cid = eid[C - 1]
-    return (cm, Sig, b, u, vE, scal, carE, cjv, cid)
+    return _out((cm, Sig, b, u, vE, scal, carE, cjv, cid))
 
 
 def _flush_carry(state):
@@ -236,9 +259,14 @@ def _cg_schur(Sigma, b, rCr, cm, budget, tol):
     solution and covariance in one loop. ``budget`` is a RUNTIME
     iteration bound (compile-free across callers); ``tol`` the
     relative residual target. Returns (dparams, cov, chi2, chi2r,
-    ok, iters): dparams is the correction to ADD (the _gls_core sign
-    convention), ok False when the basis Cholesky or CG failed
-    (caller falls back to a dense/host solve)."""
+    xf, ok, iters, rel_resid): dparams is the correction to ADD (the
+    _gls_core sign convention), ok False when the basis Cholesky or
+    CG failed (caller falls back to a dense/host solve), and
+    ``rel_resid`` the worst final relative CG residual across the
+    stacked RHS — solver effort that used to be computed on device
+    and thrown away (ISSUE 14: it now rides every solve as an extra
+    scalar of the SAME dispatch, feeding the ``HealthMonitor``, the
+    ``StreamingGLSFitter`` result surface and the scan artifact)."""
     P = Sigma.shape[0]
     p = cm.shape[0]
     q = P - p
@@ -323,7 +351,7 @@ def _cg_schur(Sigma, b, rCr, cm, budget, tol):
     resid = jnp.max(jnp.sqrt(jnp.sum(R * R, axis=0)) / bnorm)
     ok = jnp.all(jnp.isfinite(xt)) & jnp.all(jnp.isfinite(cov)) \
         & jnp.isfinite(chi2) & (resid <= jnp.sqrt(tol))
-    return dparams, cov, chi2, chi2r, xf, ok, k
+    return dparams, cov, chi2, chi2r, xf, ok, k, resid
 
 
 # -------------------------------------------------- jitted wrappers
@@ -335,11 +363,11 @@ def _finalize_kernel(state, phi, sfull, budget, tol,
     is the jac32 column-unscale vector (ones when jac32 off)."""
     state = _flush_carry(state)
     Sigma, b, rCr, cm = _finalize_prep(state, phi, incoffset)
-    dparams, cov, chi2, chi2r, xf, ok, iters = _cg_schur(
+    dparams, cov, chi2, chi2r, xf, ok, iters, resid = _cg_schur(
         Sigma, b, rCr, cm, budget, tol)
     dparams = dparams * sfull
     cov = cov * jnp.outer(sfull, sfull)
-    return dparams, cov, chi2, chi2r, xf, ok, iters
+    return dparams, cov, chi2, chi2r, xf, ok, iters, resid
 
 
 # ------------------------------------------------------ numpy mirror
@@ -528,7 +556,8 @@ def cg_solve_np(Sigma, b, rCr, cm, budget=None, tol=1e-13):
     resid = float(np.max(np.sqrt(np.sum(R * R, axis=0)) / bnorm))
     ok = bool(np.all(np.isfinite(xt)) and np.all(np.isfinite(cov))
               and np.isfinite(chi2) and resid <= np.sqrt(tol))
-    return dparams, cov, float(chi2), float(chi2r), xf, ok, iters
+    return (dparams, cov, float(chi2), float(chi2r), xf, ok, iters,
+            resid)
 
 
 def acc_finalize_np(state, phi, sfull=None, incoffset=True,
@@ -663,9 +692,12 @@ class StreamingGLS:
         self._jv_toa = jvar_np[self._eid]
         self._jvar = jvar_np
         self.nchunks = -(-n // self.chunk)
+        self.last_pass_hv = None   # worst chunk hv of the last pass
         incoffset = bool(meta["incoffset"])
         f32mm = bool(meta["f32mm"])
         has_ecorr = bool(meta["has_ecorr"])
+        health_on = bool(meta["health"])
+        self.health_on = health_on
         self.incoffset = incoffset
 
         def chunk_fn(state, th_, tl_, fh_, fl_, batch_c, sc_c, F_c,
@@ -678,7 +710,7 @@ class StreamingGLS:
                 nvec_c, valid_c, eid_c, jvar_)
             return _acc_chunk(state, M, Fv, r0, nvec2, valid2, eid2,
                               jv_c, tmask, f32mm=f32mm,
-                              has_ecorr=has_ecorr)
+                              has_ecorr=has_ecorr, health=health_on)
 
         donate = config.donation_enabled() and \
             jax.default_backend() != "cpu"
@@ -728,13 +760,30 @@ class StreamingGLS:
     def _init_state_np(self):
         return acc_init_np(self.p, self.q)
 
+    @property
+    def default_budget(self) -> int:
+        """Runtime CG iteration budget when ``solve`` is given none
+        — THE single source of the formula (the fitter's
+        ``cg_budget`` surface, the scan artifact and the
+        HealthMonitor's exhaustion threshold all derive from it, so
+        they can never disagree with what the solver actually ran):
+        exact-arithmetic CG terminates in <= p iterations, 8x is the
+        rounding-safety margin."""
+        return 8 * (self.p + 1)
+
     # -- device passes -------------------------------------------------
 
-    def accumulate(self, th, tl):
+    def accumulate(self, th, tl, observe: bool = True):
         """One full streaming pass at parameter point (th, tl):
         ceil(N/C) supervised chunk dispatches. Returns the host-side
         accumulator state. Raises DispatchError through to the caller
-        (the fitter's failover boundary)."""
+        (the fitter's failover boundary).
+
+        ``observe=False`` suppresses the health observation of this
+        pass (the downhill fitter's line-search TRIAL passes: a
+        rejected overshoot legitimately produces garbage — that is
+        the damping working, not an incident; the fitter observes
+        the entry pass and every ACCEPTED trial itself)."""
         from pint_tpu import obs
         from pint_tpu.runtime import get_supervisor
 
@@ -742,6 +791,9 @@ class StreamingGLS:
         state = tuple(np.asarray(x) for x in self._init_state_np())
         th = np.asarray(th, np.float64)
         tl = np.asarray(tl, np.float64)
+        health_on = self.health_on
+        hv_worst = None
+        self.last_pass_hv = None   # set below when armed
         with obs.span("stream.accumulate", ntoa=self.ntoa,
                       chunk=self.chunk, nchunks=self.nchunks):
             for k in range(self.nchunks):
@@ -755,9 +807,35 @@ class StreamingGLS:
                     # the watchdog covers completion
                     dev = tuple(jnp.asarray(x) for x in st)
                     out = self._jit_chunk(dev, jnp.asarray(th), jnp.asarray(tl), jnp.asarray(self.fh), jnp.asarray(self.fl), jax.tree.map(jnp.asarray, bc), jax.tree.map(jnp.asarray, scc), jnp.asarray(Fc), jnp.asarray(self.phi), jnp.asarray(nc), jnp.asarray(vc), jnp.asarray(ec), jnp.asarray(self._jvar), jnp.asarray(jc))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+                    if health_on:
+                        st_out, hv = out
+                        return (tuple(np.asarray(o) for o in st_out),
+                                np.asarray(hv))
                     return tuple(np.asarray(o) for o in out)
 
-                state = sup.dispatch(run, key="stream.chunk")
+                out = sup.dispatch(run, key="stream.chunk")
+                if health_on:
+                    state, hv = out
+                    # fold the pass's worst chunk vector (max over
+                    # both slots) — ONE observe per pass, not per
+                    # chunk, keeps the armed cost O(1) in nchunks
+                    hv_worst = hv if hv_worst is None else \
+                        np.maximum(hv_worst, hv)
+                else:
+                    state = out
+            if hv_worst is not None:
+                # kept for the caller either way: the downhill
+                # fitter observes an ACCEPTED trial's pass vector
+                # itself after suppressing the per-trial observation
+                self.last_pass_hv = hv_worst
+                if observe:
+                    from pint_tpu.obs import health as _health
+
+                    _health.observe(
+                        "stream.chunk",
+                        {"nonfinite": hv_worst[0],
+                         "rescale": hv_worst[1]},
+                        key="stream.chunk")
         from pint_tpu.obs import metrics as om
 
         om.counter("pint_tpu_stream_chunk_dispatches_total",
@@ -765,19 +843,30 @@ class StreamingGLS:
         return state
 
     def solve(self, state, budget: Optional[int] = None,
-              tol: float = 1e-13):
+              tol: float = 1e-13, observe: bool = True):
         """CG-finalize an accumulated state (one supervised
         dispatch). Returns (dparams, cov, chi2, chi2r, xf, ok,
-        iters) — dparams the correction to ADD aligned with
-        ``self.names``, chi2 the linearized post-fit chi2, chi2r the
-        bases-marginalized chi2 at the point (``Residuals.chi2``
-        semantics), xf the ML basis amplitudes."""
+        iters, rel_resid) — dparams the correction to ADD aligned
+        with ``self.names``, chi2 the linearized post-fit chi2,
+        chi2r the bases-marginalized chi2 at the point
+        (``Residuals.chi2`` semantics), xf the ML basis amplitudes,
+        (iters, rel_resid) the CG effort + final worst relative
+        residual of the same dispatch (ISSUE 14).
+
+        Health (armed via $PINT_TPU_HEALTH) observes the CG effort
+        against its budget through the process ``HealthMonitor``;
+        shadow sampling ($PINT_TPU_SHADOW_RATE) replays the SAME
+        accumulated state through the numpy CG mirror in a
+        background thread and records device-vs-host drift in sigma
+        — the state is already host-resident and (p+q)^2-small, so
+        the streaming path is the cheapest shadow in the stack."""
         from pint_tpu import obs
+        from pint_tpu.obs import health as _health
         from pint_tpu.obs import metrics as om
         from pint_tpu.runtime import get_supervisor
 
         if budget is None:
-            budget = 8 * (self.p + 1)
+            budget = self.default_budget
         sup = get_supervisor()
         sfull = np.asarray(self.meta["sfull"], np.float64)
 
@@ -786,13 +875,40 @@ class StreamingGLS:
             out = self._jit_final(dev, jnp.asarray(self.phi), jnp.asarray(sfull), jnp.asarray(int(budget), jnp.int32), jnp.asarray(float(tol)))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
             return tuple(np.asarray(o) for o in out)
 
+        def shadow(out):
+            # numpy-mirror replay of the SAME state (deep-copied —
+            # the mirror's carry flush mutates); drift = max |d dp|
+            # in sigma of the device covariance. A failed CG
+            # (ok=False: the caller raises/falls back) is not
+            # shadow-applicable — drifting against garbage would be
+            # a false verdict on top of the real solver_not_ok one.
+            if not bool(np.asarray(out[5])):
+                return None
+            mirror = [np.array(x) for x in state]
+            mdp = acc_finalize_np(
+                mirror, self.phi, sfull=sfull,
+                incoffset=self.incoffset, budget=budget,
+                tol=tol)[0]
+            return _health.drift_sigma(out[0], out[1], mdp)
+
         with obs.span("stream.solve", p=self.p, q=self.q):
-            out = sup.dispatch(run, key="stream.solve")
-        dp, cov, chi2, chi2r, xf, ok, iters = out
+            out = sup.dispatch(run, key="stream.solve",
+                               shadow=shadow, shadow_kind="stream")
+            dp, cov, chi2, chi2r, xf, ok, iters, resid = out
+            if observe:
+                _health.observe(
+                    "stream.solve",
+                    {"cg_iters": int(iters),
+                     "cg_budget": int(budget),
+                     "cg_rel_residual": float(resid),
+                     "ok": bool(ok), "chi2": float(chi2r),
+                     "values": [dp, chi2]},
+                    key="stream.solve")
         om.counter("pint_tpu_stream_cg_solves_total",
                    "streaming-GLS CG finalize dispatches").inc()
         return (np.asarray(dp), np.asarray(cov), float(chi2),
-                float(chi2r), np.asarray(xf), bool(ok), int(iters))
+                float(chi2r), np.asarray(xf), bool(ok), int(iters),
+                float(resid))
 
     def noise_realization(self, xf) -> np.ndarray:
         """ML correlated-noise realization F @ xf [s] in the ORIGINAL
@@ -839,6 +955,7 @@ class StreamingGLS:
         out = stream_solve_np(M, F, phi, r0, nvec, self.chunk,
                               incoffset=self.incoffset, eid=eid,
                               jvar=jvar, tol=tol)
-        dp, cov, chi2, chi2r, xf, ok, iters = out
+        dp, cov, chi2, chi2r, xf, ok, iters, resid = out
         return (np.asarray(dp), np.asarray(cov), float(chi2),
-                float(chi2r), np.asarray(xf), bool(ok), int(iters))
+                float(chi2r), np.asarray(xf), bool(ok), int(iters),
+                float(resid))

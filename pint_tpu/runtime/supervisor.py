@@ -286,7 +286,10 @@ class DispatchSupervisor:
                  steps: int = 1, kw: Optional[dict] = None,
                  fallback: Optional[Callable] = None,
                  guard: Optional[bool] = None, pinned: bool = False,
-                 depth: int = 1, _plan_hits=None):
+                 depth: int = 1, _plan_hits=None,
+                 shadow: Optional[Callable] = None,
+                 shadow_kind: Optional[str] = None,
+                 info: Optional[dict] = None):
         """Run ``fn(*args, **kw)`` under supervision.
 
         key       stable label for this call site (deadline first-call
@@ -308,6 +311,26 @@ class DispatchSupervisor:
                   a pipelined dispatch may legitimately queue behind
                   depth-1 others — and suppresses drift verdicts,
                   whose RTT model only holds for unoverlapped walls.
+        shadow    shadow-oracle replay hook (ISSUE 14): a callable
+                  ``shadow(out) -> drift_sigma | None`` that re-runs
+                  the completed solve on the numpy mirror and
+                  returns device-vs-host drift in sigma. The
+                  supervisor is the SCHEDULER only: when
+                  $PINT_TPU_SHADOW_RATE says this key's Nth
+                  successful dispatch is due, the hook runs on a
+                  background daemon thread and the drift lands in
+                  the ``obs.health`` registry histogram — never on
+                  the dispatch's own critical path, never on
+                  failover results (a host-mirror result shadowing
+                  itself would read as zero drift).
+        shadow_kind  health-kind label for the shadow recording
+                  (defaults to the dispatch key).
+        info      optional caller-owned dict the supervisor marks
+                  with ``{"failover": True}`` when this dispatch
+                  resolved through its host fallback — so a call
+                  site can attribute downstream health verdicts to
+                  the pool that ACTUALLY produced the result
+                  (the sampling chain tap's /healthz pools).
         _plan_hits  internal: fault-plan rules pre-fetched at ISSUE
                   time by dispatch_async (keeps injection
                   deterministic in issue order); first attempt only,
@@ -329,13 +352,46 @@ class DispatchSupervisor:
         with obs.span(f"dispatch/{key}", kind="dispatch",
                       backend=backend, steps=steps, depth=depth,
                       pinned=pinned) as sp:
-            return self._dispatch_in_span(
+            # failover marker: a host-fallback result must not be
+            # shadowed against the same mirror (vacuous zero drift),
+            # and a caller-passed ``info`` dict receives the same
+            # mark for its own pool attribution
+            fo: dict = info if info is not None else {}
+            out = self._dispatch_in_span(
                 sp, fn, args, kw, key, steps, fallback, guard,
-                pinned, depth, _plan_hits, backend)
+                pinned, depth, _plan_hits, backend, _fo=fo)
+            # never shadow a failover result OR a pinned host solve:
+            # both ran on the host CPU, so replaying the numpy
+            # mirror against them is a vacuous ~floor comparison
+            # that would fill the drift histogram with noise and
+            # burn the per-key 1-in-N sampling slots the DEVICE
+            # dispatches are supposed to get
+            if shadow is not None and not fo.get("failover") \
+                    and not pinned:
+                self._maybe_shadow(key, shadow_kind or key, shadow,
+                                   out)
+            return out
+
+    def _maybe_shadow(self, key, kind, shadow, out):
+        """Shadow-oracle scheduler (ISSUE 14): rate-gate per key,
+        then hand the replay to the health monitor's background
+        thread. Never raises into the dispatch path."""
+        try:
+            from pint_tpu.obs import health as _health
+
+            mon = _health.get_monitor()
+            if not mon.shadow_rate or not mon.shadow_due(key):
+                return
+            from pint_tpu import obs
+
+            obs.event("health.shadow_issue", key=key, kind=kind)
+            mon.shadow_replay(kind, key, lambda: shadow(out))
+        except Exception:  # the black box must not break dispatch
+            pass
 
     def _dispatch_in_span(self, sp, fn, args, kw, key, steps,
                           fallback, guard, pinned, depth, _plan_hits,
-                          backend):
+                          backend, _fo: Optional[dict] = None):
         plan = faults.active_plan()
         if guard is None:
             # pinned solves stay inline even under a fault plan: the
@@ -361,7 +417,8 @@ class DispatchSupervisor:
             sp.event("breaker.reject", backend=backend)
             return self._failover(fallback, key, BackendUnavailable(
                 f"{backend} backend circuit breaker is open "
-                f"(dispatch {key!r} short-circuited to host)"), sp)
+                f"(dispatch {key!r} short-circuited to host)"), sp,
+                fo=_fo)
         probing = gate == "probe"
 
         from pint_tpu import config
@@ -408,7 +465,7 @@ class DispatchSupervisor:
                 sp.event("dispatch.timeout",
                          deadline_s=round(deadline_s, 3))
                 self._breaker_failure(br, sp, backend)
-                return self._failover(fallback, key, e, sp)
+                return self._failover(fallback, key, e, sp, fo=_fo)
             except BaseException as e:
                 if not _is_transient(e):
                     # caller bug: no retry, no breaker verdict — but a
@@ -428,7 +485,7 @@ class DispatchSupervisor:
                     time.sleep(_backoff_s(attempt))
                     attempt += 1
                     continue
-                return self._failover(fallback, key, e, sp)
+                return self._failover(fallback, key, e, sp, fo=_fo)
             wall = time.perf_counter() - t0
             if br is not None:
                 br.on_result(True)
@@ -597,7 +654,9 @@ class DispatchSupervisor:
 
     # -- internals -----------------------------------------------------
 
-    def _failover(self, fallback, key, exc, sp=None):
+    def _failover(self, fallback, key, exc, sp=None, fo=None):
+        if fo is not None:
+            fo["failover"] = True
         if fallback is None:
             raise exc
         self.note_failover(key, exc, sp=sp)

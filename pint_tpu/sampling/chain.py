@@ -178,9 +178,30 @@ class DeviceEnsembleSampler(ChainStats):
             from pint_tpu import obs
 
             with obs.span("sampling.chunk", steps=int(budget)):
+                dinfo: dict = {}
                 out = sup.dispatch(run, key="sampling.chain",
                                    steps=budget,
-                                   fallback=run_pinned)
+                                   fallback=run_pinned, info=dinfo)
+                # health tap (ISSUE 14): the chunk's walker
+                # log-posteriors and acceptance count are ALREADY
+                # returned by the dispatch — observing them adds
+                # zero dispatches. NaN/+inf log-posteriors are the
+                # incident class; the acceptance fraction is
+                # recorded as a GAUGE only (no default band —
+                # healthy stretch ensembles range widely, so a
+                # collapse is a dashboard signal, not an incident).
+                # Attributed to the pool that ACTUALLY produced the
+                # result (the supervisor marks failovers in dinfo)
+                from pint_tpu.obs import health as _health
+
+                _health.observe(
+                    "posterior.chunk",
+                    {"lnpost": out[1],
+                     "accept_frac": float(out[2])
+                     / max(1, int(budget) * self.nwalkers)},
+                    pool="host" if dinfo.get("failover")
+                    else "device",
+                    key="sampling.chain")
             self._c_dispatches.inc()
             pos = np.asarray(out[0], np.float64)
             lp = np.asarray(out[1], np.float64)

@@ -59,6 +59,14 @@ lock — the pull surface a multi-worker fleet scrapes per worker. The
 plane; ``$PINT_TPU_SLO`` arms the burn-rate watchdog (fires the
 flight recorder with reason ``slo_burn:<name>``).
 
+Numerical health (ISSUE 14): with ``$PINT_TPU_HEALTH`` (and/or
+``$PINT_TPU_SHADOW_RATE``) armed, the ``stats`` answer and the serve
+snapshot gain a ``health`` verdict block (worst recent verdict per
+(pool, kind), last incident reason + age) and ``/healthz`` a
+``numerics`` block that degrades the response to 503 on an
+unresolved bad verdict — all monitor-lock reads, still never an
+engine lock, still never journaled.
+
 One JSON result line per request (input order NOT guaranteed — lines
 carry the request id); the final line is the engine metrics snapshot
 ({"metric": "serve_session", ...}) whose ``admission``/``router``/
@@ -282,6 +290,13 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
                "registry": om.get_registry().snapshot()}
         if snap.get("slo") is not None:
             out["slo"] = snap["slo"]
+        # ISSUE 14: the numerical-health verdict block (worst recent
+        # verdict per (pool, kind), last incident + age) — still
+        # engine-lock-free (snapshot reads monitor-lock state only),
+        # still never journaled (this whole branch is the inline
+        # introspection path)
+        if snap.get("health") is not None:
+            out["health"] = snap["health"]
         if rid is not None:
             out["id"] = rid
         report(out)

@@ -152,7 +152,7 @@ def _append_slot(cm, Sig, b, u, scal, M, F, phi, r0, nvec, valid,
     Sigma = Sigma * jnp.outer(colvalid, colvalid) + \
         jnp.diag(1.0 - colvalid)
     bfin = bfin * colvalid
-    dp, cov, chi2, chi2r, _, ok, iters = _cg_schur(
+    dp, cov, chi2, chi2r, _, ok, iters, _resid = _cg_schur(
         Sigma, bfin, rCr, cm_used, budget, tol)
     return (cm_used, dSig, db, du, dscal, dp * pvalid, cov, chi2,
             chi2r, ok, iters)
@@ -196,7 +196,7 @@ def append_slot_np(cm, Sig, b, u, scal, M, F, phi, r0, nvec, valid,
     Sigma = Sigma * np.outer(colvalid, colvalid) + \
         np.diag(1.0 - colvalid)
     bfin = bfin * colvalid
-    dp, cov, chi2, chi2r, _, ok, iters = cg_solve_np(
+    dp, cov, chi2, chi2r, _, ok, iters, _resid = cg_solve_np(
         Sigma, bfin, float(rCr), cm_used, budget=budget, tol=tol)
     return (cm_used, dSig, db, du, dscal, dp * pvalid, cov, chi2,
             chi2r, ok, iters)

@@ -550,6 +550,11 @@ class ExecutableCache:
         submean = np.zeros(Pb)
         coldf = np.zeros(Pb)
         budget = np.int32(8 * (pb + 1))
+        if info is not None:
+            # the health tap thresholds CG effort against the budget
+            # THE KERNEL ACTUALLY RAN — threaded, never recomputed
+            # (the StreamingGLS.default_budget single-source rule)
+            info["append_cg_budget"] = int(budget)
         for k, r in enumerate(requests):
             pr = r.problem
             n, p = pr.M.shape

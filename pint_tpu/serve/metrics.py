@@ -267,6 +267,15 @@ class ServeMetrics:
         slo_state = _slo.status()
         if slo_state is not None:
             out["slo"] = slo_state
+        # ISSUE 14: the numerical-health verdict block when the
+        # monitor is armed ($PINT_TPU_HEALTH / $PINT_TPU_SHADOW_RATE)
+        # — absent otherwise, keeping pre-health snapshots
+        # bit-compatible (the slo-block convention)
+        from pint_tpu.obs import health as _hmon
+
+        health_state = _hmon.status()
+        if health_state is not None:
+            out["health"] = health_state
         if self.admission is not None:
             out["admission"] = self.admission.snapshot()
         if self.append_store is not None:

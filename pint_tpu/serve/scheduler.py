@@ -885,6 +885,8 @@ class ServeEngine:
                     obs.span("serve.collect", parent=usp.ctx,
                              pool=pool):
                 out = collect()
+                self._observe_unit_health(kind, key, out, pool,
+                                          info)
             if key[0] == "phase":
                 pi, pf = out
                 for k, r in enumerate(grp):
@@ -969,6 +971,56 @@ class ServeEngine:
                                         max(0.0, t0 - adm))
             self.metrics.latency.record(hkey, "e2e", done - adm)
         self.metrics.bump("completed", len(grp))
+
+    @staticmethod
+    def _observe_unit_health(kind, key, out, pool, info):
+        """Numerical-health tap for one collected serve unit (ISSUE
+        14): every signal here is ALREADY in the collected outputs —
+        zero extra dispatches — and the math lives in
+        ``HealthMonitor.observe`` (graftlint G14), not here. A no-op
+        branch when $PINT_TPU_HEALTH is unset. GUARDED: collect()
+        already produced valid results when this runs, so an
+        instrumentation bug must degrade to a missed observation,
+        never fail the unit's futures (the supervisor's shadow hook
+        makes the same promise)."""
+        try:
+            ServeEngine._observe_unit_health_inner(
+                kind, key, out, pool, info)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _observe_unit_health_inner(kind, key, out, pool, info):
+        from pint_tpu.obs import health as _health
+
+        mon = _health.get_monitor()
+        if not mon.enabled:
+            return
+        used = info.get("used_pool", pool)
+        if kind == "posterior":
+            # lnpost, not values: -inf walkers are legal (zero-
+            # probability start positions), only NaN/+inf is garbage
+            mon.observe("serve.posterior", {"lnpost": out[1]},
+                        pool=used, key=str(key))
+        elif kind == "append":
+            # the append CG's effort vs the runtime budget the
+            # bucket kernel ACTUALLY ran (threaded through info by
+            # append_begin — never recomputed here); the worst slot
+            # of the batch is the one a budget-exhaustion incident
+            # cares about
+            mon.observe("serve.append",
+                        {"values": [out[5], out[7]],
+                         "cg_iters": int(np.max(out[10])),
+                         "cg_budget": info.get("append_cg_budget"),
+                         "ok": bool(np.all(out[9]))},
+                        pool=used, key=str(key))
+        elif kind == "phase":
+            mon.observe("serve.phase", {"values": list(out)},
+                        pool=used, key=str(key))
+        else:
+            dparams, cov, chi2, chi2r = out
+            mon.observe("serve.gls", {"values": [dparams, chi2]},
+                        pool=used, key=str(key))
 
     @staticmethod
     def _rows_of(r) -> int:

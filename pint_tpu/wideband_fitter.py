@@ -121,11 +121,16 @@ class WidebandTOAFitter(Fitter):
                     return _gls_kernel_svd(*place())  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
                 return _gls_kernel_svd(*place(), threshold=th)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
 
+        from pint_tpu import config as _config
+
+        health_on = _config.health_enabled()
+
         def run_chol(f32mm=False):
             with self._solve_scope():
-                return _gls_kernel(*place(), f32mm=f32mm)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+                return _gls_kernel(*place(), f32mm=f32mm, health=health_on)  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
 
         from pint_tpu import obs
+        from pint_tpu.obs import health as _health
 
         with obs.span("wideband.solve_once",
                       fitter=type(self).__name__):
@@ -137,15 +142,27 @@ class WidebandTOAFitter(Fitter):
                 from pint_tpu.parallel.fit_step import _use_f32_matmul
 
                 f32mm = False if pinned else _use_f32_matmul(None)
-                x, cov, chi2, noise, _, ok = sup.dispatch(
+                out = sup.dispatch(
                     run_chol, kw={"f32mm": f32mm},
                     key="wideband.solve", pinned=pinned)
+                x, cov, chi2, noise, _, ok = out[:6]
+                # observed AFTER the degenerate-retry decision below
+                # (a handled SVD fallback is not an incident); the
+                # hv only describes the chol attempt, so it rides
+                # only when that result is kept
+                hsig = {"values": [x, chi2]}
+                if bool(ok) and health_on and len(out) > 6:
+                    hsig["hv"] = out[6]
                 if not bool(ok):
                     from pint_tpu.fitter import warn_degenerate
 
                     warn_degenerate("wideband normal matrix")
                     x, cov, chi2, noise, _ = sup.dispatch(
                         run_svd, key="wideband.svd", pinned=pinned)
+                    hsig = {"values": [x, chi2]}
+                _health.observe("wideband.solve", hsig,
+                                key="wideband.solve",
+                                pool="host" if pinned else "device")
         return x, cov, chi2, noise
 
     def fit_toas(self, maxiter=1, threshold=None):

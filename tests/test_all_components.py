@@ -284,7 +284,7 @@ def test_streaming_gls_across_component_zoo():
     sg = StreamingGLS(model, toas, chunk=64, anchored=False,
                       jac_f32=False, matmul_f32=False)
     state = sg.accumulate(sg.th0, sg.tl0)
-    dp, cov, chi2, chi2r, xf, ok, iters = sg.solve(state)
+    dp, cov, chi2, chi2r, xf, ok, iters, resid = sg.solve(state)
     assert ok
     assert np.max(np.abs(dp - dpD) / sig) < 1e-6, names
     assert abs(chi2r - float(oD[2])) < 1e-8 * abs(float(oD[2]))

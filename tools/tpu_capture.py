@@ -55,6 +55,7 @@ STAGES = {
     "metrics": "serve_metrics_plane",
     "streaming": "gls_streaming_scan",
     "append": "serve_append_incremental_vs_cold_100k",
+    "health": "north_star_health_overhead",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
 # on-chip streaming points: bounded to fit one watcher stage window
@@ -485,6 +486,34 @@ def stage_metrics(backend):
     print(json.dumps(rec), flush=True)
 
 
+def stage_health(backend):
+    """Numerical-health plane ON CHIP (ISSUE 14): the disarmed-vs-
+    armed north-star step walls (the in-trace taps' real cost under
+    tunnel dispatch), plus the armed evidence run — CG effort and,
+    critically, the REAL emulated-f64 device-vs-host drift in sigma
+    from a forced shadow replay. This is the number that makes
+    captures past the 131k dense-oracle ceiling trustworthy: the
+    drift histogram here is measured against actual TPU numerics,
+    not the CPU mesh's exact f64."""
+    model, toas = bench.build_problem()
+    hblock, evidence = bench.measure_health_overhead(model, toas)
+    rec = {"metric": STAGES["health"], "backend": backend,
+           "unit": "frac",
+           "value": hblock.get("health_overhead_frac"),
+           **hblock,
+           "monitor": evidence}
+    drift_rows = evidence.get("drift") or {}
+    if not any(r.get("count") for r in drift_rows.values()):
+        # a replay that ran but DECLINED (ok=False solve) still
+        # counts in shadow_replays — the gate must demand an actual
+        # drift histogram sample, or the record ships no evidence
+        raise RuntimeError(
+            "no drift sample landed in the health stage (replay "
+            "declined or never ran); stage stays on the to-do list")
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
 def _block(jitted, args):
     import jax
 
@@ -530,6 +559,8 @@ def run_stage(name, backend):
         stage_streaming(backend)
     elif name == "append":
         stage_append(backend)
+    elif name == "health":
+        stage_health(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "
