@@ -272,6 +272,14 @@ def run(nwalkers: int = 32, nsteps: int = 512, repeats: int = 3,
     from pint_tpu import obs
 
     rec["obs"] = obs.status()
+    # ISSUE 15: which executables this run built and what each cost
+    # (chain-chunk keys land via the supervisor's first_call ledger)
+    try:
+        from pint_tpu.obs import perf as operf
+
+        rec["compiles"] = operf.ledger_summary()
+    except Exception:
+        pass
     if serve:
         rec["serve"] = measure_serve(nwalkers, max(64, nsteps // 4))
     # perf-regression verdict against BENCH_BASELINE.json (ISSUE 11)

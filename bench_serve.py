@@ -196,6 +196,21 @@ def run(nreq: int = 64, repeats: int = 3) -> dict:
             co_best["coalesced_mesh"] * 1e3, 2)
         rec["mesh_sharded_speedup"] = round(
             seq_best / co_best["coalesced_mesh"], 2)
+    # ISSUE 15: the ledger-derived attribution blocks — `compiles`
+    # summarizes every executable this process built (serve classes
+    # carry XLA cost via the ExecutableCache ledger callback), and
+    # `roofline` joins those costs against the winning engine's
+    # measured per-key dispatch walls
+    try:
+        from pint_tpu.obs import perf as operf
+
+        rec["compiles"] = operf.ledger_summary()
+        roof = operf.roofline_from_latency(
+            (co_snap.get("dispatch") or {}).get("latency"), backend)
+        if roof is not None:
+            rec["roofline"] = roof
+    except Exception as e:
+        log(f"perf attribution blocks failed: {e!r}")
     # perf-regression verdict against BENCH_BASELINE.json (ISSUE 11)
     try:
         import bench as _bench

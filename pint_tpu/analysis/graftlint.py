@@ -105,6 +105,18 @@ Rules (see ARCHITECTURE.md "Static analysis" for the table):
       thresholds, the registry recording, the span event and the
       incident/flight path all at once. Pragma/allowlist policy as
       G9.
+  G15 profiler control and compile-cost probes only in the perf
+      plane (ISSUE 15): ``jax.profiler.start_trace``/``stop_trace``
+      and the ``.lower(...).compile()`` /
+      ``.cost_analysis()``/``.memory_analysis()`` probe pattern may
+      appear only in pint_tpu/obs/perf.py and pint_tpu/profiling.py
+      — a raw trace call elsewhere bypasses the supervised, bounded,
+      rate-limited window facility (and an unclosed trace poisons
+      every later window), while an ad-hoc cost probe re-runs
+      lower/compile outside the once-per-key ledger dedup and can
+      land on a hot path. Route through
+      ``obs.perf.request_window`` / ``obs.perf.note_compile``.
+      Pragma/allowlist policy as G9.
 
 jit-reachability is inferred statically, seeded by project
 conventions: any function whose early positional parameters include
@@ -164,6 +176,10 @@ RULES = {
     "G14": "health taps read through HealthMonitor.observe: "
            "pint_tpu_health_* metrics only in obs/health.py, and "
            "dispatch-layer health vectors must reach an observe()",
+    "G15": "jax.profiler trace control and lower().compile() cost "
+           "probes only in obs/perf.py / profiling.py (the "
+           "supervised window facility and the once-per-key "
+           "compile ledger)",
 }
 
 # entry points allowed to mutate global jax config (G7): the package
@@ -1121,6 +1137,61 @@ def check_g14(m: ModuleInfo) -> List[Violation]:
     return out
 
 
+# G15 — profiler/cost probes only in the perf plane ------------------
+
+# the two sanctioned homes: the window facility + the unmanaged
+# script-scoped trace() wrapper it documents
+G15_SANCTIONED = {"pint_tpu/obs/perf.py", "pint_tpu/profiling.py"}
+_G15_TRACE_CALLS = {"start_trace", "stop_trace"}
+_G15_COST_CALLS = {"cost_analysis", "memory_analysis"}
+
+
+def check_g15(m: ModuleInfo) -> List[Violation]:
+    """Profiler control + compile-cost probes confined to the perf
+    plane (module docstring G15). Repo-wide minus the sanctioned
+    files: a stray ``jax.profiler.start_trace`` in the serve layer
+    bypasses the bounded/rate-limited window facility, and an ad-hoc
+    ``.lower(...).compile()``/``.cost_analysis()`` probe escapes the
+    once-per-key ledger dedup."""
+    if m.relpath in G15_SANCTIONED:
+        return []
+    out = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            continue
+        tail = fn.attr
+        if tail in _G15_TRACE_CALLS and \
+                "profiler" in _expr_names(fn.value):
+            out.append(Violation(
+                "G15", m.relpath, node.lineno,
+                f"raw jax.profiler.{tail}() outside the perf plane: "
+                f"an unmanaged trace bypasses the supervised, "
+                f"bounded, rate-limited window facility — use "
+                f"obs.perf.request_window (or profiling.trace for "
+                f"script-scoped attribution runs)",
+                m.line_text(node.lineno)))
+        elif tail in _G15_COST_CALLS:
+            out.append(Violation(
+                "G15", m.relpath, node.lineno,
+                f".{tail}() cost probe outside the perf plane: "
+                f"probe through obs.perf.note_compile/cost_probe so "
+                f"the lower/compile runs once per key (ledger "
+                f"dedup), never on a hot path",
+                m.line_text(node.lineno)))
+        elif tail == "compile" and isinstance(fn.value, ast.Call) \
+                and _tail_name(fn.value.func) == "lower":
+            out.append(Violation(
+                "G15", m.relpath, node.lineno,
+                ".lower(...).compile() probe outside the perf "
+                "plane: route through obs.perf.note_compile/"
+                "cost_probe (once-per-key ledger dedup)",
+                m.line_text(node.lineno)))
+    return out
+
+
 def check_g6_python(m: ModuleInfo) -> List[Violation]:
     """Timeout bounds in tools//scripts Python. The bounded-probe
     requirement is module-wide and order-insensitive — a deliberate
@@ -1516,6 +1587,7 @@ def run_lint(root: str, dynamic: bool = True,
         report.violations += check_g12(m)
         report.violations += check_g13(m)
         report.violations += check_g14(m)
+        report.violations += check_g15(m)
         report.violations += check_g7(m)
         report.violations += check_g8(m)
     for relpath, src in shell:

@@ -37,7 +37,8 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "slo_interval_s", "slo_specs", "metrics_port",
            "health_enabled", "shadow_rate", "health_drift_sigma",
            "health_chi2_factor", "health_resid_sigma",
-           "health_cg_budget_frac"]
+           "health_cg_budget_frac", "perf_enabled",
+           "compile_ledger_path", "profile_dir", "profile_max_s"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -1017,6 +1018,55 @@ def health_cg_budget_frac() -> float:
         _warn_env_range("PINT_TPU_HEALTH_CG_BUDGET_FRAC", 1.0)
         return 1.0
     return v
+
+
+def perf_enabled(flag: Optional[bool] = None) -> bool:
+    """Dispatch-wall decomposition armed? ($PINT_TPU_PERF, default
+    OFF — the $PINT_TPU_TRACE / $PINT_TPU_HEALTH opt-in stance.)
+    When armed, every successful GUARDED supervised dispatch splits
+    its wall into queue_wait / host_assembly / device_wall / collect
+    (``obs.perf`` + ``RuntimeMetrics.perf``); disarmed, the
+    supervisor pays one attribute read and a branch. The compile
+    LEDGER is always on (compiles are rare, registry-only) — this
+    flag arms only the per-dispatch work. An explicit ``flag`` wins;
+    an unrecognized env value warns once and is ignored."""
+    return _env_bool("PINT_TPU_PERF", flag,
+                     context="perf decomposition stays off")
+
+
+def compile_ledger_path():
+    """JSONL persistence path for the compile ledger
+    ($PINT_TPU_COMPILE_LEDGER; None = registry-only). Armed, every
+    NEW ledgered key appends one JSON line (key, backend, compile
+    wall, XLA cost/memory analysis, aot_restored, UTC stamp), and a
+    restarted worker reads the file back as ``prior`` entries — the
+    post-mortem record of exactly which executables existed and
+    what each cost to build."""
+    p = os.environ.get("PINT_TPU_COMPILE_LEDGER")
+    return p if p else None
+
+
+def profile_dir():
+    """Profiler-window directory ($PINT_TPU_PROFILE_DIR; None =
+    windows disarmed). Armed, ``obs.perf.request_window`` (the
+    pint_serve ``{"kind": "profile"}`` answer) and the automatic
+    one-shot incident windows (slo_burn / breaker-open) write one
+    ``window-<utc>-<reason>/`` directory each: jax device trace +
+    ``window.json`` metadata cross-linked to the triggering span ids
+    and flight dump + a Perfetto-loadable ``spans.json``. Replaces
+    bench.py's old raw read of the same env var."""
+    d = os.environ.get("PINT_TPU_PROFILE_DIR")
+    return d if d else None
+
+
+def profile_max_s() -> float:
+    """Hard bound on one profiler window's length [s]
+    ($PINT_TPU_PROFILE_MAX_S, default 30): every requested window is
+    clamped to it, so a typo'd ``{"kind": "profile", "seconds":
+    86400}`` can never leave a device trace running for a day.
+    Validated finite positive; warn-and-ignore otherwise (the
+    ``slo_interval_s`` convention)."""
+    return _env_positive_float("PINT_TPU_PROFILE_MAX_S", 30.0)
 
 
 def metrics_port() -> Optional[int]:

@@ -34,6 +34,7 @@ from typing import Optional
 
 from pint_tpu.obs import health  # noqa: F401  (ISSUE 14 monitor)
 from pint_tpu.obs import metrics  # noqa: F401  (ISSUE 11 registry)
+from pint_tpu.obs import perf  # noqa: F401  (ISSUE 15 perf plane)
 from pint_tpu.obs.flight import FlightRecorder  # noqa: F401
 from pint_tpu.obs.hist import HistogramSet, LatencyHistogram  # noqa: F401
 from pint_tpu.obs.tracer import (  # noqa: F401
@@ -46,7 +47,7 @@ from pint_tpu.obs.tracer import (  # noqa: F401
 
 __all__ = ["Tracer", "SpanHandle", "LatencyHistogram",
            "HistogramSet", "FlightRecorder", "metrics", "health",
-           "get_tracer",
+           "perf", "get_tracer",
            "get_flight", "configure", "reset", "span", "open_span",
            "open_root", "event", "record_span", "current", "attach",
            "flight_dump", "status", "export"]
@@ -150,6 +151,17 @@ def reset():
     # ISSUE 14: the health monitor holds bound registry children and
     # env-derived thresholds — same staleness hazard as the tracer
     health.reset()
+    # ISSUE 15: the perf plane (compile ledger, profiler windows,
+    # decomposition arming cache) and the global profiling
+    # scoreboard's registry-shared rows — both hold bound children
+    # of the registry that was just swapped
+    perf.reset()
+    try:
+        from pint_tpu import profiling
+
+        profiling.scoreboard.reset()
+    except Exception:
+        pass
 
 
 # ------------------------------------------------------------------
@@ -242,4 +254,10 @@ def status() -> dict:
     out = {"trace": t.status()}
     f = get_flight()
     out["flight"] = f.status() if f is not None else None
+    # ISSUE 15: the perf plane's cheap status (ledger counts +
+    # profiler window state) — additive, no probe, no jax
+    try:
+        out["perf"] = perf.status()
+    except Exception:
+        pass
     return out

@@ -219,8 +219,18 @@ class SLOWatchdog:
             from pint_tpu import obs
 
             obs.event("slo.burn", slo=name)
-            obs.flight_dump(f"slo_burn:{name}",
-                            slo=self._spec_status(spec, now))
+            fpath = obs.flight_dump(f"slo_burn:{name}",
+                                    slo=self._spec_status(spec, now))
+            # ISSUE 15: automatic one-shot profiler window on the
+            # burn — capture the dispatches of the regression WHILE
+            # it is happening, cross-linked to this episode's flight
+            # dump. One per episode: the watchdog only fires once
+            # per burn episode (latched above) and the profiler
+            # additionally rate-limits per reason. Never raises.
+            from pint_tpu.obs import perf as _perf
+
+            _perf.auto_window(f"slo_burn:{name}", slo=name,
+                              flight=fpath)
         return fired
 
     def _window_base(self, window_s: float, now: float):

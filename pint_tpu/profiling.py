@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import threading
 import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, Optional
@@ -52,32 +53,85 @@ class FitStats:
 
 class Scoreboard:
     """Accumulates named wall-clock phases; the cheap always-on half of
-    the profiling story (the expensive half is jax.profiler traces)."""
+    the profiling story (the expensive half is jax.profiler traces).
+
+    ISSUE 15: the phase rows are REGISTRY-BACKED — each phase holds a
+    shared ``obs.metrics`` histogram row
+    (``pint_tpu_scoreboard_seconds{scope, phase}``, the ISSUE-11
+    ``row_factory`` pattern), so ``annotate()`` regions appear in
+    ``/metrics`` and serve snapshots instead of a report-only dict.
+    ``totals``/``counts`` are derived views of the SAME rows (the
+    registry-vs-snapshot parity discipline); ``obs.reset()`` clears
+    the scoreboard with the registry it was bound to."""
 
     def __init__(self):
-        self.totals: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._rows: Dict[str, object] = {}
+        self._scope: Optional[str] = None
+
+    def _row(self, name: str):
+        row = self._rows.get(name)
+        if row is None:
+            from pint_tpu.obs import metrics as om
+
+            with self._lock:
+                row = self._rows.get(name)
+                if row is None:
+                    if self._scope is None:
+                        # per-instance scope: two scoreboards (the
+                        # global one, a test's) must never share rows
+                        self._scope = om.new_scope("sb")
+                    row = om.histogram(
+                        "pint_tpu_scoreboard_seconds",
+                        "annotate()/phase wall per named region"
+                    ).row(scope=self._scope, phase=name)
+                    self._rows[name] = row
+        return row
 
     @contextlib.contextmanager
     def phase(self, name: str):
+        row = self._row(name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            row.record(time.perf_counter() - t0)
+
+    # -- derived views (the pre-ISSUE-15 attribute surface) ------------
+
+    @property
+    def totals(self) -> Dict[str, float]:
+        with self._lock:
+            rows = dict(self._rows)
+        return {k: r.sum_s for k, r in rows.items() if r.count}
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            rows = dict(self._rows)
+        return {k: r.count for k, r in rows.items() if r.count}
+
+    def snapshot(self) -> dict:
+        """{phase: histogram snapshot} — the serve-snapshot block."""
+        with self._lock:
+            rows = dict(self._rows)
+        return {k: r.snapshot() for k, r in sorted(rows.items())
+                if r.count}
 
     def report(self) -> str:
+        totals, counts = self.totals, self.counts
         lines = [f"{'phase':<28} {'total_s':>10} {'calls':>7} {'avg_ms':>10}"]
-        for k in sorted(self.totals, key=self.totals.get, reverse=True):
-            t, c = self.totals[k], self.counts[k]
+        for k in sorted(totals, key=totals.get, reverse=True):
+            t, c = totals[k], counts[k]
             lines.append(f"{k:<28} {t:>10.3f} {c:>7} {t / c * 1e3:>10.2f}")
         return "\n".join(lines)
 
     def reset(self):
-        self.totals.clear()
-        self.counts.clear()
+        """Drop the rows (obs.reset calls this: the registry they
+        were bound to was just swapped — fresh phases register
+        fresh rows, stale rows stop being visible anywhere)."""
+        with self._lock:
+            self._rows.clear()
 
 
 scoreboard = Scoreboard()
@@ -86,7 +140,16 @@ scoreboard = Scoreboard()
 @contextlib.contextmanager
 def trace(logdir: Optional[str] = None):
     """Capture a jax.profiler device trace around a block (view with
-    tensorboard / xprof). No-op when logdir is None."""
+    tensorboard / xprof). No-op when logdir is None.
+
+    This is the UNMANAGED form for scripts that own their own
+    lifetime (bench attribution runs). Production code wants
+    ``pint_tpu.obs.perf.request_window`` instead: supervised,
+    bounded ($PINT_TPU_PROFILE_MAX_S), rate-limited, hang-proof
+    stop, cross-linked window metadata — and auto-fired on
+    slo_burn/breaker-open incidents (ISSUE 15). graftlint G15 keeps
+    raw ``jax.profiler.start_trace`` calls confined to these two
+    modules."""
     if logdir is None:
         yield
         return

@@ -169,6 +169,20 @@ class RuntimeMetrics:
             row_factory=lambda key, metric: hist.row(
                 scope=scope, pool=str(key[0]), key=str(key[1]),
                 metric=metric))
+        # dispatch-wall decomposition rows (ISSUE 15): per (pool,
+        # key) x (queue_wait | host_assembly | device_wall |
+        # collect), recorded only when the perf plane is armed
+        # ($PINT_TPU_PERF) and the dispatch ran on the guarded
+        # worker (the phase boundaries ARE the worker's fn-return /
+        # host-read split). Rows shared with the registry histogram,
+        # same parity-by-construction as `latency`.
+        phist = om.histogram("pint_tpu_perf_dispatch_phase_seconds",
+                             "supervised dispatch wall "
+                             "decomposition per (pool, key) x phase")
+        self.perf = HistogramSet(
+            row_factory=lambda key, metric: phist.row(
+                scope=scope, pool=str(key[0]), key=str(key[1]),
+                metric=metric))
 
     def __getattr__(self, name):
         # registry-backed counter reads (tests and call sites keep
@@ -209,6 +223,9 @@ class RuntimeMetrics:
         lat = self.latency.snapshot()
         if lat:
             out["latency"] = lat
+        pf = self.perf.snapshot()
+        if pf:
+            out["perf"] = pf
         return out
 
 
@@ -426,6 +443,14 @@ class DispatchSupervisor:
         retries = config.dispatch_retries()
         deadline_s = self._deadline_s(key, steps, backend,
                                       depth=depth)
+        # perf decomposition arming (ISSUE 15): one cached-bool read
+        # when disarmed; phases only exist on the guarded worker,
+        # whose fn-return/host-read boundaries ARE the split
+        perf_on = False
+        if guard:
+            from pint_tpu.obs import perf as _perf
+
+            perf_on = _perf.enabled()
         attempt = 0
         while True:
             if _plan_hits is not None:
@@ -449,10 +474,19 @@ class DispatchSupervisor:
                     raise (inj_err.exc if inj_err.exc is not None
                            else faults.TransientFault(
                                f"injected transient error at {key}"))
+                ph: Optional[list] = [] if perf_on else None
                 if guard:
                     m.bump("guarded")
-                    out = self._guarded_call(fn, args, kw, deadline_s,
-                                             pre_sleep, nan)
+                    # ph passed only when armed: keeps the call
+                    # signature-compatible with test doubles that
+                    # wrap _guarded_call positionally
+                    if ph is not None:
+                        out = self._guarded_call(
+                            fn, args, kw, deadline_s, pre_sleep,
+                            nan, ph=ph)
+                    else:
+                        out = self._guarded_call(
+                            fn, args, kw, deadline_s, pre_sleep, nan)
                 else:
                     out = fn(*args, **kw)
                     if nan:
@@ -509,6 +543,42 @@ class DispatchSupervisor:
                     "first-call (trace+compile+dispatch) wall per "
                     "dispatch key").set(
                     wall, scope=self.metrics.scope, key=key)
+                # ISSUE 15: the same detection feeds the compile
+                # LEDGER — every supervised dispatch key (device
+                # fits, GLS solves, serve classes, streaming/
+                # sampling chunks) gets an entry with its first-call
+                # wall; call sites that hold the jit object enrich
+                # it with XLA cost analysis (ExecutableCache, bench)
+                from pint_tpu.obs import perf as _perf
+
+                _perf.note_compile(key, backend=backend,
+                                   compile_wall_s=wall)
+            if ph is not None and len(ph) == 3:
+                # dispatch-wall decomposition (ISSUE 15): the four
+                # phases telescope over [t0, t0+wall] — queue_wait
+                # (worker spawn/schedule), host_assembly (fn body up
+                # to enqueue), device_wall (the donation-safe
+                # _host_read block), collect (worker wake + unbox).
+                # Pipelined dispatches keep their own depth in the
+                # span; like the PR-7 precedent none of this ever
+                # feeds RTT drift.
+                t_end = t0 + wall
+                qs = max(0.0, ph[0] - t0)
+                ha = max(0.0, ph[1] - ph[0])
+                dw = max(0.0, ph[2] - ph[1])
+                co = max(0.0, t_end - ph[2])
+                pkey = ("host" if pinned else backend, key)
+                pf = self.metrics.perf
+                pf.record(pkey, "queue_wait", qs)
+                pf.record(pkey, "host_assembly", ha)
+                pf.record(pkey, "device_wall", dw)
+                pf.record(pkey, "collect", co)
+                sp.event("perf.phases",
+                         queue_wait_ms=round(qs * 1e3, 3),
+                         host_assembly_ms=round(ha * 1e3, 3),
+                         device_wall_ms=round(dw * 1e3, 3),
+                         collect_ms=round(co * 1e3, 3),
+                         depth=depth)
             # no drift verdict on the first call per key: its wall
             # includes the compile the deadline logic itself budgets
             # a separate allowance for — it would read as "drift" on
@@ -541,8 +611,16 @@ class DispatchSupervisor:
 
             sp.event("breaker.open", backend=backend,
                      trips=br.trips)
-            obs.flight_dump("breaker_open", backend=backend,
-                            breaker=br.snapshot())
+            fpath = obs.flight_dump("breaker_open", backend=backend,
+                                    breaker=br.snapshot())
+            # ISSUE 15: automatic one-shot profiler window capturing
+            # the dispatches that follow the trip — armed by
+            # $PINT_TPU_PROFILE_DIR, one per episode (per-reason
+            # rate limit), never raises into the incident path
+            from pint_tpu.obs import perf as _perf
+
+            _perf.auto_window("breaker_open", backend=backend,
+                              flight=fpath)
 
     def dispatch_async(self, fn, *args, key: str = "dispatch",
                        steps: int = 1, kw: Optional[dict] = None,
@@ -663,12 +741,22 @@ class DispatchSupervisor:
         return fallback()
 
     def _guarded_call(self, fn, args, kw, deadline_s, pre_sleep,
-                      nan):
+                      nan, ph: Optional[list] = None):
+        """``ph`` (ISSUE 15): a caller-owned list the worker fills
+        with its three phase boundaries — worker start, fn return
+        (host assembly + enqueue done) and host-read return (device
+        work + D2H done) — when the perf decomposition is armed.
+        The fn-return / host-read split is exactly the
+        donation-safe ``_host_read`` boundary: on an async backend
+        ``fn`` returns at enqueue, so the read wall IS the device
+        wall + collect copy."""
         box: dict = {}
         done = threading.Event()
 
         def work():
             try:
+                if ph is not None:
+                    ph.append(time.perf_counter())
                 if pre_sleep:
                     # injected wedge: a real wedge never completes, so
                     # the payload is never run — the worker sleeps out
@@ -681,6 +769,8 @@ class DispatchSupervisor:
                     raise faults.TransientFault(
                         "injected hang elapsed (dispatch abandoned)")
                 out = fn(*args, **kw)
+                if ph is not None:
+                    ph.append(time.perf_counter())
                 # force the host read INSIDE the worker: an async jax
                 # dispatch returns after ENQUEUE (the axon tunnel
                 # happily acks enqueue and then wedges), so without
@@ -688,6 +778,8 @@ class DispatchSupervisor:
                 # block unbounded OUTSIDE the watchdog — the exact
                 # hang this supervisor exists to eliminate
                 out = _host_read(out)
+                if ph is not None:
+                    ph.append(time.perf_counter())
                 if nan:
                     out = _nan_like(out)
                 box["out"] = out

@@ -13,6 +13,7 @@ the window into padded vmapped dispatches. Request forms:
     {"kind": "posterior", "par": P, "tim": T, "nwalkers": 32,
      "nsteps": 500, "seed": 0, "thin": 1, ...}
     {"kind": "stats", "id": ...}
+    {"kind": "profile", "seconds": N, "id": ...}
 
 (par, tim) pairs are loaded once and cached — repeated requests
 against the same pulsar are the serving-state hot path, paying only
@@ -306,6 +307,25 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
             # replay forever
             ack.expect(0)
         return 0
+    if kind == "profile":
+        # ISSUE 15: open one bounded profiler window capturing the
+        # NEXT dispatches ({"kind": "profile", "seconds": N}) —
+        # answered inline like stats (zero engine submissions, never
+        # journaled, in-flight batches untouched); disarmed
+        # ($PINT_TPU_PROFILE_DIR unset) or rate-limited requests get
+        # a labeled refusal, never an error path
+        from pint_tpu.obs import perf as _perf
+
+        res = _perf.request_window(rec.get("seconds"),
+                                   reason="profile")
+        out = {"kind": "profile"}
+        out.update(res)
+        if rid is not None:
+            out["id"] = rid
+        report(out)
+        if ack is not None:
+            ack.expect(0)
+        return 0
     tenant = rec.get("tenant")
     deadline_s = rec["deadline_ms"] / 1e3 \
         if rec.get("deadline_ms") is not None else None
@@ -588,10 +608,11 @@ def main(argv=None, stdin=None) -> int:
 
         def handle(rec):
             nonlocal nsub
-            if rec.get("kind") == "stats":
-                # introspection: answered inline, never journaled
-                # (a journaled stats line would replay forever — it
-                # can never receive a terminal ack)
+            if rec.get("kind") in ("stats", "profile"):
+                # introspection/window control: answered inline,
+                # never journaled (a journaled stats line would
+                # replay forever — it can never receive a terminal
+                # ack; a profile window is a point-in-time act)
                 _submit_line(engine, cache, rec, None, report)
                 return
             rid = rec.get("id") or uuid.uuid4().hex

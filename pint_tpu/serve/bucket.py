@@ -284,7 +284,8 @@ class ExecutableCache:
 
     def _issue(self, run, host, dispatch_key, class_key, sync: bool,
                pool: str = "device", info: Optional[dict] = None,
-               export_cb=None, restored: bool = False):
+               export_cb=None, restored: bool = False,
+               ledger_cb=None):
         """Shared issue/collect plumbing: ``sync`` runs the
         supervised dispatch inline (the classic drain); otherwise the
         dispatch is ISSUED on the supervisor's pipeline mode
@@ -344,6 +345,16 @@ class ExecutableCache:
                 self.keys.add(class_key)
                 if export_cb is not None:
                     export_cb()
+                if ledger_cb is not None:
+                    # ISSUE 15: enrich this class's compile-ledger
+                    # entry (the supervisor's first_call already
+                    # recorded the wall) with XLA cost analysis.
+                    # The probe itself runs on a BACKGROUND thread
+                    # (defer_cost): lower().compile() re-pays the
+                    # in-process compile, which must never land on
+                    # a serve dispatch path; the ledger dedups per
+                    # key either way
+                    ledger_cb()
 
         if sync:
             # LAZY: the dispatch runs inside collect, so the
@@ -411,23 +422,45 @@ class ExecutableCache:
                            for h in hs)
             return hs
 
-        export_cb = None
-        if self.aot is not None and restored is None and \
-                pool == "device" and not self.aot.has("gls", key):
+        dispatch_key = f"serve.gls/{'/'.join(str(x) for x in key)}"
+        # first-compile-only work stays OFF the per-dispatch path:
+        # avals/callbacks are built only while this class still owes
+        # its AOT export or its ledger cost entry
+        need_export = self.aot is not None and restored is None and \
+            pool == "device" and not self.aot.has("gls", key)
+        # pool-gated like need_export: ledger_cb can only FIRE on
+        # a real device dispatch (_record's device branch), and the
+        # deferred probe lowers the DEVICE jit — neither belongs to
+        # a host-pool (demoted/steered) dispatch
+        need_ledger = restored is None and pool == "device" and \
+            key not in self.keys
+        export_cb = ledger_cb = None
+        if need_export or need_ledger:
             import jax
 
             avals = tuple(jax.ShapeDtypeStruct(stacked[n].shape,
                                                stacked[n].dtype)
                           for n in ("M", "F", "phi", "r", "nvec",
                                     "valid", "pvalid"))
-            export_cb = lambda: self.aot.save(  # noqa: E731
-                "gls", key, self._gls, avals)
+            if need_export:
+                export_cb = lambda: self.aot.save(  # noqa: E731
+                    "gls", key, self._gls, avals)
+            if need_ledger:
+                from pint_tpu.obs import perf as _perf
+
+                # defer_cost: the probe re-pays the in-process
+                # compile (lower().compile() is NOT a cache hit of
+                # the jit call) — it runs on a background thread,
+                # never on the serve dispatch path
+                ledger_cb = lambda: _perf.note_compile(  # noqa: E731
+                    dispatch_key, kind="serve.gls",
+                    jitted=self._gls, args=avals, defer_cost=True)
 
         return self._issue(
             run, lambda: pta_solve_np(stacked),
-            f"serve.gls/{'/'.join(str(x) for x in key)}", key, sync,
+            dispatch_key, key, sync,
             pool=pool, info=info, export_cb=export_cb,
-            restored=restored is not None)
+            restored=restored is not None, ledger_cb=ledger_cb)
 
     def gls(self, key, problems, shape):
         """Synchronous ``gls_begin`` + collect (the non-pipelined
@@ -493,22 +526,36 @@ class ExecutableCache:
                 pf[k, :n] = hf
             return pi, pf
 
-        export_cb = None
-        if self.aot is not None and restored is None and \
-                pool == "device" and not self.aot.has("phase", key):
+        dispatch_key = f"serve.phase/{'/'.join(str(x) for x in key)}"
+        # first-compile-only work off the per-dispatch path + the
+        # deferred cost probe — see gls_begin
+        need_export = self.aot is not None and restored is None and \
+            pool == "device" and not self.aot.has("phase", key)
+        need_ledger = restored is None and pool == "device" and \
+            key not in self.keys
+        export_cb = ledger_cb = None
+        if need_export or need_ledger:
             import jax
 
             avals = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                           for a in (coeffs, tmid, rpi, rpf, f0,
                                     mjds, valid))
-            export_cb = lambda: self.aot.save(  # noqa: E731
-                "phase", key, self._phase, avals)
+            if need_export:
+                export_cb = lambda: self.aot.save(  # noqa: E731
+                    "phase", key, self._phase, avals)
+            if need_ledger:
+                from pint_tpu.obs import perf as _perf
+
+                ledger_cb = lambda: _perf.note_compile(  # noqa: E731
+                    dispatch_key, kind="serve.phase",
+                    jitted=self._phase, args=avals,
+                    defer_cost=True)
 
         return self._issue(
             run, host,
-            f"serve.phase/{'/'.join(str(x) for x in key)}", key, sync,
+            dispatch_key, key, sync,
             pool=pool, info=info, export_cb=export_cb,
-            restored=restored is not None)
+            restored=restored is not None, ledger_cb=ledger_cb)
 
     def phase(self, key, requests, nb: int, kb: int, Pb: int):
         """Synchronous ``phase_begin`` + collect."""

@@ -470,6 +470,19 @@ class AotStore:
             self._restored.update(restored)
             self.restored = len(self._restored)
             self._g_restored.set(self.restored)
+        # ISSUE 15: restored executables are COMPILES this process
+        # never paid for — the ledger records them with
+        # aot_restored=True (key spelled as the scheduler's dispatch
+        # key, so a later first_call merges into the same entry)
+        try:
+            from pint_tpu.obs import perf as _perf
+
+            for ks in restored:
+                _perf.note_compile(f"serve.{ks}",
+                                   backend=fp.get("platform"),
+                                   kind="aot", aot_restored=True)
+        except Exception:
+            pass
         return self.restored
 
     def get(self, kind: str, full_key: tuple) -> Optional[Callable]:

@@ -259,6 +259,17 @@ class ServeMetrics:
         from pint_tpu import obs
 
         out["obs"] = obs.status()
+        # ISSUE 15: the annotate()/phase scoreboard is registry-
+        # backed now — its rows (serve.assemble, serve.dispatch)
+        # ride the snapshot instead of living in a report-only dict
+        try:
+            from pint_tpu.profiling import scoreboard
+
+            sb = scoreboard.snapshot()
+            if sb:
+                out["scoreboard"] = sb
+        except Exception:
+            pass
         # ISSUE 11: the SLO watchdog's burn state rides the snapshot
         # when armed ($PINT_TPU_SLO) — absent otherwise, keeping the
         # pre-metrics-plane snapshot shape bit-compatible

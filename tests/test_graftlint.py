@@ -855,3 +855,73 @@ def test_g13_vocabulary_covers_the_health_counters():
         self.stats["shadow_replays"] += 1
     """)
     assert [x.rule for x in v] == ["G13"] * 2
+
+
+# ----------------------------------------------------------- G15
+
+
+def _lint_g15(src, relpath="pint_tpu/serve/_fixture.py"):
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    return gl.check_g15(m)
+
+
+def test_g15_flags_raw_profiler_trace_control():
+    v = _lint_g15("""
+    def capture(self):
+        jax.profiler.start_trace("/tmp/x")
+        self.work()
+        jax.profiler.stop_trace()
+    """)
+    assert [x.rule for x in v] == ["G15"] * 2
+    # TraceAnnotation (the annotate() region marker) is NOT trace
+    # control — only start/stop windows are G15's business
+    assert _lint_g15("""
+    def region(self):
+        with jax.profiler.TraceAnnotation("x"):
+            pass
+    """) == []
+
+
+def test_g15_flags_cost_probe_patterns():
+    v = _lint_g15("""
+    def probe(self, jitted, args):
+        c = jitted.lower(*args).compile()
+        ca = compiled.cost_analysis()
+        ma = compiled.memory_analysis()
+    """)
+    assert [x.rule for x in v] == ["G15"] * 3
+    # a plain .compile() (no .lower() receiver) is not the probe
+    # pattern — re.compile, sre patterns, etc. must never flag
+    assert _lint_g15("""
+    def other(self):
+        pat = re.compile("x")
+        low = text.lower()
+    """) == []
+
+
+def test_g15_sanctioned_files_are_exempt():
+    src = """
+    def capture(self):
+        jax.profiler.start_trace("/tmp/x")
+        jax.profiler.stop_trace()
+        c = jitted.lower(*args).compile().cost_analysis()
+    """
+    assert _lint_g15(src, relpath="pint_tpu/obs/perf.py") == []
+    assert _lint_g15(src, relpath="pint_tpu/profiling.py") == []
+    # everywhere else — including obs/ siblings and the dispatch
+    # dirs — the rule is pinned
+    assert _lint_g15(src, relpath="pint_tpu/obs/metrics.py")
+    assert _lint_g15(src, relpath="pint_tpu/parallel/_f.py")
+    assert _lint_g15(src, relpath="tools/_f.py")
+
+
+def test_g15_pragma_suppression_works():
+    src = ("def f(self):\n"
+           "    jax.profiler.start_trace('/tmp/x')  "
+           "# graftlint: allow G15 -- fixture: scripted capture\n")
+    m = gl.ModuleInfo("pint_tpu/serve/_fixture.py", src)
+    report = gl.LintReport(violations=gl.check_g15(m))
+    gl.apply_suppressions(
+        report, [], {"pint_tpu/serve/_fixture.py": src})
+    assert report.violations == []
+    assert len(report.suppressed) == 1

@@ -1,0 +1,425 @@
+"""Performance-attribution plane acceptance suite (ISSUE 15).
+
+The contracts CLAUDE.md promises for the perf plane:
+
+- compile ledger: every supervised first_call lands an entry; the
+  registry counter and the snapshot are the SAME number (derived
+  view, the ISSUE-11 parity discipline); JSONL persistence reads
+  back as ``prior`` after a restart; AOT-restored serve classes are
+  ledgered with ``aot_restored=True``;
+- dispatch-wall decomposition: armed, the four phases telescope to
+  (at most) the dispatch wall; disarmed, ZERO rows are recorded and
+  the snapshot carries no ``perf`` block;
+- roofline blocks derive from ledger cost ÷ measured walls against
+  the per-backend peak table (bench's constants must match it);
+- profiler windows: bounded (clamped to $PINT_TPU_PROFILE_MAX_S),
+  rate-limited per reason, zero records when disarmed; an slo_burn
+  episode auto-opens EXACTLY one window cross-linked to the
+  episode's flight dump; a window open across an injected backend
+  death never wedges the dispatch path and still ends in a labeled
+  status with parseable metadata;
+- the profiling scoreboard's phase rows are registry-shared and
+  cleared by ``obs.reset()``.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pint_tpu import obs
+from pint_tpu.obs import metrics as om
+from pint_tpu.obs import perf
+from pint_tpu.runtime import (
+    DispatchSupervisor,
+    Fault,
+    FaultPlan,
+    reset_runtime,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    """A configured plane (or tripped breaker) must never leak
+    across tests — the obs.reset() isolation contract."""
+    obs.reset()
+    reset_runtime()
+    yield
+    obs.reset()
+    reset_runtime()
+
+
+# ------------------------------------------------------------- ledger
+
+
+def test_ledger_registry_vs_snapshot_parity():
+    led = perf.get_ledger()
+    led.record("k1", backend="cpu", compile_wall_s=0.5, flops=1e9,
+               bytes_accessed=2e8)
+    led.record("k1", compile_wall_s=0.6)   # merge, not a new compile
+    led.record("k2", backend="cpu", aot_restored=True)
+    snap = led.snapshot()
+    assert snap["compiles"] == 2
+    assert int(om.get_registry().total(
+        "pint_tpu_perf_compiles_total")) == snap["compiles"]
+    assert int(om.get_registry().total(
+        "pint_tpu_perf_aot_restored_total")) == snap["aot_restored"] \
+        == 1
+    assert snap["entries"]["k2"]["aot_restored"] is True
+    # the merge updated the wall in place — entry and gauge agree
+    assert snap["entries"]["k1"]["compile_wall_s"] == 0.6
+    assert om.get_registry().value(
+        "pint_tpu_perf_compile_wall_seconds", key="k1") == 0.6
+    assert om.get_registry().value(
+        "pint_tpu_perf_cost_flops", key="k1") == 1e9
+
+
+def test_ledger_jsonl_persists_and_restores_as_prior(tmp_path):
+    p = str(tmp_path / "ledger.jsonl")
+    perf.configure(ledger_path=p)
+    perf.get_ledger().record("a", backend="cpu", compile_wall_s=0.1,
+                             flops=5.0)
+    perf.get_ledger().record("b", backend="cpu", compile_wall_s=0.2)
+    lines = [json.loads(x) for x in
+             open(p, encoding="utf-8").read().splitlines()]
+    assert {r["key"] for r in lines} == {"a", "b"}
+    # a restarted worker reads the file back as prior entries —
+    # visible by key, NOT counted against this process's registry
+    obs.reset()
+    perf.configure(ledger_path=p)
+    led = perf.get_ledger()
+    snap = led.snapshot()
+    assert snap["compiles"] == 0 and snap["prior"] == 2
+    assert led.get("a")["flops"] == 5.0
+
+
+def test_supervisor_first_call_feeds_the_ledger():
+    sup = DispatchSupervisor()
+    sup.dispatch(lambda: 1.0, key="unit.first")
+    sup.dispatch(lambda: 2.0, key="unit.first")  # no second entry
+    entry = perf.get_ledger().get("unit.first")
+    assert entry is not None
+    assert entry["compile_wall_s"] >= 0.0
+    assert perf.get_ledger().snapshot()["compiles"] == 1
+
+
+def test_cost_probe_on_a_real_jit_and_roofline_block():
+    import jax
+
+    f = jax.jit(lambda x: x @ x)
+    x = np.zeros((64, 64))
+    jax.block_until_ready(f(x))
+    perf.note_compile("unit.mm", backend="cpu", kind="test",
+                      jitted=f, args=(x,))
+    entry = perf.get_ledger().get("unit.mm")
+    assert entry and entry.get("flops", 0) > 0
+    blk = perf.roofline_block("unit.mm", 1e-3, "cpu")
+    assert blk["source"] == "compile_ledger"
+    assert blk["gflops_achieved"] == pytest.approx(
+        entry["flops"] / 1e-3 / 1e9, rel=0.01)
+    # achieved fraction only where a peak is declared (no fabricated
+    # host "peak"); bench's historical constants must match the table
+    assert "achieved_frac_flops" not in blk
+    import bench
+
+    assert bench.V5E_PEAK_FLOPS == perf.PEAKS["tpu"]["flops"]
+    assert bench.V5E_PEAK_HBM_BPS == perf.PEAKS["tpu"]["bytes_per_s"]
+    # the gauges landed
+    assert om.get_registry().value(
+        "pint_tpu_perf_achieved_gflops", key="unit.mm") == \
+        blk["gflops_achieved"]
+
+
+# ------------------------------------------------- wall decomposition
+
+
+def test_decomposition_phases_sum_to_at_most_the_wall():
+    perf.configure(enabled=True)
+    sup = DispatchSupervisor()
+
+    def payload():
+        time.sleep(0.01)
+        return np.zeros(8)
+
+    t0 = time.perf_counter()
+    sup.dispatch(payload, key="unit.decomp", guard=True)
+    wall = time.perf_counter() - t0
+    snap = sup.metrics.perf.snapshot()
+    import jax
+
+    row = snap[f"{jax.default_backend()}/unit.decomp"]
+    phases = ("queue_wait", "host_assembly", "device_wall",
+              "collect")
+    assert all(row[p]["count"] == 1 for p in phases)
+    total_s = sum(row[p]["mean_ms"] for p in phases) / 1e3
+    assert total_s <= wall + 1e-3
+    # the payload sleep must land INSIDE the host_assembly phase
+    # (the worker's fn wall), not be lost to the residual phases
+    assert row["host_assembly"]["mean_ms"] >= 9.0
+    # the supervisor snapshot carries the block
+    assert "perf" in sup.metrics.snapshot()
+
+
+def test_decomposition_disarmed_records_nothing():
+    sup = DispatchSupervisor()
+    sup.dispatch(lambda: np.zeros(4), key="unit.off", guard=True)
+    assert len(sup.metrics.perf) == 0
+    assert "perf" not in sup.metrics.snapshot()
+
+
+# --------------------------------------------------- profiler windows
+
+
+def test_window_disarmed_is_a_labeled_refusal_with_zero_records(
+        tmp_path, monkeypatch):
+    monkeypatch.delenv("PINT_TPU_PROFILE_DIR", raising=False)
+    res = perf.request_window(1, reason="t")
+    assert res["ok"] is False and "armed" in res["error"]
+    # nothing recorded anywhere: no counters, no files
+    assert om.get_registry().total(
+        "pint_tpu_perf_profile_windows_total") == 0
+    assert om.get_registry().total(
+        "pint_tpu_perf_profile_suppressed_total") == 0
+    assert perf.auto_window("breaker_open") is None
+
+
+def test_window_bounded_and_rate_limited(tmp_path):
+    d = str(tmp_path / "prof")
+    perf.configure(profile_dir=d, max_s=0.2)
+    res = perf.request_window(99, reason="t")   # clamped to max_s
+    assert res["ok"] and res["seconds"] <= 0.2
+    # a second request while open (or inside the per-reason rate
+    # limit) is refused and counted
+    res2 = perf.request_window(1, reason="t")
+    assert res2["ok"] is False
+    assert om.get_registry().total(
+        "pint_tpu_perf_profile_suppressed_total") == 1
+    t0 = time.time()
+    while perf.get_profiler().status()["open"] is not None and \
+            time.time() - t0 < 10:
+        time.sleep(0.05)
+    meta = json.load(open(os.path.join(res["dir"], "window.json"),
+                          encoding="utf-8"))
+    assert meta["status"] in ("closed", "aborted", "abandoned")
+    assert meta["reason"] == "t"
+    # even after the close, the same reason stays rate-limited
+    res3 = perf.request_window(0.05, reason="t")
+    assert res3["ok"] is False and "rate-limited" in res3["error"]
+
+
+def test_slo_burn_opens_exactly_one_crosslinked_window(tmp_path):
+    """The chaos-oracle acceptance: one slo_burn episode -> exactly
+    one auto profiler window, cross-linked to the episode's flight
+    dump, with Perfetto-parseable span export."""
+    from pint_tpu.obs.slo import SLOSpec, SLOWatchdog
+
+    fdir = str(tmp_path / "flight")
+    pdir = str(tmp_path / "prof")
+    obs.configure(enabled=True, flight_dir=fdir)
+    perf.configure(profile_dir=pdir, max_s=0.2)
+    spec = SLOSpec(name="unit_ratio", type="ratio",
+                   bad=["unit_bad_total"], total=["unit_all_total"],
+                   budget=0.01, fast_s=10.0, slow_s=30.0,
+                   min_events=1, min_samples=1)
+    bad = om.counter("unit_bad_total")
+    allc = om.counter("unit_all_total")
+    wd = SLOWatchdog(specs=[spec], interval_s=1.0)
+    allc.inc(10)
+    wd.tick(now=0.0)
+    bad.inc(10)
+    allc.inc(10)
+    fired = wd.tick(now=40.0)
+    assert fired == ["unit_ratio"]
+    windows = [x for x in os.listdir(pdir)
+               if x.startswith("window-")]
+    assert len(windows) == 1
+    # burning on: the episode is latched — no second fire, and the
+    # window count stays one
+    bad.inc(10)
+    allc.inc(10)
+    assert wd.tick(now=80.0) == []
+    assert len([x for x in os.listdir(pdir)
+                if x.startswith("window-")]) == 1
+    # wait out the window close, then check the cross-links
+    t0 = time.time()
+    while perf.get_profiler().status()["open"] is not None and \
+            time.time() - t0 < 10:
+        time.sleep(0.05)
+    wdir = os.path.join(pdir, windows[0])
+    meta = json.load(open(os.path.join(wdir, "window.json"),
+                          encoding="utf-8"))
+    assert meta["reason"] == "slo_burn:unit_ratio"
+    assert meta["status"] in ("closed", "aborted", "abandoned")
+    extra = meta.get("extra") or {}
+    flight = extra.get("flight")
+    assert flight and os.path.exists(flight), meta
+    fdoc = json.load(open(flight, encoding="utf-8"))
+    assert fdoc["reason"].startswith("slo_burn:")
+    # Perfetto-parseable span export rides the window dir (tracing
+    # was armed): the Chrome trace-event wrapper with causal ids
+    spath = os.path.join(wdir, "spans.json")
+    assert os.path.exists(spath)
+    sdoc = json.load(open(spath, encoding="utf-8"))
+    assert isinstance(sdoc["traceEvents"], list)
+    for e in sdoc["traceEvents"]:
+        assert e["ph"] in ("X", "i") and "ts" in e
+
+
+def test_window_survives_injected_backend_death(tmp_path):
+    """Chaos: a profile window open across an injected backend death
+    must never wedge the drain — the dispatch fails over on its own
+    deadline, and the window still ends in a labeled status with
+    parseable metadata."""
+    d = str(tmp_path / "prof")
+    perf.configure(profile_dir=d, max_s=0.3)
+    res = perf.request_window(0.3, reason="chaos")
+    assert res["ok"]
+    plan = FaultPlan([Fault(match="unit.dead", kind="hang",
+                            seconds=2.0)])
+    sup = DispatchSupervisor()
+    with plan.active():
+        os.environ["PINT_TPU_DISPATCH_DEADLINE_MS"] = "200"
+        try:
+            out = sup.dispatch(lambda: np.ones(3), key="unit.dead",
+                               fallback=lambda: np.zeros(3))
+        finally:
+            os.environ.pop("PINT_TPU_DISPATCH_DEADLINE_MS", None)
+    np.testing.assert_array_equal(out, np.zeros(3))
+    assert sup.metrics.failovers == 1
+    t0 = time.time()
+    while perf.get_profiler().status()["open"] is not None and \
+            time.time() - t0 < 10:
+        time.sleep(0.05)
+    meta = json.load(open(os.path.join(res["dir"], "window.json"),
+                          encoding="utf-8"))
+    assert meta["status"] in ("closed", "aborted", "abandoned")
+
+
+def test_breaker_open_fires_an_auto_window(tmp_path, monkeypatch):
+    """The breaker-open incident trigger: tripping the breaker opens
+    one auto window (flight-recorder pattern) and never raises into
+    the dispatch path."""
+    pdir = str(tmp_path / "prof")
+    perf.configure(profile_dir=pdir, max_s=0.2)
+    monkeypatch.setenv("PINT_TPU_BREAKER_THRESHOLD", "1")
+    plan = FaultPlan([Fault(match="unit.trip", kind="error",
+                            count=8)])
+    sup = DispatchSupervisor()
+    with plan.active():
+        out = sup.dispatch(lambda: 1.0, key="unit.trip",
+                           fallback=lambda: -1.0)
+    assert out == -1.0
+    windows = [x for x in os.listdir(pdir)
+               if x.startswith("window-")]
+    assert len(windows) == 1
+    assert "breaker_open" in windows[0]
+
+
+# ------------------------------------------------- AOT restore ledger
+
+
+def test_aot_restored_classes_are_ledgered(tmp_path):
+    """A warm restart's restored executables land in the ledger with
+    aot_restored=True, keyed as the scheduler's dispatch-key
+    spelling (``serve.<kind>/<class>``) so a later first_call merges
+    into the same entry. Exercised directly against AotStore (the
+    full engine round-trip is test_serve_restart's oracle) with a
+    tiny exported kernel — the ledgering path is restore_all's."""
+    import jax
+    import jax.numpy as jnp
+
+    from pint_tpu.serve.journal import AotStore
+
+    d = str(tmp_path / "aot")
+    store = AotStore(d)
+    f = jax.jit(lambda a: a * 2.0 + 1.0)
+    aval = jax.ShapeDtypeStruct((8,), jnp.float64)
+    jax.block_until_ready(f(np.zeros(8)))
+    store.save("gls", (64, 8, 0, 1), f, (aval,))
+    assert store.exported == 1
+    obs.reset()          # fresh plane: the restart's process state
+    reset_runtime()
+    store2 = AotStore(d)
+    assert store2.restore_all() == 1
+    snap = perf.get_ledger().snapshot()
+    restored = {k: e for k, e in snap["entries"].items()
+                if e.get("aot_restored")}
+    assert list(restored) == ["serve.gls/64/8/0/1"]
+    assert snap["aot_restored"] == 1
+    # the spelling matches the scheduler's dispatch key, so the
+    # supervisor's later first_call MERGES rather than minting a
+    # second entry
+    perf.note_compile("serve.gls/64/8/0/1", compile_wall_s=0.25)
+    snap = perf.get_ledger().snapshot()
+    assert snap["compiles"] == 1
+    e = snap["entries"]["serve.gls/64/8/0/1"]
+    assert e["aot_restored"] is True and \
+        e["compile_wall_s"] == 0.25
+
+
+# ------------------------------------------- scoreboard unification
+
+
+def test_scoreboard_rows_are_registry_shared_and_reset_clears():
+    from pint_tpu.profiling import scoreboard
+
+    scoreboard.reset()
+    with scoreboard.phase("unit-phase"):
+        pass
+    assert scoreboard.counts["unit-phase"] == 1
+    hist = om.get_registry().get("pint_tpu_scoreboard_seconds")
+    assert hist is not None
+    rows = [h for key, h in hist.rows()
+            if ("phase", "unit-phase") in key]
+    assert len(rows) == 1
+    # the SAME object: registry row and scoreboard row can never
+    # disagree (parity by construction, the row_factory discipline)
+    assert rows[0] is scoreboard._rows["unit-phase"]
+    assert rows[0].count == 1
+    obs.reset()
+    assert scoreboard.totals == {}
+    # fresh phases re-register against the fresh registry
+    with scoreboard.phase("unit-phase"):
+        pass
+    assert scoreboard.counts["unit-phase"] == 1
+    hist2 = om.get_registry().get("pint_tpu_scoreboard_seconds")
+    assert hist2 is not None and hist2 is not hist
+
+
+def test_serve_snapshot_carries_the_scoreboard_block():
+    from pint_tpu.profiling import annotate
+    from pint_tpu.serve.metrics import ServeMetrics
+
+    with annotate("unit.region"):
+        pass
+    snap = ServeMetrics().snapshot()
+    assert "unit.region" in snap.get("scoreboard", {})
+
+
+# ------------------------------------------------------- obs surface
+
+
+def test_obs_status_carries_the_perf_block():
+    perf.get_ledger().record("k", backend="cpu", compile_wall_s=0.1)
+    st = obs.status()
+    assert st["perf"]["compiles"] == 1
+    assert st["perf"]["decomposition_armed"] is False
+
+
+def test_perf_enabled_env_parser(monkeypatch):
+    from pint_tpu import config
+
+    monkeypatch.setenv("PINT_TPU_PERF", "on")
+    assert config.perf_enabled() is True
+    monkeypatch.setenv("PINT_TPU_PERF", "definitely")
+    assert config.perf_enabled() is False   # warn-and-ignore
+    monkeypatch.setenv("PINT_TPU_PROFILE_MAX_S", "-3")
+    assert config.profile_max_s() == 30.0   # warn-and-ignore
+    monkeypatch.setenv("PINT_TPU_PROFILE_MAX_S", "7.5")
+    assert config.profile_max_s() == 7.5
+    monkeypatch.setenv("PINT_TPU_PROFILE_DIR", "")
+    assert config.profile_dir() is None
+    monkeypatch.setenv("PINT_TPU_COMPILE_LEDGER", "")
+    assert config.compile_ledger_path() is None

@@ -56,6 +56,7 @@ STAGES = {
     "streaming": "gls_streaming_scan",
     "append": "serve_append_incremental_vs_cold_100k",
     "health": "north_star_health_overhead",
+    "perf": "north_star_perf_attribution",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
 # on-chip streaming points: bounded to fit one watcher stage window
@@ -514,6 +515,65 @@ def stage_health(backend):
     print(json.dumps(rec), flush=True)
 
 
+def stage_perf(backend):
+    """Performance-attribution plane ON CHIP (ISSUE 15): the compile
+    ledger + ledger-derived roofline of the production north-star
+    step against the REAL v5e peak table (the quantitative target
+    line for the >600k TOA/s goal), the dispatch-wall decomposition
+    under real tunnel RTT (queue/assembly/device/collect — the
+    first direct measurement of where the 0.1-0.25 s dispatch cost
+    actually goes), and one bounded profiler window of the step —
+    the Perfetto-loadable device trace cross-linked to span ids."""
+    from pint_tpu import obs
+    from pint_tpu.obs import perf as operf
+
+    model, toas = bench.build_problem()
+    t, chi2, jitted, args, step_fn = bench.measure_step(model, toas)
+    per = t
+    try:
+        per = min(per, bench.measure_step_chained((step_fn, args),
+                                                  k=8))
+    except Exception as e:
+        bench.log(f"  chained failed: {e!r}")
+    # decomposition first (it resets the plane on exit), then the
+    # ledger + window under an explicit configure
+    decomp = bench.measure_perf_decomposition(
+        lambda: _block(jitted, args))
+    pdir = os.path.join(REPO, "profile_tpu")
+    obs.configure(enabled=True)  # span ring for the window export
+    operf.configure(enabled=True, profile_dir=pdir, max_s=30.0)
+    try:
+        operf.note_compile("bench.north_star_step", backend=backend,
+                           kind="fit_step", jitted=jitted, args=args)
+        roof = operf.roofline_block("bench.north_star_step", per,
+                                    backend)
+        window = operf.request_window(5.0, reason="tpu_capture")
+        t_end = time.perf_counter() + 5.5
+        while time.perf_counter() < t_end:
+            _block(jitted, args)
+        # bounded: wait for the window's own close, then read status
+        t0 = time.perf_counter()
+        while operf.get_profiler().status()["open"] is not None \
+                and time.perf_counter() - t0 < 60.0:
+            time.sleep(0.25)
+        pstat = operf.get_profiler().status()
+        ledger = operf.ledger_summary()
+    finally:
+        obs.reset()
+    if roof is None or not roof.get("flops"):
+        raise RuntimeError(
+            "no cost analysis landed in the ledger (backend did not "
+            "report); stage stays on the to-do list")
+    rec = {"metric": STAGES["perf"], "backend": backend,
+           "unit": "GFLOP/s", "value": roof.get("gflops_achieved"),
+           "step_ms": round(per * 1e3, 2),
+           "roofline": roof, "dispatch_decomposition": decomp,
+           "compiles": ledger, "profile_window": window,
+           "profiler": pstat}
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
 def _block(jitted, args):
     import jax
 
@@ -561,6 +621,8 @@ def run_stage(name, backend):
         stage_append(backend)
     elif name == "health":
         stage_health(backend)
+    elif name == "perf":
+        stage_perf(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "
