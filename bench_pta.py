@@ -1,12 +1,19 @@
-"""PTA batch benchmark (BASELINE.md config #5): 67 heterogeneous
-pulsars fit as ONE vmapped GLS solve per iteration on the accelerator.
+"""PTA array benchmarks: batch fit (BASELINE.md config #5) and the
+array-level GWB detection sweep (ISSUE 17).
 
-Not part of the driver's bench.py protocol (that measures the single-
-pulsar GLS north star); run manually:
+Default mode measures the 67-pulsar vmapped GLS batch fit
+(``pta_batch_fit_throughput``). ``--gwb`` measures the array GWB
+likelihood plane: Hellings-Downs block assembly single-device vs
+sharded over the full mesh (the scale-out acceptance number — BOTH
+walls are recorded), then the chunked (log10_A, gamma) detection
+sweep, with roofline / dispatch_supervisor / health / regress blocks
+on the LAST-JSON-line artifact (bench.py parity, including the
+BENCH_TPU.jsonl provenance merge on CPU-fallback runs):
 
     python bench_pta.py [--npulsars 67] [--ntoa 100]
+    python bench_pta.py --gwb [--nfreq 5] [--grid 8]
 
-Prints one JSON line {metric, value, unit, npulsars, ...}.
+The LAST stdout JSON line is the recorded artifact.
 """
 
 from __future__ import annotations
@@ -58,10 +65,191 @@ UNITS TDB
     return m, t, truth
 
 
+def run_batch(args) -> dict:
+    """BASELINE config #5: one vmapped GLS solve per iteration."""
+    from pint_tpu.parallel import fit_pta
+
+    t0 = time.perf_counter()
+    pulsars = [build_pulsar(k, args.ntoa)
+               for k in range(args.npulsars)]
+    log(f"built {len(pulsars)} pulsars in "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    res = fit_pta([(t, m) for m, t, _ in pulsars], maxiter=2)
+    stats = fit_pta.last_stats
+    n_ok = sum(1 for (m, t, truth), r in zip(pulsars, res)
+               if abs(m.F0.value - truth["F0"])
+               < 5 * r["errors"]["F0"])
+    log(f"recovered F0 within 5 sigma: {n_ok}/{len(pulsars)}")
+    log(f"stats: {stats}")
+    return {
+        "metric": "pta_batch_fit_throughput",
+        "value": round(stats["toas_per_sec"], 1),
+        "unit": "TOA/s",
+        "npulsars": args.npulsars,
+        "ntoa_total": stats["ntoa_total"],
+        "device_solve_s": round(stats["device_solve_s"], 3),
+        "recovered": n_ok,
+    }
+
+
+def run_gwb(args) -> dict:
+    """Array GWB likelihood plane (ISSUE 17): sharded-vs-single-device
+    block assembly walls + the chunked detection sweep, instrumented
+    with the roofline / health evidence blocks."""
+    import jax
+    import numpy as np
+
+    from pint_tpu import config
+    from pint_tpu.obs import health as oh
+    from pint_tpu.obs import perf as operf
+    from pint_tpu.parallel.pta import build_problem
+    from pint_tpu.pta import GWBLikelihood
+    from pint_tpu.pta.gwb import (
+        _OUTER_NDIMS_IN,
+        _OUTER_NDIMS_OUT,
+        _gwb_outer_batch,
+    )
+    from pint_tpu.pta.shard import compile_with_plan
+
+    backend = jax.default_backend()
+    devices = jax.devices()
+    ndev = len(devices)
+
+    t0 = time.perf_counter()
+    pulsars = [build_pulsar(k, args.ntoa)
+               for k in range(args.npulsars)]
+    problems = [build_problem(t, m) for m, t, _ in pulsars]
+    log(f"built {len(pulsars)} pulsars in "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    # -- block assembly: single-device vs mesh-sharded ----------------
+    # The same problems feed both likelihoods, so the ONLY variable is
+    # the compile plan (jit(vmap) on one device vs shard_map blocks
+    # over the pulsar axis). Warm each plan once (compile excluded),
+    # then take the best of `reps` forced rebuilds.
+    def timed_blocks(lk, reps=3):
+        lk.build_blocks(force=True)  # warm: compile + placement
+        best = float("inf")
+        for _ in range(reps):
+            t1 = time.perf_counter()
+            lk.build_blocks(force=True)
+            best = min(best, time.perf_counter() - t1)
+        return best
+
+    lk_single = GWBLikelihood(problems=problems, nfreq=args.nfreq)
+    t_single = timed_blocks(lk_single)
+    log(f"block assembly single-device: {t_single * 1e3:.1f} ms "
+        f"(P={lk_single.npulsars}, m={lk_single.m})")
+
+    t_shard = None
+    lk = lk_single
+    if ndev > 1:
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(devices), ("pulsar",))
+        lk_shard = GWBLikelihood(problems=problems, nfreq=args.nfreq,
+                                 mesh=mesh)
+        t_shard = timed_blocks(lk_shard)
+        log(f"block assembly sharded x{ndev}: {t_shard * 1e3:.1f} ms")
+        A1 = lk_single.build_blocks()[0]
+        A8 = lk_shard.build_blocks()[0]
+        consistent = bool(np.allclose(A1, A8, rtol=1e-9, atol=1e-12))
+        log(f"sharded blocks match single-device: {consistent}")
+        lk = lk_shard
+    else:
+        consistent = True
+        log("single device only; skipping the sharded comparison")
+
+    # -- the detection sweep ------------------------------------------
+    g = args.grid
+    la2, ga2 = np.meshgrid(np.linspace(-15.5, -13.5, g),
+                           np.linspace(2.0, 6.0, g))
+    la, ga = la2.ravel(), ga2.ravel()
+    K = config.gwb_chunk()
+    nchunks = -(-len(la) // K)
+
+    mon = oh.configure(enabled=True)
+    lk.loglik_grid(la, ga)  # warm: outer-kernel compile
+    t1 = time.perf_counter()
+    logL = lk.loglik_grid(la, ga)
+    sweep_s = time.perf_counter() - t1
+    kbest = int(np.argmax(logL))
+    pts_per_s = len(la) / sweep_s
+    log(f"sweep {g}x{g} grid in {sweep_s * 1e3:.1f} ms "
+        f"({pts_per_s:.1f} points/s, chunk={K}); best "
+        f"log10A={la[kbest]:.2f} gamma={ga[kbest]:.2f}")
+
+    mon.observe("bench.gwb_sweep", {"values": [np.asarray(logL)]},
+                pool=lk.blocks_info.get("used_pool", "device"),
+                key="bench.gwb_sweep")
+    health = oh.status()
+
+    rec = {
+        "metric": "gwb_sweep",
+        "value": round(pts_per_s, 2),
+        "unit": "points/s",
+        "backend": backend,
+        "npulsars": args.npulsars,
+        "ntoa": args.ntoa,
+        "nfreq": args.nfreq,
+        "grid": f"{g}x{g}",
+        "chunk": K,
+        "sweep_ms": round(sweep_s * 1e3, 2),
+        "block_assembly": {
+            "single_device_ms": round(t_single * 1e3, 2),
+            "sharded_ms": (round(t_shard * 1e3, 2)
+                           if t_shard is not None else None),
+            "sharded_speedup": (round(t_single / t_shard, 2)
+                                if t_shard else None),
+            "ndevices": ndev,
+            "consistent": consistent,
+            "used_pool": lk.blocks_info.get("used_pool"),
+        },
+        "best": {"log10A": round(float(la[kbest]), 3),
+                 "gamma": round(float(ga[kbest]), 3),
+                 "logL": round(float(logL[kbest]), 3)},
+        "counters": lk.metrics.snapshot(),
+    }
+    if health is not None:
+        rec["health"] = health
+
+    # roofline: the outer Schur kernel is the sweep's hot loop — probe
+    # its XLA cost once (same cached plan the driver dispatched) and
+    # judge the measured per-chunk wall against the backend peaks.
+    try:
+        import jax.numpy as jnp
+
+        A, x, rdr_sum, ld_sum = lk.build_blocks()
+        kernel = compile_with_plan(
+            _gwb_outer_batch, name="pta.gwb_sweep",
+            ndims_in=_OUTER_NDIMS_IN, ndims_out=_OUTER_NDIMS_OUT)
+        ex = (jnp.asarray(A), jnp.asarray(x), jnp.asarray(rdr_sum),
+              jnp.asarray(ld_sum), jnp.asarray(lk.Gamma),
+              jnp.asarray(lk.fcols), jnp.asarray(lk.tspan),
+              jnp.asarray(la[:K]), jnp.asarray(ga[:K]))
+        operf.note_compile("bench.gwb_sweep_chunk", backend=backend,
+                           kind="bench", jitted=kernel, args=ex)
+        roof = operf.roofline_block("bench.gwb_sweep_chunk",
+                                    sweep_s / nchunks, backend)
+        if roof:
+            rec["roofline"] = roof
+        rec["compiles"] = operf.ledger_summary()
+    except Exception as e:
+        log(f"roofline attribution failed: {e!r}")
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--npulsars", type=int, default=67)
     ap.add_argument("--ntoa", type=int, default=100)
+    ap.add_argument("--gwb", action="store_true",
+                    help="array GWB likelihood plane benchmark")
+    ap.add_argument("--nfreq", type=int, default=5,
+                    help="GWB basis frequencies (--gwb)")
+    ap.add_argument("--grid", type=int, default=8,
+                    help="detection sweep grid side (--gwb)")
     args = ap.parse_args()
 
     import os
@@ -79,32 +267,57 @@ def main():
 
     import jax
 
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        # CPU run: pin the platform (the sitecustomize-registered TPU
+        # plugin otherwise wins) and force the 8-virtual-device mesh
+        # (same as tests/conftest.py) so the sharded block-assembly
+        # leg is a real scale-out measurement — both only effective
+        # BEFORE the backend initializes, so decide from env, not
+        # jax.default_backend()
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
-    from pint_tpu.parallel import fit_pta
 
-    log(f"backend: {jax.default_backend()}")
-    t0 = time.perf_counter()
-    pulsars = [build_pulsar(k, args.ntoa)
-               for k in range(args.npulsars)]
-    log(f"built {len(pulsars)} pulsars in "
-        f"{time.perf_counter() - t0:.1f}s")
+    backend = jax.default_backend()
+    log(f"backend: {backend} ({len(jax.devices())} device(s))")
 
-    res = fit_pta([(t, m) for m, t, _ in pulsars], maxiter=2)
-    stats = fit_pta.last_stats
-    n_ok = sum(1 for (m, t, truth), r in zip(pulsars, res)
-               if abs(m.F0.value - truth["F0"])
-               < 5 * r["errors"]["F0"])
-    log(f"recovered F0 within 5 sigma: {n_ok}/{len(pulsars)}")
-    log(f"stats: {stats}")
-    print(json.dumps({
-        "metric": "pta_batch_fit_throughput",
-        "value": round(stats["toas_per_sec"], 1),
-        "unit": "TOA/s",
-        "npulsars": args.npulsars,
-        "ntoa_total": stats["ntoa_total"],
-        "device_solve_s": round(stats["device_solve_s"], 3),
-        "recovered": n_ok,
-    }))
+    rec = run_gwb(args) if args.gwb else run_batch(args)
+    rec.setdefault("backend", backend)
+
+    # bench.py parity: dispatch-supervisor counters + lint state +
+    # regress verdict on the artifact, and the BENCH_TPU.jsonl
+    # provenance merge — an on-chip run appends to the committed
+    # ledger, a CPU-fallback run carries the latest on-chip record
+    # with provenance instead of silently reporting host-only numbers.
+    from bench import (
+        attach_dispatch_counters,
+        load_tpu_records,
+        record_key,
+        tpu_record_append,
+    )
+
+    if backend == "tpu":
+        tpu_record_append(rec)
+    else:
+        chip = load_tpu_records().get(record_key(rec))
+        if chip is not None:
+            rec["tpu_on_chip"] = {
+                k: chip[k] for k in
+                ("value", "sweep_ms", "device_solve_s", "utc",
+                 "imported", "provenance") if k in chip}
+            rec["tpu_note"] = (
+                "TPU unreachable this run; latest committed on-chip "
+                f"record from {chip.get('utc', '?')} "
+                "(BENCH_TPU.jsonl)")
+        elif os.environ.get("PINT_TPU_BENCH_FALLBACK"):
+            rec["tpu_note"] = ("TPU unreachable this run; no "
+                               "committed on-chip record found")
+
+    print(json.dumps(attach_dispatch_counters(rec)), flush=True)
 
 
 if __name__ == "__main__":

@@ -726,7 +726,7 @@ G6_DISPATCH_FILES = {"pint_tpu/fitter.py", "pint_tpu/gls.py",
                      "pint_tpu/wideband_fitter.py",
                      "pint_tpu/config.py"}
 G6_DISPATCH_DIRS = ("pint_tpu/serve/", "pint_tpu/parallel/",
-                    "pint_tpu/sampling/")
+                    "pint_tpu/sampling/", "pint_tpu/pta/")
 
 
 def _g6_dispatch_applies(relpath: str) -> bool:
@@ -740,7 +740,10 @@ def collect_jit_products(modules: List[ModuleInfo]):
     """Names bound to jit PRODUCTS (callables whose invocation is a
     device dispatch): assignment targets of a jit(...) call —
     including ``self.x = jax.jit(...)`` attributes — and functions
-    decorated with a jit. Private names are shared across modules
+    decorated with a jit. ``pta.shard.compile_with_plan(...)``
+    products count too: a plan IS a jitted executable (plain or
+    shard_map-wrapped), so calling one directly is the same
+    unsupervised dispatch. Private names are shared across modules
     (wideband_fitter imports gls's _gls_kernel); public names stay
     module-local, same convention as the jit-reachability seeds."""
     per_module: Dict[str, Set[str]] = {}
@@ -750,7 +753,8 @@ def collect_jit_products(modules: List[ModuleInfo]):
         for node in ast.walk(m.tree):
             if isinstance(node, ast.Assign) and \
                     isinstance(node.value, ast.Call) and \
-                    _tail_name(node.value.func) == "jit":
+                    _tail_name(node.value.func) in (
+                        "jit", "compile_with_plan"):
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         names.add(t.id)
@@ -965,6 +969,8 @@ G13_COUNTER_NAMES = frozenset({
     # numerical health (ISSUE 14)
     "health_incidents", "shadow_replays", "shadow_drift_exceeded",
     "cg_budget_exhausted",
+    # array GWB likelihood plane (ISSUE 17)
+    "gwb_solves", "block_assemblies", "hd_outer_solves",
 })
 
 

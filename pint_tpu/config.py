@@ -31,7 +31,7 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "serve_queue_cap", "serve_pipeline_depth",
            "tenant_qps", "tenant_burst", "shed_policy", "aot_dir",
            "journal_path", "serve_drain_timeout_s",
-           "chain_chunk_steps", "journal_compact_bytes",
+           "chain_chunk_steps", "gwb_chunk", "journal_compact_bytes",
            "trace_enabled", "trace_stream_path", "trace_ring_size",
            "flight_dir", "f32_mode", "no_pallas", "slo_enabled",
            "slo_interval_s", "slo_specs", "metrics_port",
@@ -653,6 +653,32 @@ def chain_chunk_steps(nsteps: int, thin: int = 1) -> int:
             k *= 2
     thin = max(1, int(thin))
     return ((k + thin - 1) // thin) * thin
+
+
+def gwb_chunk() -> int:
+    """(log10_A, gamma) grid points evaluated per supervised GWB
+    sweep dispatch (pint_tpu.pta.gwb): the chunk is the failover /
+    deadline / journal-progress boundary, NOT a vectorization width
+    (the outer kernel lax.maps the chunk so only one (Npsr*m)^2
+    Schur system is live at a time). Power of two in [1, 64] —
+    part of the sweep program's compile key, same quantization
+    rationale as chain_chunk_steps. $PINT_TPU_GWB_CHUNK pins it
+    (rounded UP to the nearest power of two, warn-and-ignore on bad
+    values)."""
+    env = _env_number("PINT_TPU_GWB_CHUNK", None, cast=int)
+    if env is None:
+        return 8
+    k = int(env)
+    if k < 1 or k > 64:
+        key = ("PINT_TPU_GWB_CHUNK", str(env))
+        if key not in _WARNED_ENV:
+            _WARNED_ENV.add(key)
+            from pint_tpu.logging import log
+
+            log.warning("$PINT_TPU_GWB_CHUNK=%r outside [1, 64]; "
+                        "using default 8", env)
+        return 8
+    return 1 << (k - 1).bit_length()
 
 
 def journal_compact_bytes() -> int:

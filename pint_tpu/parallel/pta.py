@@ -178,7 +178,20 @@ def _solve_one(M, F, phi, r, nvec, valid, pvalid):
     return dparams, cov, chi2, chi2r
 
 
-_pta_kernel = jax.jit(jax.vmap(_solve_one))
+def _pta_batch(M, F, phi, r, nvec, valid, pvalid):
+    """Leading-axis batch of ``_solve_one`` — compiled through
+    ``pta.shard.compile_with_plan`` (plain jit on one device;
+    shard_map per-device blocks over the mesh's pulsar axis)."""
+    return jax.vmap(_solve_one)(M, F, phi, r, nvec, valid, pvalid)
+
+
+# ranks of the batch kernel's inputs/outputs (for the sharding plan)
+_PTA_NDIMS_IN = (3, 3, 2, 2, 2, 2, 2)
+_PTA_NDIMS_OUT = (2, 3, 1, 1)
+
+# single-device compatibility alias (pre-ISSUE-17 name); the solve
+# path now compiles through the plan cache
+_pta_kernel = jax.jit(_pta_batch)
 
 
 def _solve_one_np(M, F, phi, r, nvec, valid, pvalid):
@@ -246,38 +259,48 @@ def pta_solve_np(stacked: dict):
 def pta_solve(stacked: dict, mesh=None, axis: str = "pulsar"):
     """Solve the whole batch in one supervised device call (runtime
     watchdog + host ``pta_solve_np`` failover). With ``mesh``, the
-    pulsar axis is block-sharded over ``axis`` (pads P up to a mesh
-    multiple)."""
+    batch kernel is compiled through the ISSUE-17 sharding plan
+    (``pta.shard.compile_with_plan``): shard_map per-device pulsar
+    blocks with explicit in/out shardings — not GSPMD partitioning,
+    which serialized the batched Cholesky sequence and LOST to
+    single-device — padding P up to a mesh multiple. ``pvalid`` is
+    donated to its alias-exact ``dparams`` output on real
+    accelerators (the serve cache's donation discipline: never on
+    the CPU backend, rebuilt fresh per dispatch)."""
+    from pint_tpu import config
     from pint_tpu.runtime import get_supervisor
 
     P = np.asarray(stacked["M"]).shape[0]
+    donate = (6,) if config.donation_enabled() and \
+        jax.default_backend() != "cpu" else ()
 
     def run():
         """Place + dispatch + host read, all on the supervisor's
-        guarded worker so the deadline covers completion."""
-        arrs = {k: jnp.asarray(v) for k, v in stacked.items()}
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as Pspec
+        guarded worker so the deadline covers completion. The shard
+        plan is resolved here (lazily — ``pint_tpu.pta`` imports
+        this module) and cached per (mesh, donation)."""
+        from pint_tpu.pta.shard import batch_sharding, \
+            compile_with_plan, pad_batch
 
-            nshard = mesh.shape[axis]
-            pad = (-P) % nshard
-            if pad:
-                arrs = {k: jnp.concatenate(
-                    [v, jnp.ones((pad,) + v.shape[1:]) if k in
-                     ("nvec", "phi")
-                     else jnp.zeros((pad,) + v.shape[1:])],
-                    axis=0) for k, v in arrs.items()}
-            sh = {k: NamedSharding(
-                mesh, Pspec(axis, *([None] * (v.ndim - 1))))
+        kernel = compile_with_plan(
+            _pta_batch, name="pta.batch_solve",
+            ndims_in=_PTA_NDIMS_IN, ndims_out=_PTA_NDIMS_OUT,
+            mesh=mesh, axis=axis, donate_argnums=donate)
+        arrs = pad_batch(stacked, mesh, axis)
+        if mesh is not None:
+            st = {k: jax.device_put(
+                v, batch_sharding(mesh, axis,
+                                  np.asarray(v).ndim))
                 for k, v in arrs.items()}
-            arrs = {k: jax.device_put(v, sh[k])
-                    for k, v in arrs.items()}
-        out = _pta_kernel(arrs["M"], arrs["F"], arrs["phi"], arrs["r"], arrs["nvec"], arrs["valid"], arrs["pvalid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+        else:
+            st = {k: jnp.asarray(v) for k, v in arrs.items()}
+        out = kernel(st["M"], st["F"], st["phi"], st["r"], st["nvec"], st["valid"], st["pvalid"])  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
         return tuple(np.asarray(o)[:P] for o in out)
 
     from pint_tpu import obs
 
-    with obs.span("pta.solve", npulsars=P):
+    with obs.span("pta.solve", npulsars=P,
+                  sharded=mesh is not None):
         return get_supervisor().dispatch(
             run, key="pta.batch",
             fallback=lambda: pta_solve_np(stacked))

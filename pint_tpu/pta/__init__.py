@@ -1,0 +1,41 @@
+"""Array-level likelihood plane (ISSUE 17).
+
+``pint_tpu.pta`` owns everything that treats the pulsar ARRAY — not a
+single pulsar — as the unit of work:
+
+- ``shard``: the compile-with-plan helper — explicit-sharding /
+  donation compilation of batch kernels over the mesh's pulsar axis
+  (shard_map per-device blocks, no GSPMD guessing), used by
+  ``parallel.pta.pta_solve`` and the GWB block assembly.
+- ``gwb``: the Hellings–Downs cross-correlated gravitational-wave-
+  background likelihood — per-pulsar inner blocks from the SAME
+  joint normal assembly the fitters use, a second-stage Schur
+  complement over the (Npsr*m)^2 cross-correlated outer system, and
+  a numpy mirror as the CPU oracle.
+- ``metrics``: the plane's registry-bound counters
+  (``block_assemblies`` / ``hd_outer_solves`` / ``gwb_solves``).
+
+Serve integration (``GWBRequest``) lives in ``pint_tpu.serve``; this
+package stays importable without the serve machinery.
+"""
+
+from pint_tpu.pta.gwb import (  # noqa: F401
+    GWBLikelihood,
+    gwb_basis,
+    gwb_loglik_np,
+    gwb_phi,
+    hd_matrix,
+    pulsar_positions,
+)
+from pint_tpu.pta.metrics import PTAMetrics  # noqa: F401
+from pint_tpu.pta.shard import (  # noqa: F401
+    batch_sharding,
+    compile_with_plan,
+    pad_batch,
+)
+
+__all__ = [
+    "GWBLikelihood", "PTAMetrics", "batch_sharding",
+    "compile_with_plan", "gwb_basis", "gwb_loglik_np", "gwb_phi",
+    "hd_matrix", "pad_batch", "pulsar_positions",
+]

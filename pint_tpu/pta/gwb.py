@@ -1,0 +1,688 @@
+"""Hellings–Downs cross-correlated GWB likelihood (ISSUE 17).
+
+Reference: enterprise ``signal_base.LogLikelihood`` (basis-Woodbury
+marginal likelihood) and van Haasteren & Vallisneri 2014 (1407.1838,
+the low-rank GP formulation); PAPERS.md 2506.13866 for the
+beyond-block-diagonal covariance structure.
+
+Model: the array covariance is
+
+    C = blockdiag(D_a) + U (Gamma ⊗ diag(phi_g)) U^T
+
+where ``D_a = N_a + T_a P_a T_a^T`` is pulsar *a*'s own marginal
+covariance (white noise + improper-flat timing model + its per-pulsar
+noise bases — EXACTLY the system ``parallel.pta._assemble_normal``
+builds), ``U = blockdiag(U_a)`` stacks a COMMON-span Fourier basis
+(same frequencies, same reference epoch across pulsars — the
+cross-correlation couples same-frequency coefficients), ``phi_g`` is
+the common-process power-law PSD (``models.noise.powerlaw`` — the
+same convention PLRedNoise uses) and ``Gamma`` the (Npsr, Npsr) HD
+overlap-reduction matrix.
+
+Blocked Woodbury, two stages:
+
+- inner (per pulsar, sharded over the mesh's pulsar axis): from the
+  SAME preconditioned joint-normal Cholesky ``_solve_one`` runs,
+  compute ``A_a = U_a^T D_a^{-1} U_a``, ``x_a = U_a^T D_a^{-1} r_a``,
+  ``rdr_a = r_a^T D_a^{-1} r_a`` (identically ``_solve_one``'s chi2)
+  and ``ld_a = logdet D_a`` (up to the improper-prior constant);
+- outer (one device, second-stage Schur complement): the (Npsr*m)^2
+  cross-correlated system ``S = Gamma^{-1} ⊗ diag(1/phi_g)
+  + blockdiag(A_a)``, giving
+
+    log L = -1/2 [ sum_a rdr_a - x^T S^{-1} x + sum_a ld_a
+                   + m logdet Gamma + Npsr sum_i log phi_g_i
+                   + logdet S ]  (+ const).
+
+In the block-diagonal limit ``Gamma = I`` this is EXACTLY the sum of
+per-pulsar marginal likelihoods with the GWB basis appended as
+ordinary red noise (tests/test_gwb.py asserts it against the existing
+``_solve_one_np`` path). The GWB hyperparameters (log10_A, gamma)
+enter ONLY through the outer stage, so the blocks are assembled once
+and a whole (log10_A, gamma) detection sweep reuses them.
+
+Every device call goes through the dispatch supervisor under an
+``obs.span`` (G6/G12); hyperparameter grids, Gamma, the basis
+frequencies and Tspan are runtime args (G10); everything is f64 (no
+G9 registry entries needed). The numpy mirror (``gwb_loglik_np``) is
+the CPU oracle and the host-failover target.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from pint_tpu.models.noise import (
+    FYR,
+    _tdb_seconds,
+    create_fourier_design_matrix,
+    powerlaw,
+)
+from pint_tpu.parallel.pta import (
+    PulsarProblem,
+    _assemble_normal,
+    build_problem,
+    stack_problems,
+)
+from pint_tpu.pta.metrics import PTAMetrics
+from pint_tpu.pta.shard import batch_sharding, compile_with_plan, \
+    pad_batch
+
+__all__ = ["GWBLikelihood", "gwb_basis", "gwb_blocks_np",
+           "gwb_loglik_np", "gwb_phi", "gwb_sweep_driver",
+           "hd_matrix", "pulsar_positions"]
+
+
+# -- geometry ----------------------------------------------------------
+
+def pulsar_positions(models: Sequence) -> np.ndarray:
+    """(P, 3) unit sky vectors from each model's astrometry
+    (RAJ/DECJ, or ELONG/ELAT rotated by the mean obliquity — the HD
+    matrix only consumes angular separations, so the frame just has
+    to be common)."""
+    out = []
+    for m in models:
+        raj = getattr(m, "RAJ", None)
+        if raj is not None and raj.value is not None:
+            a, d = raj.value, m.DECJ.value
+            out.append((math.cos(d) * math.cos(a),
+                        math.cos(d) * math.sin(a), math.sin(d)))
+            continue
+        elong = getattr(m, "ELONG", None)
+        if elong is not None and elong.value is not None:
+            lam, bet = elong.value, m.ELAT.value
+            x = math.cos(bet) * math.cos(lam)
+            y = math.cos(bet) * math.sin(lam)
+            z = math.sin(bet)
+            eps = math.radians(23.4392911)
+            out.append((x, y * math.cos(eps) - z * math.sin(eps),
+                        y * math.sin(eps) + z * math.cos(eps)))
+            continue
+        raise ValueError(
+            "GWB likelihood needs sky positions: model "
+            f"{getattr(m, 'name', '?')} has neither RAJ/DECJ nor "
+            "ELONG/ELAT")
+    return np.asarray(out, dtype=np.float64)
+
+
+def hd_matrix(positions: np.ndarray) -> np.ndarray:
+    """Hellings–Downs overlap-reduction matrix Gamma_ab for unit sky
+    vectors (P, 3): with x = (1 - cos zeta_ab)/2,
+
+        Gamma_ab = 3/2 x ln x - x/4 + 1/2   (a != b)
+        Gamma_aa = 1                        (pulsar term: + 1/2)
+
+    Symmetric positive definite for distinct sky positions (it is the
+    correlation of an isotropic background plus the uncorrelated
+    pulsar-term diagonal)."""
+    pos = np.asarray(positions, dtype=np.float64)
+    c = np.clip(pos @ pos.T, -1.0, 1.0)
+    x = (1.0 - c) / 2.0
+    safe = np.where(x > 0.0, x, 1.0)
+    g = 1.5 * x * np.log(safe) - x / 4.0 + 0.5
+    np.fill_diagonal(g, 1.0)
+    return g
+
+
+# -- common-process basis ----------------------------------------------
+
+def gwb_basis(toas_list: Sequence, nfreq: int):
+    """Common-span Fourier basis for the array: ONE reference epoch
+    (the array's earliest TDB day) and ONE Tspan pin the frequencies
+    and phases across pulsars — a per-pulsar span would rotate each
+    sin/cos pair and the cross-correlation would couple mismatched
+    modes (the same alignment contract the serve append path pins
+    through ``noise_basis_weight(tspan=, tref_day=)``).
+
+    Returns (U_list, fcols, tspan_s): per-pulsar (n_a, 2*nfreq) basis
+    blocks, the per-COLUMN frequencies [Hz], and the common span [s].
+    """
+    for t in toas_list:
+        if getattr(t, "tdb_day", None) is None:
+            t.compute_TDBs()
+    ref_day = min(float(np.min(t.tdb_day)) for t in toas_list)
+    ts = [_tdb_seconds(t, ref_day=ref_day) for t in toas_list]
+    lo = min(float(t.min()) for t in ts)
+    hi = max(float(t.max()) for t in ts)
+    tspan = hi - lo
+    if not (tspan > 0.0):
+        raise ValueError("GWB basis needs a positive common Tspan")
+    U_list = []
+    fcols = None
+    for t in ts:
+        U, fc = create_fourier_design_matrix(t, int(nfreq),
+                                             Tspan=tspan)
+        U_list.append(U)
+        fcols = fc
+    return U_list, np.asarray(fcols, dtype=np.float64), float(tspan)
+
+
+def gwb_phi(fcols: np.ndarray, tspan: float, log10_A: float,
+            gamma: float) -> np.ndarray:
+    """Per-column prior weights [s^2] of the common process — the
+    PLRedNoise convention exactly: powerlaw PSD times the bin width
+    df = 1/Tspan."""
+    return powerlaw(fcols, 10.0 ** float(log10_A), float(gamma)) \
+        / float(tspan)
+
+
+# -- inner stage: per-pulsar blocks (device kernel + numpy mirror) -----
+
+def _gwb_block_one(M, F, phi, r, nvec, valid, pvalid, U):
+    """One pulsar's GWB coupling blocks from the shared joint-normal
+    assembly (``_assemble_normal`` — the same system ``_solve_one``
+    factors, so ``rdr`` here EQUALS its chi2 output):
+
+        A  = U^T D^{-1} U          (m, m)
+        x  = U^T D^{-1} r          (m,)
+        rdr = r^T D^{-1} r
+        ld  = logdet D  (improper-prior constant dropped)
+
+    with D^{-1} applied through the Woodbury identity on the
+    preconditioned Cholesky of Sigma. The logdet undoes the column
+    scaling explicitly: Sigma was assembled over M/(colmax*norm), so
+    logdet Sigma_true = logdet Sigma_scaled
+    + 2 sum_j pvalid_j log(colmax_j norm_j). Fully-padded batch slots
+    (valid = pvalid = 0, unit nvec/phi, zero U) return exact zeros
+    everywhere — safe to sum before slicing."""
+    import jax
+    import jax.numpy as jnp
+
+    Sigma, b, w, colmax, norm = _assemble_normal(
+        M, F, phi, r, nvec, valid, pvalid)
+    q = F.shape[1]
+    d = jnp.sqrt(jnp.diagonal(Sigma))
+    d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+    cf = jax.scipy.linalg.cho_factor(Sigma / jnp.outer(d, d),
+                                     lower=True)
+    Mn = (M * pvalid[None, :]) / colmax[None, :] / norm[None, :]
+    big = jnp.concatenate([Mn, F], axis=1)
+    colvalid = jnp.concatenate([pvalid, jnp.ones(q)])
+    Uw = U * w[:, None]
+    V = (big.T @ Uw) * colvalid[:, None]
+    u = Uw.T @ r
+    G = U.T @ Uw
+    SinvV = jax.scipy.linalg.cho_solve(cf, V / d[:, None]) \
+        / d[:, None]
+    A = G - V.T @ SinvV
+    x = u - SinvV.T @ b
+    xhat = jax.scipy.linalg.cho_solve(cf, b / d) / d
+    rdr = jnp.sum(r * r * w) - xhat @ b
+    ldSigma = 2.0 * jnp.sum(jnp.log(d)) + \
+        2.0 * jnp.sum(jnp.log(jnp.diagonal(cf[0])))
+    ld = jnp.sum(valid * jnp.log(nvec)) + jnp.sum(jnp.log(phi)) + \
+        ldSigma + 2.0 * jnp.sum(pvalid * jnp.log(colmax * norm))
+    return A, x, rdr, ld
+
+
+def _gwb_block_batch(M, F, phi, r, nvec, valid, pvalid, U):
+    """Leading-axis batch of ``_gwb_block_one`` — the kernel
+    ``compile_with_plan`` shards over the pulsar axis."""
+    import jax
+
+    return jax.vmap(_gwb_block_one)(M, F, phi, r, nvec, valid,
+                                    pvalid, U)
+
+
+# ranks of the block kernel's inputs/outputs (for the sharding plan)
+_BLOCK_NDIMS_IN = (3, 3, 2, 2, 2, 2, 2, 3)
+_BLOCK_NDIMS_OUT = (3, 2, 1, 1)
+
+
+def _gwb_block_one_np(M, F, phi, r, nvec, valid, pvalid, U):
+    """Numpy mirror of ``_gwb_block_one`` (identical masked algebra,
+    scipy Cholesky) — the host-failover path and the oracle's inner
+    stage."""
+    from scipy.linalg import cho_factor, cho_solve
+
+    p = M.shape[1]
+    q = F.shape[1]
+    w = valid / nvec
+    Mm = M * pvalid[None, :]
+    colmax = np.max(np.abs(Mm), axis=0)
+    colmax = np.where(colmax == 0, 1.0, colmax)
+    Ms = Mm / colmax[None, :]
+    norm = np.sqrt(np.sum(Ms * Ms * w[:, None], axis=0))
+    norm = np.where(norm == 0, 1.0, norm)
+    Mn = Ms / norm[None, :]
+    big = np.concatenate([Mn, F], axis=1)
+    bigw = big * w[:, None]
+    Sigma = big.T @ bigw
+    prior = np.concatenate([np.zeros(p), 1.0 / phi])
+    Sigma = Sigma + np.diag(prior)
+    colvalid = np.concatenate([pvalid, np.ones(q)])
+    Sigma = Sigma * np.outer(colvalid, colvalid) + \
+        np.diag(1.0 - colvalid)
+    b = bigw.T @ r * colvalid
+    d = np.sqrt(np.diagonal(Sigma)).copy()
+    d[(d == 0) | ~np.isfinite(d)] = 1.0
+    cf = cho_factor(Sigma / np.outer(d, d), lower=True)
+    Uw = U * w[:, None]
+    V = (big.T @ Uw) * colvalid[:, None]
+    u = Uw.T @ r
+    G = U.T @ Uw
+    SinvV = cho_solve(cf, V / d[:, None]) / d[:, None]
+    A = G - V.T @ SinvV
+    x = u - SinvV.T @ b
+    xhat = cho_solve(cf, b / d) / d
+    rdr = float(np.sum(r * r * w) - xhat @ b)
+    ldSigma = 2.0 * float(np.sum(np.log(d))) + \
+        2.0 * float(np.sum(np.log(np.diagonal(cf[0]))))
+    ld = float(np.sum(valid * np.log(nvec)) + np.sum(np.log(phi)) +
+               ldSigma + 2.0 * np.sum(pvalid *
+                                      np.log(colmax * norm)))
+    return A, x, rdr, ld
+
+
+def gwb_blocks_np(stacked: dict, U: np.ndarray):
+    """Batched numpy inner stage: (A (P,m,m), x (P,m), rdr (P,),
+    ld (P,))."""
+    P = stacked["M"].shape[0]
+    outs = [_gwb_block_one_np(stacked["M"][k], stacked["F"][k],
+                              stacked["phi"][k], stacked["r"][k],
+                              stacked["nvec"][k],
+                              stacked["valid"][k],
+                              stacked["pvalid"][k], U[k])
+            for k in range(P)]
+    return (np.stack([o[0] for o in outs]),
+            np.stack([o[1] for o in outs]),
+            np.asarray([o[2] for o in outs]),
+            np.asarray([o[3] for o in outs]))
+
+
+# -- outer stage: cross-correlated Schur system ------------------------
+
+def _gwb_outer_batch(A, x, rdr_sum, ld_sum, Gamma, fcols, tspan,
+                     log10A, gamma):
+    """log L at each (log10A[k], gamma[k]) grid point from the
+    assembled blocks: factor Gamma once, then per point build and
+    factor the (P*m)^2 second-stage Schur system
+    S = Gamma^{-1} ⊗ diag(1/phi_g) + blockdiag(A). ``lax.map`` (not
+    vmap) keeps one S in memory at a time — the chunk exists for
+    failover granularity, not vectorization. The phi_g formula is
+    the in-trace mirror of ``models.noise.powerlaw`` (times
+    df = 1/Tspan)."""
+    import jax
+    import jax.numpy as jnp
+
+    P, m = x.shape
+    cfG = jax.scipy.linalg.cho_factor(Gamma, lower=True)
+    Ginv = jax.scipy.linalg.cho_solve(cfG, jnp.eye(P))
+    ldG = 2.0 * jnp.sum(jnp.log(jnp.diagonal(cfG[0])))
+    xs = x.reshape(P * m)
+    iP = jnp.arange(P)
+
+    def one(point):
+        la, ga = point
+        phi_g = (10.0 ** la) ** 2 / (12.0 * jnp.pi ** 2) * \
+            FYR ** (ga - 3.0) * fcols ** (-ga) / tspan
+        S = jnp.kron(Ginv, jnp.diag(1.0 / phi_g))
+        S = S.reshape(P, m, P, m).at[iP, :, iP, :].add(A) \
+            .reshape(P * m, P * m)
+        d = jnp.sqrt(jnp.diagonal(S))
+        d = jnp.where((d == 0) | ~jnp.isfinite(d), 1.0, d)
+        cf = jax.scipy.linalg.cho_factor(S / jnp.outer(d, d),
+                                         lower=True)
+        quad = (xs / d) @ jax.scipy.linalg.cho_solve(cf, xs / d)
+        ldS = 2.0 * jnp.sum(jnp.log(d)) + \
+            2.0 * jnp.sum(jnp.log(jnp.diagonal(cf[0])))
+        return -0.5 * (rdr_sum - quad + ld_sum + m * ldG +
+                       P * jnp.sum(jnp.log(phi_g)) + ldS)
+
+    return jax.lax.map(one, (log10A, gamma))
+
+
+_OUTER_NDIMS_IN = (3, 2, 0, 0, 2, 1, 0, 1, 1)
+_OUTER_NDIMS_OUT = (1,)
+
+
+def _gwb_outer_np(A, x, rdr_sum, ld_sum, Gamma, fcols, tspan,
+                  log10A, gamma):
+    """Numpy mirror of ``_gwb_outer_batch`` — CPU oracle outer stage
+    and the sweep chunks' host-failover target."""
+    from scipy.linalg import cho_factor, cho_solve
+
+    P, m = x.shape
+    cfG = cho_factor(Gamma, lower=True)
+    Ginv = cho_solve(cfG, np.eye(P))
+    ldG = 2.0 * float(np.sum(np.log(np.diagonal(cfG[0]))))
+    xs = x.reshape(P * m)
+    out = np.zeros(len(log10A))
+    for k, (la, ga) in enumerate(zip(log10A, gamma)):
+        phi_g = powerlaw(fcols, 10.0 ** float(la), float(ga)) \
+            / float(tspan)
+        S = np.kron(Ginv, np.diag(1.0 / phi_g))
+        S4 = S.reshape(P, m, P, m)
+        for a in range(P):
+            S4[a, :, a, :] += A[a]
+        S = S4.reshape(P * m, P * m)
+        d = np.sqrt(np.diagonal(S)).copy()
+        d[(d == 0) | ~np.isfinite(d)] = 1.0
+        cf = cho_factor(S / np.outer(d, d), lower=True)
+        quad = float((xs / d) @ cho_solve(cf, xs / d))
+        ldS = 2.0 * float(np.sum(np.log(d))) + \
+            2.0 * float(np.sum(np.log(np.diagonal(cf[0]))))
+        out[k] = -0.5 * (rdr_sum - quad + ld_sum + m * ldG +
+                         P * float(np.sum(np.log(phi_g))) + ldS)
+    return out
+
+
+def gwb_loglik_np(stacked: dict, U: np.ndarray, Gamma: np.ndarray,
+                  fcols: np.ndarray, tspan: float,
+                  log10A: np.ndarray, gamma: np.ndarray):
+    """Full numpy mirror: inner blocks + cross-correlated outer
+    stage, end to end on the host — the CPU oracle for the device
+    path (tests/test_gwb.py) and the mirror ``GWBLikelihood`` falls
+    over to."""
+    A, x, rdr, ld = gwb_blocks_np(stacked, U)
+    return _gwb_outer_np(A, x, float(rdr.sum()), float(ld.sum()),
+                         np.asarray(Gamma), np.asarray(fcols),
+                         float(tspan), np.asarray(log10A),
+                         np.asarray(gamma))
+
+
+# -- the likelihood object ---------------------------------------------
+
+class GWBLikelihood:
+    """Array-level GWB marginal likelihood over fixed per-pulsar
+    linearized problems.
+
+    Blocks are assembled ONCE (sharded over ``mesh``'s pulsar axis
+    when given — the hyperparameters never touch the inner stage),
+    then ``loglik_grid`` sweeps (log10_A, gamma) points through
+    chunked supervised dispatches of the outer Schur system. All
+    device calls ride the dispatch supervisor with the numpy mirror
+    as labeled host failover."""
+
+    def __init__(self, pairs: Optional[Sequence] = None,
+                 problems: Optional[Sequence[PulsarProblem]] = None,
+                 positions: Optional[np.ndarray] = None,
+                 gamma_matrix: Optional[np.ndarray] = None,
+                 nfreq: int = 10, mesh=None, axis: str = "pulsar",
+                 metrics: Optional[PTAMetrics] = None,
+                 supervisor=None, track_mode=None):
+        if problems is None:
+            if pairs is None:
+                raise ValueError("need pairs or problems")
+            problems = [build_problem(t, m, track_mode=track_mode)
+                        for t, m in pairs]
+        self.problems = list(problems)
+        P = len(self.problems)
+        if P < 2:
+            raise ValueError("a pulsar-ARRAY likelihood needs >= 2 "
+                             "pulsars")
+        if gamma_matrix is None:
+            if positions is None:
+                models = [pr.model for pr in self.problems]
+                if any(m is None for m in models):
+                    raise ValueError(
+                        "problems carry no models: pass positions= "
+                        "or gamma_matrix=")
+                positions = pulsar_positions(models)
+            gamma_matrix = hd_matrix(positions)
+        self.Gamma = np.asarray(gamma_matrix, dtype=np.float64)
+        if self.Gamma.shape != (P, P):
+            raise ValueError(
+                f"gamma_matrix shape {self.Gamma.shape} != ({P},{P})")
+        toas_list = [pr.toas for pr in self.problems]
+        if any(t is None for t in toas_list):
+            raise ValueError("problems carry no TOAs (build them via "
+                             "build_problem) — the common-span GWB "
+                             "basis needs the TOA epochs")
+        U_list, self.fcols, self.tspan = gwb_basis(toas_list,
+                                                   int(nfreq))
+        self.nfreq = int(nfreq)
+        self.m = 2 * self.nfreq
+        self.stacked = stack_problems(self.problems)
+        N = self.stacked["M"].shape[1]
+        self.U = np.zeros((P, N, self.m))
+        for k, Uk in enumerate(U_list):
+            self.U[k, :Uk.shape[0], :] = Uk
+        self.mesh = mesh
+        self.axis = axis
+        self.metrics = metrics if metrics is not None else \
+            PTAMetrics()
+        self._supervisor = supervisor
+        self._blocks = None
+        self.blocks_info: dict = {}
+
+    @property
+    def npulsars(self) -> int:
+        return len(self.problems)
+
+    def _sup(self):
+        if self._supervisor is not None:
+            return self._supervisor
+        from pint_tpu.runtime import get_supervisor
+
+        return get_supervisor()
+
+    def build_blocks(self, pool: str = "device", force: bool = False):
+        """Assemble (A, x, rdr_sum, ld_sum) in ONE supervised batch
+        dispatch, sharded over the pulsar axis when a mesh was given
+        (``compile_with_plan`` — per-device blocks, zero
+        collectives). Cached: the GWB hyperparameters never reach
+        this stage. ``blocks_info['used_pool']`` labels who actually
+        served."""
+        if self._blocks is not None and not force:
+            return self._blocks
+        from pint_tpu import obs
+
+        P = self.npulsars
+        arrs = dict(self.stacked)
+        arrs["U"] = self.U
+        arrs = pad_batch(arrs, self.mesh, self.axis)
+        names = ("M", "F", "phi", "r", "nvec", "valid", "pvalid",
+                 "U")
+        kernel = compile_with_plan(
+            _gwb_block_batch, name="pta.gwb_blocks",
+            ndims_in=_BLOCK_NDIMS_IN, ndims_out=_BLOCK_NDIMS_OUT,
+            mesh=self.mesh, axis=self.axis)
+        mesh, axis = self.mesh, self.axis
+        fell_over = []
+        info = self.blocks_info = {}
+
+        def run():
+            import jax
+            import jax.numpy as jnp
+
+            if mesh is not None:
+                st = {k: jax.device_put(
+                    v, batch_sharding(mesh, axis, v.ndim))
+                    for k, v in arrs.items()}
+            else:
+                st = {k: jnp.asarray(v) for k, v in arrs.items()}
+            out = kernel(*(st[n] for n in names))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            return tuple(np.asarray(o)[:P] for o in out)
+
+        def host():
+            out = gwb_blocks_np(self.stacked, self.U)
+            return tuple(np.asarray(o)[:P] for o in out)
+
+        with obs.span("pta.gwb_blocks", npulsars=P, m=self.m,
+                      sharded=mesh is not None):
+            if pool == "host":
+                A, x, rdr, ld = self._sup().dispatch(
+                    host, key="pta.gwb_blocks", pinned=True)
+                info["used_pool"] = "host"
+            else:
+                def host_counted():
+                    fell_over.append(True)
+                    return host()
+
+                A, x, rdr, ld = self._sup().dispatch(
+                    run, key="pta.gwb_blocks",
+                    fallback=host_counted)
+                info["used_pool"] = "host-failover" if fell_over \
+                    else "device"
+        self.metrics.bump("block_assemblies")
+        self._blocks = (np.asarray(A), np.asarray(x),
+                        float(np.sum(rdr)), float(np.sum(ld)))
+        return self._blocks
+
+    def loglik_grid(self, log10A, gamma, chunk: Optional[int] = None,
+                    pool: str = "device", sync: bool = True,
+                    info: Optional[dict] = None, progress=None,
+                    key_tag: str = "pta.gwb"):
+        """log L at each grid point, swept in chunks of
+        ``config.gwb_chunk()`` supervised dispatches (chunk boundary
+        = failover/deadline boundary). ``sync=False`` returns a
+        zero-arg collect (the serve path's lazy half)."""
+        from pint_tpu import config
+
+        K = int(chunk) if chunk else config.gwb_chunk()
+        collect = gwb_sweep_driver(
+            self, np.asarray(log10A, dtype=np.float64).ravel(),
+            np.asarray(gamma, dtype=np.float64).ravel(), K,
+            supervisor=self._sup(), key_tag=key_tag, pool=pool,
+            sync=sync, info=info, progress=progress)
+        if sync:
+            return collect()
+        return collect
+
+    def loglik(self, log10_A: float, gamma: float,
+               **kw) -> float:
+        """Single-point log L (a grid of one)."""
+        return float(self.loglik_grid([log10_A], [gamma], **kw)[0])
+
+
+def gwb_sweep_driver(like: GWBLikelihood, log10A: np.ndarray,
+                     gamma: np.ndarray, K: int, supervisor=None,
+                     key_tag: str = "pta.gwb",
+                     pool: str = "device", sync: bool = True,
+                     info: Optional[dict] = None, progress=None):
+    """Chunked supervised sweep of the outer Schur system — the
+    template ``posterior_chunk_driver`` set: each chunk of K grid
+    points is its own deadline-bounded dispatch with the numpy outer
+    mirror as host failover (the blocks are already collected host
+    arrays, so a mid-sweep device death finishes on the host from
+    the chunk boundary), per-chunk ``progress`` acks, and
+    ``info['used_pool']`` labeling. The last chunk pads by repeating
+    its final point (dropped on gather). ``sync=False`` pipelines
+    chunk 0 on the supervisor's async path."""
+    from pint_tpu import obs
+
+    if supervisor is None:
+        supervisor = like._sup()
+    if info is None:
+        info = {}
+    npts = len(log10A)
+    if npts == 0:
+        def empty():
+            info["used_pool"] = pool if pool == "host" else "device"
+            return np.zeros(0)
+        return empty
+    nchunks = -(-npts // K)
+    A, x, rdr_sum, ld_sum = like.build_blocks(pool=pool)
+    if like.blocks_info.get("used_pool") == "host-failover":
+        info["used_pool"] = "host-failover"
+    Gamma, fcols, tspan = like.Gamma, like.fcols, like.tspan
+    kernel = compile_with_plan(
+        _gwb_outer_batch, name="pta.gwb_sweep",
+        ndims_in=_OUTER_NDIMS_IN, ndims_out=_OUTER_NDIMS_OUT)
+    fell_over: List[bool] = []
+    placed: dict = {}
+
+    def _chunk_grids(c):
+        la = np.full(K, log10A[npts - 1])
+        ga = np.full(K, gamma[npts - 1])
+        n = min(npts, (c + 1) * K) - c * K
+        la[:n] = log10A[c * K:c * K + n]
+        ga[:n] = gamma[c * K:c * K + n]
+        return la, ga, n
+
+    def _chunk_closures(c):
+        la, ga, n = _chunk_grids(c)
+
+        def run():
+            import jax.numpy as jnp
+
+            if not placed:
+                placed.update(
+                    A=jnp.asarray(A), x=jnp.asarray(x),
+                    G=jnp.asarray(Gamma), f=jnp.asarray(fcols))
+            out = kernel(placed["A"], placed["x"], jnp.asarray(rdr_sum), jnp.asarray(ld_sum), placed["G"], placed["f"], jnp.asarray(tspan), jnp.asarray(la), jnp.asarray(ga))  # graftlint: allow G6 -- called inside the supervisor-dispatched closure (watchdog applies)
+            h = np.asarray(out)
+            return h if h.flags.owndata else h.copy()
+
+        def run_pinned():
+            placed.clear()
+            return _gwb_outer_np(A, x, rdr_sum, ld_sum, Gamma,
+                                 fcols, tspan, la, ga)
+
+        return run, run_pinned, n
+
+    def chunk_run(c):
+        run, run_pinned, n = _chunk_closures(c)
+        with obs.span("pta.gwb_sweep", chunk=c, points=K,
+                      pool=pool):
+            if pool == "host":
+                out = supervisor.dispatch(
+                    run_pinned, key=f"{key_tag}/chunk{c}", steps=K,
+                    pinned=True)
+                info["used_pool"] = "host"
+            else:
+                def host_counted():
+                    fell_over.append(True)
+                    return run_pinned()
+
+                out = supervisor.dispatch(
+                    run, key=f"{key_tag}/chunk{c}", steps=K,
+                    fallback=host_counted)
+        like.metrics.bump("gwb_solves")
+        like.metrics.bump("hd_outer_solves", K)
+        return out, n
+
+    def _finish(vals):
+        if pool != "host" and \
+                info.get("used_pool") != "host-failover":
+            info["used_pool"] = "host-failover" if fell_over \
+                else "device"
+        return np.concatenate(vals)[:npts]
+
+    def run_chunks():
+        vals = []
+        for c in range(nchunks):
+            out, _ = chunk_run(c)
+            vals.append(np.asarray(out))
+            if progress is not None:
+                progress(min(npts, (c + 1) * K))
+        return _finish(vals)
+
+    if sync:
+        return run_chunks
+    first_fut = None
+    if pool != "host":
+        run0, run0_pinned, _ = _chunk_closures(0)
+
+        def host_counted0():
+            fell_over.append(True)
+            return run0_pinned()
+
+        with obs.span("pta.gwb_sweep.issue", chunk=0, points=K):
+            first_fut = supervisor.dispatch_async(
+                run0, key=f"{key_tag}/chunk0", steps=K,
+                fallback=host_counted0)
+
+    def collect():
+        nonlocal first_fut
+        if first_fut is None:
+            return run_chunks()
+        out0 = first_fut.result()
+        first_fut = None
+        like.metrics.bump("gwb_solves")
+        like.metrics.bump("hd_outer_solves", K)
+        vals = [np.asarray(out0)]
+        if progress is not None:
+            progress(min(npts, K))
+        for c in range(1, nchunks):
+            out, _ = chunk_run(c)
+            vals.append(np.asarray(out))
+            if progress is not None:
+                progress(min(npts, (c + 1) * K))
+        return _finish(vals)
+
+    return collect

@@ -1,0 +1,131 @@
+"""Compile-with-plan: explicit-sharding compilation of batch kernels.
+
+The pulsar batch axis used to be "sharded" by device_put-ing inputs
+with a NamedSharding and letting GSPMD partition ``jit(vmap(...))`` —
+which on the CPU mesh LOST to single-device (BASELINE config 5's old
+note): the partitioner keeps the batched Cholesky sequence serialized
+on one logical program. Here the batch kernel is compiled through
+``shard_map`` instead (reference: SNIPPETS [3], Titanax's
+compile-with-plan helper): each device runs the per-slot kernel over
+ITS contiguous block of pulsars — zero collectives, and the CPU
+client executes the per-device partials concurrently, so the pulsar
+axis finally scales. Explicit ``in_shardings``/``out_shardings`` on
+the outer jit make placement part of the compiled plan (no resharding
+on entry), and ``donate_argnums`` threads through to the XLA aliasing
+table exactly like the serve cache's donation plumbing (SNIPPETS
+[1]/[2]): only alias-exact positions may be donated, and donated
+arrays must be rebuilt fresh per dispatch (graftlint G11).
+
+This module is pure compilation planning — it never dispatches; the
+supervised call sites (``parallel.pta.pta_solve``, ``pta.gwb``) own
+the dispatch discipline (G6/G12).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+try:  # jax >= 0.4.35 staging area
+    from jax.experimental.shard_map import shard_map
+except ImportError:  # pragma: no cover - newer jax promoted it
+    from jax import shard_map  # type: ignore
+
+__all__ = ["batch_sharding", "compile_with_plan", "mesh_fingerprint",
+           "pad_batch", "plan_cache_clear"]
+
+# plan cache: (name, mesh fingerprint, donate, ndims) -> compiled fn.
+# Keyed on the mesh's device ids, not the Mesh object, so two Mesh
+# wrappers over the same devices share one executable.
+_PLANS: Dict[tuple, object] = {}
+
+
+def mesh_fingerprint(mesh, axis: str):
+    """Hashable identity of (mesh, axis) for the plan cache."""
+    if mesh is None:
+        return None
+    return (tuple(int(d.id) for d in np.asarray(mesh.devices).flat),
+            tuple(mesh.axis_names), str(axis))
+
+
+def batch_sharding(mesh, axis: str, ndim: int) -> NamedSharding:
+    """Leading-axis block sharding: dim 0 over ``axis``, the rest
+    replicated — the one layout every batch kernel input/output here
+    uses."""
+    return NamedSharding(
+        mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
+
+
+def pad_batch(arrs: Dict[str, np.ndarray], mesh, axis: str,
+              ones_keys: Sequence[str] = ("nvec", "phi")) -> dict:
+    """Pad every array's leading (pulsar) dim up to a mesh multiple so
+    shard_map never sees a ragged block. Pad slots are fully-masked
+    pulsars: unit ``nvec``/``phi`` (so logs and reciprocals stay
+    finite), zeros elsewhere (valid = pvalid = 0 masks them out of
+    every sum) — the same convention ``stack_problems`` uses for
+    extra batch slots."""
+    if mesh is None:
+        return dict(arrs)
+    nshard = mesh.shape[axis]
+    P = next(iter(arrs.values())).shape[0]
+    pad = (-P) % nshard
+    if not pad:
+        return dict(arrs)
+    out = {}
+    for k, v in arrs.items():
+        v = np.asarray(v)
+        fill = np.ones if k in ones_keys else np.zeros
+        out[k] = np.concatenate(
+            [v, fill((pad,) + v.shape[1:], dtype=v.dtype)], axis=0)
+    return out
+
+
+def compile_with_plan(fn, *, name: str, ndims_in: Sequence[int],
+                      ndims_out: Sequence[int], mesh=None,
+                      axis: str = "pulsar",
+                      donate_argnums: Tuple[int, ...] = ()):
+    """Compile a batch kernel under an explicit placement plan.
+
+    ``fn`` maps leading-axis-batched arrays to leading-axis-batched
+    outputs (a ``vmap`` of a per-slot kernel). Without a mesh this is
+    plain ``jax.jit`` (plus donation); with one, ``fn`` is wrapped in
+    ``shard_map`` over ``axis`` (every input/output block-sharded on
+    dim 0, per-device blocks solved independently) and jitted with
+    matching explicit in/out shardings so the compiled executable owns
+    its layout end to end. ``ndims_in``/``ndims_out`` are the array
+    ranks (specs and shardings are derived from them). Plans are
+    cached per (name, mesh devices, axis, donation)."""
+    donate = tuple(sorted(int(d) for d in donate_argnums))
+    key = (name, mesh_fingerprint(mesh, axis), donate,
+           tuple(ndims_in), tuple(ndims_out))
+    got = _PLANS.get(key)
+    if got is not None:
+        return got
+    if mesh is None:
+        planned = jax.jit(fn, donate_argnums=donate)
+    else:
+        spec = PartitionSpec(axis)
+        mapped = shard_map(
+            fn, mesh=mesh,
+            in_specs=tuple(spec for _ in ndims_in),
+            out_specs=tuple(spec for _ in ndims_out),
+            # no collectives anywhere in these kernels; skipping the
+            # replication check keeps closed-over constants legal
+            check_rep=False)
+        planned = jax.jit(
+            mapped,
+            in_shardings=tuple(batch_sharding(mesh, axis, nd)
+                               for nd in ndims_in),
+            out_shardings=tuple(batch_sharding(mesh, axis, nd)
+                                for nd in ndims_out),
+            donate_argnums=donate)
+    _PLANS[key] = planned
+    return planned
+
+
+def plan_cache_clear():
+    """Drop every cached plan (tests that rebuild meshes)."""
+    _PLANS.clear()

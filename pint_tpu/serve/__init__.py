@@ -4,8 +4,8 @@ The ROADMAP north star is a system serving heavy traffic; the library
 half of that is here. The moving parts (one module each):
 
 - ``serve.request``: typed requests (fit step / residuals / phase
-  prediction / posterior sampling) with deadlines and result
-  futures;
+  prediction / posterior sampling / array GWB sweeps) with deadlines
+  and result futures;
 - ``serve.bucket``: power-of-two shape-class bucketing + the bounded
   executable cache (compiles scale with bucket count, not traffic);
 - ``serve.scheduler``: the coalescing ServeEngine (admission queue,
@@ -45,6 +45,8 @@ from pint_tpu.serve.request import (  # noqa: F401
     EngineKilled,
     FitStepRequest,
     FitStepResult,
+    GWBRequest,
+    GWBResult,
     PhasePredictRequest,
     PhasePredictResult,
     PosteriorRequest,

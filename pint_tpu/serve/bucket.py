@@ -32,7 +32,8 @@ from pint_tpu.parallel.pta import _solve_one, pta_solve_np, \
 
 __all__ = ["bucket_for", "pad_dim", "pow2_ceil", "ExecutableCache",
            "gls_shape_class", "phase_shape_class",
-           "posterior_shape_class", "append_shape_class"]
+           "posterior_shape_class", "append_shape_class",
+           "gwb_shape_class"]
 
 
 def pow2_ceil(n: int) -> int:
@@ -111,6 +112,16 @@ def append_shape_class(n: int, p: int, q: int,
     if nb is None:
         return None
     return ("append", nb, pad_dim(p), pad_dim(q))
+
+
+def gwb_shape_class(P: int, m: int, K: int):
+    """(kind, npulsars, basis columns, chunk) for a GWB sweep
+    request. EXACT, never None: the compiled programs are keyed on
+    the array size, the common-basis column count and the sweep
+    chunk — the hyperparameter GRIDS are runtime args (distinct
+    grids share a class), and there is no TOA axis to bucket (the
+    per-pulsar blocks are request state, assembled once)."""
+    return ("gwb", int(P), int(m), int(K))
 
 
 def _phase_eval_one(coeffs, tmid, rphase_int, rphase_frac, f0, mjds,
@@ -724,5 +735,59 @@ class ExecutableCache:
                 # (or reused) its executable
                 self.keys.add(key)
             return out
+
+        return collect
+
+    def gwb_begin(self, key, requests, sync: bool = False,
+                  pool: str = "device",
+                  info: Optional[dict] = None, progress=None):
+        """Sweep each request's (log10A, gamma) grid through the
+        array-likelihood chunk driver (``pta.gwb.gwb_sweep_driver``):
+        every chunk of K grid points is its own supervised,
+        deadline-bounded dispatch with the numpy outer mirror as host
+        failover, so the chunk boundary is the failover/drain
+        boundary. ``progress(k, points_done)`` fires after each of
+        request k's chunks — the scheduler journals it as
+        non-terminal progress acks (the posterior convention).
+        Returns the zero-arg ``collect`` yielding one logL host
+        array per request.
+
+        Batch coalescing here is ADMISSION coalescing only: each
+        request owns its array (its own blocks, Gamma and basis), so
+        same-class requests ride one sealed unit but sweep as
+        separate chunked dispatches — under ``sync=False`` every
+        request's chunk 0 is issued on the supervisor's pipeline, so
+        the unit still overlaps device work. Not AOT-exported and
+        not donated: the assembled blocks are long-lived request
+        state read back by every chunk (the posterior kernel's
+        rationale, verbatim)."""
+        from pint_tpu.pta.gwb import gwb_sweep_driver
+
+        K = key[3]
+        if info is None:
+            info = {}
+        infos = [dict() for _ in requests]
+        tag = "serve.gwb/" + "/".join(str(x) for x in key)
+        collects = []
+        for k, r in enumerate(requests):
+            prog = None if progress is None else \
+                (lambda done, k=k: progress(k, done))
+            collects.append(gwb_sweep_driver(
+                r.likelihood, r.log10A, r.gamma, K,
+                supervisor=self.supervisor, key_tag=tag,
+                pool=pool, sync=sync, info=infos[k],
+                progress=prog))
+
+        def collect():
+            outs = [np.asarray(c()) for c in collects]
+            pools = [i.get("used_pool") for i in infos]
+            if "host-failover" in pools:
+                info["used_pool"] = "host-failover"
+            elif pools and all(p == "host" for p in pools):
+                info["used_pool"] = "host"
+            else:
+                info["used_pool"] = "device"
+                self.keys.add(key)
+            return outs
 
         return collect

@@ -39,9 +39,10 @@ __all__ = ["ServeFuture", "DeadlineExceeded", "ServeOverload",
            "TenantOverQuota", "ShutdownShed", "EngineKilled",
            "StateMissing",
            "FitStepRequest", "ResidualsRequest", "PhasePredictRequest",
-           "PosteriorRequest", "AppendTOAsRequest", "FitStepResult",
+           "PosteriorRequest", "AppendTOAsRequest", "GWBRequest",
+           "FitStepResult",
            "ResidualsResult", "PhasePredictResult", "PosteriorResult",
-           "AppendResult"]
+           "AppendResult", "GWBResult"]
 
 
 class DeadlineExceeded(TimeoutError):
@@ -405,6 +406,105 @@ class AppendTOAsRequest(_GLSRequest):
         if entry is not None and not cold:
             entry.check_compatible(self.problem)
         return self.problem
+
+
+@dataclass
+class GWBResult:
+    """One array's swept GWB detection grid: ``logL[k]`` is the
+    Hellings–Downs cross-correlated marginal log-likelihood at
+    ``(log10A[k], gamma[k])`` (``pta.gwb.GWBLikelihood`` semantics —
+    the improper-prior constant is dropped, so COMPARE values across
+    the grid, don't read them absolutely)."""
+
+    logL: np.ndarray             # (npts,)
+    log10A: np.ndarray           # (npts,) the grid actually swept
+    gamma: np.ndarray            # (npts,)
+    npulsars: int
+    nfreq: int
+
+    def best(self) -> Dict[str, float]:
+        """The grid's maximum-likelihood point."""
+        k = int(np.argmax(self.logL))
+        return {"log10A": float(self.log10A[k]),
+                "gamma": float(self.gamma[k]),
+                "logL": float(self.logL[k])}
+
+
+class GWBRequest(Request):
+    """Sweep the array-level GWB likelihood over a hyperparameter
+    grid (ISSUE 17).
+
+    Carries a whole pulsar ARRAY (``pairs`` of (toas, model), prebuilt
+    ``PulsarProblem``s, or a prebuilt ``pta.gwb.GWBLikelihood`` — the
+    serving-state form: a service holding a hot array builds the
+    likelihood once, blocks and all, and re-sweeps per request). The
+    served work is the chunked outer Schur sweep
+    (``pta.gwb.gwb_sweep_driver``): each chunk of
+    ``config.gwb_chunk()`` grid points is one supervised dispatch, so
+    the chunk boundary is the failover/deadline boundary and journal
+    progress is acked per chunk — NOT AOT-exported and NOT donated
+    (the blocks are long-lived request state, exactly the posterior
+    chains' rationale). ``log10A``/``gamma`` are RUNTIME grids
+    (requests with different grids share a compiled shape class);
+    the shape class is (npulsars, basis size, chunk)."""
+
+    kind = "gwb"
+
+    def __init__(self, pairs=None, problems=None, likelihood=None,
+                 log10A=None, gamma=None, nfreq: int = 10,
+                 positions=None, gamma_matrix=None, track_mode=None,
+                 **kw):
+        super().__init__(**kw)
+        if likelihood is None and pairs is None and problems is None:
+            raise ValueError(
+                "GWBRequest needs pairs, problems, or a prebuilt "
+                "GWBLikelihood")
+        self.pairs = pairs
+        self.problems = problems
+        self.likelihood = likelihood
+        self.positions = positions
+        self.gamma_matrix = gamma_matrix
+        self.nfreq = int(nfreq)
+        self.track_mode = track_mode
+        self.log10A = np.atleast_1d(
+            np.asarray(log10A, np.float64)).ravel()
+        self.gamma = np.atleast_1d(
+            np.asarray(gamma, np.float64)).ravel()
+        if self.log10A.shape != self.gamma.shape:
+            raise ValueError(
+                f"log10A grid ({self.log10A.shape}) and gamma grid "
+                f"({self.gamma.shape}) must have the same length")
+        if len(self.log10A) < 1:
+            raise ValueError("GWBRequest needs a non-empty grid")
+
+    def ensure_likelihood(self, mesh=None, axis: str = "pulsar",
+                          supervisor=None):
+        """Build (or return the cached) array likelihood. The
+        engine's mesh threads through so the inner block assembly is
+        sharded over the pulsar axis."""
+        if self.likelihood is None:
+            from pint_tpu.pta.gwb import GWBLikelihood
+
+            self.likelihood = GWBLikelihood(
+                pairs=self.pairs, problems=self.problems,
+                positions=self.positions,
+                gamma_matrix=self.gamma_matrix, nfreq=self.nfreq,
+                mesh=mesh, axis=axis, supervisor=supervisor,
+                track_mode=self.track_mode)
+        return self.likelihood
+
+    @property
+    def npoints(self) -> int:
+        """Grid points this sweep costs — the kind-local 'rows' unit
+        the capacity router learns GWB service rates in."""
+        return len(self.log10A)
+
+    @property
+    def sizes(self):
+        """(npulsars, basis columns) — the shape-class inputs, read
+        off the assembled likelihood."""
+        lk = self.ensure_likelihood()
+        return (lk.npulsars, lk.m)
 
 
 class PhasePredictRequest(Request):

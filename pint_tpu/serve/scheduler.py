@@ -73,6 +73,7 @@ from pint_tpu.serve.bucket import (
     ExecutableCache,
     append_shape_class,
     gls_shape_class,
+    gwb_shape_class,
     pad_dim,
     phase_shape_class,
     posterior_shape_class,
@@ -86,6 +87,8 @@ from pint_tpu.serve.request import (
     EngineKilled,
     FitStepRequest,
     FitStepResult,
+    GWBRequest,
+    GWBResult,
     PhasePredictRequest,
     PhasePredictResult,
     PosteriorRequest,
@@ -468,6 +471,8 @@ class ServeEngine:
             return "posterior"
         if isinstance(req, AppendTOAsRequest):
             return "append"
+        if isinstance(req, GWBRequest):
+            return "gwb"
         return "gls"
 
     def _predicted_wait_locked(self, req) -> float:
@@ -689,6 +694,21 @@ class ServeEngine:
                 return ("append", pow2_ceil(n), pad_dim(p),
                         pad_dim(q)), True
             return key, False
+        if isinstance(r, GWBRequest):
+            from pint_tpu import config
+
+            # assembly here builds the whole array likelihood (the
+            # per-pulsar blocks stay lazy — they assemble as ONE
+            # supervised dispatch at issue time); the engine's mesh
+            # and supervisor thread through so block assembly shards
+            # over the pulsar axis and counts against this
+            # deployment's dispatch counters
+            with annotate("serve.assemble"):
+                lk = r.ensure_likelihood(mesh=self.mesh,
+                                         axis=self.axis,
+                                         supervisor=self.supervisor)
+            return gwb_shape_class(lk.npulsars, lk.m,
+                                   config.gwb_chunk()), False
         with annotate("serve.assemble"):
             pr = r.ensure_problem()
         n, p = pr.M.shape
@@ -738,7 +758,7 @@ class ServeEngine:
         full_key = key + (Pb,)
         t0 = time.monotonic()
         kind = key[0] if key[0] in ("phase", "posterior",
-                                    "append") else "gls"
+                                    "append", "gwb") else "gls"
         rows = self._unit_rows(key, grp, Pb)
         pool = self.router.pick(kind, rows)
         self.router.issued(pool, len(grp), rows, kind=kind)
@@ -782,6 +802,11 @@ class ServeEngine:
                         full_key, grp, shape=(Pb, nb, pb, qb),
                         sync=sync, pool=pool, info=info,
                         progress=self._posterior_progress(grp))
+                elif key[0] == "gwb":
+                    collect = self.cache.gwb_begin(
+                        full_key, grp, sync=sync, pool=pool,
+                        info=info,
+                        progress=self._gwb_progress(grp))
                 else:
                     _, nb, pb, qb = key
                     collect = self.cache.gls_begin(
@@ -848,6 +873,12 @@ class ServeEngine:
             W, K = key[4], key[5]
             kmax = max((r.nsteps for r in grp), default=0)
             return Pb * W * max(1, -(-kmax // K)) * K
+        if key[0] == "gwb":
+            # each request sweeps its OWN chunked grid (batch slots
+            # never pad: coalescing is admission-only), so the
+            # executed work is the sum of per-request padded points
+            K = key[3]
+            return sum(max(1, -(-r.npoints // K)) * K for r in grp)
         return Pb * key[1]
 
     def _posterior_progress(self, grp: List):
@@ -867,6 +898,23 @@ class ServeEngine:
 
         return progress
 
+    def _gwb_progress(self, grp: List):
+        """Per-chunk journal progress for a GWB unit (the posterior
+        convention): one non-terminal ack per journalable request
+        after each of ITS sweep chunks, so a crash mid-sweep is
+        visible in the journal (the replay restarts the sweep; the
+        marks label how far the dead run got)."""
+        if self.journal is None:
+            return None
+        journal = self.journal
+
+        def progress(k, done_points):
+            r = grp[k]
+            if r.rid is not None and r.payload is not None:
+                journal.progress(r.rid, int(done_points))
+
+        return progress
+
     def _dispatch_finish(self, key, full_key, grp, Pb, t0, collect,
                          pool, info, usp):
         """Collect one issued dispatch and scatter results to the
@@ -876,7 +924,7 @@ class ServeEngine:
         latency histograms (queue wait / dispatch wall / e2e per
         (pool, kind, class), ISSUE 10) with every member request."""
         kind = key[0] if key[0] in ("phase", "posterior",
-                                    "append") else "gls"
+                                    "append", "gwb") else "gls"
         rows = self._unit_rows(key, grp, Pb)
         try:
             if isinstance(collect, Exception):
@@ -912,6 +960,17 @@ class ServeEngine:
                         nsteps=r.nsteps))
             elif key[0] == "append":
                 self._append_finish(key, grp, out, info)
+            elif key[0] == "gwb":
+                for k, r in enumerate(grp):
+                    # the driver's concatenate already owns its
+                    # buffer; ascontiguousarray keeps the no-view
+                    # promise if that ever changes
+                    r.future.set_result(GWBResult(
+                        logL=np.ascontiguousarray(out[k]),
+                        log10A=r.log10A.copy(),
+                        gamma=r.gamma.copy(),
+                        npulsars=r.likelihood.npulsars,
+                        nfreq=r.likelihood.nfreq))
             else:
                 dparams, cov, chi2, chi2r = out
                 for k, r in enumerate(grp):
@@ -1017,6 +1076,14 @@ class ServeEngine:
         elif kind == "phase":
             mon.observe("serve.phase", {"values": list(out)},
                         pool=used, key=str(key))
+        elif kind == "gwb":
+            # every swept logL value: nonfinite anywhere in the grid
+            # is the garbage signal (a -inf grid point would mean a
+            # non-PD outer Schur system, not a low-probability one)
+            mon.observe("serve.gwb",
+                        {"values": [np.concatenate(
+                            [np.ravel(o) for o in out])]},
+                        pool=used, key=str(key))
         else:
             dparams, cov, chi2, chi2r = out
             mon.observe("serve.gls", {"values": [dparams, chi2]},
@@ -1031,6 +1098,8 @@ class ServeEngine:
             return len(r.mjds)
         if isinstance(r, PosteriorRequest):
             return r.walker_steps
+        if isinstance(r, GWBRequest):
+            return r.npoints
         return r.problem.M.shape[0]
 
     # -- threaded serving loop ----------------------------------------

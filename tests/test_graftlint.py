@@ -337,6 +337,32 @@ def test_g6_covers_sampling_package():
         assert [x.rule for x in v] == ["G6"], rel
 
 
+def test_g6_covers_pta_package():
+    """ISSUE-17 satellite: the dispatch half of G6 is pinned over the
+    array-likelihood plane (``pint_tpu/pta/``) — a direct call of a
+    jit product there must lint, and a ``compile_with_plan(...)``
+    product (the sharded plan IS a jitted executable) flags exactly
+    the same way."""
+    for mod in ("gwb", "shard", "metrics"):
+        rel = f"pint_tpu/pta/{mod}.py"
+        assert gl._g6_dispatch_applies(rel), rel
+    v = _lint_dispatch("""
+        import jax
+        kernel = jax.jit(lambda x: x + 1)
+        def sweep(x):
+            return kernel(x)
+    """, relpath="pint_tpu/pta/gwb.py")
+    assert [x.rule for x in v] == ["G6"]
+    v = _lint_dispatch("""
+        from pint_tpu.pta.shard import compile_with_plan
+        planned = compile_with_plan(lambda x: x, name="k",
+                                    ndims_in=(2,), ndims_out=(2,))
+        def sweep(x):
+            return planned(x)
+    """, relpath="pint_tpu/pta/gwb.py")
+    assert [x.rule for x in v] == ["G6"]
+
+
 def test_g6_dispatch_flags_direct_jit_product_call():
     v = _lint_dispatch("""
         import jax

@@ -533,6 +533,98 @@ def test_chaos_overload_tenant_burst_backend_death(monkeypatch,
         obs.reset()
 
 
+# ------------------------------------------- GWB sweep (ISSUE 17)
+
+
+def test_gwb_sweep_survives_mid_sweep_backend_death(monkeypatch,
+                                                    tmp_path):
+    """ISSUE-17 acceptance: the device dies MID-GWB-SWEEP — the
+    block assembly and the first sweep chunk serve on device, every
+    later chunk hangs. The request must complete via LABELED host
+    failover from the chunk boundary (values identical to the
+    no-fault reference), bounded by the watchdog deadline, with
+    exactly ONE terminal span for the submitted request."""
+    import io as _io
+    import json as _json
+
+    from pint_tpu import obs
+    from pint_tpu.models import get_model
+    from pint_tpu.serve import GWBRequest, ServeEngine
+    from pint_tpu.simulation import make_fake_toas_uniform
+
+    def mk(psr, f0, n, seed, ra, dec):
+        par = (f"PSR {psr}\nRAJ {ra} 1\nDECJ {dec} 1\n"
+               f"F0 {f0} 1\nF1 -1e-15 1\nPEPOCH 55000\n"
+               f"POSEPOCH 55000\nDM {10 + seed} 1\nTZRMJD 55000.1\n"
+               f"TZRSITE @\nTZRFRQ 1400\nUNITS TDB")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m = get_model(_io.StringIO(par))
+            t = make_fake_toas_uniform(
+                54500, 55500, n, m, error_us=1.0, add_noise=True,
+                rng=np.random.default_rng(seed))
+        return t, m
+
+    pairs = [mk("J0001+21", 101.1, 30, 21, "12:01:00.0",
+                "21:00:00.0"),
+             mk("J0430-10", 317.9, 40, 22, "04:30:00.0",
+                "-10:00:00.0"),
+             mk("J1820+55", 218.5, 36, 23, "18:20:00.0",
+                "55:00:00.0")]
+    la = np.linspace(-15.0, -13.5, 10)
+    ga = np.full(10, 13.0 / 3.0)
+
+    # reference pass, no faults: warms every compile + the oracle
+    ref_eng = ServeEngine()
+    ref = ref_eng.submit(GWBRequest(pairs=pairs, log10A=la,
+                                    gamma=ga, nfreq=2)) \
+        .result(timeout=120)
+
+    monkeypatch.setenv("PINT_TPU_DISPATCH_DEADLINE_MS", "250")
+    tracer = obs.configure(enabled=True)
+    eng = ServeEngine()
+    # chunk 0 of the sweep serves on device (the blocks key
+    # "pta.gwb_blocks" never matches) — every later chunk hangs:
+    # the death is genuinely MID-sweep
+    hang_s = 8.0
+    plan = FaultPlan([Fault(match="serve.gwb", kind="hang",
+                            seconds=hang_s, after=1)])
+    req = GWBRequest(pairs=pairs, log10A=la, gamma=ga, nfreq=2,
+                     rid="gwb-chaos", payload={"kind": "gwb"})
+    t0 = time.monotonic()
+    with plan.active():
+        fut = eng.submit(req)
+        eng.flush()
+    wall = time.monotonic() - t0
+    assert wall < hang_s - 1.0    # bounded by failover, not the hang
+    assert fut.done()
+    res = fut.result(timeout=0)   # labeled failover, never raises
+    # chunk-boundary failover: the host mirror finishes the sweep,
+    # values identical to the healthy reference
+    np.testing.assert_allclose(res.logL, ref.logL, rtol=1e-9)
+    snap = eng.metrics.snapshot()
+    assert snap["completed"] == 1
+    disp = snap["dispatch"]
+    assert disp["failovers"] >= 1
+    assert disp["timeouts"] >= 1
+    assert "DEGRADED" in eng.metrics.report()
+
+    # the trace tells the same story: exactly ONE terminal span,
+    # served, and the unit is labeled host-failover
+    path = str(tmp_path / "gwb_chaos_trace.json")
+    tracer.export(path)
+    evs = _json.load(open(path, encoding="utf-8"))["traceEvents"]
+    terms = [e for e in evs if e["name"] == "serve.terminal"]
+    assert len(terms) == 1
+    assert terms[0]["args"]["status"] == "served"
+    units = [e for e in evs if e["name"] == "serve.unit"]
+    assert [u["args"]["used_pool"] for u in units] == \
+        ["host-failover"]
+    names = {e["name"] for e in evs}
+    assert "dispatch.failover" in names and \
+        "dispatch.timeout" in names
+
+
 # ------------------------------------------------- pipelined drain
 
 

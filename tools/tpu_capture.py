@@ -46,6 +46,7 @@ STAGES = {
     "config4": "config4_j0613like_fullcov_gls_2k",
     "config5": "config5_pta_batch_67psr",
     "pta_scale": "pta_batch_scaling",
+    "pta_gwb": "gwb_sweep",
     "stress": "stress_nanograv_like_10k_fit",
     "stress_wideband": "stress_nanograv_like_10k_fit_wideband",
     "serve": "serve_coalesced_vs_sequential_64req",
@@ -259,6 +260,28 @@ def stage_pta_scale(backend):
                "recovered_5sigma": n_ok}
         bench.tpu_record_append(rec)
         print(json.dumps(rec), flush=True)
+
+
+def stage_pta_gwb(backend):
+    """Array GWB likelihood plane ON CHIP (ISSUE 17): Hellings-Downs
+    block assembly sharded over the chip's local devices vs
+    single-device, then the chunked (log10_A, gamma) detection sweep
+    through the supervised outer Schur dispatches. On a 1-device
+    chip the sharded leg auto-skips and the sweep throughput +
+    roofline are the record."""
+    import argparse
+
+    import bench_pta
+
+    rec = bench_pta.run_gwb(argparse.Namespace(
+        npulsars=67, ntoa=100, nfreq=5, grid=8))
+    if rec.get("backend") != backend:
+        raise RuntimeError(
+            f"bench_pta.run_gwb ran on {rec.get('backend')!r}, not "
+            f"{backend!r} (tunnel died?); stage stays on the "
+            f"to-do list")
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
 
 
 def stage_stress(backend, wideband=False):
@@ -601,6 +624,8 @@ def run_stage(name, backend):
         _config_stage(bench.config5_pta, backend)
     elif name == "pta_scale":
         stage_pta_scale(backend)
+    elif name == "pta_gwb":
+        stage_pta_gwb(backend)
     elif name == "stress":
         stage_stress(backend)
     elif name == "stress_wideband":
