@@ -910,6 +910,62 @@ def measure_perf_decomposition(step_call, reps=5):
         obs.reset()
 
 
+def measure_lock_trace_overhead(step_call, reps=5):
+    """Lock-sanitizer overhead (ISSUE 18 acceptance: disarmed <1%,
+    armed <5% on the north-star step). Arming is a CONSTRUCTION-time
+    property — the disarmed factories return BARE stdlib primitives,
+    so each leg builds a FRESH DispatchSupervisor under its own
+    arming state: the off leg's locks are the exact production
+    objects, not wrappers with a dormant branch. The ON leg pays the
+    full traced path on every dispatch: held-stack push/pop, order-
+    graph edge paint, hold/wait histogram records into the registry,
+    and the armed ``check_dispatch_clear`` engine scan. Same
+    methodology as ``measure_metrics_overhead``: the off/on
+    per-dispatch delta on a x200 tiny-payload batch, reported
+    against the real step wall; the raw step walls ride as
+    evidence."""
+    from pint_tpu import obs
+    from pint_tpu.runtime import DispatchSupervisor, locks
+
+    def leg(enabled):
+        locks.configure(enabled=enabled)
+        sup = DispatchSupervisor()
+
+        def once():
+            sup.dispatch(step_call, key="bench.lock_step")
+
+        def tiny_batch(n=_TINY_N):
+            for _ in range(n):
+                sup.dispatch(_noop_payload, key="bench.lock_tiny")
+
+        once()  # warm both dispatch keys
+        tiny_batch(2)
+        t_tiny = t_step = float("inf")
+        for _ in range(max(2, reps)):
+            t_tiny = min(t_tiny, time_fn(tiny_batch, 1))
+            t_step = min(t_step, time_fn(once, 1))
+        return t_tiny, t_step
+
+    try:
+        t_tiny_off, t_off = leg(False)
+        t_tiny_on, t_on = leg(True)
+        per_iter_us = max(0.0, t_tiny_on - t_tiny_off) \
+            / _TINY_N * 1e6
+        return {
+            # one supervised dispatch per north-star step, so the
+            # per-dispatch cost against the step wall IS the frac
+            "lock_trace_per_dispatch_overhead_us":
+                round(per_iter_us, 2),
+            "lock_trace_overhead_frac":
+                round(per_iter_us * 1e-6 / t_off, 6)
+            if t_off and t_off != float("inf") else None,
+            "lock_trace_off_step_ms": round(t_off * 1e3, 3),
+            "lock_trace_on_step_ms": round(t_on * 1e3, 3),
+        }
+    finally:
+        obs.reset()
+
+
 def measure_health_overhead(model, toas, reps=5):
     """Numerical-health overhead (ISSUE 14 acceptance: disarmed <1%,
     armed <5% on the north-star step). The OFF leg is the production
@@ -1761,6 +1817,22 @@ def main():
             f"{decomp_block}")
     except Exception as e:
         log(f"perf-plane measurement failed: {e!r}")
+    # lock-sanitizer overhead (ISSUE 18): disarmed bare-stdlib locks
+    # vs the armed traced path, each on a freshly-built supervisor —
+    # the concurrency plane's <1%/<5% acceptance evidence
+    try:
+        lblock = measure_lock_trace_overhead(
+            lambda: jax.block_until_ready(jitted(*args)))
+        if obs_block is None:
+            obs_block = lblock
+        else:
+            obs_block.update(lblock)
+        log(f"lock-trace overhead [{backend}]: off "
+            f"{lblock['lock_trace_off_step_ms']} ms, on "
+            f"{lblock['lock_trace_on_step_ms']} ms "
+            f"(frac={lblock['lock_trace_overhead_frac']})")
+    except Exception as e:
+        log(f"lock-trace measurement failed: {e!r}")
 
     # transparency: the f32-Jacobian variant is auto-on only on TPU;
     # when we're on the CPU backend measure it too (it halves the CPU

@@ -117,6 +117,35 @@ Rules (see ARCHITECTURE.md "Static analysis" for the table):
       land on a hot path. Route through
       ``obs.perf.request_window`` / ``obs.perf.note_compile``.
       Pragma/allowlist policy as G9.
+  G16 lock discipline over the dispatch layer (the G6 file set) +
+      runtime/ + obs/ + the serve CLI, against
+      analysis/lock_registry.py (ISSUE 18; the dynamic mirror is
+      ``runtime.locks`` under $PINT_TPU_LOCK_TRACE): (0) raw
+      ``threading.Lock/RLock/Condition`` construction must go
+      through the ``runtime.locks`` factories so the traced build
+      sees every lock; (1) registry-GUARDED fields may be written
+      only in ``__init__``, ``*_locked`` methods, declared holder
+      methods, or lexically under ``with self.<lock>`` (or a
+      declared alias like the Condition wrapping it); (2) registry
+      SCRAPE_ROOTS (MetricsServer handlers, lock-free snapshot
+      surfaces) must be statically unreachable from any ENGINE_LOCKS
+      acquisition over the resolvable call graph — the repo-wide
+      proof that a /metrics scrape never blocks on an engine lock;
+      (3) no supervised dispatch, journal fsync/admit/ack, or host
+      solve (BLOCKING_CALLS) lexically under ``with`` on an
+      ENGINE_LOCKS attribute — the scheduler's ``_dispatch_lock``
+      is deliberately unlisted (dispatch under it IS the drain
+      design). Registry entries carry written justifications and
+      stale entries fail the run; pragma/allowlist policy as G9.
+  G17 no raw ``os.environ`` / ``os.getenv`` outside
+      pint_tpu/config.py (ISSUE 18, finishing the ISSUE 11 ban):
+      every env knob reads through a validated config parser
+      (warn-and-ignore on bad values — the
+      ``dispatch_rtt_override_ms`` pattern), so a typo'd value can
+      never silently change production behavior. Whole-environment
+      subprocess passthroughs (``env=dict(os.environ)``) forward
+      rather than parse and are sanctioned per-site with a G17
+      pragma. Pragma/allowlist policy as G7.
 
 jit-reachability is inferred statically, seeded by project
 conventions: any function whose early positional parameters include
@@ -180,6 +209,16 @@ RULES = {
            "probes only in obs/perf.py / profiling.py (the "
            "supervised window facility and the once-per-key "
            "compile ledger)",
+    "G16": "lock discipline in the dispatch/serve/runtime/obs "
+           "layers: locks constructed through runtime.locks "
+           "factories, registry-guarded fields written only under "
+           "their lock, scrape paths statically unreachable from "
+           "engine-lock acquisition, and no dispatch/fsync/host "
+           "solve under an engine lock "
+           "(analysis/lock_registry.py)",
+    "G17": "no raw os.environ/os.getenv outside pint_tpu/config.py "
+           "— env knobs read through validated config parsers; "
+           "subprocess whole-env passthroughs pragma-sanctioned",
 }
 
 # entry points allowed to mutate global jax config (G7): the package
@@ -1583,6 +1622,12 @@ def run_lint(root: str, dynamic: bool = True,
                 "PARSE", relpath, e.lineno or 0, f"syntax error: {e}"))
     seed_names = collect_jit_seed_names(modules)
     prod_per_module, prod_private = collect_jit_products(modules)
+    # the concurrency rule family (G16/G17) lives in
+    # analysis/concurrency; imported lazily like graftflow so AST
+    # fixtures in tests can drive the halves standalone
+    from pint_tpu.analysis import concurrency as _conc
+
+    g16_hits: Dict[int, int] = {}
     for m in modules:
         mark_jit_regions(m, seed_names.get(m.relpath, set()))
         report.violations += check_g1(m)
@@ -1596,6 +1641,10 @@ def run_lint(root: str, dynamic: bool = True,
         report.violations += check_g15(m)
         report.violations += check_g7(m)
         report.violations += check_g8(m)
+        report.violations += _conc.check_g16(m, g16_hits)
+        report.violations += _conc.check_g17(m)
+    report.violations += _conc.g16_stale_entries(g16_hits)
+    report.violations += _conc.check_g16_scrape_paths(modules)
     for relpath, src in shell:
         report.violations += check_g6_shell(relpath, src)
     graph = ClassGraph(modules)
@@ -1662,19 +1711,32 @@ def find_repo_root(start: Optional[str] = None) -> str:
         cur = parent
 
 
+def github_annotation(v: Violation) -> str:
+    """One GitHub Actions ``::error`` workflow-command line for a
+    violation (%/CR/LF escaped per the workflow-command spec;
+    repo-scope findings pin to line 1 so the annotation renders)."""
+    msg = f"{v.rule}: {v.msg}".replace(
+        "%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    return (f"::error file={v.path},line={max(1, v.line)},"
+            f"title=graftlint {v.rule}::{msg}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m pint_tpu.analysis.graftlint",
-        description="project invariant linter (rules G1-G8)")
+        description="project invariant linter (rules G1-G17)")
     ap.add_argument("--root", default=None,
                     help="repo root (default: walk up to pint_tpu/)")
     ap.add_argument("--json", action="store_true",
                     help="single-document machine-readable output")
-    ap.add_argument("--format", choices=("text", "json"),
+    ap.add_argument("--format", choices=("text", "json", "github"),
                     default="text",
                     help="json: one {file,line,rule,msg} record per "
                          "line (JSONL) plus a trailing summary "
-                         "record — the pre-commit/CI wire format")
+                         "record — the pre-commit/CI wire format; "
+                         "github: `::error file=..,line=..::..` "
+                         "workflow-annotation lines so CI findings "
+                         "land inline on the PR diff")
     ap.add_argument("--changed-only", action="store_true",
                     help="report only findings in files changed vs "
                          "HEAD (git diff + untracked) — the fast "
@@ -1708,6 +1770,8 @@ def main(argv=None) -> int:
                 print(json.dumps({"summary": True, "clean": True,
                                   "files_scanned": 0, "violations": 0,
                                   "changed_only": True}))
+            elif args.format == "github":
+                pass  # clean run = zero annotation lines
             else:
                 print("graftlint: no lintable files changed")
             return 0
@@ -1722,6 +1786,13 @@ def main(argv=None) -> int:
         report.violations = [v for v in report.violations
                              if v.path in changed or
                              v.scope == "repo"]
+    if args.format == "github":
+        # GitHub Actions workflow-annotation wire format: one
+        # ::error line per finding (newlines %0A-escaped per the
+        # workflow-command spec) so violations annotate the PR diff
+        for v in report.violations:
+            print(github_annotation(v))
+        return 0 if report.clean else 1
     if args.format == "json":
         for v in report.violations:
             print(json.dumps({"file": v.path, "line": v.line,

@@ -38,7 +38,8 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "health_enabled", "shadow_rate", "health_drift_sigma",
            "health_chi2_factor", "health_resid_sigma",
            "health_cg_budget_frac", "perf_enabled",
-           "compile_ledger_path", "profile_dir", "profile_max_s"]
+           "compile_ledger_path", "profile_dir", "profile_max_s",
+           "lock_trace_enabled"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -1058,6 +1059,22 @@ def perf_enabled(flag: Optional[bool] = None) -> bool:
     an unrecognized env value warns once and is ignored."""
     return _env_bool("PINT_TPU_PERF", flag,
                      context="perf decomposition stays off")
+
+
+def lock_trace_enabled(flag: Optional[bool] = None) -> bool:
+    """Traced-lock sanitizer armed? ($PINT_TPU_LOCK_TRACE, default
+    OFF — the $PINT_TPU_TRACE / $PINT_TPU_HEALTH opt-in stance.)
+    When armed, ``runtime.locks`` constructors hand out
+    TracedLock/TracedRLock wrappers that record per-thread
+    acquisition order into the process lock-order graph (cycle
+    detection fires a ``lockorder:<edge>`` flight dump) and feed the
+    ``pint_tpu_lock_*`` hold/contention histograms. Disarmed (the
+    production default), the constructors return the BARE stdlib
+    primitives — a true zero-cost passthrough, banded <1% on the
+    north-star step in bench's ``obs`` block. An explicit ``flag``
+    wins; an unrecognized env value warns once and is ignored."""
+    return _env_bool("PINT_TPU_LOCK_TRACE", flag,
+                     context="lock tracing stays off")
 
 
 def compile_ledger_path():

@@ -50,9 +50,11 @@ def get_ephemeris(name=None):
         key = str(name).upper()
         if key in _REGISTRY:
             return _REGISTRY[key]
-        ephem_dir = os.environ.get("PINT_TPU_EPHEM_DIR")
-        if ephem_dir:
-            cand = os.path.join(ephem_dir, f"{key.lower()}.bsp")
+        from pint_tpu import config
+
+        ephem_dir = config.ephem_dir()
+        if ephem_dir is not None:
+            cand = os.path.join(str(ephem_dir), f"{key.lower()}.bsp")
             if os.path.exists(cand):
                 register_kernel(key, cand)
                 return _REGISTRY[key]

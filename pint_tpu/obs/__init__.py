@@ -29,7 +29,7 @@ journal layers that keep the same property.
 
 from __future__ import annotations
 
-import threading
+from pint_tpu.runtime import locks
 from typing import Optional
 
 from pint_tpu.obs import health  # noqa: F401  (ISSUE 14 monitor)
@@ -52,7 +52,7 @@ __all__ = ["Tracer", "SpanHandle", "LatencyHistogram",
            "open_root", "event", "record_span", "current", "attach",
            "flight_dump", "status", "export"]
 
-_LOCK = threading.Lock()
+_LOCK = locks.make_lock("obs.global")
 _TRACER: Optional[Tracer] = None
 _FLIGHT: Optional[FlightRecorder] = None
 _CONFIGURED = False
@@ -162,6 +162,12 @@ def reset():
         profiling.scoreboard.reset()
     except Exception:
         pass
+    # ISSUE 18: the lock-order graph + per-edge incident latches +
+    # arming cache — the same episode/isolation contract as the
+    # numerics incident latches above
+    from pint_tpu.runtime import locks as _locks
+
+    _locks.reset()
 
 
 # ------------------------------------------------------------------

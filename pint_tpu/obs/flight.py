@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import json
 import os
-import threading
+from pint_tpu.runtime import locks
 import time
 from typing import Optional
 
@@ -49,7 +49,7 @@ class FlightRecorder:
         self.tracer = tracer
         self.min_interval_s = float(min_interval_s)
         self._last_by_reason: dict = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.flight")
         self.dumps = 0
         self.suppressed = 0
         self.errors = 0

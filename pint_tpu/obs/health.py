@@ -61,6 +61,8 @@ the bucket math is shared with the latency rows.
 from __future__ import annotations
 
 import threading
+
+from pint_tpu.runtime import locks
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -130,7 +132,7 @@ class HealthMonitor:
         self.chi2_factor = config.health_chi2_factor()
         self.resid_band = config.health_resid_sigma()
         self.cg_frac = config.health_cg_budget_frac()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.health")
         self._shadow_seen: Dict[str, int] = {}
         self._worst: Dict[Tuple[str, str], dict] = {}
         self.last_incident: Optional[dict] = None
@@ -482,7 +484,7 @@ class HealthMonitor:
 # ------------------------------------------------------------------
 
 _MON: Optional[HealthMonitor] = None
-_LOCK = threading.Lock()
+_LOCK = locks.make_lock("obs.health_global")
 
 
 def get_monitor() -> HealthMonitor:

@@ -23,7 +23,7 @@ for non-serve callers (device fits, PTA solves).
 
 from __future__ import annotations
 
-import threading
+from pint_tpu.runtime import locks
 from typing import Dict, Optional, Tuple
 
 __all__ = ["LatencyHistogram", "HistogramSet"]
@@ -61,7 +61,7 @@ class LatencyHistogram:
         self.count = 0
         self.sum_s = 0.0
         self.max_s = 0.0
-        self._lock = threading.Lock()
+        self._lock = locks.make_plane_lock("obs.hist_row")
 
     def record(self, seconds: float):
         if not (seconds >= 0.0):   # negative AND NaN clamp to zero
@@ -121,7 +121,7 @@ class HistogramSet:
 
     def __init__(self, row_factory=None):
         self._rows: Dict[Tuple, LatencyHistogram] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_plane_lock("obs.hist_set")
         self._factory = row_factory or \
             (lambda key, metric: LatencyHistogram())
 

@@ -47,6 +47,8 @@ import itertools
 import json
 import re
 import threading
+
+from pint_tpu.runtime import locks
 from typing import Callable, Dict, List, Optional, Tuple
 
 from pint_tpu.obs.hist import LatencyHistogram
@@ -126,7 +128,7 @@ class Metric:
     def __init__(self, name: str, help: str = ""):
         self.name = _NAME_BAD.sub("_", name)
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = locks.make_plane_lock("obs.metric")
         self._vals: Dict[tuple, float] = {}
 
     def child(self, **labels) -> _Bound:
@@ -262,7 +264,7 @@ class MetricRegistry:
 
     def __init__(self):
         self._metrics: Dict[str, Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_plane_lock("obs.registry")
 
     def _get(self, cls, name: str, help: str) -> Metric:
         name = _NAME_BAD.sub("_", name)
@@ -377,7 +379,7 @@ def _hist_state(row: LatencyHistogram):
 # ------------------------------------------------------------------
 
 _REG: Optional[MetricRegistry] = None
-_REG_LOCK = threading.Lock()
+_REG_LOCK = locks.make_plane_lock("obs.registry_global")
 
 
 def get_registry() -> MetricRegistry:

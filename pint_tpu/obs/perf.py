@@ -71,6 +71,8 @@ from __future__ import annotations
 import json
 import os
 import threading
+
+from pint_tpu.runtime import locks
 import time
 from typing import Optional
 
@@ -157,7 +159,7 @@ class CompileLedger:
 
         self.path = config.compile_ledger_path() \
             if path is None else path
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.perf_ledger")
         self._entries: dict = {}
         self._prior: dict = {}
         # counters are SCOPE-labelled per instance (the
@@ -516,7 +518,7 @@ class ProfilerWindows:
         self.max_s = config.profile_max_s() if max_s is None \
             else float(max_s)
         self.min_interval_s = float(min_interval_s)
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.profiler")
         self._open: Optional[dict] = None
         self._last_by_reason: dict = {}
         self._n = 0
@@ -780,7 +782,7 @@ class ProfilerWindows:
 # process-global plane (armed by env, like the tracer/monitor)
 # ------------------------------------------------------------------
 
-_LOCK = threading.Lock()
+_LOCK = locks.make_lock("obs.perf_global")
 _LEDGER: Optional[CompileLedger] = None
 _PROFILER: Optional[ProfilerWindows] = None
 _ENABLED: Optional[bool] = None

@@ -51,6 +51,8 @@ from __future__ import annotations
 
 import collections
 import threading
+
+from pint_tpu.runtime import locks
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -159,7 +161,7 @@ class SLOWatchdog:
                                 + 4)))
         self._ring: collections.deque = collections.deque(maxlen=cap)
         self._burning: set = set()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.slo")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.fires = 0
@@ -344,7 +346,7 @@ class SLOWatchdog:
 # ------------------------------------------------------------------
 
 _WATCHDOG: Optional[SLOWatchdog] = None
-_LOCK = threading.Lock()
+_LOCK = locks.make_lock("obs.slo_global")
 
 
 def get_watchdog() -> Optional[SLOWatchdog]:

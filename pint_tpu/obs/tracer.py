@@ -46,6 +46,8 @@ import contextvars
 import json
 import os
 import threading
+
+from pint_tpu.runtime import locks
 import time
 from typing import Optional
 
@@ -190,7 +192,7 @@ class Tracer:
         self.ring_size = max(16, int(ring_size))
         self._ring: list = []
         self._head = 0            # next slot once the ring is full
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("obs.tracer.ring")
         self._ids = 0
         self._traces = 0
         self.dropped = 0          # records overwritten by the ring
@@ -203,7 +205,7 @@ class Tracer:
         # appends the admission/dispatch hot paths perform under
         # self._lock
         self._stream = None
-        self._stream_lock = threading.Lock()
+        self._stream_lock = locks.make_lock("obs.tracer.stream")
         self._stream_path = None
         if stream is not None:
             if isinstance(stream, str):

@@ -62,7 +62,10 @@ def get_index(mirror: Optional[str] = None,
 
 
 def clock_mirror() -> Optional[str]:
-    return _MIRROR or os.environ.get("PINT_TPU_CLOCK_DIR")
+    from pint_tpu import config
+
+    d = config.clock_dir()
+    return _MIRROR or (str(d) if d is not None else None)
 
 
 @dataclass

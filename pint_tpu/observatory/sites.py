@@ -102,9 +102,11 @@ SITES = {
 def load_sites() -> dict:
     """The site table, honoring $PINT_TPU_OBS_OVERRIDE (a JSON file of the
     same structure, merged over the built-ins)."""
+    from pint_tpu import config
+
     sites = {k: dict(v) for k, v in SITES.items()}
-    override = os.environ.get("PINT_TPU_OBS_OVERRIDE")
-    if override and os.path.exists(override):
+    override = config.obs_override()
+    if override is not None and override.exists():
         with open(override) as f:
             for name, entry in json.load(f).items():
                 sites[name.lower()] = entry

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import json
-import threading
+from pint_tpu.runtime import locks
 import time
 from dataclasses import asdict, dataclass, field
 from typing import Dict, Optional
@@ -65,7 +65,7 @@ class Scoreboard:
     the scoreboard with the registry it was bound to."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("profiling.scoreboard")
         self._rows: Dict[str, object] = {}
         self._scope: Optional[str] = None
 

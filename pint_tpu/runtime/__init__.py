@@ -30,6 +30,13 @@ from pint_tpu.runtime.breaker import (  # noqa: F401
     OPEN,
     CircuitBreaker,
 )
+from pint_tpu.runtime.locks import (  # noqa: F401
+    TracedLock,
+    TracedRLock,
+    make_condition,
+    make_lock,
+    make_rlock,
+)
 from pint_tpu.runtime.faults import (  # noqa: F401
     Fault,
     FaultPlan,

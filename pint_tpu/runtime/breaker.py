@@ -31,9 +31,10 @@ one thread probes at a time.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable, Optional
+
+from pint_tpu.runtime import locks
 
 __all__ = ["CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN"]
 
@@ -69,8 +70,8 @@ class CircuitBreaker:
         self.failures = 0          # consecutive, CLOSED state
         self.trips = 0             # lifetime OPEN transitions
         self.opened_at: Optional[float] = None
-        self._lock = threading.Lock()
-        self._probing = threading.Lock()
+        self._lock = locks.make_lock("breaker.state")
+        self._probing = locks.make_lock("breaker.probe")
 
     # -- gate ----------------------------------------------------------
 

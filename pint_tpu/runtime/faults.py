@@ -30,9 +30,10 @@ happen) so deadline behavior is exercised by the test suite.
 from __future__ import annotations
 
 import contextlib
-import threading
 from dataclasses import dataclass, field
 from typing import List, Optional
+
+from pint_tpu.runtime import locks
 
 __all__ = ["Fault", "FaultPlan", "active_plan", "TransientFault",
            "FatalFault"]
@@ -125,7 +126,7 @@ class FaultPlan:
         self.rules: List[Fault] = list(rules or [])
         self.probe_ok = probe_ok
         self.applied: List[tuple] = []   # (key, kind) log for asserts
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("faults.plan")
 
     def faults_for(self, key: str,
                    kinds: Optional[tuple] = None) -> List[Fault]:

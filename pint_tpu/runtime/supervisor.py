@@ -68,7 +68,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from pint_tpu.runtime import faults
+from pint_tpu.runtime import faults, locks
 from pint_tpu.runtime.breaker import CircuitBreaker
 
 __all__ = ["DispatchSupervisor", "DispatchFuture", "RuntimeMetrics",
@@ -135,7 +135,7 @@ class RuntimeMetrics:
         from pint_tpu.obs import HistogramSet
         from pint_tpu.obs import metrics as om
 
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("runtime.metrics")
         self.scope = om.new_scope("sup")
         self._c = {
             name: om.counter(
@@ -236,7 +236,7 @@ class RuntimeMetrics:
 # ------------------------------------------------------------------
 
 _BREAKERS: dict = {}
-_BREAKERS_LOCK = threading.Lock()
+_BREAKERS_LOCK = locks.make_lock("runtime.breaker_table")
 
 
 def bounded_backend_probe(timeout_s: Optional[float] = None) -> bool:
@@ -254,7 +254,7 @@ def bounded_backend_probe(timeout_s: Optional[float] = None) -> bool:
             [sys.executable, "-c",
              "import jax; jax.devices(); print('ok')"],
             timeout=timeout_s, capture_output=True,
-            env=dict(os.environ))
+            env=dict(os.environ))  # graftlint: allow G17 -- whole-env passthrough to the hang-probe subprocess (forwards, never parses; the probe needs the caller's PALLAS_AXON_* tunnel vars)
         return r.returncode == 0
     except (subprocess.TimeoutExpired, OSError):
         return False
@@ -295,7 +295,7 @@ class DispatchSupervisor:
         self.metrics = metrics or RuntimeMetrics()
         self._seen: set = set()   # dispatch keys past first call
         self._inflight = 0        # async dispatches currently issued
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = locks.make_lock("runtime.inflight")
 
     # -- public API ----------------------------------------------------
 
@@ -366,6 +366,12 @@ class DispatchSupervisor:
 
         kw = kw or {}
         backend = jax.default_backend()
+        # lock sanitizer (ISSUE 18): a guarded dispatch issued while
+        # this thread holds a traced ENGINE lock is the blocking-
+        # under-lock bug G16 bans statically — one labeled
+        # ``lockheld:<name>`` incident per episode, detection only
+        # (the dispatch itself proceeds)
+        locks.check_dispatch_clear(f"dispatch/{key}")
         with obs.span(f"dispatch/{key}", kind="dispatch",
                       backend=backend, steps=steps, depth=depth,
                       pinned=pinned) as sp:
@@ -1063,7 +1069,7 @@ def _log():
 # ------------------------------------------------------------------
 
 _GLOBAL: Optional[DispatchSupervisor] = None
-_GLOBAL_LOCK = threading.Lock()
+_GLOBAL_LOCK = locks.make_lock("runtime.global_supervisor")
 
 
 def get_supervisor() -> DispatchSupervisor:

@@ -81,6 +81,8 @@ import json
 import signal
 import sys
 import threading
+
+from pint_tpu.runtime import locks
 import uuid
 
 __all__ = ["main"]
@@ -179,7 +181,7 @@ class _LineAck:
     def __init__(self, journal, rid):
         self.journal = journal
         self.rid = rid
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.cli_state")
         self._expected = None
         self._emitted = 0
         self._acked = False
@@ -555,7 +557,7 @@ def main(argv=None, stdin=None) -> int:
         _restore_signal_handlers(prev_handlers)
         return 0
 
-    out_lock = threading.Lock()
+    out_lock = locks.make_lock("serve.cli_stdout")
     pending = threading.Semaphore(0)
     nsub = 0
 

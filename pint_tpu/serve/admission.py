@@ -45,7 +45,7 @@ import threading
 import time
 from typing import Dict, Optional
 
-from pint_tpu.runtime import faults
+from pint_tpu.runtime import faults, locks
 
 __all__ = ["TokenBucket", "AdmissionController"]
 
@@ -105,7 +105,7 @@ class AdmissionController:
         self.policy = config.shed_policy() if policy is None \
             else str(policy)
         self._buckets: Dict[str, TokenBucket] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.admission")
         # shed accounting (the admission block of the metrics
         # snapshot): every decision that drops a request lands in
         # exactly one of these. ISSUE 11: the counters are bound

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pint_tpu.parallel.pta import PulsarProblem
+from pint_tpu.runtime import locks
 from pint_tpu.parallel.streaming import _cg_schur, cg_solve_np
 
 __all__ = ["AppendProblem", "AppendStore", "AppendStateEntry",
@@ -273,7 +274,7 @@ class AppendStore:
 
         from pint_tpu.obs import metrics as om
 
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.append_store")
         self._states: dict = {}
         scope = om.new_scope("append")
         self._c_cold = om.counter(

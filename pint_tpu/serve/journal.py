@@ -48,6 +48,8 @@ import hashlib
 import json
 import os
 import threading
+
+from pint_tpu.runtime import locks
 from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["RequestJournal", "AotStore", "save_state", "load_state"]
@@ -97,7 +99,7 @@ class RequestJournal:
         from pint_tpu.obs import metrics as om
 
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.journal")
         self._fh = None
         # ISSUE 11: compaction count rides the metric registry (the
         # counts() dict reads it back — derived view, G13-clean)
@@ -309,7 +311,7 @@ class AotStore:
         self._manifest_path = os.path.join(dirpath, "manifest.json")
         self._restored: Dict[str, Callable] = {}
         self._saved: set = set()
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.aot_store")
         # ISSUE 11: registry-backed counters (scope-labelled), read
         # back via __getattr__ — snapshot() stays a derived view;
         # hits/misses count restored-executable lookups at dispatch

@@ -41,6 +41,8 @@ learned rates, demotion count).
 from __future__ import annotations
 
 import threading
+
+from pint_tpu.runtime import locks
 from typing import Dict, Optional
 
 __all__ = ["CapacityRouter"]
@@ -152,7 +154,7 @@ class CapacityRouter:
         self.scope = om.new_scope("router")
         self.pools = {"device": _Pool("device", scope=self.scope),
                       "host": _Pool("host", scope=self.scope)}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("serve.router")
 
     # -- routing -------------------------------------------------------
 

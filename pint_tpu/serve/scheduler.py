@@ -67,7 +67,7 @@ import numpy as np
 from pint_tpu import obs
 from pint_tpu.fitter import Fitter
 from pint_tpu.profiling import annotate
-from pint_tpu.runtime import faults
+from pint_tpu.runtime import faults, locks
 from pint_tpu.serve.admission import AdmissionController
 from pint_tpu.serve.bucket import (
     ExecutableCache,
@@ -206,9 +206,18 @@ class ServeEngine:
         self._earliest_expiry: Optional[float] = None
         self._dead = False
         self._drain_stop_at: Optional[float] = None  # shutdown bound
-        self._lock = threading.RLock()
-        self._cv = threading.Condition(self._lock)
-        self._dispatch_lock = threading.Lock()
+        # the ENGINE lock (admission-critical): every submitter
+        # serializes on it, so a supervised dispatch / journal fsync
+        # / host solve under it stalls admission — engine=True arms
+        # the runtime.locks dispatch-clear check, and G16 part 3 bans
+        # it statically (analysis/lock_registry.py ENGINE_LOCKS)
+        self._lock = locks.make_rlock("serve.engine", engine=True)
+        self._cv = locks.make_condition(self._lock)
+        # the dispatch SERIALIZER: sealed units issue/collect while
+        # holding it BY DESIGN (one drain at a time; _cv is released
+        # per iteration so admission keeps flowing) — deliberately
+        # NOT engine-marked and exempt from G16 part 3
+        self._dispatch_lock = locks.make_lock("serve.dispatch")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # ISSUE 11: arm the SLO burn-rate watchdog when $PINT_TPU_SLO

@@ -21,7 +21,7 @@ from typing import Dict, List, NamedTuple, Optional
 import jax.numpy as jnp
 import numpy as np
 
-from pint_tpu import c_m_s
+from pint_tpu import c_m_s, config
 from pint_tpu.ephemeris import get_ephemeris
 from pint_tpu.io.tim import TimTOA, parse_tim, write_tim
 from pint_tpu.observatory import get_observatory
@@ -29,6 +29,13 @@ from pint_tpu.ops import dd_np
 from pint_tpu.ops.dd import DD
 from pint_tpu.time import mjd as mjdmod
 from pint_tpu.time import scales
+
+
+def _env_dir_key(d) -> Optional[str]:
+    """Stringify a config dir (Optional[Path]) for the TOA-cache
+    digest — None stays None so an unset override keys identically
+    across platforms."""
+    return None if d is None else str(d)
 
 SECS_PER_DAY = 86400.0
 
@@ -605,8 +612,8 @@ def get_TOAs(timfile, ephem=None, planets=False, model=None,
             digest.update(repr((
                 ephem, planets, include_gps, include_bipm,
                 bipm_version, __version__,
-                os.environ.get("PINT_TPU_CLOCK_DIR"),
-                os.environ.get("PINT_TPU_EPHEM_DIR"))).encode())
+                _env_dir_key(config.clock_dir()),
+                _env_dir_key(config.ephem_dir()))).encode())
             cache_key = digest.hexdigest()
             base = os.path.basename(fpath)
             cdir = cachedir or os.path.dirname(os.path.abspath(fpath))

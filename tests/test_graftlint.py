@@ -951,3 +951,291 @@ def test_g15_pragma_suppression_works():
         report, [], {"pint_tpu/serve/_fixture.py": src})
     assert report.violations == []
     assert len(report.suppressed) == 1
+
+
+# ----------------------------------------------------------- G16
+
+
+def _lint_g16(src, relpath="pint_tpu/serve/_fixture.py", hits=None):
+    from pint_tpu.analysis import concurrency as conc
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    return conc.check_g16(m, {} if hits is None else hits)
+
+
+def test_g16_flags_raw_threading_primitives():
+    v = _lint_g16("""
+    import threading
+    from threading import RLock
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._rl = RLock()
+            self._cv = threading.Condition(self._lock)
+    """)
+    assert [x.rule for x in v] == ["G16"] * 3
+    assert "make_lock" in v[0].msg
+    assert "make_rlock" in v[1].msg
+    assert "make_condition" in v[2].msg
+
+
+def test_g16_factories_and_other_layers_are_clean():
+    src = """
+    from pint_tpu.runtime import locks
+
+    class Engine:
+        def __init__(self):
+            self._lock = locks.make_rlock("serve.engine", engine=True)
+            self._cv = locks.make_condition(self._lock)
+    """
+    assert _lint_g16(src) == []
+    raw = """
+    import threading
+
+    class Host:
+        def __init__(self):
+            self._lock = threading.Lock()
+    """
+    # the rule only applies to the dispatch/serve/runtime/obs layers
+    assert _lint_g16(raw, relpath="pint_tpu/pintk/_fixture.py") == []
+    assert _lint_g16(raw, relpath="pint_tpu/obs/_fixture.py")
+    assert _lint_g16(raw, relpath="pint_tpu/runtime/_fixture.py")
+
+
+def test_g16_guarded_write_outside_lock_flags():
+    """The registry owns ServeEngine._nqueued under _lock (alias
+    _cv): an unlocked write — including a mutator call on a guarded
+    container — flags; with/cv, *_locked, __init__ and declared
+    holders stay clean."""
+    v = _lint_g16("""
+    class ServeEngine:
+        def __init__(self):
+            self._nqueued = 0          # __init__: allowed
+            self._open = {}
+
+        def submit(self, req):
+            self._nqueued += 1         # UNLOCKED: flags
+            self._open.pop(req, None)  # UNLOCKED mutator: flags
+
+        def _seal_locked(self, key):
+            self._nqueued -= 1         # *_locked suffix: allowed
+
+        def sweep(self):
+            with self._cv:
+                self._nqueued = 0      # under the declared alias
+            with self._lock:
+                self._open[1] = 2      # under the owning lock
+
+        def _dispatch_finish(self, unit):
+            self._pool_last_collect = 1.0  # declared holder
+
+        def _drain(self):
+            with self._dispatch_lock:
+                self._pool_last_collect = 2.0
+    """, relpath="pint_tpu/serve/scheduler.py")
+    assert [x.rule for x in v] == ["G16"] * 2
+    assert "_nqueued" in v[0].msg and v[0].line
+    assert "_open" in v[1].msg
+
+
+def test_g16_closure_inside_locked_method_is_allowed():
+    assert _lint_g16("""
+    class ServeEngine:
+        def _expire_locked(self):
+            def inner():
+                self._nqueued -= 1     # lexically inside *_locked
+            inner()
+    """, relpath="pint_tpu/serve/scheduler.py") == []
+
+
+def test_g16_stale_registry_entry_fails_repo_scope():
+    from pint_tpu.analysis import concurrency as conc
+    from pint_tpu.analysis import lock_registry as reg
+    stale = conc.g16_stale_entries({})
+    assert len(stale) == len(reg.GUARDED)
+    assert all(x.scope == "repo" and "stale" in x.msg for x in stale)
+    assert conc.g16_stale_entries(
+        {i: 1 for i in range(len(reg.GUARDED))}) == []
+
+
+def test_g16_blocking_call_under_engine_lock_flags():
+    v = _lint_g16("""
+    class ServeEngine:
+        def bad(self, sup, fn):
+            with self._cv:
+                return sup.dispatch(fn, key="x")
+
+        def bad_fsync(self):
+            with self._lock:
+                self._fh.fsync()
+
+        def fine(self, sup, fn):
+            with self._dispatch_lock:   # NOT an engine lock
+                return sup.dispatch(fn, key="x")
+
+        def fine_outside(self, sup, fn):
+            with self._cv:
+                pending = fn
+            return sup.dispatch(pending, key="x")
+    """, relpath="pint_tpu/serve/scheduler.py")
+    assert [x.rule for x in v] == ["G16"] * 2
+    assert "dispatch" in v[0].msg and "fsync" in v[1].msg
+
+
+def test_g16_scrape_root_reaching_engine_lock_flags():
+    """A metrics handler that calls into the scheduler (directly or
+    through a module-alias helper chain) reaches `with self._lock`
+    -> flags with the call chain; the isolated handler is clean."""
+    from pint_tpu.analysis import concurrency as conc
+
+    sched = gl.ModuleInfo(
+        "pint_tpu/serve/scheduler.py", textwrap.dedent("""
+        class ServeEngine:
+            def snapshot_all(self):
+                with self._lock:
+                    return dict(self._open)
+        """))
+    bad = gl.ModuleInfo(
+        "pint_tpu/obs/metrics.py", textwrap.dedent("""
+        from pint_tpu.serve import scheduler
+
+        def _collect(eng):
+            return scheduler.snapshot_all(eng)
+
+        def do_GET(self):
+            return _collect(self.eng)
+
+        def default_health():
+            return {}
+        """))
+    v = conc.check_g16_scrape_paths([sched, bad])
+    # admission.py snapshot root is absent from the fixture set ->
+    # one stale-entry finding rides along with the reachability one
+    reach = [x for x in v if "reaches engine-lock" in x.msg]
+    assert len(reach) == 1
+    assert "do_GET" in reach[0].msg and "_lock" in reach[0].msg
+    clean = gl.ModuleInfo(
+        "pint_tpu/obs/metrics.py", textwrap.dedent("""
+        def do_GET(self):
+            return self.registry.render()
+
+        def default_health():
+            return {}
+        """))
+    v2 = conc.check_g16_scrape_paths([sched, clean])
+    assert [x for x in v2 if "reaches engine-lock" in x.msg] == []
+
+
+def test_g16_missing_scrape_root_is_stale():
+    from pint_tpu.analysis import concurrency as conc
+    v = conc.check_g16_scrape_paths([])
+    assert v and all("stale" in x.msg and x.scope == "repo"
+                     for x in v)
+
+
+def test_g16_pragma_suppression_works():
+    src = ("import threading\n"
+           "def f():\n"
+           "    return threading.Lock()"
+           "  # graftlint: allow G16 -- fixture: sanctioned raw site\n")
+    m = gl.ModuleInfo("pint_tpu/serve/_fixture.py", src)
+    from pint_tpu.analysis import concurrency as conc
+    report = gl.LintReport(violations=conc.check_g16(m, {}))
+    gl.apply_suppressions(
+        report, [], {"pint_tpu/serve/_fixture.py": src})
+    assert report.violations == []
+    assert len(report.suppressed) == 1
+
+
+def test_lock_registry_entry_count_pins_drift():
+    """Registry size drift must be a conscious edit (the
+    precision_registry pattern): update this pin WITH the new
+    entry's written justification."""
+    from pint_tpu.analysis import lock_registry as reg
+    assert len(reg.GUARDED) == 13
+    assert len(reg.ENGINE_LOCKS) == 1
+    assert len(reg.SCRAPE_ROOTS) == 3
+    assert reg.entry_count() == 17
+    for e in reg.GUARDED:
+        assert e["why"], e
+    for e in reg.ENGINE_LOCKS + reg.SCRAPE_ROOTS:
+        assert e["why"], e
+    # the dispatch serializer must stay OUT of the engine set: the
+    # drain design dispatches while holding it
+    assert all("_dispatch_lock" not in e["attrs"]
+               for e in reg.ENGINE_LOCKS)
+
+
+# ----------------------------------------------------------- G17
+
+
+def _lint_g17(src, relpath="pint_tpu/serve/_fixture.py"):
+    from pint_tpu.analysis import concurrency as conc
+    m = gl.ModuleInfo(relpath, textwrap.dedent(src))
+    return conc.check_g17(m)
+
+
+def test_g17_flags_raw_env_reads_everywhere():
+    src = """
+    import os
+    from os import environ, getenv
+
+    def f():
+        a = os.environ.get("PINT_TPU_X")
+        b = os.getenv("PINT_TPU_Y", "0")
+        c = environ["PINT_TPU_Z"]
+        d = getenv("PINT_TPU_W")
+        return a, b, c, d
+    """
+    v = _lint_g17(src)
+    assert [x.rule for x in v] == ["G17"] * 4
+    # repo-wide: models/ and tools-adjacent paths flag too
+    assert _lint_g17(src, relpath="pint_tpu/models/_fixture.py")
+    assert _lint_g17(src, relpath="pint_tpu/observatory/_f.py")
+
+
+def test_g17_config_is_sanctioned_and_bare_names_need_import():
+    src = """
+    import os
+
+    def parse():
+        return os.environ.get("PINT_TPU_X")
+    """
+    assert _lint_g17(src, relpath="pint_tpu/config.py") == []
+    # bare `environ`/`getenv` names flag ONLY when from-imported
+    # from os — a local variable of that name is not an env read
+    assert _lint_g17("""
+    def f(environ, getenv):
+        return environ["X"], getenv("Y")
+    """) == []
+
+
+def test_g17_pragma_suppression_works():
+    src = ("import os\n"
+           "def probe():\n"
+           "    return dict(os.environ)"
+           "  # graftlint: allow G17 -- fixture: whole-env passthrough\n")
+    m = gl.ModuleInfo("pint_tpu/serve/_fixture.py", src)
+    from pint_tpu.analysis import concurrency as conc
+    report = gl.LintReport(violations=conc.check_g17(m))
+    gl.apply_suppressions(
+        report, [], {"pint_tpu/serve/_fixture.py": src})
+    assert report.violations == []
+    assert len(report.suppressed) == 1
+
+
+# ------------------------------------------------- github format
+
+
+def test_github_annotation_wire_format():
+    v = gl.Violation("G16", "pint_tpu/serve/scheduler.py", 42,
+                     "bad thing\nsecond line with % and \r")
+    line = gl.github_annotation(v)
+    assert line.startswith(
+        "::error file=pint_tpu/serve/scheduler.py,line=42,"
+        "title=graftlint G16::G16: ")
+    assert "\n" not in line and "\r" not in line
+    assert "%0A" in line and "%0D" in line and "%25" in line
+    # repo-scope findings at line 0 pin to 1 so GitHub renders them
+    v0 = gl.Violation("G16", "x.py", 0, "stale", scope="repo")
+    assert ",line=1," in gl.github_annotation(v0)

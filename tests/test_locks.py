@@ -1,0 +1,283 @@
+"""runtime.locks — the traced-lock sanitizer (ISSUE 18, dynamic half
+of the G16 concurrency plane; ARCHITECTURE.md "Concurrency
+correctness plane").
+
+Disarmed — the production default — the factories return BARE stdlib
+primitives (the off state is the stdlib, not a wrapper with a
+branch). Armed (`$PINT_TPU_LOCK_TRACE` / `locks.configure`) they
+paint per-thread acquisition order into the process lock-order
+graph, fire ONE labeled incident per episode (``lockorder:<edge>``
+on an inversion, ``lockheld:<name>`` on a dispatch issued under an
+engine lock) and record hold/wait histograms into the obs.metrics
+registry. ``obs.reset()`` drops the graph, the latches and the
+arming cache — the isolation contract the autouse fixture leans on.
+The end-to-end seeded-fault oracles (flight dumps through a REAL
+supervised dispatch) live in tests/test_runtime_faults.py.
+"""
+
+import threading
+
+import pytest
+
+from pint_tpu import obs
+from pint_tpu.obs import metrics as om
+from pint_tpu.runtime import locks
+
+
+@pytest.fixture(autouse=True)
+def clean_locks(monkeypatch):
+    """Fresh graph/latches/arming cache per test; the env default
+    must not leak in from the outer shell."""
+    monkeypatch.delenv("PINT_TPU_LOCK_TRACE", raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ------------------------------------------------- disarmed = stdlib
+
+
+def test_disarmed_factories_return_bare_stdlib_primitives():
+    """The production default: no wrapper, no branch — the exact
+    stdlib types (bench's <1% north-star band is this property)."""
+    locks.configure(enabled=False)
+    lk = locks.make_lock("t.bare")
+    rk = locks.make_rlock("t.bare_r")
+    assert type(lk) is type(threading.Lock())
+    assert type(rk) is type(threading.RLock())
+    cv = locks.make_condition(rk)
+    assert isinstance(cv, threading.Condition)
+    with cv:
+        cv.notify_all()
+    # nothing painted: the bare primitives never touch the graph
+    with lk:
+        pass
+    assert locks.status()["edges"] == 0
+    assert locks.held_locks() == []
+
+
+def test_env_default_is_disarmed():
+    """No $PINT_TPU_LOCK_TRACE (the fixture guarantees it) and no
+    configure override -> the lazy _armed() resolves to off."""
+    assert type(locks.make_lock("t.env")) is type(threading.Lock())
+    assert locks.status()["armed"] is False
+
+
+# ------------------------------------------- armed graph + tracking
+
+
+def test_armed_lock_paints_acquisition_order():
+    locks.configure(enabled=True)
+    a = locks.make_lock("t.A")
+    b = locks.make_lock("t.B")
+    assert isinstance(a, locks.TracedLock)
+    with a:
+        assert locks.held_locks() == ["t.A"]
+        with b:
+            assert locks.held_locks() == ["t.A", "t.B"]
+    assert locks.held_locks() == []
+    assert locks.lock_graph_edges() == {"t.A": ["t.B"]}
+    st = locks.status()
+    assert st["armed"] and st["cycles_fired"] == 0
+    # hold-time histogram rides the registry
+    assert "pint_tpu_lock_hold_seconds" in om.get_registry().render()
+
+
+def test_reentrant_rlock_is_one_held_entry_no_self_edge():
+    locks.configure(enabled=True)
+    r = locks.make_rlock("t.R")
+    with r:
+        with r:  # re-acquire: bumps the count, paints nothing
+            assert locks.held_locks() == ["t.R"]
+        assert locks.held_locks() == ["t.R"]
+    assert locks.held_locks() == []
+    assert locks.lock_graph_edges() == {}
+
+
+def test_sibling_instances_of_one_name_share_a_node():
+    """Discipline is a property of the lock CLASS: two engines'
+    `serve.engine` locks are one graph node, and nesting them is
+    not a self-edge (no false inversion)."""
+    locks.configure(enabled=True)
+    a1 = locks.make_lock("t.same")
+    a2 = locks.make_lock("t.same")
+    with a1:
+        with a2:
+            pass
+    assert locks.lock_graph_edges() == {}
+    assert locks.status()["cycles_fired"] == 0
+
+
+def test_condition_protocol_over_traced_rlock():
+    """threading.Condition(TracedRLock): wait() fully releases
+    through _release_save (the held entry drops so a waiter does not
+    hold the engine node) and re-registers via _acquire_restore."""
+    locks.configure(enabled=True)
+    cv = locks.make_condition(locks.make_rlock("t.cv"))
+    state = {"woke": False, "held_in_wait": None}
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=5)
+            state["woke"] = True
+            state["held_in_wait"] = locks.held_locks()
+
+    th = threading.Thread(target=waiter, daemon=True)
+    th.start()
+    # hand the cv to the waiter, then notify
+    for _ in range(500):
+        with cv:
+            cv.notify_all()
+        th.join(timeout=0.01)
+        if not th.is_alive():
+            break
+    th.join(timeout=5)
+    assert not th.is_alive() and state["woke"]
+    assert state["held_in_wait"] == ["t.cv"]
+    assert locks.held_locks() == []
+
+
+# -------------------------------------------------- incident firing
+
+
+def test_inversion_fires_exactly_one_incident_per_episode(tmp_path):
+    obs.configure(enabled=True, flight_dir=str(tmp_path))
+    locks.configure(enabled=True)
+    a = locks.make_lock("t.A")
+    b = locks.make_lock("t.B")
+    with a:
+        with b:
+            pass
+    for _ in range(3):  # repeat the inversion: latched after one
+        with b:
+            with a:
+                pass
+    st = locks.status()
+    assert st["cycles_fired"] == 1
+    assert int(om.get_registry().total(
+        "pint_tpu_lock_incidents_total")) == 1
+    dumps = list(tmp_path.glob("flight-*lockorder*.json"))
+    assert len(dumps) == 1
+
+
+def test_obs_reset_drops_graph_latches_and_rearms():
+    locks.configure(enabled=True)
+    a = locks.make_lock("t.A")
+    b = locks.make_lock("t.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert locks.status()["cycles_fired"] == 1
+    obs.reset()  # new episode: graph + latches + arming cache gone
+    assert locks.status() == {"armed": False, "edges": 0, "nodes": 0,
+                              "cycles_fired": 0, "held_fired": 0}
+    locks.configure(enabled=True)
+    # existing traced locks keep working and repaint a fresh graph
+    with b:
+        with a:
+            pass
+    with a:
+        with b:
+            pass
+    assert locks.status()["cycles_fired"] == 1
+
+
+def test_check_dispatch_clear_fires_once_per_lock_name():
+    locks.configure(enabled=True)
+    eng = locks.make_rlock("t.engine", engine=True)
+    leaf = locks.make_lock("t.leaf")  # non-engine: never flags
+    assert locks.check_dispatch_clear("t") is True
+    with leaf:
+        assert locks.check_dispatch_clear("t") is True
+    with eng:
+        assert locks.check_dispatch_clear("t") is False
+        assert locks.check_dispatch_clear("t") is False  # latched
+    assert locks.status()["held_fired"] == 1
+    assert int(om.get_registry().total(
+        "pint_tpu_lock_incidents_total")) == 1
+    assert locks.check_dispatch_clear("t") is True  # released
+
+
+def test_contention_wait_rides_the_registry_histogram():
+    locks.configure(enabled=True)
+    lk = locks.make_lock("t.cont")
+    lk.acquire()
+    state = {}
+
+    def contender():
+        with lk:
+            state["got"] = True
+
+    th = threading.Thread(target=contender, daemon=True)
+    th.start()
+    th.join(timeout=0.05)  # let it block on the held lock
+    lk.release()
+    th.join(timeout=5)
+    assert state.get("got")
+    assert "pint_tpu_lock_wait_seconds" in om.get_registry().render()
+
+
+# --------------------- watcher single-instance guard (shell level)
+
+
+def test_tpu_watcher_double_launch_one_survivor(tmp_path):
+    """Process-level mutual exclusion for tools/tpu_watcher.sh: two
+    launches leave EXACTLY ONE survivor — the second sees the held
+    flock and exits 0 immediately with a log line saying so (a
+    respawned watcher must never race a live one over the stage
+    list: double-append + double-commit of ledger lines). The script
+    is copied into a tmp repo dir so its repo-local lockfile is
+    isolated from any real watcher on this machine, and a fake
+    `python` shim (exit 7) keeps the survivor inert in its
+    probe-failed sleep loop — no jax, no git."""
+    import os
+    import shutil
+    import subprocess
+    import time
+
+    if shutil.which("flock") is None:
+        pytest.skip("flock unavailable")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fake_repo = tmp_path / "repo"
+    (fake_repo / "tools").mkdir(parents=True)
+    script = fake_repo / "tools" / "tpu_watcher.sh"
+    shutil.copy(os.path.join(repo, "tools", "tpu_watcher.sh"), script)
+    shim = tmp_path / "bin"
+    shim.mkdir()
+    (shim / "python").write_text("#!/bin/sh\nexit 7\n")
+    (shim / "python").chmod(0o755)
+    env = dict(os.environ, PATH=f"{shim}:{os.environ['PATH']}",
+               SLEEP_S="60", PROBE_TIMEOUT="5")
+    p1 = subprocess.Popen(["bash", str(script)], env=env,
+                          stdout=subprocess.DEVNULL,
+                          stderr=subprocess.DEVNULL)
+    try:
+        lockfile = fake_repo / ".tpu_watcher.lock"
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            assert p1.poll() is None, \
+                "first watcher exited instead of holding the lock"
+            probe = subprocess.run(
+                ["flock", "-n", str(lockfile), "true"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            if probe.returncode != 0:
+                break  # p1 owns the flock
+            time.sleep(0.1)
+        else:
+            pytest.fail("first watcher never took the lockfile")
+        second = subprocess.run(["bash", str(script)], env=env,
+                                timeout=30, capture_output=True,
+                                text=True)
+        assert second.returncode == 0
+        assert p1.poll() is None, "the survivor died"
+        with open("/tmp/tpu_watcher_repo.log") as fh:
+            assert "another tpu_watcher holds" in fh.read()
+    finally:
+        p1.terminate()
+        try:
+            p1.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p1.kill()

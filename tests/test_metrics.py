@@ -381,6 +381,64 @@ def test_scrape_never_blocks_on_the_engine_lock():
     assert out["health"]["pools"]["host"]["open"] is False
 
 
+def test_scrape_chaos_with_lock_sanitizer_armed():
+    """ISSUE-18 chaos extension of the scrape contract: the same
+    burst-then-scrape-under-held-engine-lock drill with
+    $PINT_TPU_LOCK_TRACE armed BEFORE the engine is built, so every
+    serve/obs lock is traced and the REAL acquisition graph gets
+    painted. Asserts: the burst completes, the scrape still answers
+    while the (now traced) engine lock is held, the painted graph
+    has ZERO lock-order cycles and ZERO dispatch-under-engine-lock
+    incidents, no lock incident dump fired, and obs.reset() returns
+    the sanitizer to a clean slate (the isolation contract)."""
+    from pint_tpu.runtime import locks
+
+    locks.configure(enabled=True)
+    from pint_tpu.serve import ServeEngine
+
+    fresh = _workload(4, base=6350)
+    eng = ServeEngine(pipeline_depth=2)  # built ARMED: traced locks
+    assert isinstance(eng._lock, locks.TracedRLock)
+    futs = [eng.submit(r) for r in fresh()]
+    eng.flush()
+    for f in futs:
+        f.result(timeout=0)
+
+    srv = om.MetricsServer(port=0,
+                           health_fn=om.default_health).start()
+    out = {}
+    try:
+        assert eng._lock.acquire(timeout=5)
+        try:
+            def scrape():
+                base = f"http://127.0.0.1:{srv.port}"
+                out["metrics"] = urllib.request.urlopen(
+                    base + "/metrics", timeout=10).read().decode()
+
+            th = threading.Thread(target=scrape, daemon=True)
+            th.start()
+            th.join(timeout=10)
+            assert not th.is_alive(), \
+                "scrape blocked while the traced engine lock was held"
+        finally:
+            eng._lock.release()
+    finally:
+        srv.close()
+    st = locks.status()
+    assert st["armed"] is True
+    assert st["edges"] > 0, "armed burst painted no graph"
+    assert st["cycles_fired"] == 0, locks.lock_graph_edges()
+    assert st["held_fired"] == 0
+    assert om.get_registry().total(
+        "pint_tpu_lock_incidents_total") == 0
+    # the traced-lock histograms surfaced through the scrape itself
+    assert "pint_tpu_lock_hold_seconds" in out["metrics"]
+    # clean-slate isolation: reset drops graph, latches and arming
+    obs.reset()
+    assert locks.status() == {"armed": False, "edges": 0, "nodes": 0,
+                              "cycles_fired": 0, "held_fired": 0}
+
+
 # ---------------------------------------------------- SLO watchdog
 
 

@@ -1165,3 +1165,69 @@ def test_cg_budget_exhaustion_fires_one_numerics_dump(tmp_path):
             and e["args"].get("parent") in stream_spans]
     assert hevs and hevs[0]["args"]["ok"] is False
     assert "cg_budget" in (hevs[0]["args"].get("reasons") or "")
+
+
+# ------------------------------------- lock sanitizer (ISSUE 18)
+
+
+def test_lock_inversion_fires_one_lockorder_dump(tmp_path):
+    """ISSUE-18 seeded concurrency fault #1: an A->B / B->A
+    acquisition-order inversion under $PINT_TPU_LOCK_TRACE must
+    produce EXACTLY ONE labeled ``lockorder:<edge>`` flight dump —
+    repeating the inversion in-episode stays latched (the
+    numerics:<reason> once-per-episode pattern)."""
+    import json as _json
+
+    from pint_tpu import obs
+    from pint_tpu.obs import metrics as om
+    from pint_tpu.runtime import locks
+
+    obs.configure(enabled=True, flight_dir=str(tmp_path))
+    locks.configure(enabled=True)
+    a = locks.make_lock("chaos.A")
+    b = locks.make_lock("chaos.B")
+    with a:
+        with b:
+            pass
+    for _ in range(4):  # the inversion, repeated: one incident
+        with b:
+            with a:
+                pass
+    dumps = list(tmp_path.glob("flight-*lockorder*.json"))
+    assert len(dumps) == 1
+    doc = _json.loads(dumps[0].read_text())
+    assert doc["reason"] == "lockorder:chaos.B->chaos.A"
+    assert locks.status()["cycles_fired"] == 1
+    assert int(om.get_registry().total(
+        "pint_tpu_lock_incidents_total")) == 1
+
+
+def test_dispatch_under_engine_lock_fires_one_lockheld_dump(
+        tmp_path):
+    """ISSUE-18 seeded concurrency fault #2: a REAL supervised
+    dispatch issued while the thread holds an engine-marked traced
+    lock (the G16 part-3 bug, runtime edition) fires exactly one
+    ``lockheld:<name>`` dump via the supervisor's
+    check_dispatch_clear hook; the dispatch itself still completes
+    (detection, not prevention) and a clear thread stays silent."""
+    import json as _json
+
+    from pint_tpu import obs
+    from pint_tpu.obs import metrics as om
+    from pint_tpu.runtime import locks
+
+    obs.configure(enabled=True, flight_dir=str(tmp_path))
+    locks.configure(enabled=True)
+    eng = locks.make_rlock("serve.engine", engine=True)
+    sup = DispatchSupervisor()
+    with eng:
+        assert sup.dispatch(lambda: 11, key="under_lock") == 11
+        assert sup.dispatch(lambda: 12, key="under_lock") == 12
+    dumps = list(tmp_path.glob("flight-*lockheld*.json"))
+    assert len(dumps) == 1
+    doc = _json.loads(dumps[0].read_text())
+    assert doc["reason"] == "lockheld:serve.engine"
+    assert locks.status()["held_fired"] == 1
+    # released: further dispatches are clean, no second episode
+    assert sup.dispatch(lambda: 13, key="under_lock") == 13
+    assert len(list(tmp_path.glob("flight-*lockheld*.json"))) == 1

@@ -173,7 +173,7 @@ def _run_driver(name: str) -> Optional[dict]:
     import subprocess
 
     argv_tail, timeout_s = DRIVERS[name]
-    env = dict(os.environ)
+    env = dict(os.environ)  # graftlint: allow G17 -- whole-env passthrough to the bench subprocess (forwards, never parses)
     env.setdefault("PINT_TPU_BENCH_FALLBACK", "1")
     try:
         r = subprocess.run(

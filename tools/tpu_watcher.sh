@@ -25,6 +25,12 @@ if ! flock -n 9; then
   echo "$(date -u '+%F %T') another tpu_watcher holds $LOCKFILE; exiting" >>"$LOG"
   exit 0
 fi
+# the watcher exists ONLY for on-chip capture: a JAX_PLATFORMS=cpu
+# inherited from the launching shell would make the probe see CPU
+# devices and report the tunnel "ALIVE" forever (every stage then
+# no-ops with rc=3, observed 2026-08-07) — strip it, and make the
+# probe require an actual TPU device, not just an answer
+unset JAX_PLATFORMS
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-150}
 STAGE_TIMEOUT=${STAGE_TIMEOUT:-2400}
 SLEEP_S=${SLEEP_S:-530}
@@ -58,7 +64,8 @@ while :; do
     exit 0
   fi
   if timeout "$PROBE_TIMEOUT" python -c \
-      "import jax; jax.devices()" >/dev/null 2>&1; then
+      "import jax; assert any(d.platform == 'tpu' for d in jax.devices())" \
+      >/dev/null 2>&1; then
     say "tunnel ALIVE; remaining stages: $rem"
     for st in $rem; do
       say "stage $st starting"
@@ -76,7 +83,8 @@ while :; do
       # stage failed AND probe now dead -> window closed, back to poll
       if [ "$rc" -ne 0 ]; then
         if ! timeout "$PROBE_TIMEOUT" python -c \
-            "import jax; jax.devices()" >/dev/null 2>&1; then
+            "import jax; assert any(d.platform == 'tpu' for d in jax.devices())" \
+            >/dev/null 2>&1; then
           say "tunnel died mid-window"
           break
         fi
