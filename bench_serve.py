@@ -330,6 +330,132 @@ def run_degraded(nreq: int = 64) -> dict:
     }
 
 
+def run_fleet(nreq: int = 48) -> dict:
+    """3-worker kill-one throughput curve (ISSUE 19, the
+    ``fleet_degraded`` capture stage): a ``FleetFront`` over three
+    sync-mode workers serves a fit burst at full strength
+    (baseline), then the same burst with one worker KILLED mid-burst
+    — its journaled in-flight requests re-home onto the survivors
+    and the degraded wall INCLUDES the sweep + re-home replay — then
+    a clean survivors-only pass (recovered). The guarantee under
+    test: lose a worker, lose ~1/N capacity and ZERO requests
+    (``lost`` must be 0; every re-homed future resolves from a
+    survivor). Shape warm-up covers BOTH batch paddings (16 at full
+    strength, 32 on the survivors) so the degraded number measures
+    re-home + serving, not compiles."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    from pint_tpu.parallel.pta import build_problem
+    from pint_tpu.serve import FitStepRequest, FleetFront
+    from pint_tpu.serve.workload import synth_pulsar
+
+    nwork = 3
+    pulsars = {k: synth_pulsar(k, 40, base=5100) for k in (0, 1, 2)}
+    stock = {k: build_problem(t, m) for k, (m, t) in pulsars.items()}
+
+    def factory(payload):
+        return FitStepRequest(problem=stock[payload["k"]],
+                              payload=payload)
+
+    def burst(n):
+        return [factory({"k": i % len(stock)}) for i in range(n)]
+
+    tmp = tempfile.mkdtemp(prefix="pint_tpu_fleet_bench_")
+    front = FleetFront(factory, n=nwork,
+                       journal=os.path.join(tmp, "fleet.jsonl"),
+                       heartbeat_s=3600.0, lease_ttl_s=7200.0,
+                       start=False)
+
+    def flush_live():
+        for wid in front.live_workers():
+            front.workers[wid].engine.flush()
+
+    rehomed_mid = 0
+
+    def drive(reqs, kill_at=None):
+        nonlocal rehomed_mid
+        lost = 0
+        t0 = time.perf_counter()
+        futs = []
+        for i, r in enumerate(reqs):
+            if kill_at is not None and i == kill_at:
+                front.kill_worker("w1")
+                rehomed_mid = front.sweep()
+            futs.append(front.submit(r))
+        flush_live()
+        for f in futs:
+            try:
+                f.result(timeout=0)
+            except Exception:
+                lost += 1
+        return time.perf_counter() - t0, lost
+
+    try:
+        # warm-up: full-strength shapes (Pb=16 per worker at
+        # nreq=48) AND the survivor shapes (Pb=32: 64 reqs over 3
+        # workers pads each worker's bucket to 32 — the same padding
+        # the two survivors see post-kill)
+        drive(burst(nreq))
+        drive(burst(64))
+        base_wall = min(drive(burst(nreq))[0] for _ in range(2))
+        deg_wall, lost = drive(burst(nreq), kill_at=nreq // 2)
+        rec_wall = min(drive(burst(nreq))[0] for _ in range(2))
+        # read every post-mortem surface BEFORE stop() tears the
+        # engines down and the tempdir (journal included) goes away
+        snap = front.metrics.snapshot()
+        live = front.live_workers()
+        pools = front.health_blocks()
+        unacked = len(
+            front.workers["w0"].engine.journal.unacknowledged())
+    finally:
+        try:
+            front.stop()
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    fleet = snap.get("fleet") or {}
+    base_rps = nreq / base_wall if base_wall else None
+    deg_rps = nreq / deg_wall if deg_wall else None
+    rec_rps = nreq / rec_wall if rec_wall else None
+    rec = {
+        "metric": "fleet_degraded",
+        "backend": jax.default_backend(),
+        "unit": "frac",
+        # the headline: degraded-vs-baseline served throughput with
+        # a third of the fleet dead MID-burst (ideal ~2/3 minus the
+        # sweep + re-home replay tax)
+        "value": round(deg_rps / base_rps, 3) if base_rps else None,
+        "nreq": nreq,
+        "workers": nwork,
+        "killed": "w1",
+        "live": live,
+        "lost": lost,                       # must be 0 — the guarantee
+        "rehomed": rehomed_mid,
+        "counters": fleet.get("counters"),
+        "states": fleet.get("workers"),
+        "baseline_req_per_s": round(base_rps, 1),
+        "degraded_req_per_s": round(deg_rps, 1),
+        "recovered_req_per_s": round(rec_rps, 1),
+        "recovered_vs_baseline": round(rec_rps / base_rps, 3),
+        "journal_unacked": unacked,
+        "dispatch_supervisor": snap.get("dispatch"),
+        "pools": pools,
+        "latency": snap.get("latency"),
+        "lint": _lint_block(),
+    }
+    try:
+        import bench as _bench
+
+        _bench.attach_regress(rec)
+    except Exception:
+        pass
+    return rec
+
+
 def run_append(ntoa: int = 100_000, nnew: int = 128) -> dict:
     """Incremental-append-vs-cold-refit at the 100k-TOA scale
     (ISSUE 12 acceptance): a cold ``AppendTOAsRequest`` accumulates
@@ -448,6 +574,11 @@ def main():
                     help="measure coalesced-vs-shed throughput "
                          "under injected overload instead of the "
                          "speedup artifact")
+    ap.add_argument("--fleet", action="store_true",
+                    help="measure the 3-worker kill-one fleet "
+                         "throughput curve (baseline / degraded "
+                         "mid-kill with re-home / recovered) "
+                         "instead of the speedup artifact")
     ap.add_argument("--append", action="store_true",
                     help="measure incremental AppendTOAsRequest "
                          "re-convergence vs a cold refit at the "
@@ -486,6 +617,8 @@ def main():
 
     if args.degraded:
         rec = run_degraded(nreq=args.nreq)
+    elif args.fleet:
+        rec = run_fleet()
     elif args.append:
         rec = run_append(ntoa=args.append_ntoa,
                          nnew=args.append_new)

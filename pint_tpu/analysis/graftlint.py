@@ -1010,6 +1010,9 @@ G13_COUNTER_NAMES = frozenset({
     "cg_budget_exhausted",
     # array GWB likelihood plane (ISSUE 17)
     "gwb_solves", "block_assemblies", "hd_outer_solves",
+    # serve fleet / journal hardening (ISSUE 19)
+    "rehomed", "lease_expiries", "worker_kills", "heartbeats",
+    "torn_records",
 })
 
 

@@ -130,6 +130,32 @@ GUARDED = [
          field="_next_compact", lock="_lock", holders=(),
          why="compaction hysteresis threshold, written only by "
              "_compact_locked (suffix convention) after a rewrite."),
+    dict(file="pint_tpu/serve/journal.py", cls="RequestJournal",
+         field="_torn_seen", lock="_lock", holders=(),
+         why="damaged-record dedup set behind the torn-record "
+             "counter (ISSUE 19): written only in __init__ and "
+             "_torn_locked (suffix convention — every _scan caller "
+             "holds the journal lock); an unlocked add double-counts "
+             "a torn line against a concurrent compaction scan."),
+    # ------------------------------------------------ serve fleet
+    dict(file="pint_tpu/serve/fleet.py", cls="FleetFront",
+         field="_state", lock="_lock", holders=(),
+         why="worker lifecycle latch (live -> dead -> rehomed): the "
+             "sweep's fence + re-home transition and submit's "
+             "live-set pick must observe it atomically, or two "
+             "sweeps re-home the same dead worker's admits twice "
+             "(double-replay = double-serve)."),
+    dict(file="pint_tpu/serve/fleet.py", cls="FleetFront",
+         field="_rr", lock="_lock", holders=(),
+         why="round-robin cursor behind the live-worker pick; torn "
+             "increments skew placement, harmless but the lock is "
+             "already held for the live-set read."),
+    dict(file="pint_tpu/serve/fleet.py", cls="FleetFront",
+         field="_inflight", lock="_lock", holders=(),
+         why="rid -> original-request map the re-home pass resolves "
+             "survivor results into: insert (submit track), pop "
+             "(future done callback) and the re-home lookup run on "
+             "three different threads."),
 ]
 
 # ---------------------------------------------------------------- G16.3

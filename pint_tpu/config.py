@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import os
 from pathlib import Path
-from typing import Optional
+from typing import Optional, Tuple
 
 __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "obs_override", "enable_compile_cache", "solve_device",
@@ -39,7 +39,8 @@ __all__ = ["datadir", "runtimefile", "clock_dir", "ephem_dir",
            "health_chi2_factor", "health_resid_sigma",
            "health_cg_budget_frac", "perf_enabled",
            "compile_ledger_path", "profile_dir", "profile_max_s",
-           "lock_trace_enabled"]
+           "lock_trace_enabled", "pool_spec", "fleet_lease_ttl_s",
+           "fleet_heartbeat_s", "fleet_workers"]
 
 _RTT_MS: dict = {}
 _WARNED_ENV: set = set()
@@ -1143,3 +1144,71 @@ def serve_pipeline_depth() -> int:
     scatter, next)."""
     return max(1, int(_env_number("PINT_TPU_SERVE_PIPELINE", 2,
                                   cast=int)))
+
+
+# ------------------------------------------------ serve fleet (ISSUE 19)
+
+
+def pool_spec() -> Optional[Tuple[str, ...]]:
+    """Named capacity pools for the serve router ($PINT_TPU_POOLS,
+    comma-separated; None = the classic {"device", "host"} pair).
+    The spec must contain "device" and "host" — the engine's jitted
+    executables and the numpy failover mirrors are structural, every
+    extra name is an additional device-class pool with its own
+    ``runtime.breaker`` instance and learned EWMA rates. Names must
+    be identifier-ish ([a-z0-9_-]); a malformed spec warns once and
+    is ignored (classic pools), never half-applied."""
+    raw = os.environ.get("PINT_TPU_POOLS", "")
+    if not raw:
+        return None
+    names = tuple(s.strip() for s in raw.split(",") if s.strip())
+    ok = (len(names) == len(set(names)) and "device" in names
+          and "host" in names
+          and all(n.replace("_", "").replace("-", "").isalnum()
+                  and n == n.lower() for n in names))
+    if not ok:
+        if ("PINT_TPU_POOLS", raw) not in _WARNED_ENV:
+            _WARNED_ENV.add(("PINT_TPU_POOLS", raw))
+            from pint_tpu.logging import log
+
+            log.warning(
+                "malformed $PINT_TPU_POOLS=%r (want unique "
+                "lowercase comma-separated names including "
+                "'device' and 'host'); using the classic pools",
+                raw)
+        return None
+    return names
+
+
+def fleet_lease_ttl_s() -> float:
+    """Worker lease time-to-live [s] ($PINT_TPU_FLEET_LEASE_TTL_S,
+    default 15): a fleet worker whose newest journal heartbeat is
+    older than this is declared dead at the front's next sweep and
+    its unacknowledged requests are re-homed onto survivors.
+    Validated finite positive (warn-and-ignore otherwise)."""
+    return _env_positive_float("PINT_TPU_FLEET_LEASE_TTL_S", 15.0)
+
+
+def fleet_heartbeat_s() -> float:
+    """Worker heartbeat period [s] ($PINT_TPU_FLEET_HEARTBEAT_S,
+    default 5): each live worker appends a journal heartbeat record
+    this often. Validated finite positive; values at or above the
+    lease TTL are clamped to TTL/3 (a heartbeat slower than the
+    lease it renews would expire every healthy worker)."""
+    v = _env_positive_float("PINT_TPU_FLEET_HEARTBEAT_S", 5.0)
+    ttl = fleet_lease_ttl_s()
+    if v >= ttl:
+        _warn_env_range("PINT_TPU_FLEET_HEARTBEAT_S", ttl / 3.0)
+        return ttl / 3.0
+    return v
+
+
+def fleet_workers() -> int:
+    """Default fleet size for ``pint_serve --fleet`` / the fleet
+    bench ($PINT_TPU_FLEET_WORKERS, default 3, min 1). Validated
+    positive int; warn-and-ignore otherwise."""
+    v = int(_env_number("PINT_TPU_FLEET_WORKERS", 3, cast=int))
+    if v < 1:
+        _warn_env_range("PINT_TPU_FLEET_WORKERS", 3)
+        return 3
+    return v

@@ -50,7 +50,17 @@ KINDS = ("hang", "error", "nan", "rtt_drift",
          # simulated SIGKILL: in-flight futures die with the engine,
          # journal entries stay unacknowledged, and the restart path
          # (AOT restore + journal replay) is what recovers them.
-         "overload", "tenant_burst", "kill_restart")
+         "overload", "tenant_burst", "kill_restart",
+         # fleet kinds (ISSUE 19), consumed by serve.fleet:
+         # "worker_kill" kills one named fleet worker mid-burst (its
+         # engine dies like kill_restart, its lease stops beating,
+         # and the front's expiry sweep re-homes its unacked journal
+         # entries onto survivors), "lease_expire" forces one
+         # worker's lease to read as expired at the front's next
+         # sweep without killing the engine (a live worker whose
+         # heartbeats stopped reaching the journal — the split-brain
+         # case the ownership transfer must stay safe under).
+         "worker_kill", "lease_expire")
 
 
 class TransientFault(RuntimeError):

@@ -696,17 +696,24 @@ class DispatchSupervisor:
         with self._inflight_lock:
             return self._inflight
 
-    def pool_health(self) -> dict:
+    def pool_health(self, pools=None) -> dict:
         """Capacity-pool health surface for the serve router (ISSUE
         8): the device pool's breaker state + in-flight depth, and
         the host pool (always available — the local host cannot
         wedge like the tunnel; its 'breaker' is definitionally
         closed). Read-only: consulting this never probes the
-        backend, so it is safe to call per routing decision."""
+        backend, so it is safe to call per routing decision.
+
+        ``pools`` (ISSUE 19) names EXTRA device-class pools beyond
+        the classic pair: each gets its own process-global
+        ``runtime.breaker`` instance keyed ``pool:<name>`` (an open
+        breaker demotes only that pool), reported alongside device/
+        host in the same shape — the surface the N-pool router and
+        the /healthz ``pools`` block read."""
         import jax
 
         backend = jax.default_backend()
-        return {
+        out = {
             "device": {
                 "backend": backend,
                 "breaker": breaker_for(backend).snapshot(),
@@ -715,6 +722,15 @@ class DispatchSupervisor:
             },
             "host": {"backend": "cpu", "open": False},
         }
+        for name in pools or ():
+            if name in out:
+                continue
+            br = breaker_for(f"pool:{name}")
+            out[name] = {"backend": f"pool:{name}",
+                         "breaker": br.snapshot(),
+                         "open": br.is_open,
+                         "inflight": 0}
+        return out
 
     def note_failover(self, key: str, exc: BaseException, sp=None):
         """Record a failover — performed by the CALL SITE (the

@@ -253,19 +253,89 @@ def _polycos_for(cache, par, obs, mjd_lo, mjd_hi, seg_min):
     return cache[key]
 
 
-def _submit_line(engine, cache, rec, emit, report, ack=None):
+def _posterior_request(cache, rec, deadline_s, tenant,
+                       payload=None):
+    """Build one quantized PosteriorRequest from a line record —
+    shared by the submit path and the fleet replay factory (the
+    quantization below must be identical in both or a re-homed chain
+    lands in a different shape class than the original)."""
+    from pint_tpu.parallel.pta import build_problem
+    from pint_tpu.serve import PosteriorRequest
+    from pint_tpu.serve.bucket import pow2_ceil
+
+    model, toas = _load_pair(cache, rec["par"], rec["tim"])
+    problem = build_problem(toas, model)
+    # client-facing quantization: nwalkers/thin ride EXACTLY in
+    # the posterior compile key (they are compile-time constants
+    # of the scan program), so arbitrary client values would mean
+    # one multi-second XLA compile per distinct request shape.
+    # Pow2-quantize both (more walkers is strictly better
+    # sampling; nsteps rounds up to stay a thin multiple) so
+    # compiles stay bounded by class count, not traffic. The
+    # walker FLOOR comes from the problem's real dimension count
+    # (the 2*ndim ensemble guard), so a default request never
+    # hard-fails on a wide model; nsteps is capped so one
+    # request cannot monopolize a pool with an unbounded
+    # sequential chunk loop.
+    p = problem.M.shape[1]
+    W = max(int(rec.get("nwalkers", 32)), 2 * p + 2)
+    W = min(1024, max(8, pow2_ceil(W)))
+    thin = min(16, max(1, pow2_ceil(int(rec.get("thin", 1)))))
+    nsteps = min(int(rec.get("nsteps", 500)), 1_000_000)
+    nsteps = ((nsteps + thin - 1) // thin) * thin
+    return PosteriorRequest(
+        problem=problem, nwalkers=W, nsteps=nsteps,
+        seed=int(rec.get("seed", 0)), thin=thin,
+        deadline_s=deadline_s, tenant=tenant, payload=payload)
+
+
+def _line_factory(cache):
+    """Fleet replay factory (ISSUE 19): rebuild a single-submission
+    request from its journaled line record. Re-homing resolves the
+    ORIGINAL caller's future with the rebuilt request's result, so
+    the daemon's emission callback stays wired to the original."""
+
+    def factory(payload):
+        from pint_tpu.serve import FitStepRequest, ResidualsRequest
+
+        kind = payload.get("kind", "fit_step")
+        deadline_s = payload["deadline_ms"] / 1e3 \
+            if payload.get("deadline_ms") is not None else None
+        tenant = payload.get("tenant")
+        if kind in ("fit_step", "residuals"):
+            model, toas = _load_pair(cache, payload["par"],
+                                     payload["tim"])
+            cls = FitStepRequest if kind == "fit_step" \
+                else ResidualsRequest
+            return cls(toas, model, deadline_s=deadline_s,
+                       tenant=tenant, payload=payload)
+        if kind == "posterior":
+            return _posterior_request(cache, payload, deadline_s,
+                                      tenant, payload=payload)
+        raise ValueError(f"kind {kind!r} is not fleet-replayable")
+
+    return factory
+
+
+def _submit_line(engine, cache, rec, emit, report, ack=None,
+                 journal_payload=False):
     """Parse one request record and submit it; wire result emission
     through the future's done-callback so the daemon never blocks on
     a single request. Returns the number of requests actually
     submitted (= the number of ``emit`` calls this line will
     eventually produce — the pending-semaphore contract); failures
-    that submit NOTHING go through ``report`` (uncounted)."""
+    that submit NOTHING go through ``report`` (uncounted).
+
+    ``journal_payload=True`` (fleet mode) attaches the line record
+    as the request payload for single-submission kinds, so the
+    WORKER engine journals it with an owner and a lost worker's
+    requests re-home; phase fan-outs stay unjournaled (several
+    requests per line — a line-level replay covers them instead)."""
     import numpy as np
 
     from pint_tpu.serve import (
         FitStepRequest,
         PhasePredictRequest,
-        PosteriorRequest,
         ResidualsRequest,
         ShutdownShed,
     )
@@ -369,43 +439,19 @@ def _submit_line(engine, cache, rec, emit, report, ack=None):
             emit(out)
         return cb
 
+    payload = rec if journal_payload else None
     if kind in ("fit_step", "residuals"):
         model, toas = _load_pair(cache, rec["par"], rec["tim"])
         cls = FitStepRequest if kind == "fit_step" else ResidualsRequest
         fut = engine.submit(cls(toas, model, deadline_s=deadline_s,
-                                tenant=tenant))
+                                tenant=tenant, payload=payload))
         fut.add_done_callback(finish(kind))
         if ack is not None:
             ack.expect(1)
         return 1
     if kind == "posterior":
-        from pint_tpu.parallel.pta import build_problem
-        from pint_tpu.serve.bucket import pow2_ceil
-
-        model, toas = _load_pair(cache, rec["par"], rec["tim"])
-        problem = build_problem(toas, model)
-        # client-facing quantization: nwalkers/thin ride EXACTLY in
-        # the posterior compile key (they are compile-time constants
-        # of the scan program), so arbitrary client values would mean
-        # one multi-second XLA compile per distinct request shape.
-        # Pow2-quantize both (more walkers is strictly better
-        # sampling; nsteps rounds up to stay a thin multiple) so
-        # compiles stay bounded by class count, not traffic. The
-        # walker FLOOR comes from the problem's real dimension count
-        # (the 2*ndim ensemble guard), so a default request never
-        # hard-fails on a wide model; nsteps is capped so one
-        # request cannot monopolize a pool with an unbounded
-        # sequential chunk loop.
-        p = problem.M.shape[1]
-        W = max(int(rec.get("nwalkers", 32)), 2 * p + 2)
-        W = min(1024, max(8, pow2_ceil(W)))
-        thin = min(16, max(1, pow2_ceil(int(rec.get("thin", 1)))))
-        nsteps = min(int(rec.get("nsteps", 500)), 1_000_000)
-        nsteps = ((nsteps + thin - 1) // thin) * thin
-        fut = engine.submit(PosteriorRequest(
-            problem=problem, nwalkers=W, nsteps=nsteps,
-            seed=int(rec.get("seed", 0)), thin=thin,
-            deadline_s=deadline_s, tenant=tenant))
+        fut = engine.submit(_posterior_request(
+            cache, rec, deadline_s, tenant, payload=payload))
         fut.add_done_callback(finish(kind))
         if ack is not None:
             ack.expect(1)
@@ -493,7 +539,22 @@ def main(argv=None, stdin=None) -> int:
                         "this port (0 = ephemeral, announced as an "
                         "event line; default $PINT_TPU_METRICS_PORT "
                         "or off)")
+    p.add_argument("--worker-id", default=None, metavar="ID",
+                   help="fleet worker identity (ISSUE 19): admits "
+                        "are owner-stamped, a lease heartbeat rides "
+                        "the shared journal, and restart replay is "
+                        "scoped to THIS worker's records — one "
+                        "pint_serve --worker-id per process over a "
+                        "shared --journal is the cross-process fleet")
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="run N in-process fleet workers over one "
+                        "shared journal (FleetFront: lease expiry "
+                        "re-homes a dead worker's requests onto "
+                        "survivors); requires --journal")
     args = p.parse_args(argv)
+    if args.fleet is not None and args.worker_id is not None:
+        p.error("--fleet and --worker-id are mutually exclusive "
+                "(the front names its own workers)")
 
     # handlers BEFORE the pint_tpu/jax import: startup takes seconds
     # (jax init, AOT restore), and a signal landing in that window
@@ -515,30 +576,68 @@ def main(argv=None, stdin=None) -> int:
 
             obs.configure(stream=args.trace_jsonl)
 
+        from pint_tpu import config as _config
         from pint_tpu.serve import ServeEngine
 
-        engine = ServeEngine(
+        # (par, tim) cache: hoisted above engine construction because
+        # the fleet replay factory closes over it — re-homed requests
+        # rebuild against the same loaded pulsars as stdin ones
+        cache: dict = {}
+        fleet = None
+        worker_lease = None
+        engine_kw = dict(
             window_s=None if args.window_ms is None
             else args.window_ms / 1e3,
-            max_batch=args.max_batch, queue_cap=args.queue_cap,
-            aot_dir=args.aot_dir, journal=args.journal)
+            max_batch=args.max_batch, queue_cap=args.queue_cap)
+        if args.fleet is not None:
+            from pint_tpu.serve import FleetFront
+
+            journal_path = args.journal
+            if journal_path is None:
+                journal_path = _config.journal_path()
+            if journal_path is None:
+                p.error("--fleet requires --journal (the shared "
+                        "replicated log is the fleet's ownership "
+                        "protocol)")
+            engine = fleet = FleetFront(
+                factory=_line_factory(cache), n=args.fleet,
+                journal=journal_path, aot_dir=args.aot_dir,
+                engine_kwargs=engine_kw, start=False)
+        else:
+            engine = ServeEngine(
+                aot_dir=args.aot_dir, journal=args.journal,
+                worker_id=args.worker_id, **engine_kw)
+            if args.worker_id is not None and \
+                    engine.journal is not None:
+                from pint_tpu.serve import WorkerLease
+
+                worker_lease = WorkerLease(engine.journal,
+                                           args.worker_id)
+                worker_lease.start()
 
         # metrics plane (ISSUE 11): /metrics + /healthz on a stdlib
         # daemon thread — reads registry/breaker state only, never
         # the engine lock, so a scrape cannot perturb admission or
         # an in-flight drain
         metrics_srv = None
-        from pint_tpu import config as _config
-
         mport = args.metrics_port if args.metrics_port is not None \
             else _config.metrics_port()
         if mport is not None:
             from pint_tpu.obs import metrics as _om
 
-            def _health(engine=engine, _om=_om):
+            def _health(engine=engine, fleet=fleet, _om=_om):
                 h = _om.default_health()
                 try:
-                    h["pools"] = engine.supervisor.pool_health()
+                    # ISSUE 19: per-pool breaker state + learned EWMA
+                    # rate + in-flight depth — router leaf-lock reads
+                    # only, never an engine lock (the scrape contract
+                    # tests/test_metrics.py asserts by holding
+                    # eng._lock while hitting /healthz)
+                    if fleet is not None:
+                        h["pools"] = fleet.health_blocks()
+                        h["fleet"] = {"live": fleet.live_workers()}
+                    else:
+                        h["pools"] = engine.router.health_block()
                 except Exception:
                     pass
                 return h
@@ -606,7 +705,13 @@ def main(argv=None, stdin=None) -> int:
                     "drain_timeout_s": drain_timeout})
     else:
         engine.start()
-        cache: dict = {}
+
+        def fleet_emit(obj, status="served"):
+            # fleet mode: the WORKER engine journals each single-
+            # submission request (payload = the line record, owner =
+            # the worker) so re-homing works at request granularity;
+            # the line-level journal + _LineAck stay out of the way
+            raw_emit(obj)
 
         def handle(rec):
             nonlocal nsub
@@ -617,11 +722,16 @@ def main(argv=None, stdin=None) -> int:
                 # ack; a profile window is a point-in-time act)
                 _submit_line(engine, cache, rec, None, report)
                 return
+            if fleet is not None:
+                nsub += _submit_line(engine, cache, rec, fleet_emit,
+                                     report, journal_payload=True)
+                return
             rid = rec.get("id") or uuid.uuid4().hex
             ack = _LineAck(engine.journal, rid)
             if engine.journal is not None:
                 engine.journal.admit(rid, rec,
-                                     tenant=rec.get("tenant"))
+                                     tenant=rec.get("tenant"),
+                                     worker=args.worker_id)
 
             def emit(obj, status="served", _ack=ack):
                 raw_emit(obj)
@@ -648,11 +758,38 @@ def main(argv=None, stdin=None) -> int:
         def replay_journal():
             """Re-admit the records a previous process died holding
             (no terminal ack in the journal). Runs BEFORE stdin so
-            recovered work is first in line."""
+            recovered work is first in line. Worker mode scopes the
+            replay to THIS worker's owner-stamped records — a peer's
+            unacked work belongs to its lease (the fleet re-home
+            protocol moves it, not a restart); fleet mode replays
+            everything (the front owns the whole journal)."""
             nonlocal nsub
             if engine.journal is None:
                 return
-            for jrec in engine.journal.unacknowledged():
+            if fleet is not None:
+                # engine-level records: the payload IS the line
+                # record, so the stale rid acks terminally and the
+                # work resubmits fresh (new rid, new owner) through
+                # the same path stdin takes
+                for jrec in engine.journal.unacknowledged():
+                    rec = jrec.get("payload") or {}
+                    engine.journal.ack(jrec["rid"], "replayed")
+                    try:
+                        n = _submit_line(engine, cache, rec,
+                                         fleet_emit, report,
+                                         journal_payload=True)
+                        nsub += n
+                        ri = engine.metrics.restart_info
+                        ri["replayed"] = ri.get("replayed", 0) + n
+                    except _Shutdown:
+                        raise
+                    except Exception as e:
+                        report({"id": rec.get("id"), "ok": False,
+                                "error": f"replay: "
+                                         f"{type(e).__name__}: {e}"})
+                return
+            for jrec in engine.journal.unacknowledged(
+                    owner=args.worker_id):
                 rec = jrec.get("payload") or {}
                 engine.journal.ack(jrec["rid"], "replayed")
                 ack = _LineAck(engine.journal, jrec["rid"])
@@ -713,6 +850,10 @@ def main(argv=None, stdin=None) -> int:
 
         obs.flight_dump("sigterm_drain", signal=shutdown_reason,
                         drain_timeout_s=drain_timeout)
+    if worker_lease is not None:
+        # stop heartbeating BEFORE the drain: a peer's sweep must be
+        # free to re-home whatever this worker cannot drain in time
+        worker_lease.stop()
     engine.stop(drain=True,
                 timeout=drain_timeout if shutdown_reason else None)
     for _ in range(nsub):

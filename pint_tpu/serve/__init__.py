@@ -27,7 +27,12 @@ half of that is here. The moving parts (one module each):
 - ``serve.journal`` (ISSUE 8): crash-safe restart — append-only
   request journal with replay, jax.export AOT bucket executables
   (warm restart serves its first request with zero new compiles),
-  serve-state snapshot.
+  serve-state snapshot;
+- ``serve.fleet`` (ISSUE 19): N workers over one journal-as-
+  replicated-log — worker leases with journal heartbeats,
+  missed-lease fencing, and re-homing of a dead worker's
+  unacknowledged admits onto survivors (lose a worker, lose 1/N
+  capacity and zero accepted requests).
 
 Every device dispatch routes through the engine's
 ``pint_tpu.runtime.DispatchSupervisor`` (watchdog deadline, circuit
@@ -81,4 +86,9 @@ from pint_tpu.serve.router import CapacityRouter  # noqa: F401
 from pint_tpu.serve.journal import (  # noqa: F401
     AotStore,
     RequestJournal,
+)
+from pint_tpu.serve.fleet import (  # noqa: F401
+    FleetFront,
+    FleetWorker,
+    WorkerLease,
 )

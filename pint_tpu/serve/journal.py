@@ -48,6 +48,7 @@ import hashlib
 import json
 import os
 import threading
+import time
 
 from pint_tpu.runtime import locks
 from typing import Callable, Dict, List, Optional, Tuple
@@ -73,6 +74,28 @@ class RequestJournal:
     in admit order — the replay set. "replayed" is a progress marker
     (the restarted engine re-admitted the entry), not a terminal
     status; a crash DURING replay leaves the entry replayable again.
+
+    **Fleet ownership protocol** (ISSUE 19): the journal doubles as
+    the fleet's replicated log. Admit records may carry a
+    ``"worker"`` owner; ``lease``/``heartbeat`` records register a
+    worker and renew its lease (``workers()`` reads the newest
+    heartbeat per worker); a ``rehome`` record transfers an admit's
+    ownership to a survivor (applied at scan time, so
+    ``unacknowledged(owner=...)`` — the per-worker replay set —
+    always reflects the LAST recorded owner and a re-homed entry is
+    never replayed twice by two workers)::
+
+        {"op": "lease",     "worker": W, "t": ...}
+        {"op": "heartbeat", "worker": W, "t": ...}
+        {"op": "rehome",    "rid": ..., "worker": W}
+
+    **Torn-record hardening** (ISSUE 19 satellite): a crash
+    mid-append leaves a partial last line, and records interleaved
+    around a ``compact()`` can leave stale bytes; every scan
+    warn-and-skips any unparseable (or non-object) record — counted
+    once per distinct record in ``pint_tpu_journal_torn_records`` —
+    and NEVER raises: a damaged journal degrades to a smaller replay
+    set, not a dead restart path.
 
     Long-running chunked work (a posterior chain) additionally writes
     ``progress`` lines between its chunk dispatches — non-terminal
@@ -103,10 +126,19 @@ class RequestJournal:
         self._fh = None
         # ISSUE 11: compaction count rides the metric registry (the
         # counts() dict reads it back — derived view, G13-clean)
+        _scope = om.new_scope("journal")
         self._c_compactions = om.counter(
             "pint_tpu_journal_compactions_total",
             "journal auto/explicit compactions"
-        ).child(scope=om.new_scope("journal"))
+        ).child(scope=_scope)
+        # ISSUE 19 satellite: unparseable records warn-and-skip at
+        # scan, counted once per distinct damaged line (scans repeat;
+        # the damage does not)
+        self._c_torn = om.counter(
+            "pint_tpu_journal_torn_records",
+            "unparseable journal records skipped at scan"
+        ).child(scope=_scope)
+        self._torn_seen: set = set()
         if compact_bytes is None:
             from pint_tpu import config
 
@@ -150,16 +182,41 @@ class RequestJournal:
 
     def admit(self, rid: str, payload: dict,
               tenant: Optional[str] = None,
-              deadline_s: Optional[float] = None):
+              deadline_s: Optional[float] = None,
+              worker: Optional[str] = None):
         rec = {"op": "admit", "rid": rid, "payload": payload}
         if tenant is not None:
             rec["tenant"] = tenant
         if deadline_s is not None:
             rec["deadline_s"] = deadline_s
+        if worker is not None:
+            rec["worker"] = worker
         self._append(rec)
 
     def ack(self, rid: str, status: str):
         self._append({"op": "ack", "rid": rid, "status": status})
+
+    # -- fleet ownership (ISSUE 19) ------------------------------------
+
+    def lease(self, worker: str):
+        """Register ``worker`` as a fleet member (first heartbeat)."""
+        self._append({"op": "lease", "worker": worker,
+                      "t": time.time()})
+
+    def heartbeat(self, worker: str):
+        """Renew ``worker``'s lease. The fleet front's expiry sweep
+        compares the newest heartbeat per worker against the lease
+        TTL — a worker whose beats stop (killed OR partitioned from
+        the journal) reads as expired and its unacked admits are
+        re-homed."""
+        self._append({"op": "heartbeat", "worker": worker,
+                      "t": time.time()})
+
+    def rehome(self, rid: str, worker: str):
+        """Transfer ownership of one admit to ``worker``. Applied at
+        scan time (last rehome wins), so the per-owner replay set
+        moves with the record and survives compaction."""
+        self._append({"op": "rehome", "rid": rid, "worker": worker})
 
     def progress(self, rid: str, steps: int):
         """Non-terminal progress mark for chunked work (a posterior
@@ -181,10 +238,19 @@ class RequestJournal:
 
     def _compact_locked(self):
         keep = self.unacknowledged_unlocked()
+        # fleet liveness survives compaction: one heartbeat record
+        # per leased worker at its newest recorded time (ISSUE 19 —
+        # compacting mid-fleet must not make every worker read as
+        # never-leased / instantly-expired)
+        _, _, beats = self._scan()
         tmp = self.path + ".tmp"
         with open(tmp, "w", encoding="utf-8") as fh:
             for rec in keep:
                 fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            for w in sorted(beats):
+                fh.write(json.dumps(
+                    {"op": "heartbeat", "worker": w, "t": beats[w]},
+                    sort_keys=True) + "\n")
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, self.path)
@@ -218,9 +284,28 @@ class RequestJournal:
 
     # -- reads ---------------------------------------------------------
 
-    def _scan(self) -> Tuple[List[dict], Dict[str, str]]:
+    def _torn_locked(self, line: str):
+        """Count one unparseable record, once per distinct line —
+        scans repeat every restart/compaction; the damage does not.
+        Warn-and-skip, NEVER raise (ISSUE 19 satellite)."""
+        h = hashlib.sha256(line.encode("utf-8", "replace")).digest()
+        if h in self._torn_seen:
+            return
+        self._torn_seen.add(h)
+        self._c_torn.inc()
+        _log().warning("journal %s: skipping torn/unparseable "
+                       "record (%d bytes)", self.path, len(line))
+
+    def _scan(self) -> Tuple[List[dict], Dict[str, str],
+                             Dict[str, float]]:
+        """One pass over the file: (admits with ownership rehomes
+        applied, terminal acks by rid, newest heartbeat per worker).
+        Callers hold ``self._lock`` (scan races auto-compaction's
+        rewrite+rename otherwise)."""
         admits: List[dict] = []
         acks: Dict[str, str] = {}
+        beats: Dict[str, float] = {}
+        rehomes: Dict[str, str] = {}
         try:
             with open(self.path, encoding="utf-8") as fh:
                 for line in fh:
@@ -230,19 +315,47 @@ class RequestJournal:
                     try:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
-                        continue  # torn tail line from a crash
-                    if rec.get("op") == "admit":
+                        self._torn_locked(line)
+                        continue
+                    if not isinstance(rec, dict):
+                        # parses but is not a record (a bare scalar
+                        # from interleaved torn writes)
+                        self._torn_locked(line)
+                        continue
+                    op = rec.get("op")
+                    if op == "admit":
                         admits.append(rec)
-                    elif rec.get("op") == "ack":
+                    elif op == "ack":
                         st = str(rec.get("status", ""))
                         if st.split(":", 1)[0] in self._TERMINAL:
                             acks[rec.get("rid")] = st
+                    elif op in ("lease", "heartbeat"):
+                        w = rec.get("worker")
+                        if w is not None:
+                            try:
+                                t = float(rec.get("t", 0.0))
+                            except (TypeError, ValueError):
+                                t = 0.0
+                            beats[w] = max(beats.get(w, 0.0), t)
+                    elif op == "rehome":
+                        rid, w = rec.get("rid"), rec.get("worker")
+                        if rid is not None and w is not None:
+                            rehomes[rid] = w
         except OSError:
             pass
-        return admits, acks
+        if rehomes:
+            # last recorded owner wins; applied to a COPY so the
+            # verbatim admit line is what compaction re-serializes
+            # only when ownership did not move
+            admits = [
+                dict(rec, worker=rehomes[rec.get("rid")])
+                if rec.get("rid") in rehomes else rec
+                for rec in admits]
+        return admits, acks, beats
 
-    def unacknowledged_unlocked(self) -> List[dict]:
-        admits, acks = self._scan()
+    def unacknowledged_unlocked(
+            self, owner: Optional[str] = None) -> List[dict]:
+        admits, acks, _ = self._scan()
         seen = set()
         out = []
         for rec in admits:
@@ -250,22 +363,34 @@ class RequestJournal:
             if rid in acks or rid in seen:
                 continue
             seen.add(rid)
+            if owner is not None and rec.get("worker") != owner:
+                continue
             out.append(rec)
         return out
 
-    def unacknowledged(self) -> List[dict]:
+    def unacknowledged(self,
+                       owner: Optional[str] = None) -> List[dict]:
         # under the lock so a concurrent auto-compaction's
-        # rewrite+rename never races the scan
+        # rewrite+rename never races the scan. ``owner`` filters to
+        # one worker's replay set (fleet re-home path).
         with self._lock:
-            return self.unacknowledged_unlocked()
+            return self.unacknowledged_unlocked(owner)
+
+    def workers(self) -> Dict[str, float]:
+        """Newest heartbeat time per leased worker."""
+        with self._lock:
+            _, _, beats = self._scan()
+            return beats
 
     def counts(self) -> dict:
         with self._lock:
-            admits, acks = self._scan()
+            admits, acks, beats = self._scan()
             unacked = len(self.unacknowledged_unlocked())
             return {"admitted": len(admits), "acked": len(acks),
                     "unacknowledged": unacked,
                     "compactions": self.compactions,
+                    "torn": int(self._c_torn.value()),
+                    "workers": len(beats),
                     "bytes": self._bytes}
 
 

@@ -7,11 +7,14 @@ and a dead backend cost each dispatch a watchdog deadline before the
 host solved it. This module promotes the host to a first-class
 capacity pool:
 
-- **two pools**: "device" (the engine's jitted / AOT-restored bucket
-  executables on the default backend) and "host" (the numpy mirrors
-  — ``pta_solve_np`` / ``PolycoEntry.abs_phase`` — running pinned,
-  hang-free, on the caller's CPU). In a pipelined drain, units routed
-  to different pools genuinely execute concurrently.
+- **N named pools** (ISSUE 19; classically two): "device" (the
+  engine's jitted / AOT-restored bucket executables on the default
+  backend) and "host" (the numpy mirrors — ``pta_solve_np`` /
+  ``PolycoEntry.abs_phase`` — running pinned, hang-free, on the
+  caller's CPU) are structural; ``$PINT_TPU_POOLS`` adds further
+  device-class pools, each with its own breaker, rates and
+  counters. In a pipelined drain, units routed to different pools
+  genuinely execute concurrently.
 - **learned service rates**: every completed dispatch feeds an EWMA
   of rows/s per (pool, kind). Rows are KIND-LOCAL units (padded
   TOA/MJD rows for gls/phase, walker-steps for posterior chains), so
@@ -40,10 +43,9 @@ learned rates, demotion count).
 
 from __future__ import annotations
 
-import threading
+from typing import Dict, Optional
 
 from pint_tpu.runtime import locks
-from typing import Dict, Optional
 
 __all__ = ["CapacityRouter"]
 
@@ -145,42 +147,76 @@ class CapacityRouter:
 
     ``supervisor`` provides the ``pool_health`` surface (breaker
     state). One router per engine — its shares are that deployment's
-    accounting, like the engine's compile counts."""
+    accounting, like the engine's compile counts.
 
-    def __init__(self, supervisor=None):
+    ``pools`` (ISSUE 19) generalizes the capacity layer to N NAMED
+    pools (default ``config.pool_spec()``, i.e. the classic
+    ``("device", "host")`` pair): "device" and "host" stay
+    structural — the engine's jitted executables and the always-
+    available numpy mirrors — and every extra name is an additional
+    device-class pool with its own process-global ``runtime.breaker``
+    instance (keyed ``pool:<name>`` through the supervisor's
+    ``pool_health`` surface), its own learned EWMA rates, and its own
+    G13 registry counters. An OPEN breaker demotes ONLY its pool;
+    host demotion-of-last-resort happens only when every device-class
+    pool is open. With the default spec the routing decisions are
+    bit-identical to the two-pool router."""
+
+    def __init__(self, supervisor=None, pools=None):
+        from pint_tpu import config
         from pint_tpu.obs import metrics as om
 
         self.supervisor = supervisor
         self.scope = om.new_scope("router")
-        self.pools = {"device": _Pool("device", scope=self.scope),
-                      "host": _Pool("host", scope=self.scope)}
+        if pools is None:
+            pools = config.pool_spec() or ("device", "host")
+        # stable routing order: device first (ties prefer it, the
+        # two-pool behavior), extra device-class pools in spec
+        # order, host last (the failover pool never wins a tie)
+        names = ["device"]
+        names += [n for n in pools if n not in ("device", "host")]
+        names.append("host")
+        self._order = tuple(names)
+        self._extra = tuple(n for n in self._order
+                            if n not in ("device", "host"))
+        self.pools = {n: _Pool(n, scope=self.scope)
+                      for n in self._order}
         self._lock = locks.make_lock("serve.router")
 
     # -- routing -------------------------------------------------------
 
-    def _device_open(self) -> bool:
+    def _open_pools(self) -> dict:
+        """Breaker-open flags per device-class pool (host is never
+        open — definitionally closed). One ``pool_health`` read per
+        routing decision, never a probe."""
         if self.supervisor is None:
-            return False
+            return {}
         try:
-            return bool(self.supervisor.pool_health()["device"]["open"])
+            h = self.supervisor.pool_health(pools=self._extra)
+            return {n: bool(h.get(n, {}).get("open", False))
+                    for n in self._order if n != "host"}
         except Exception:
-            return False
+            return {}
+
+    def _device_open(self) -> bool:
+        return self._open_pools().get("device", False)
 
     def pick(self, kind: str, rows: int) -> str:
         """Choose the pool for one sealed unit of ``rows`` padded
-        rows. Breaker-open demotes the device outright; otherwise
+        rows. A breaker-open device-class pool is demoted outright
+        (only when EVERY device-class pool is open does the unit
+        route straight to host, counted as a demotion); otherwise
         the pool with the smaller predicted completion time wins,
-        with the device preferred until the host has a LEARNED
-        rate."""
+        with device-class pools preferred until the host has a
+        LEARNED rate."""
         with self._lock:
-            dev, host = self.pools["device"], self.pools["host"]
-            if self._device_open():
+            host = self.pools["host"]
+            open_map = self._open_pools()
+            live = [n for n in self._order
+                    if n != "host" and not open_map.get(n, False)]
+            if not live:
                 host.bump("demotions")
                 return "host"
-            hr = host.rate(kind)
-            if hr is None:
-                return "device"
-            dr = dev.rate(kind) or _DEVICE_PRIOR
 
             def backlog_s(p, r_kind):
                 # per-kind backlog costing (each kind at its own
@@ -193,9 +229,20 @@ class CapacityRouter:
                         t += v / r
                 return t
 
-            t_dev = backlog_s(dev, dr) + rows / dr
+            best, best_t = None, None
+            for n in live:
+                p = self.pools[n]
+                r = p.rate(kind) or _DEVICE_PRIOR
+                t = backlog_s(p, r) + rows / r
+                if best_t is None or t < best_t:
+                    best, best_t = n, t
+            hr = host.rate(kind)
+            if hr is None:
+                # cold host: routing away from the device classes
+                # requires evidence, never a guess
+                return best
             t_host = backlog_s(host, hr) + rows / hr
-            return "device" if t_dev <= t_host else "host"
+            return best if best_t <= t_host else "host"
 
     def _best_rate(self, kind: str) -> Optional[float]:
         rates = [p.rate(kind) for p in self.pools.values()]
@@ -281,4 +328,29 @@ class CapacityRouter:
         for p in out.values():
             p["share"] = round(p["dispatches"] / total, 4) \
                 if total else 0.0
+        return out
+
+    def health_block(self) -> dict:
+        """The /healthz ``pools`` block (ISSUE 19 satellite): per
+        pool, the breaker state (through the supervisor's
+        ``pool_health`` surface), the learned EWMA rates, and the
+        in-flight depth. Engine-lock-free by construction — the only
+        locks touched are the router's own leaf lock and the
+        per-breaker locks, so the fleet front (and any scrape) can
+        read it while the engine lock is held (the G16 SCRAPE_ROOTS
+        contract tests/test_metrics.py asserts)."""
+        try:
+            health = self.supervisor.pool_health(pools=self._extra) \
+                if self.supervisor is not None else {}
+        except Exception:
+            health = {}
+        with self._lock:
+            out = {}
+            for name, p in self.pools.items():
+                h = dict(health.get(name, {}))
+                h["rows_per_s"] = {k: round(v, 1)
+                                   for k, v in sorted(
+                                       p.rates.items())}
+                h["inflight_rows"] = p.inflight_rows
+                out[name] = h
         return out

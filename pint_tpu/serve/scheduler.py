@@ -140,7 +140,9 @@ class ServeEngine:
                  tenant_burst: Optional[float] = None,
                  shed_policy: Optional[str] = None,
                  aot_dir: Optional[str] = None,
-                 journal=None):
+                 journal=None,
+                 worker_id: Optional[str] = None,
+                 pools: Optional[Tuple[str, ...]] = None):
         from pint_tpu import config
         from pint_tpu.runtime import DispatchSupervisor
 
@@ -170,16 +172,26 @@ class ServeEngine:
         self.admission = AdmissionController(
             tenant_qps=tenant_qps, tenant_burst=tenant_burst,
             policy=shed_policy)
-        self.router = CapacityRouter(supervisor=self.supervisor)
+        # fleet identity (ISSUE 19): stamped onto every journaled
+        # admit so the fleet front can re-home exactly this worker's
+        # unacked set when its lease expires; None = classic
+        # single-worker engine, admits carry no owner.
+        self.worker_id = worker_id
+        self.router = CapacityRouter(supervisor=self.supervisor,
+                                     pools=pools)
         if aot_dir is None:
             aot_dir = config.aot_dir()
         self.cache = ExecutableCache(mesh=mesh, axis=axis,
                                      supervisor=self.supervisor,
                                      aot_dir=aot_dir)
         # journal: a path (str), a prebuilt RequestJournal, or None
-        # (default $PINT_TPU_JOURNAL)
+        # (default $PINT_TPU_JOURNAL). A prebuilt journal is NOT
+        # owned: a fleet shares one journal across workers, and one
+        # worker's stop() must not close it under the others.
         if journal is None:
             journal = config.journal_path()
+        self._journal_owned = journal is None or isinstance(journal,
+                                                            str)
         if isinstance(journal, str):
             from pint_tpu.serve.journal import RequestJournal
 
@@ -409,7 +421,8 @@ class ServeEngine:
         if not getattr(req, "_journal_replayed", False):
             self.journal.admit(req.rid, req.payload,
                                tenant=req.tenant,
-                               deadline_s=req.deadline_s)
+                               deadline_s=req.deadline_s,
+                               worker=self.worker_id)
         journal = self.journal
 
         osp = getattr(req, "_osp", None)
@@ -429,17 +442,26 @@ class ServeEngine:
 
         req.future.add_done_callback(_ack)
 
-    def replay(self, factory) -> List:
+    def replay(self, factory, owner: Optional[str] = None,
+               records: Optional[List[dict]] = None) -> List:
         """Re-submit every unacknowledged journal entry (crash
         recovery): ``factory(payload)`` rebuilds the request from
         the journaled payload. Returns the new futures, in journal
         order. Each entry gets a non-terminal "replayed" progress
         mark; its terminal ack lands when the replayed future
-        resolves — a crash DURING replay leaves it replayable."""
+        resolves — a crash DURING replay leaves it replayable.
+
+        ``owner`` scopes the replay set to one worker's admits (the
+        fleet re-home path — a survivor must NOT replay its own
+        in-flight entries); ``records`` replays an explicit
+        already-scanned set instead (the fleet front scans once,
+        writes the ``rehome`` marks, then hands the records here)."""
         if self.journal is None:
             return []
+        if records is None:
+            records = self.journal.unacknowledged(owner=owner)
         futs = []
-        for rec in self.journal.unacknowledged():
+        for rec in records:
             req = factory(rec["payload"])
             req.rid = rec["rid"]
             if req.payload is None:
@@ -447,7 +469,7 @@ class ServeEngine:
             req._journal_replayed = True
             self.journal.ack(rec["rid"], "replayed")
             futs.append(self.submit(req))
-        self.metrics.restart_info["replayed"] = len(futs)
+        self.metrics.restart_info["replayed"] = self.metrics.restart_info.get("replayed", 0) + len(futs)  # graftlint: allow G13 -- restart_info is the labeled restart-summary dict on the snapshot surface, not registry counter state; it accumulates because a fleet re-home may call replay() several times on one survivor
         return futs
 
     # -- queue bookkeeping (all under self._lock) ----------------------
@@ -623,6 +645,13 @@ class ServeEngine:
         sync = self.pipeline_depth <= 1
         pending: collections.deque = collections.deque()
         with self._dispatch_lock:
+            # a fleet worker_kill (ServeEngine.kill) latches _dead
+            # under this lock between drains — a dead engine must
+            # never dispatch again (its queued work re-homes)
+            if self._dead:
+                raise EngineKilled(
+                    "engine was killed; queued work stays "
+                    "unacknowledged in the journal")
             while True:
                 with self._cv:
                     if not self._ready:
@@ -1125,6 +1154,28 @@ class ServeEngine:
         self._thread.start()
         return self
 
+    def kill(self):
+        """Simulated SIGKILL for the fleet chaos path (worker_kill):
+        latch the engine dead WITHOUT draining. Queued work is NOT
+        failed — futures stay unresolved exactly as a real process
+        death leaves them, journal entries stay unacknowledged, and
+        the fleet front re-homes them onto a survivor (the original
+        caller's future is then resolved with the survivor's
+        bit-identical result). The shared journal is deliberately
+        NOT closed and no state snapshot is written: both belong to
+        the fleet, not the corpse. Blocks at most one in-flight
+        drain unit (the kill lands at the next drain boundary, like
+        the injected kill_restart fault)."""
+        self._stop.set()
+        with self._dispatch_lock:
+            self._dead = True
+        with self._cv:
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=60.0)
+            self._thread = None
+
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None):
         """Stop the loop. ``drain=True`` (default) keeps dispatching
@@ -1196,7 +1247,7 @@ class ServeEngine:
                            self.metrics.snapshot(), reason=reason)
             except Exception:
                 pass
-        if self.journal is not None:
+        if self.journal is not None and self._journal_owned:
             self.journal.close()
 
     def _loop(self):

@@ -1,0 +1,366 @@
+"""Serve-fleet unit oracles (ISSUE 19).
+
+Deterministic, mesh-light tests for the fleet building blocks:
+validated config parsers ($PINT_TPU_POOLS / lease TTL / heartbeat),
+the N-pool capacity router and its /healthz ``health_block``, the
+journal ownership protocol (lease / heartbeat / owner-stamped admits
+/ rehome / compaction keeping liveness), torn-record hardening, and
+the ``FleetFront`` fence + re-home machinery driven by hand (engines
+in sync mode, manual sweeps — the threaded chaos oracle lives in
+tests/test_runtime_faults.py, the bit-identity and AOT oracles in
+tests/test_serve_restart.py).
+"""
+
+import json
+import time
+
+import pytest
+
+from pint_tpu.runtime import Fault, FaultPlan, reset_runtime
+from pint_tpu.serve import (
+    EngineKilled,
+    FitStepRequest,
+    FleetFront,
+    WorkerLease,
+)
+from pint_tpu.serve.journal import RequestJournal
+from pint_tpu.serve.router import CapacityRouter
+from pint_tpu.serve.workload import synth_pulsar
+
+
+@pytest.fixture(autouse=True)
+def clean_runtime():
+    reset_runtime()
+    yield
+    reset_runtime()
+
+
+@pytest.fixture(scope="module")
+def stock():
+    from pint_tpu.parallel.pta import build_problem
+
+    pulsars = {k: synth_pulsar(k, 40, base=4300) for k in (0, 1)}
+    return {k: build_problem(t, m) for k, (m, t) in pulsars.items()}
+
+
+def _factory(stock):
+    def factory(payload):
+        return FitStepRequest(problem=stock[payload["k"]],
+                              payload=payload)
+
+    return factory
+
+
+def _fit(stock, k):
+    return FitStepRequest(problem=stock[k], payload={"k": k})
+
+
+def _front(stock, tmp_path, n=2, **kw):
+    """A hand-driven front: engines in SYNC mode (never started),
+    leases never heartbeating on their own, no sweeper thread —
+    every state transition in these tests is an explicit call."""
+    kw.setdefault("heartbeat_s", 3600.0)
+    kw.setdefault("lease_ttl_s", 7200.0)
+    return FleetFront(_factory(stock), n=n,
+                      journal=str(tmp_path / "fleet.jsonl"),
+                      start=False, **kw)
+
+
+# ---------------------------------------------------------------- config
+
+
+def test_fleet_config_parsers(monkeypatch):
+    from pint_tpu import config
+
+    monkeypatch.delenv("PINT_TPU_POOLS", raising=False)
+    assert config.pool_spec() is None
+    monkeypatch.setenv("PINT_TPU_POOLS", "device,aux,host")
+    assert config.pool_spec() == ("device", "aux", "host")
+    # missing a structural pool / malformed names: warn-and-ignore,
+    # never half-applied
+    monkeypatch.setenv("PINT_TPU_POOLS", "device,aux")
+    assert config.pool_spec() is None
+    monkeypatch.setenv("PINT_TPU_POOLS", "device,AUX,host")
+    assert config.pool_spec() is None
+    monkeypatch.setenv("PINT_TPU_POOLS", "device,host,device")
+    assert config.pool_spec() is None
+
+    monkeypatch.setenv("PINT_TPU_FLEET_LEASE_TTL_S", "nope")
+    assert config.fleet_lease_ttl_s() == 15.0
+    monkeypatch.setenv("PINT_TPU_FLEET_LEASE_TTL_S", "6")
+    assert config.fleet_lease_ttl_s() == 6.0
+    # heartbeat at/above the TTL is clamped to TTL/3 — a heartbeat
+    # slower than the lease it renews expires every healthy worker
+    monkeypatch.setenv("PINT_TPU_FLEET_HEARTBEAT_S", "10")
+    assert config.fleet_heartbeat_s() == pytest.approx(2.0)
+    monkeypatch.setenv("PINT_TPU_FLEET_HEARTBEAT_S", "1.5")
+    assert config.fleet_heartbeat_s() == 1.5
+
+    monkeypatch.setenv("PINT_TPU_FLEET_WORKERS", "-2")
+    assert config.fleet_workers() == 3
+    monkeypatch.setenv("PINT_TPU_FLEET_WORKERS", "5")
+    assert config.fleet_workers() == 5
+
+
+# ---------------------------------------------------------------- router
+
+
+class _FakeSup:
+    """Deterministic pool_health stand-in: breaker state per pool by
+    fiat, so demotion logic is tested without tripping real
+    breakers."""
+
+    def __init__(self, open_pools=()):
+        self.open_pools = set(open_pools)
+
+    def pool_health(self, pools=None):
+        out = {"device": {"backend": "cpu",
+                          "open": "device" in self.open_pools,
+                          "inflight": 0},
+               "host": {"backend": "cpu", "open": False}}
+        for name in pools or ():
+            out[name] = {"backend": f"pool:{name}",
+                         "open": name in self.open_pools,
+                         "inflight": 0}
+        return out
+
+
+def test_router_n_pools_order_and_pick():
+    sup = _FakeSup()
+    r = CapacityRouter(supervisor=sup, pools=("device", "aux", "host"))
+    assert r._order == ("device", "aux", "host")
+    # ties prefer the device pool (the two-pool behavior)
+    assert r.pick("gls", 100) == "device"
+    # a faster learned device-class pool wins
+    r.seed_rate("aux", "gls", 1e12)
+    assert r.pick("gls", 100) == "aux"
+    # an OPEN breaker demotes ONLY its pool
+    sup.open_pools = {"aux"}
+    assert r.pick("gls", 100) == "device"
+    # every device-class pool open -> host demotion of last resort
+    sup.open_pools = {"device", "aux"}
+    assert r.pick("gls", 100) == "host"
+    assert r.pools["host"].demotions == 1
+    # accounting runs per named pool
+    r.issued("aux", nreq=2, rows=64, kind="gls")
+    r.finished("aux", "gls", rows=64, wall_s=0.01)
+    snap = r.snapshot()
+    assert snap["aux"]["dispatches"] == 1
+    assert snap["aux"]["rows"] == 64
+
+
+def test_router_health_block_shape():
+    sup = _FakeSup(open_pools={"aux"})
+    r = CapacityRouter(supervisor=sup, pools=("device", "aux", "host"))
+    r.seed_rate("device", "gls", 1000.0)
+    r.issued("device", nreq=1, rows=8, kind="gls")
+    h = r.health_block()
+    assert set(h) == {"device", "aux", "host"}
+    assert h["aux"]["open"] is True
+    assert h["device"]["open"] is False
+    assert h["device"]["rows_per_s"] == {"gls": 1000.0}
+    assert h["device"]["inflight_rows"] == 8
+    assert h["host"]["inflight_rows"] == 0
+
+
+# --------------------------------------------------------------- journal
+
+
+def test_journal_ownership_protocol(tmp_path):
+    jpath = str(tmp_path / "j.jsonl")
+    j = RequestJournal(jpath)
+    lease = WorkerLease(j, "w0", heartbeat_s=3600.0)
+    WorkerLease(j, "w1", heartbeat_s=3600.0)
+    t0 = j.workers()["w0"]
+    time.sleep(0.01)
+    lease.beat()
+    beats = j.workers()
+    assert set(beats) == {"w0", "w1"}
+    assert beats["w0"] > t0          # newest beat wins
+    j.admit("r1", {"k": 0}, worker="w0")
+    j.admit("r2", {"k": 1}, worker="w1")
+    j.admit("r3", {"k": 0})          # legacy ownerless admit
+    assert [r["rid"] for r in j.unacknowledged()] == \
+        ["r1", "r2", "r3"]
+    assert [r["rid"] for r in j.unacknowledged(owner="w0")] == ["r1"]
+    # rehome moves ownership in the log (last mark wins)
+    j.rehome("r1", "w1")
+    assert [r["rid"] for r in j.unacknowledged(owner="w1")] == \
+        ["r1", "r2"]
+    assert j.unacknowledged(owner="w0") == []
+    counts = j.counts()
+    assert counts["workers"] == 2 and counts["torn"] == 0
+    # compaction preserves ownership AND one newest beat per worker
+    j.compact()
+    assert j.counts()["compactions"] == 1
+    assert set(j.workers()) == {"w0", "w1"}
+    assert [r["rid"] for r in j.unacknowledged(owner="w1")] == \
+        ["r1", "r2"]
+    j.close()
+    # ...and the rewritten journal reads back identically
+    j2 = RequestJournal(jpath)
+    assert set(j2.workers()) == {"w0", "w1"}
+    assert [r["rid"] for r in j2.unacknowledged(owner="w1")] == \
+        ["r1", "r2"]
+    j2.close()
+
+
+def test_journal_torn_records_warn_and_skip(tmp_path):
+    """ISSUE 19 satellite: a torn tail record AND torn records
+    interleaved around compaction are warn-and-skip (counted on
+    ``pint_tpu_journal_torn_records``), never a raise."""
+    jpath = str(tmp_path / "j.jsonl")
+    j = RequestJournal(jpath)
+    j.admit("r1", {"k": 0}, worker="w0")
+    j.admit("r2", {"k": 1})
+    j.ack("r2", "served")
+    j.close()
+    with open(jpath, "r+") as fh:
+        text = fh.read()
+        fh.seek(0)
+        # a corrupt line in the MIDDLE (bit rot / interleaved torn
+        # write) and a non-dict record
+        lines = text.splitlines()
+        lines.insert(1, '{"op": "admit", "rid": "half')
+        lines.insert(2, "[1, 2, 3]")
+        fh.write("\n".join(lines) + "\n")
+        # and a crash-torn tail
+        fh.write('{"op": "ack", "rid": "r1", "sta')
+    j2 = RequestJournal(jpath)
+    assert [r["rid"] for r in j2.unacknowledged()] == ["r1"]
+    assert j2.counts()["torn"] == 3
+    # the same damaged line is deduped across repeated scans (every
+    # unacknowledged()/counts() call rescans the file)
+    j2.unacknowledged()
+    assert j2.counts()["torn"] == 3
+    # compaction drops the damage; the rewritten file is clean
+    j2.compact()
+    recs = [json.loads(x) for x in open(jpath)]
+    assert all(r["op"] in ("admit", "heartbeat") for r in recs)
+    j2.close()
+    j3 = RequestJournal(jpath)
+    assert [r["rid"] for r in j3.unacknowledged()] == ["r1"]
+    assert j3.counts()["torn"] == 0
+    j3.close()
+
+
+# ----------------------------------------------------------------- fleet
+
+
+def test_fleet_kill_worker_rehomes_onto_survivor(stock, tmp_path):
+    front = _front(stock, tmp_path, n=2)
+    f0 = front.submit(_fit(stock, 0))     # round-robin: w0
+    f1 = front.submit(_fit(stock, 1))     # w1
+    assert front.live_workers() == ["w0", "w1"]
+    assert front.journal.counts()["unacknowledged"] == 2
+
+    front.kill_worker("w0")
+    assert front.live_workers() == ["w1"]
+    # the corpse's future is unresolved, its journal entry unacked —
+    # exactly what a process death leaves behind
+    assert not f0.done()
+    with pytest.raises(EngineKilled):
+        front.workers["w0"].engine.submit(_fit(stock, 0))
+
+    moved = front.sweep()
+    assert moved == 1
+    snap = front.snapshot()
+    assert snap["workers"] == {"w0": "rehomed", "w1": "live"}
+    assert snap["counters"]["worker_kills"] == 1
+    assert snap["counters"]["rehomed"] == 1
+    # a second sweep must NOT re-home again (dead -> rehomed latch)
+    assert front.sweep() == 0
+
+    front.workers["w1"].engine.flush()
+    r0, r1 = f0.result(timeout=30), f1.result(timeout=30)
+    assert r0.chi2 > 0 and r1.chi2 > 0
+    # every accepted request reached a terminal ack: zero lost
+    assert front.journal.counts()["unacknowledged"] == 0
+    # the fleet keeps serving on the survivor
+    f2 = front.submit(_fit(stock, 0))
+    front.workers["w1"].engine.flush()
+    assert f2.result(timeout=30).chi2 > 0
+    front.stop()
+
+
+def test_fleet_lease_expiry_fault_and_outage(stock, tmp_path):
+    front = _front(stock, tmp_path, n=2)
+    f0 = front.submit(_fit(stock, 0))     # w0
+    # forced lease_expire (kind-scoped at fleet.lease/<id>): the
+    # sweep fences w0 WITHOUT killing it first — the fence inside
+    # the sweep is what keeps the transfer safe
+    plan = FaultPlan([Fault(match="fleet.lease/w0",
+                            kind="lease_expire")])
+    with plan.active():
+        moved = front.sweep()
+    assert moved == 1
+    assert front.live_workers() == ["w1"]
+    assert front.snapshot()["counters"]["lease_expiries"] == 1
+    front.workers["w1"].engine.flush()
+    assert f0.result(timeout=30).chi2 > 0
+
+    # heartbeat staleness: every worker silent past the TTL is a
+    # fleet-wide outage — nobody to re-home onto, submits raise
+    assert front.sweep(now=time.time() + 1e6) == 0
+    assert front.live_workers() == []
+    with pytest.raises(EngineKilled, match="no live workers"):
+        front.submit(_fit(stock, 0))
+    front.stop()
+
+
+def test_fleet_metrics_view_and_health_blocks(stock, tmp_path):
+    front = _front(stock, tmp_path, n=2)
+    f0 = front.submit(_fit(stock, 0))
+    front.workers["w0"].engine.flush()
+    f0.result(timeout=30)
+    snap = front.metrics.snapshot()
+    assert set(snap["workers"]) == {"w0", "w1"}
+    assert snap["submitted"] == 1        # fleet-wide sum
+    assert snap["fleet"]["live"] == ["w0", "w1"]
+    assert snap["fleet"]["journal"]["unacknowledged"] == 0
+    assert isinstance(front.metrics.restart_info, dict)
+    assert "[w0]" in front.metrics.report()
+    blocks = front.health_blocks()
+    assert set(blocks) == {"w0", "w1"}
+    assert set(blocks["w0"]) >= {"device", "host"}
+    front.stop()
+
+
+def test_fleet_single_worker_fault_free_matches_engine(stock,
+                                                       tmp_path):
+    """Acceptance guard: a fault-free single-worker fleet is the old
+    engine — same bucket composition, bit-identical results, zero
+    fence/re-home activity."""
+    import numpy as np
+
+    from pint_tpu.serve import ServeEngine
+
+    front = _front(stock, tmp_path, n=1)
+    futs = [front.submit(_fit(stock, k)) for k in (0, 1)]
+    front.workers["w0"].engine.flush()
+    got = [f.result(timeout=30) for f in futs]
+
+    eng = ServeEngine()
+    refs = [eng.submit(FitStepRequest(problem=stock[k]))
+            for k in (0, 1)]
+    eng.flush()
+    ref = [f.result(timeout=0) for f in refs]
+    eng.stop()
+
+    for a, b in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(a.dparams),
+                                      np.asarray(b.dparams))
+        np.testing.assert_array_equal(np.asarray(a.cov),
+                                      np.asarray(b.cov))
+        assert a.chi2 == b.chi2
+    snap = front.snapshot()
+    assert snap["counters"] == \
+        {"rehomed": 0, "lease_expiries": 0, "worker_kills": 0}
+    assert snap["workers"] == {"w0": "live"}
+    front.stop()
+
+
+def test_fleet_requires_a_journal(stock, monkeypatch):
+    monkeypatch.delenv("PINT_TPU_JOURNAL", raising=False)
+    with pytest.raises(ValueError, match="replicated log"):
+        FleetFront(_factory(stock), n=2, journal=None, start=False)

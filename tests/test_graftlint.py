@@ -1152,10 +1152,12 @@ def test_lock_registry_entry_count_pins_drift():
     precision_registry pattern): update this pin WITH the new
     entry's written justification."""
     from pint_tpu.analysis import lock_registry as reg
-    assert len(reg.GUARDED) == 13
+    # PR 19 added four entries: RequestJournal._torn_seen and the
+    # FleetFront _state/_rr/_inflight trio (all under serve.fleet).
+    assert len(reg.GUARDED) == 17
     assert len(reg.ENGINE_LOCKS) == 1
     assert len(reg.SCRAPE_ROOTS) == 3
-    assert reg.entry_count() == 17
+    assert reg.entry_count() == 21
     for e in reg.GUARDED:
         assert e["why"], e
     for e in reg.ENGINE_LOCKS + reg.SCRAPE_ROOTS:
@@ -1208,6 +1210,33 @@ def test_g17_config_is_sanctioned_and_bare_names_need_import():
     def f(environ, getenv):
         return environ["X"], getenv("Y")
     """) == []
+
+
+def test_g17_covers_fleet_module_and_knobs():
+    """ISSUE 19 satellite: a raw read of any fleet env knob inside
+    serve/fleet.py is a G17 violation — the validated config
+    parsers (pool_spec / fleet_lease_ttl_s / fleet_heartbeat_s /
+    fleet_workers) are the only sanctioned readers."""
+    src = """
+    import os
+
+    def sweep_cadence():
+        ttl = float(os.environ.get("PINT_TPU_FLEET_LEASE_TTL_S", 15))
+        hb = os.getenv("PINT_TPU_FLEET_HEARTBEAT_S")
+        pools = os.environ["PINT_TPU_POOLS"]
+        return ttl, hb, pools
+    """
+    v = _lint_g17(src, relpath="pint_tpu/serve/fleet.py")
+    assert [x.rule for x in v] == ["G17"] * 3
+    # ...and the shipped fleet module is clean: zero raw env reads
+    import os as _os
+
+    import pint_tpu.serve.fleet as _fleet
+    real = gl.ModuleInfo("pint_tpu/serve/fleet.py",
+                         open(_fleet.__file__).read())
+    from pint_tpu.analysis import concurrency as conc
+    assert conc.check_g17(real) == []
+    assert _os.path.basename(_fleet.__file__) == "fleet.py"
 
 
 def test_g17_pragma_suppression_works():

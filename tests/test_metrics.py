@@ -338,11 +338,15 @@ def test_metrics_server_scrape_and_healthz():
 def test_scrape_never_blocks_on_the_engine_lock():
     """THE fleet-readiness contract: /metrics and /healthz answer
     while the serve engine lock is HELD (a scrape that needed it
-    would deadlock here and time out)."""
+    would deadlock here and time out). ISSUE 19: the ``pools``
+    block is the router's ``health_block()`` — per-pool breaker
+    state, learned EWMA rates, in-flight depth — for every NAMED
+    pool, still engine-lock-free."""
     from pint_tpu.serve import ServeEngine
 
     fresh = _workload(4, base=6300)
-    eng = ServeEngine(pipeline_depth=2)
+    eng = ServeEngine(pipeline_depth=2,
+                      pools=("device", "aux", "host"))
     futs = [eng.submit(r) for r in fresh()]
     eng.flush()
     for f in futs:
@@ -350,7 +354,7 @@ def test_scrape_never_blocks_on_the_engine_lock():
 
     def _health():
         h = om.default_health()
-        h["pools"] = eng.supervisor.pool_health()
+        h["pools"] = eng.router.health_block()
         return h
 
     srv = om.MetricsServer(port=0, health_fn=_health).start()
@@ -378,7 +382,14 @@ def test_scrape_never_blocks_on_the_engine_lock():
     key = ("pint_tpu_serve_completed_total",
            frozenset({("scope", eng.metrics.scope)}))
     assert samples[key] == len(futs)
-    assert out["health"]["pools"]["host"]["open"] is False
+    pools = out["health"]["pools"]
+    assert set(pools) == {"device", "aux", "host"}
+    assert pools["host"]["open"] is False
+    assert pools["aux"]["open"] is False
+    assert "breaker" in pools["aux"]
+    # the device pool served the burst: a learned rate + empty queue
+    assert pools["device"]["rows_per_s"]
+    assert pools["device"]["inflight_rows"] == 0
 
 
 def test_scrape_chaos_with_lock_sanitizer_armed():

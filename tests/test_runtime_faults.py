@@ -533,6 +533,102 @@ def test_chaos_overload_tenant_burst_backend_death(monkeypatch,
         obs.reset()
 
 
+def test_fleet_chaos_worker_kill_mid_burst(tmp_path):
+    """ISSUE 19 chaos oracle: 3 workers over one shared journal, a
+    seeded ``worker_kill`` landing MID-BURST. Required outcome: zero
+    lost requests — the killed worker's queued admits re-home onto
+    survivors, every ORIGINAL future resolves with the survivor's
+    result, the journal ends fully acknowledged — and the trace
+    tells the same story: every request root resolves to exactly one
+    ``serve.terminal``, zero orphan spans."""
+    import json as _json
+
+    from pint_tpu import obs
+    from pint_tpu.parallel.pta import build_problem
+    from pint_tpu.serve import FitStepRequest
+    from pint_tpu.serve.fleet import FleetFront
+    from pint_tpu.serve.workload import synth_pulsar
+
+    problems = {}
+    for k in (0, 1):
+        m, t = synth_pulsar(k, 40, base=5200)
+        problems[k] = build_problem(t, m)
+
+    def factory(payload):
+        return FitStepRequest(problem=problems[payload["k"]],
+                              payload=payload)
+
+    # per-problem reference (fault-free single engine)
+    from pint_tpu.serve import ServeEngine
+
+    ref = {}
+    ref_eng = ServeEngine()
+    for k in (0, 1):
+        f = ref_eng.submit(FitStepRequest(problem=problems[k]))
+        ref_eng.flush()
+        ref[k] = f.result(timeout=0)
+    ref_eng.stop()
+
+    tracer = obs.configure(enabled=True)
+    front = FleetFront(factory, n=3,
+                       journal=str(tmp_path / "fleet.jsonl"),
+                       heartbeat_s=3600.0, lease_ttl_s=7200.0,
+                       start=False)
+    # one fault lookup per submit while w1 is live (the key is
+    # kind- and worker-scoped): the kill lands on submit #7, with
+    # two of w1's requests still queued
+    plan = FaultPlan([Fault(match="fleet.worker/w1",
+                            kind="worker_kill", after=6)])
+    reqs = [FitStepRequest(problem=problems[i % 2],
+                           payload={"k": i % 2})
+            for i in range(12)]
+    with plan.active():
+        futs = [front.submit(r) for r in reqs]
+    assert front.live_workers() == ["w0", "w2"]
+    assert front.snapshot()["counters"]["worker_kills"] == 1
+    assert front.sweep() == 2           # w1 held submits #2 and #5
+    for wid in ("w0", "w2"):
+        front.workers[wid].engine.flush()
+    # ZERO lost requests: every submitted future resolves, correctly
+    assert all(f.done() for f in futs)
+    for r, f in zip(reqs, futs):
+        res = f.result(timeout=0)
+        assert res.chi2 == pytest.approx(
+            ref[r.payload["k"]].chi2, rel=1e-8)
+    assert front.journal.counts()["unacknowledged"] == 0
+    snap = front.snapshot()
+    assert snap["workers"] == \
+        {"w0": "live", "w1": "rehomed", "w2": "live"}
+    assert snap["counters"]["rehomed"] == 2
+
+    # --- the trace is the same story, causally -------------------
+    try:
+        path = str(tmp_path / "fleet_trace.json")
+        tracer.export(path)
+        doc = _json.load(open(path, encoding="utf-8"))
+        evs = doc["traceEvents"]
+        ids = {e["args"]["span"] for e in evs}
+        orphans = [e for e in evs
+                   if e["args"].get("parent") is not None
+                   and e["args"]["parent"] not in ids]
+        assert orphans == []
+        roots = {e["args"]["span"] for e in evs
+                 if e["name"] == "serve.request"}
+        terms = [e for e in evs if e["name"] == "serve.terminal"]
+        # every request root — the 12 originals plus the 2 survivor
+        # replays — resolves to exactly ONE terminal, all served
+        assert len(terms) == len(roots) == len(reqs) + 2
+        assert len({e["args"]["parent"] for e in terms}) == \
+            len(terms)
+        assert all(e["args"]["status"] == "served" for e in terms)
+        # the fence left its mark
+        names = {e["name"] for e in evs}
+        assert "fleet.rehome" in names
+    finally:
+        obs.reset()
+    front.stop()
+
+
 # ------------------------------------------- GWB sweep (ISSUE 17)
 
 

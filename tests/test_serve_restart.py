@@ -340,6 +340,79 @@ def test_replay_does_not_duplicate_admit_records(tmp_path, stock):
     eng_b.stop()
 
 
+def test_fleet_rehome_replay_bit_identical_and_warm_aot(tmp_path,
+                                                        stock):
+    """ISSUE 19 acceptance: a killed fleet worker's unacknowledged
+    requests re-home onto a survivor and replay BIT-IDENTICAL to an
+    uninterrupted single engine serving the same batch — and when the
+    shape classes were ever AOT-exported (by ANY worker into the
+    shared store), the survivor serves the re-homed classes with
+    ZERO new serve-kernel compiles (Sanitizer-asserted)."""
+    from pint_tpu.analysis import Sanitizer
+    from pint_tpu.serve.fleet import FleetFront
+
+    aot = str(tmp_path / "aot")
+
+    def mk_front(tag):
+        return FleetFront(_factory(stock), n=2,
+                          journal=str(tmp_path / f"{tag}.jsonl"),
+                          aot_dir=aot, heartbeat_s=3600.0,
+                          lease_ttl_s=7200.0, start=False)
+
+    # --- front A: serves one batch so every shape class lands in the
+    # SHARED AOT store (whichever worker compiles it, exports it).
+    # The extra fit makes round-robin give one worker a TWO-fit gls
+    # bucket — the batch class the post-re-home survivor will seal
+    front_a = mk_front("ja")
+    warm = _mk_batch(stock) + [
+        FitStepRequest(problem=stock["problems"][0],
+                       payload={"kind": "fit", "k": 0})]
+    futs = [front_a.submit(r) for r in warm]
+    for w in front_a.workers.values():
+        w.engine.flush()
+    for f in futs:
+        f.result(timeout=30)
+    assert sum(w.engine.cache.aot.exported
+               for w in front_a.workers.values()) >= 3
+    front_a.stop()
+
+    # --- reference: an uninterrupted engine, same batch, one flush
+    # (same bucket composition as the post-re-home survivor: its own
+    # fit joins the re-homed fit in the one gls bucket)
+    eng_r = ServeEngine()
+    rfuts = [eng_r.submit(r) for r in _mk_batch(stock)]
+    eng_r.flush()
+    ref = [f.result(timeout=0) for f in rfuts]
+    eng_r.stop()
+
+    # --- front B: warm workers (classes restored+primed at ctor),
+    # w0 dies holding phase + one fit; the survivor replays them
+    # without a single new compile
+    front_b = mk_front("jb")
+    for w in front_b.workers.values():
+        assert w.engine.cache.aot.restored == 3
+        assert w.engine.metrics.restart_info["warm"] is True
+    surv = front_b.workers["w1"].engine
+    with Sanitizer() as san:
+        san.watch(surv.cache._gls, "gls")
+        san.watch(surv.cache._phase, "phase")
+        futs = [front_b.submit(r) for r in _mk_batch(stock)]
+        # round-robin placed phase + fit1 on w0, fit0 on w1
+        front_b.kill_worker("w0")
+        assert front_b.sweep() == 2
+        surv.flush()
+        res = [f.result(timeout=30) for f in futs]
+        growth = san.executable_growth()
+    assert all(g in (0, None) for g in growth.values()), growth
+    assert san.compiles() == 0
+    for a, b in zip(res, ref):
+        _assert_bitwise(a, b)
+    # zero lost: every accepted request reached its terminal ack
+    assert front_b.journal.counts()["unacknowledged"] == 0
+    assert front_b.snapshot()["counters"]["rehomed"] == 2
+    front_b.stop()
+
+
 def test_daemon_replays_unacked_journal(tmp_path, capsys):
     """The daemon's startup replay: a journal left by a killed
     process (admit, no ack) is re-served before stdin, and the

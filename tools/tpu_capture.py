@@ -58,6 +58,7 @@ STAGES = {
     "append": "serve_append_incremental_vs_cold_100k",
     "health": "north_star_health_overhead",
     "perf": "north_star_perf_attribution",
+    "fleet": "fleet_degraded",
 }
 SCAN_NS = (10_000, 30_000, 100_000)
 # on-chip streaming points: bounded to fit one watcher stage window
@@ -348,6 +349,23 @@ def stage_serve_degraded(backend):
         raise RuntimeError(
             f"bench_serve.run_degraded ran on {rec.get('backend')!r}"
             f", not {backend!r} (tunnel died?); stage stays on the "
+            f"to-do list")
+    bench.tpu_record_append(rec)
+    print(json.dumps(rec), flush=True)
+
+
+def stage_fleet(backend):
+    """3-worker kill-one fleet throughput curve ON CHIP (ISSUE 19):
+    baseline / degraded-with-mid-burst-kill / recovered — on the
+    tunnel the re-home replay pays real dispatch RTTs, so this is
+    the honest blast-radius number (lost must still be 0)."""
+    import bench_serve
+
+    rec = bench_serve.run_fleet()
+    if rec.get("backend") != backend:
+        raise RuntimeError(
+            f"bench_serve.run_fleet ran on {rec.get('backend')!r}, "
+            f"not {backend!r} (tunnel died?); stage stays on the "
             f"to-do list")
     bench.tpu_record_append(rec)
     print(json.dumps(rec), flush=True)
@@ -648,6 +666,8 @@ def run_stage(name, backend):
         stage_health(backend)
     elif name == "perf":
         stage_perf(backend)
+    elif name == "fleet":
+        stage_fleet(backend)
     else:
         raise SystemExit(f"unknown stage {name}")
     bench.log(f"=== stage {name} done in "
